@@ -1,0 +1,131 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability set
+of PaddlePaddle (reference: sneaxiy/Paddle ~v2.1), re-designed for JAX/XLA.
+
+Top-level namespace mirrors `paddle.*` (reference: python/paddle/__init__.py):
+tensor creation/math ops, nn, optimizer, amp, io, jit, distributed, vision,
+plus device/dtype/flags management. The execution core is XLA via jax —
+eager ops are per-op jit-compiled executables, `paddle_tpu.jit.to_static`
+captures whole training steps as single XLA programs, and distribution is
+expressed over `jax.sharding.Mesh` axes rather than NCCL rings.
+"""
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (  # noqa: F401
+    bool_ as bool, int8, uint8, int16, int32, int64, float16, bfloat16,  # noqa: A004
+    float32, float64, complex64, complex128,
+    set_default_dtype, get_default_dtype,
+)
+from .core.device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    device_count, CPUPlace, TPUPlace, Place,
+)
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.tensor import Tensor, Parameter  # noqa: F401
+from .core.dispatch import no_grad, enable_grad, is_grad_enabled  # noqa: F401
+from .core.rng import seed, default_generator  # noqa: F401
+from .core import trace as _trace  # noqa: F401
+
+from . import ops  # patches Tensor methods  # noqa: F401
+from .ops.creation import (  # noqa: F401
+    to_tensor, zeros, ones, full, empty, zeros_like, ones_like, full_like,
+    empty_like, arange, linspace, logspace, eye, tril, triu, diag, diagflat,
+    assign, clone, uniform, rand, randn, normal, randint, randperm,
+    bernoulli, multinomial,
+)
+from .ops.math import (  # noqa: F401
+    add, subtract, multiply, divide, floor_divide, remainder, mod, pow,  # noqa: A004
+    maximum, minimum, fmax, fmin, matmul, mm, bmm, dot, mv, addmm, abs,  # noqa: A004
+    neg, exp, expm1, log, log2, log10, log1p, sqrt, rsqrt, square, sin, cos,
+    tan, asin, acos, atan, sinh, cosh, tanh, asinh, acosh, atanh, floor,
+    ceil, round, trunc, frac, sign, reciprocal, erf, erfinv, lgamma,  # noqa: A004
+    digamma, sigmoid, cast, scale, clip, lerp, cumsum, cumprod, isnan,
+    isinf, isfinite, einsum, atan2, hypot, logit, nan_to_num, increment,
+    stanh, kron, inner, outer, trace, diff, deg2rad, rad2deg, angle, conj,
+    real, imag, heaviside, logaddexp, multiply as elementwise_mul,
+)
+from .ops.reduction import (  # noqa: F401
+    sum, mean, max, min, prod, all, any, std, var, median, logsumexp, norm,  # noqa: A004
+    dist, amax, amin, count_nonzero, nansum, nanmean, quantile,
+)
+from .ops.manipulation import (  # noqa: F401
+    reshape, transpose, t, flatten, squeeze, unsqueeze, concat, stack,
+    split, chunk, unbind, slice, gather, gather_nd, scatter, scatter_nd_add,  # noqa: A004
+    index_select, index_sample, masked_select, masked_fill, tile, expand,
+    expand_as, broadcast_to, broadcast_tensors, flip, roll, rot90,
+    repeat_interleave, where, meshgrid, numel, shape, take_along_axis,
+    put_along_axis, unstack, shard_index, unfold, strided_slice,
+)
+from .ops.logic import (  # noqa: F401
+    equal, not_equal, greater_than, greater_equal, less_than, less_equal,
+    logical_and, logical_or, logical_not, logical_xor, bitwise_and,
+    bitwise_or, bitwise_xor, bitwise_not, isclose, allclose, equal_all,
+    is_empty, is_tensor,
+)
+from .ops.search import (  # noqa: F401
+    argmax, argmin, argsort, sort, topk, nonzero, unique, kthvalue, mode,
+    searchsorted,
+)
+from .ops.nn_ops import one_hot  # noqa: F401
+from .ops import linalg  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import autograd  # noqa: F401
+from . import metric  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io_utils import save, load  # noqa: F401
+from . import static  # noqa: F401
+from .autograd import grad  # noqa: F401
+from . import device  # noqa: F401
+from . import distributed  # noqa: F401
+from . import vision  # noqa: F401
+from . import text  # noqa: F401
+from . import profiler  # noqa: F401
+from . import utils  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from .hapi import summary  # noqa: F401
+
+import numpy as _np
+
+DataParallel = None  # set by distributed.parallel import below
+
+
+def _late_bind():
+    global DataParallel
+    from .distributed.parallel import DataParallel as _DP
+    DataParallel = _DP
+
+
+_late_bind()
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    """Dygraph is the default and only eager mode; kept for API parity."""
+    return None
+
+
+def enable_static():
+    from . import static as _static
+    _static._enable()
+
+
+def in_dynamic_mode():
+    from . import static as _static
+    return not _static._static_mode[0]
+
+
+def get_cudnn_version():
+    return None
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def rank(x):
+    return to_tensor(_np.asarray(x.ndim if isinstance(x, Tensor) else _np.ndim(x)))

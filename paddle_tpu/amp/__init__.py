@@ -1,0 +1,25 @@
+from . import auto_cast  # noqa: F401  (module; dispatch imports it)
+from .auto_cast import auto_cast, amp_guard, amp_enabled  # noqa: F811,F401
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate"]
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: for O2, cast model params to low precision.
+
+    Reference: python/paddle/amp/auto_cast.py amp_decorate. With bfloat16 on
+    TPU master weights default to keeping fp32 copies in the optimizer.
+    """
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    if level == "O2":
+        jdt = {"bfloat16": jnp.bfloat16, "float16": jnp.float16}[dtype]
+        model_list = models if isinstance(models, (list, tuple)) else [models]
+        for m in model_list:
+            for p in m.parameters():
+                p.value = p.value.astype(jdt)
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,172 @@
+"""Dynamic loss scaling.
+
+TPU-native equivalent of the reference GradScaler (reference:
+python/paddle/amp/grad_scaler.py:20, built on
+paddle/fluid/operators/amp/check_finite_and_unscale_op and
+update_loss_scaling_op). The two AMP primitive ops are implemented as pure
+jax functions; the scale/good-steps counters are state Tensors so a traced
+training step threads them functionally.
+
+With bfloat16 (TPU default) loss scaling is unnecessary; enable=True is
+mainly for float16 parity and numerics experiments.
+"""
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+
+
+@register_op("check_finite_and_unscale", differentiable=False)
+def _check_finite_and_unscale(*args):
+    """Last arg is scale; rest are grads. Returns unscaled grads + found_inf.
+    Reference: operators/amp/check_finite_and_unscale_op.h."""
+    grads, scale = args[:-1], args[-1]
+    inv = 1.0 / scale
+    found = jnp.zeros((), jnp.bool_)
+    outs = []
+    for g in grads:
+        outs.append(g * inv.astype(g.dtype))
+        found = found | ~jnp.all(jnp.isfinite(g))
+    return tuple(outs) + (found,)
+
+
+@register_op("update_loss_scaling", differentiable=False)
+def _update_loss_scaling(scale, good_steps, bad_steps, found_inf, *,
+                         incr_every_n_steps, decr_every_n_nan_or_inf,
+                         incr_ratio, decr_ratio):
+    """Reference: operators/amp/update_loss_scaling_op.h — grow after N
+    consecutive good steps, shrink after decr_every_n_nan_or_inf
+    consecutive bad steps. Branch-free so it traces."""
+    new_bad = jnp.where(found_inf, bad_steps + 1, 0)
+    new_good = jnp.where(found_inf, 0, good_steps + 1)
+    shrink = new_bad >= decr_every_n_nan_or_inf
+    grow = new_good >= incr_every_n_steps
+    new_scale = jnp.where(shrink, jnp.maximum(scale * decr_ratio, 1.0),
+                          jnp.where(grow, scale * incr_ratio, scale))
+    new_scale = jnp.where(jnp.isfinite(new_scale), new_scale, scale)
+    new_bad = jnp.where(shrink, 0, new_bad)
+    new_good = jnp.where(grow, 0, new_good)
+    return new_scale, new_good, new_bad
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n = int(decr_every_n_nan_or_inf)
+        self._scale = Tensor(jnp.asarray(float(init_loss_scaling), jnp.float32),
+                             name="loss_scaling", persistable=True)
+        self._good_steps = Tensor(jnp.asarray(0, jnp.int32),
+                                  name="loss_scaling_good_steps",
+                                  persistable=True)
+        self._bad_steps = Tensor(jnp.asarray(0, jnp.int32),
+                                 name="loss_scaling_bad_steps",
+                                 persistable=True)
+        self._found_inf_t = None
+
+    def is_enable(self):
+        return self._enable
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from .. import ops
+        return ops.math.multiply(loss, ops.math.cast(
+            Tensor(self._scale.value), dtype=loss.value.dtype))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = [p for p in optimizer._parameter_list()
+                  if p._grad is not None]
+        if not params:
+            return
+        grads = [p._grad for p in params]
+        outs = _check_finite_and_unscale(*grads, self._scale)
+        new_grads, found = outs[:-1], outs[-1]
+        for p, g in zip(params, new_grads):
+            p._grad.value = g.value
+        self._found_inf_t = found
+
+    def step(self, optimizer):
+        """scaler.step(opt): unscale then apply the update, masked on
+        overflow. Branch-free (no python conditional on the device value):
+        grads are zeroed and every mutated state tensor is restored with
+        where(found_inf, old, new), so skipped-update semantics hold in
+        both eager and traced (to_static) execution — and optimizer state
+        is always materialized, keeping trace capture complete."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._found_inf_t is None:
+            self.unscale_(optimizer)
+        found = self._found_inf_t
+        if found is None:
+            optimizer.step()
+            self.update()
+            return
+        fv = found.value
+        params = [p for p in optimizer._parameter_list()
+                  if p._grad is not None and p.trainable]
+        snapshot = [(p, p.value) for p in params]
+        for store in optimizer._accumulators.values():
+            for t in store.values():
+                snapshot.append((t, t.value))
+        for p in params:
+            g = p._grad.value
+            p._grad.value = jnp.where(fv, jnp.zeros_like(g), g)
+        optimizer.step()
+        for t, old in snapshot:
+            t.value = jnp.where(fv, old, t.value)
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._use_dynamic):
+            self._found_inf_t = None
+            return
+        found = getattr(self, "_found_inf_t", None)
+        if found is None:
+            return
+        new_scale, new_good, new_bad = _update_loss_scaling(
+            self._scale, self._good_steps, self._bad_steps, found,
+            incr_every_n_steps=self._incr_every_n_steps,
+            decr_every_n_nan_or_inf=self._decr_every_n,
+            incr_ratio=self._incr_ratio, decr_ratio=self._decr_ratio)
+        self._scale.value = new_scale.value
+        self._good_steps.value = new_good.value
+        self._bad_steps.value = new_bad.value
+        self._found_inf_t = None
+
+    def state_dict(self):
+        return {"scale": self._scale.numpy(),
+                "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps.numpy(),
+                "use_dynamic_loss_scaling": self._use_dynamic}
+
+    def load_state_dict(self, state):
+        import jax.numpy as jnp
+        self._scale.value = jnp.asarray(state["scale"], jnp.float32)
+        self._good_steps.value = jnp.asarray(state["good_steps"], jnp.int32)
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale.value)
+
+
+def _is_tracer(v):
+    import jax.core
+    return isinstance(v, jax.core.Tracer)
+
+
+AmpScaler = GradScaler

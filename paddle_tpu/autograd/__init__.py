@@ -1,0 +1,161 @@
+"""paddle.autograd equivalent: PyLayer custom autograd + paddle.grad.
+
+Reference parity: python/paddle/autograd/py_layer.py:192 (PyLayer) and
+paddle/fluid/imperative/partial_grad_engine.cc (paddle.grad).
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.engine import GradNode, run_backward
+from ..core.dispatch import is_grad_enabled, no_grad, enable_grad  # noqa: F401
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class _PyLayerNode(GradNode):
+    """GradNode whose backward calls the user's static backward()."""
+
+    def __init__(self, layer_cls, ctx, input_tensors, out_avals):
+        # op/key/closure unused; we override backward dispatch
+        super().__init__(None, None, None, None, input_tensors, out_avals)
+        self.layer_cls = layer_cls
+        self.ctx = ctx
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError("PyLayer is not instantiable; use .apply()")
+
+
+class PyLayer:
+    """User subclasses define @staticmethod forward(ctx, ...) and
+    backward(ctx, *grads)."""
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        record = is_grad_enabled() and any(not t.stop_gradient
+                                           for t in tensor_inputs)
+        if not record:
+            return out
+        out_avals = [(tuple(o.aval_shape()), o.value.dtype) for o in outs]
+        node = _PyLayerNode(cls, ctx, tensor_inputs, out_avals)
+        node.multi_out = multi
+
+        layer_cls = cls
+
+        class _Op:
+            name = f"py_layer_{cls.__name__}"
+
+            @staticmethod
+            def vjp_fn(key, closure):
+                def bwd(arrays, cts):
+                    ct_tensors = [Tensor(c) for c in
+                                  (cts if isinstance(cts, tuple) else (cts,))]
+                    with no_grad():
+                        gin = layer_cls.backward(ctx, *ct_tensors) \
+                            if len(ct_tensors) > 1 else \
+                            layer_cls.backward(ctx, ct_tensors[0])
+                    gins = gin if isinstance(gin, (list, tuple)) else (gin,)
+                    return tuple(g.value if isinstance(g, Tensor) else g
+                                 for g in gins)
+                return bwd
+
+        node.op = _Op
+        results = []
+        for i, o in enumerate(outs):
+            t = Tensor(o.value, stop_gradient=False)
+            t._grad_node = (node, i)
+            results.append(t)
+        node.out_refs = results
+        return tuple(results) if multi else results[0]
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    gs = grad_tensors if isinstance(grad_tensors, (list, tuple)) else \
+        [grad_tensors] * len(ts)
+    for t, g in zip(ts, gs):
+        run_backward(t, g, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """paddle.grad — compute grads of outputs wrt inputs without touching
+    .grad of other leaves (reference: partial_grad_engine.cc)."""
+    if create_graph:
+        raise NotImplementedError("double grad (create_graph) not yet supported")
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # Snapshot .grad of every reachable leaf plus the requested inputs, zero
+    # them, run backward, extract input grads, then restore the snapshots so
+    # paddle.grad has no visible side effects on .grad.
+    leaves = _reachable_leaves(outs)
+    snapshot = {id(t): (t, t._grad) for t in leaves}
+    for t in ins:
+        snapshot.setdefault(id(t), (t, t._grad))
+    for t, _ in snapshot.values():
+        t._grad = None
+    gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else \
+        [grad_outputs] * len(outs)
+    retain = retain_graph if retain_graph is not None else create_graph
+    for o, g in zip(outs, gouts):
+        run_backward(o, g, retain_graph=bool(retain))
+    results = []
+    for t in ins:
+        if t._grad is None and not allow_unused:
+            raise RuntimeError(f"input {t.name} unused in graph "
+                               "(pass allow_unused=True)")
+        results.append(t._grad)
+    for t, g in snapshot.values():
+        t._grad = g
+    return results
+
+
+def _reachable_leaves(outs):
+    leaves = []
+    seen = set()
+    stack = [o._grad_node[0] for o in outs if o._grad_node is not None]
+    visited = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        for t in node.input_tensors:
+            if t is None:
+                continue
+            if t._grad_node is not None:
+                stack.append(t._grad_node[0])
+            elif not t.stop_gradient and id(t) not in seen:
+                seen.add(id(t))
+                leaves.append(t)
+    return leaves

@@ -1,0 +1,11 @@
+"""Core runtime: dtype/device/dispatch/tensor/autograd/trace/flags/rng."""
+import jax
+
+# Match reference dtype semantics (int64 / float64 tensors exist as real
+# dtypes; reference framework.proto VarType supports FP64/INT64). TPU work
+# should use float32/bfloat16 explicitly — creation APIs default to float32.
+jax.config.update("jax_enable_x64", True)
+
+from . import dtype, device, flags, trace, dispatch, tensor, engine, rng  # noqa: E402,F401
+from .tensor import Tensor, Parameter  # noqa: E402,F401
+from .dispatch import no_grad, enable_grad, is_grad_enabled, register_op  # noqa: E402,F401

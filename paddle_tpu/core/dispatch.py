@@ -1,0 +1,224 @@
+"""Op registry and eager dispatcher.
+
+TPU-native equivalent of the reference op system + dygraph tracer
+(reference: paddle/fluid/framework/operator.h:138,466 OperatorWithKernel,
+paddle/fluid/imperative/tracer.cc:144 Tracer::TraceOp,
+paddle/fluid/pybind/op_function_generator.cc:519 generated _C_ops entry
+points). Design differences, deliberate and TPU-first:
+
+- An op "kernel" is a pure jax function building XLA HLO, not a CUDA kernel.
+  Eager dispatch executes it through a jit-compiled executable cached per
+  (op, static attrs, amp-state, input avals) — jax.jit provides the
+  aval-level cache; we cache the jitted callable per (op, attrs).
+- The backward kernel is derived automatically via jax.vjp of the same
+  function (reference analogue: per-op GradOpMaker,
+  paddle/fluid/framework/grad_op_desc_maker.h:61) and jit-cached the same
+  way. XLA dead-code-eliminates any forward recomputation the vjp does not
+  need.
+- Under a TraceContext (to_static / jit capture) ops apply the raw jax
+  function so tracers flow through and the whole step fuses into one XLA
+  program — the analogue of running a ProgramDesc through the Executor,
+  minus the interpreter.
+- AMP autocast is applied inside the jitted closure (reference:
+  paddle/fluid/imperative/amp_auto_cast.h:85 AutoCastInputs) so the cast
+  fuses with the op.
+"""
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import trace as trace_mod
+from . import flags as flags_mod
+
+_grad_state = threading.local()
+
+
+def is_grad_enabled():
+    return getattr(_grad_state, "enabled", True)
+
+
+class no_grad:
+    """paddle.no_grad: context manager + decorator disabling tape recording."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+_REGISTRY = {}
+_jit_cache = {}
+
+
+def get_op(name):
+    return _REGISTRY[name]
+
+
+def _hashable(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(_hashable(v) for v in x)
+    if isinstance(x, dict):
+        return tuple(sorted((k, _hashable(v)) for k, v in x.items()))
+    if isinstance(x, np.ndarray):
+        return (x.shape, str(x.dtype), x.tobytes())
+    return x
+
+
+class Op:
+    """A differentiable primitive: a pure jax function over arrays.
+
+    `fn(*arrays, **attrs)` where every positional arg is an array and every
+    keyword arg is a static attribute. The public wrapper accepts Tensors in
+    positional slots (None allowed for optional tensors) and plain python
+    values as attrs.
+    """
+
+    def __init__(self, name, fn, differentiable=True):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        _REGISTRY[name] = self
+
+    def __repr__(self):
+        return f"<op {self.name}>"
+
+    def __call__(self, *args, **attrs):
+        from .tensor import Tensor
+        from .engine import GradNode
+
+        tensor_args = []   # Tensor (or None) owner per *array slot*
+        arrays = []
+        slots = []  # index into arrays per positional slot, or None
+        for a in args:
+            if isinstance(a, Tensor):
+                slots.append(len(arrays))
+                tensor_args.append(a)
+                arrays.append(a.value)  # may notify trace ctx
+            elif a is None:
+                slots.append(None)
+            else:
+                # allow raw arrays / numpy / python scalars as dynamic inputs
+                slots.append(len(arrays))
+                tensor_args.append(None)
+                arr = a if isinstance(a, jax.Array) else jnp.asarray(a)
+                arrays.append(arr)
+
+        from ..amp.auto_cast import _cast_dtype_for
+        cast_dtype = _cast_dtype_for(self.name)
+
+        attr_key = _hashable(attrs)
+        key = (self.name, tuple(slots), attr_key, cast_dtype)
+        closure = self._closure(key, tuple(slots), attrs, cast_dtype)
+
+        ctx = trace_mod.current_trace()
+        if ctx is not None and ctx.mode == "jit":
+            outs = closure(*arrays)
+        else:
+            jitted = _jit_cache.get(key)
+            if jitted is None:
+                jitted = jax.jit(closure)
+                _jit_cache[key] = jitted
+            outs = jitted(*arrays)
+
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        if flags_mod.get_flag("FLAGS_check_nan_inf") and ctx is None:
+            _check_finite(self.name, out_list)
+
+        record = (self.differentiable and is_grad_enabled()
+                  and any(t is not None and not t.stop_gradient
+                          for t in tensor_args))
+
+        out_tensors = []
+        for o in out_list:
+            t = Tensor(o, stop_gradient=not (record and _is_float(o)))
+            if ctx is not None:
+                ctx.register_created(t)
+            out_tensors.append(t)
+
+        if record:
+            node = GradNode(self, key, closure, arrays, tensor_args,
+                            [ (o.shape, o.dtype) for o in out_list ])
+            node.multi_out = multi
+            for i, t in enumerate(out_tensors):
+                if not t.stop_gradient:
+                    t._grad_node = (node, i)
+            node.out_refs = out_tensors  # strong refs OK; graph freed after bwd
+
+        return tuple(out_tensors) if multi else out_tensors[0]
+
+    def _closure(self, key, slots, attrs, cast_dtype):
+        fn = self.fn
+
+        def closure(*arrays):
+            call_args = []
+            for s in slots:
+                if s is None:
+                    call_args.append(None)
+                else:
+                    a = arrays[s]
+                    if cast_dtype is not None and jnp.issubdtype(a.dtype, jnp.floating):
+                        a = a.astype(cast_dtype)
+                    call_args.append(a)
+            return fn(*call_args, **attrs)
+        closure.__name__ = self.name
+        return closure
+
+    def vjp_fn(self, key, closure):
+        def bwd_impl(arrays, cts):
+            _, vjp = jax.vjp(closure, *arrays)
+            return vjp(cts)
+        ctx = trace_mod.current_trace()
+        if ctx is not None and ctx.mode == "jit":
+            return bwd_impl
+        bkey = key + ("<vjp>",)
+        bwd = _jit_cache.get(bkey)
+        if bwd is None:
+            bwd = jax.jit(bwd_impl)
+            _jit_cache[bkey] = bwd
+        return bwd
+
+
+def _is_float(arr):
+    return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(arr.dtype, jnp.complexfloating)
+
+
+def _check_finite(op_name, out_list):
+    for o in out_list:
+        if _is_float(o) and not bool(jnp.all(jnp.isfinite(o))):
+            raise FloatingPointError(
+                f"Operator {op_name} output contains NaN or Inf "
+                f"(FLAGS_check_nan_inf is set)")
+
+
+def register_op(name, differentiable=True):
+    """Decorator: register a pure jax function as a framework op."""
+    def deco(fn):
+        return Op(name, fn, differentiable=differentiable)
+    return deco

@@ -1,0 +1,109 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native equivalent of the reference dtype enum (reference:
+paddle/fluid/framework/framework.proto VarType.Type and
+paddle/fluid/platform/float16.h / bfloat16.h). On TPU the portable scalar
+types are provided by XLA itself, so this module is a thin mapping layer
+between paddle-style dtype names and numpy/jax dtypes.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+
+class DType:
+    """A paddle-style dtype handle wrapping a jax/numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype) if name != "bfloat16" else jnp.bfloat16
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        try:
+            return to_paddle_dtype(other).name == self.name
+        except (TypeError, ValueError):
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def is_floating(self):
+        return self.name in ("float16", "bfloat16", "float32", "float64")
+
+    @property
+    def is_complex(self):
+        return self.name in ("complex64", "complex128")
+
+    @property
+    def is_integer(self):
+        return self.name in ("int8", "uint8", "int16", "int32", "int64")
+
+
+bool_ = DType("bool", np.bool_)
+int8 = DType("int8", np.int8)
+uint8 = DType("uint8", np.uint8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, int8, uint8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16",
+            "int": "int32", "long": "int64", "bf16": "bfloat16",
+            "fp16": "float16", "fp32": "float32", "fp64": "float64"}
+
+
+def to_paddle_dtype(dtype):
+    """Normalize any dtype spec (str, numpy dtype, jnp dtype, DType) to DType."""
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        raise ValueError(f"unknown dtype {dtype!r}")
+    # numpy / jax dtypes
+    name = np.dtype(dtype).name if dtype is not jnp.bfloat16 else "bfloat16"
+    if dtype == jnp.bfloat16:
+        name = "bfloat16"
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise ValueError(f"unknown dtype {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    """Normalize any dtype spec to the jax/numpy dtype object."""
+    d = to_paddle_dtype(dtype)
+    return jnp.bfloat16 if d.name == "bfloat16" else d.np_dtype
+
+
+# Default dtype management (reference: paddle.set_default_dtype,
+# python/paddle/framework/framework.py).
+_default_dtype = float32
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = to_paddle_dtype(dtype)
+    if not d.is_floating:
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype.name

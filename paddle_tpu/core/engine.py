@@ -1,0 +1,183 @@
+"""Autograd backward engine.
+
+TPU-native equivalent of the reference dygraph autograd engine (reference:
+paddle/fluid/imperative/basic_engine.cc BasicEngine::Execute,
+paddle/fluid/imperative/op_base.h:202 GradOpNode,
+paddle/fluid/imperative/gradient_accumulator.cc). Differences:
+
+- A GradNode's backward is the jax.vjp of the forward closure (jit-cached),
+  rather than a separately-registered grad op; XLA prunes unused primal
+  computation from the vjp.
+- Gradient accumulation for leaf tensors is an in-place `.value` update so
+  the accumulation threads through traced (to_static) steps.
+- Topological traversal is an iterative postorder DFS instead of reference
+  dependency counting; the visible semantics (sum-accumulation, hooks,
+  stop_gradient cuts) match.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class GradNode:
+    __slots__ = ("op", "key", "closure", "arrays", "input_tensors",
+                 "out_avals", "out_refs", "pending", "released", "multi_out")
+
+    def __init__(self, op, key, closure, arrays, input_tensors, out_avals):
+        self.op = op
+        self.key = key
+        self.closure = closure
+        self.arrays = arrays
+        # Tensor owner (or None for raw-array inputs) per array slot, aligned
+        # with `arrays` and with jax.vjp's returned gradients.
+        self.input_tensors = input_tensors
+        self.out_avals = out_avals  # list of (shape, jnp dtype)
+        self.out_refs = None
+        self.pending = None  # cotangent slots during a backward run
+        self.released = False
+        self.multi_out = False
+
+    def parents(self):
+        seen = []
+        for t in self.input_tensors:
+            if t is not None and t._grad_node is not None:
+                node = t._grad_node[0]
+                if node is not self:
+                    seen.append(node)
+        return seen
+
+
+def register_tensor_hook(tensor, hook):
+    """Hook called with the gradient Tensor when it is computed; may return a
+    replacement (reference: VarBase::RegisterGradHook via pybind). Fires for
+    both leaf gradients (at accumulation) and non-leaf gradients (on the
+    cotangent flowing into the producing node). Hooks live on the Tensor
+    itself, so their lifetime matches the tensor's."""
+    if tensor._hooks is None:
+        tensor._hooks = []
+    hooks = tensor._hooks
+    hooks.append(hook)
+
+    class _Removable:
+        def remove(self_inner):
+            try:
+                hooks.remove(hook)
+            except ValueError:
+                pass
+    return _Removable()
+
+
+def _apply_hooks(tensor, grad_array):
+    from .tensor import Tensor
+    if tensor is None or not tensor._hooks:
+        return grad_array
+    g = Tensor(grad_array, stop_gradient=True)
+    for h in list(tensor._hooks):
+        out = h(g)
+        if out is not None:
+            g = out
+    return g.value if isinstance(g, Tensor) else g
+
+
+def _zero_ct(shape, dt):
+    if jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating):
+        return jnp.zeros(shape, dt)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _accumulate_into_leaf(tensor, grad_array):
+    from .tensor import Tensor
+    grad_array = _apply_hooks(tensor, grad_array)
+    if tensor._grad is None:
+        tensor._grad = Tensor(grad_array, stop_gradient=True,
+                              name=tensor.name + "@GRAD")
+    else:
+        # keep the same Tensor object so traced steps functionalize correctly
+        tensor._grad.value = tensor._grad.value + grad_array
+
+
+def run_backward(loss, grad_tensor=None, retain_graph=False):
+    from .tensor import Tensor
+    if loss.stop_gradient or loss._grad_node is None:
+        raise RuntimeError(
+            f"Tensor {loss.name!r} has no grad graph (stop_gradient=True or "
+            "no recorded ops)")
+    root_node, root_idx = loss._grad_node
+    if grad_tensor is None:
+        shape, dt = root_node.out_avals[root_idx]
+        init_ct = jnp.ones(shape, dt)
+    else:
+        init_ct = grad_tensor.value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # Postorder DFS for reverse-topological order over reachable nodes.
+    order = []
+    state = {}  # node -> 0 visiting, 1 done
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            state[node] = 1
+            order.append(node)
+            continue
+        if state.get(node) is not None:
+            continue
+        state[node] = 0
+        stack.append((node, True))
+        for p in node.parents():
+            if state.get(p) is None:
+                stack.append((p, False))
+
+    for node in order:
+        node.pending = [None] * len(node.out_avals)
+    root_node.pending[root_idx] = init_ct
+
+    for node in reversed(order):
+        cts = []
+        any_ct = False
+        for i, (shape, dt) in enumerate(node.out_avals):
+            ct = node.pending[i]
+            if ct is None:
+                ct = _zero_ct(shape, dt)
+            else:
+                any_ct = True
+                if node.out_refs is not None and i < len(node.out_refs):
+                    ct = _apply_hooks(node.out_refs[i], ct)
+            cts.append(ct)
+        node.pending = None
+        if not any_ct:
+            continue
+        if node.released:
+            raise RuntimeError(
+                "trying to backward through a released graph; pass "
+                "retain_graph=True to backward()")
+        ct_arg = tuple(cts) if node.multi_out else cts[0]
+        bwd = node.op.vjp_fn(node.key, node.closure)
+        in_grads = bwd(node.arrays, ct_arg)
+        _distribute(node, in_grads)
+        if not retain_graph:
+            node.released = True
+            node.arrays = None
+            node.closure = None
+
+
+def _distribute(node, in_grads):
+    # in_grads aligns with closure's positional arrays (= input_tensors slots)
+    for t, g in zip(node.input_tensors, in_grads):
+        if t is None or t.stop_gradient:
+            continue
+        if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
+            continue
+        if t._grad_node is not None:
+            pnode, pidx = t._grad_node
+            if pnode.released:
+                raise RuntimeError(
+                    "trying to backward through a released graph; pass "
+                    "retain_graph=True to backward()")
+            if pnode.pending is None:
+                pnode.pending = [None] * len(pnode.out_avals)
+            if pnode.pending[pidx] is None:
+                pnode.pending[pidx] = g
+            else:
+                pnode.pending[pidx] = pnode.pending[pidx] + g
+        else:
+            _accumulate_into_leaf(t, g)

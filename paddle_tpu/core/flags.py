@@ -1,0 +1,60 @@
+"""Process-level flags, paddle.set_flags / get_flags style.
+
+TPU-native equivalent of the reference gflags registry (reference:
+paddle/fluid/platform/flags.cc:33-353 and
+paddle/fluid/pybind/global_value_getter_setter.cc). Flags can be set via
+environment (FLAGS_xxx=...) or paddle_tpu.set_flags({...}).
+Only flags meaningful on the XLA/PjRt runtime are kept; CUDA-specific ones
+are accepted but ignored for compatibility.
+"""
+import os
+
+_DEFAULTS = {
+    # debugging: scan op outputs for NaN/Inf (flags.cc:44 FLAGS_check_nan_inf)
+    "FLAGS_check_nan_inf": False,
+    # deterministic execution (flags.cc:108 FLAGS_cudnn_deterministic analogue):
+    # on TPU, XLA is deterministic by default; flag kept for API parity.
+    "FLAGS_deterministic": True,
+    # eager op dispatch: log compiles (debugging aid, no reference analogue)
+    "FLAGS_log_compiles": False,
+    # DDP/DP gradient fusion bucket size in MB (reference reducer.h:84
+    # group_size_limits ~25MB)
+    "FLAGS_fuse_parameter_memory_size": 25.0,
+}
+
+_flags = {}
+
+
+def _coerce(default, v):
+    if isinstance(default, bool):
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    if isinstance(default, float):
+        return float(v)
+    if isinstance(default, int):
+        return int(v)
+    return v
+
+
+def get_flag(name):
+    if name in _flags:
+        return _flags[name]
+    env = os.environ.get(name)
+    default = _DEFAULTS.get(name)
+    if env is not None:
+        return _coerce(default if default is not None else env, env)
+    return default
+
+
+def set_flags(flags):
+    """paddle.set_flags({'FLAGS_check_nan_inf': 1})"""
+    for k, v in flags.items():
+        default = _DEFAULTS.get(k)
+        _flags[k] = _coerce(default, v) if default is not None else v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    return {n: get_flag(n) for n in names}

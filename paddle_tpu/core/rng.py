@@ -1,0 +1,66 @@
+"""Global random state.
+
+TPU-native equivalent of the reference's global Generator / seed system
+(reference: paddle/fluid/framework/generator.cc, python paddle.seed).
+Design: the generator state is itself a framework Tensor holding a JAX PRNG
+key. Every random op splits the key through the normal op dispatcher, so
+the state mutation is observed by the trace context — a compiled training
+step automatically threads RNG state in and out, giving different dropout
+masks per step (the reference achieves this with a stateful cuRAND
+generator; we get it functionally).
+"""
+import jax
+import jax.numpy as jnp
+
+from .dispatch import register_op
+from .tensor import Tensor
+
+
+@register_op("rng_split", differentiable=False)
+def _rng_split(state):
+    k1, k2 = jax.random.split(state)
+    return k1, k2
+
+
+class Generator:
+    """Stateful generator; key creation is lazy so importing the package
+    does not touch the device runtime."""
+
+    def __init__(self, seed=0):
+        self._state = None
+        self._seed = seed
+
+    @property
+    def state(self):
+        if self._state is None:
+            self._state = Tensor(jax.random.PRNGKey(self._seed),
+                                 stop_gradient=True, name="rng_state",
+                                 persistable=True)
+        return self._state
+
+    def manual_seed(self, seed):
+        self._seed = seed
+        self.state.value = jax.random.PRNGKey(seed)
+        return self
+
+    def initial_seed(self):
+        return self._seed
+
+    def next_key(self):
+        """Returns a fresh PRNG key Tensor and advances the state in place."""
+        new_state, key = _rng_split(self.state)
+        self.state.value = new_state.value
+        return key
+
+
+default_generator = Generator(0)
+
+
+def seed(s):
+    """paddle.seed equivalent."""
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def next_key():
+    return default_generator.next_key()

@@ -1,0 +1,253 @@
+"""Eager Tensor.
+
+TPU-native equivalent of the reference eager tensor stack (reference:
+paddle/fluid/imperative/layer.h:66 VarBase + paddle/fluid/framework/tensor.h:89
+Tensor + pybind tensor_py.h numpy interop). A Tensor wraps an immutable
+jax.Array; paddle's in-place mutation semantics (optimizer updates,
+set_value) are expressed by swapping the wrapped array, which the trace
+context observes to functionalize compiled steps (see core/trace.py).
+Autograd metadata (grad tensor, producing GradNode, stop_gradient) lives
+here, mirroring VarBase's autograd fields.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from . import device as device_mod
+from . import trace as trace_mod
+
+_name_counter = [0]
+
+
+def _auto_name(prefix="tensor"):
+    _name_counter[0] += 1
+    return f"{prefix}_{_name_counter[0]}"
+
+
+class Tensor:
+    __slots__ = ("_value", "name", "stop_gradient", "persistable",
+                 "_grad", "_grad_node", "trainable", "_hooks", "__weakref__")
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True,
+                 name=None, persistable=False):
+        if isinstance(value, Tensor):
+            value = value.value
+        if not isinstance(value, jax.Array) or dtype is not None:
+            jdt = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
+            value = jnp.asarray(value, dtype=jdt)
+        if place is not None and not isinstance(value, jax.core.Tracer):
+            value = jax.device_put(value, place.jax_device())
+        self._value = value
+        self.name = name or _auto_name()
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = True
+        self._grad = None
+        self._grad_node = None
+        self._hooks = None
+
+    # ---- value plumbing (trace-aware) -----------------------------------
+    @property
+    def value(self):
+        ctx = trace_mod.current_trace()
+        if ctx is not None:
+            return ctx.read(self)
+        if self._value is None:
+            raise RuntimeError(
+                f"Tensor {self.name!r} has no value; it escaped a jit trace. "
+                "Keep backward/step/clear_grad inside the traced function.")
+        return self._value
+
+    @value.setter
+    def value(self, v):
+        ctx = trace_mod.current_trace()
+        if ctx is not None:
+            ctx.write(self, v)
+        else:
+            self._value = v
+
+    def set_value(self, value):
+        """In-place assignment (reference: paddle.Tensor.set_value)."""
+        if isinstance(value, Tensor):
+            value = value.value
+        arr = jnp.asarray(value, dtype=self.value.dtype)
+        if tuple(arr.shape) != tuple(self.shape):
+            raise ValueError(
+                f"set_value shape mismatch {arr.shape} vs {tuple(self.shape)}")
+        self.value = arr
+        return self
+
+    # ---- metadata --------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.aval_shape())
+
+    def aval_shape(self):
+        v = self._value
+        if v is None:
+            ctx = trace_mod.current_trace()
+            if ctx is not None:
+                v = ctx.final_value(self)
+        return tuple(v.shape)
+
+    @property
+    def ndim(self):
+        return len(self.aval_shape())
+
+    @property
+    def dtype(self):
+        v = self._value
+        if v is None:
+            ctx = trace_mod.current_trace()
+            if ctx is not None:
+                v = ctx.final_value(self)
+        return dtype_mod.to_paddle_dtype(v.dtype)
+
+    @property
+    def place(self):
+        return device_mod.get_place()
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval_shape())) if self.aval_shape() else 1
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    @property
+    def T(self):
+        from .. import ops
+        return ops.manipulation.t(self)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        self._grad = g
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # ---- host interop ----------------------------------------------------
+    def numpy(self):
+        v = self.value
+        if isinstance(v, jax.core.Tracer):
+            raise RuntimeError("cannot call .numpy() inside a jit trace")
+        if v.dtype == jnp.bfloat16:
+            return np.asarray(v)
+        return np.asarray(v)
+
+    def item(self, *args):
+        return self.numpy().item(*args)
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of multi-element Tensor is ambiguous")
+        return bool(self.numpy())
+
+    def __len__(self):
+        s = self.aval_shape()
+        if not s:
+            raise TypeError("len() of a 0-d tensor")
+        return s[0]
+
+    def __repr__(self):
+        try:
+            data = self.numpy()
+            body = np.array2string(np.asarray(data, dtype=np.float32)
+                                   if self.dtype.name == "bfloat16" else data,
+                                   precision=6, threshold=64)
+        except RuntimeError:
+            body = "<traced>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}, stop_gradient={self.stop_gradient},\n"
+                f"       {body})")
+
+    # ---- autograd --------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .engine import run_backward
+        run_backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        t = Tensor(self.value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self.stop_gradient = True
+        self._grad_node = None
+        return self
+
+    def clone(self):
+        from .. import ops
+        return ops.math.clone(self)
+
+    def register_hook(self, hook):
+        from .engine import register_tensor_hook
+        return register_tensor_hook(self, hook)
+
+    # ---- conversion ------------------------------------------------------
+    def astype(self, dtype):
+        from .. import ops
+        return ops.math.cast(self, dtype=dtype_mod.to_jax_dtype(dtype))
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            if isinstance(a, (str, dtype_mod.DType)):
+                try:
+                    return self.astype(a)
+                except ValueError:
+                    pass
+        return self
+
+    # ---- operators: patched in ops/__init__.py ---------------------------
+
+    def __hash__(self):
+        return id(self)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: python/paddle/fluid/framework.py Parameter).
+    Defaults stop_gradient=False and persistable=True."""
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name or _auto_name("param"), persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter " + super().__repr__()

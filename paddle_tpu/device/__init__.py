@@ -1,0 +1,30 @@
+"""paddle.device equivalent (reference: python/paddle/device/__init__.py)."""
+from ..core.device import (  # noqa: F401
+    set_device, get_device, get_place, device_count, is_compiled_with_cuda,
+    is_compiled_with_tpu, Place, CPUPlace, TPUPlace,
+)
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"tpu:{i}" for i in range(device_count())]
+
+
+class cuda:  # namespace shim for paddle.device.cuda users
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+def synchronize(device=None):
+    import jax
+    jax.effects_barrier()

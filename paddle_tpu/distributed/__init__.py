@@ -1,0 +1,19 @@
+"""paddle.distributed equivalent (reference: python/paddle/distributed/).
+
+TPU-native model: single-controller SPMD over a jax.sharding.Mesh. NCCL
+ring groups map to mesh axes; collectives map to XLA collectives (SURVEY
+§5 mapping table). Multi-host uses jax.distributed coordination instead of
+TCP ncclUniqueId broadcast.
+"""
+from . import env  # noqa: F401
+from .env import get_rank, get_world_size, ParallelEnv  # noqa: F401
+from .parallel import init_parallel_env, DataParallel  # noqa: F401
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, broadcast, reduce, scatter, alltoall,
+    reduce_scatter, barrier, wait, new_group, get_group, Group, ReduceOp,
+    is_initialized, _c_identity, _mp_allreduce,
+)
+from . import topology  # noqa: F401
+from . import fleet  # noqa: F401
+from .launch_mod import spawn, launch  # noqa: F401
+from . import sharding  # noqa: F401

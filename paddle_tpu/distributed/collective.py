@@ -1,0 +1,364 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/collective.py (broadcast:348,
+all_reduce:415, reduce:495, all_gather:589, scatter:667, alltoall,
+barrier:167) over the reference's c_* NCCL ops
+(paddle/fluid/operators/collective/). TPU-native mapping (SURVEY §5):
+
+    c_allreduce_sum  -> lax.psum       over a mesh axis
+    c_reducescatter  -> lax.psum_scatter
+    c_allgather      -> lax.all_gather
+    send_v2/recv_v2  -> lax.ppermute
+    alltoall         -> lax.all_to_all
+
+A Group names a mesh axis (ring_id -> axis name). Collectives are valid in
+two contexts:
+  1. inside an SPMD region (shard_map / pjit manual axes) — lowers to the
+     XLA collective on ICI;
+  2. eagerly on a Tensor — executed via a one-op shard_map over the
+     group's mesh so single-controller eager code sees paddle semantics
+     (the tensor's leading-axis shards are the "per-rank" values).
+If the group spans a single device, collectives are identities, matching
+single-process paddle.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..core.dispatch import register_op
+from . import topology
+
+_GROUPS = {}
+_next_group_id = [0]
+
+
+class Group:
+    """A communication group = a mesh axis (reference: collective.py:79
+    Group over NCCL ring ids)."""
+
+    def __init__(self, axis=None, mesh=None, ranks=None, gid=None):
+        self.axis = axis
+        self.mesh = mesh if mesh is not None else topology.get_mesh()
+        self.ranks = ranks
+        self.id = gid if gid is not None else _next_group_id[0]
+        _next_group_id[0] += 1
+
+    @property
+    def nranks(self):
+        if self.mesh is not None and self.axis in (self.mesh.shape or {}):
+            return int(self.mesh.shape[self.axis])
+        if self.ranks:
+            return len(self.ranks)
+        return jax.device_count()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis}, nranks={self.nranks})"
+
+
+def _default_group():
+    mesh = topology.get_mesh()
+    if mesh is None:
+        # implicit flat dp mesh over all devices
+        hc = topology.HybridCommunicateGroup(dp=jax.device_count())
+        mesh = hc.mesh
+    return Group(axis="dp", mesh=mesh)
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Reference: collective.py:209. Creates a group over the given global
+    ranks; in the mesh model sub-groups map to mesh axes — a custom rank
+    subset gets a dedicated 1-axis mesh over those devices."""
+    if ranks is None:
+        return _default_group()
+    devs = jax.devices()
+    sub = [devs[r] for r in ranks]
+    import numpy as np
+    mesh = jax.sharding.Mesh(np.asarray(sub), ("sub",))
+    return Group(axis="sub", mesh=mesh, ranks=list(ranks))
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid) or _default_group()
+
+
+def _axis_in_scope(axis):
+    """True when `axis` is a manual (shard_map) axis in the current trace —
+    collectives then lower directly to XLA collectives over ICI."""
+    try:
+        from jax._src import core as _core
+        return axis in _core.unsafe_get_axis_names()
+    except Exception:
+        return False
+
+
+_REDUCE_FNS = {
+    "sum": jax.lax.psum,
+    "max": jax.lax.pmax,
+    "min": jax.lax.pmin,
+}
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _eager_collective(x, group, per_shard_fn, out_spec_fn=None):
+    """Run an XLA collective eagerly over the group's mesh axis via a
+    one-op shard_map. x is sharded (or replicated) on the leading dim."""
+    mesh = group.mesh
+    axis = group.axis
+    n = int(mesh.shape[axis])
+    if n == 1:
+        return per_shard_fn(x, single=True)
+    in_spec = P(axis)
+    out_spec = out_spec_fn(axis) if out_spec_fn is not None else P(axis)
+    fn = jax.shard_map(lambda v: per_shard_fn(v, single=False),
+                       mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
+    return fn(x)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    """paddle.distributed.all_reduce. Inside SPMD: psum over the axis.
+    Eager: reduces the per-rank values along the tensor's leading shards;
+    single-device groups are identity."""
+    g = group or _default_group()
+    axis = g.axis
+    if isinstance(tensor, Tensor) and _axis_in_scope(axis):
+        out = _spmd_allreduce(tensor, axis=axis,
+                              op=op if isinstance(op, str) else "sum")
+        tensor.value = out.value
+        return tensor
+    n = g.nranks
+    if n == 1:
+        return tensor
+    red_name = op if isinstance(op, str) else "sum"
+
+    def shard_fn(v, single):
+        red = _REDUCE_FNS.get(red_name, jax.lax.psum)
+        if red_name == "avg":
+            return jax.lax.psum(v, axis) / n
+        if red_name == "prod":
+            # no pprod primitive: log-sum-exp style via all_gather
+            g_all = jax.lax.all_gather(v, axis)
+            return jnp.prod(g_all, axis=0)
+        return red(v, axis)
+
+    out = _eager_collective(tensor.value, g, shard_fn)
+    tensor.value = out
+    return tensor
+
+
+@register_op("c_allreduce", differentiable=True)
+def _spmd_allreduce(x, *, axis, op):
+    if op == "sum":
+        return jax.lax.psum(x, axis)
+    if op == "max":
+        return jax.lax.pmax(x, axis)
+    if op == "min":
+        return jax.lax.pmin(x, axis)
+    raise ValueError(op)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    g = group or _default_group()
+    n = g.nranks
+    if _axis_in_scope(g.axis):
+        gathered = _spmd_allgather(tensor, axis=g.axis)
+        from ..ops import manipulation
+        tensor_list.extend(manipulation.unbind(gathered, axis=0))
+        return tensor_list
+    if n == 1:
+        tensor_list.append(tensor)
+        return tensor_list
+    # Eager single-controller: the tensor's shards along the group axis are
+    # the per-rank values; gather them to host-visible tensors.
+    v = tensor.value
+    shards = jnp.split(jnp.asarray(v), n, axis=0) if v.shape and \
+        v.shape[0] % n == 0 else [jnp.asarray(v)] * n
+    tensor_list.extend(Tensor(s) for s in shards)
+    return tensor_list
+
+
+@register_op("c_allgather", differentiable=False)
+def _spmd_allgather(x, *, axis):
+    return jax.lax.all_gather(x, axis)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Single-controller: all mesh shards already share the controller's
+    value for replicated tensors; for sharded tensors broadcast copies the
+    src shard to all shards."""
+    g = group or _default_group()
+    n = g.nranks
+    if n == 1 or not isinstance(tensor, Tensor):
+        return tensor
+
+    def shard_fn(v, single):
+        g_all = jax.lax.all_gather(v, g.axis)
+        return g_all[src]
+
+    out = _eager_collective(tensor.value, g, shard_fn)
+    tensor.value = out
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):  # noqa: A001
+    # all ranks compute the reduction; dst semantics collapse in SPMD
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = group or _default_group()
+    if g.nranks == 1:
+        if tensor_list:
+            tensor.value = tensor_list[0].value
+        return tensor
+    # Single-controller: scatter = shard the stacked list over the group
+    # axis; the receiving "rank's" view is the sharded array itself.
+    from ..ops import manipulation
+    from jax.sharding import NamedSharding, PartitionSpec
+    stacked = manipulation.concat(tensor_list, axis=0)
+    sharded = jax.device_put(stacked.value,
+                             NamedSharding(g.mesh, PartitionSpec(g.axis)))
+    tensor.value = sharded
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    g = group or _default_group()
+    n = g.nranks
+    if _axis_in_scope(g.axis):
+        from ..ops import manipulation
+        stacked = manipulation.stack(in_tensor_list, axis=0)
+        out = _spmd_alltoall(stacked, axis=g.axis)
+        outs = manipulation.unbind(out, axis=0)
+        if out_tensor_list is not None:
+            out_tensor_list.extend(outs)
+            return out_tensor_list
+        return outs
+    if n == 1:
+        if out_tensor_list is not None:
+            out_tensor_list.extend(in_tensor_list)
+            return out_tensor_list
+        return list(in_tensor_list)
+    raise NotImplementedError(
+        "eager alltoall across mesh shards: use inside shard_map")
+
+
+@register_op("c_alltoall", differentiable=True)
+def _spmd_alltoall(x, *, axis):
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = group or _default_group()
+    if _axis_in_scope(g.axis):
+        from ..ops import manipulation
+        stacked = manipulation.stack(tensor_list, axis=0) \
+            if tensor_list is not None else tensor
+        out = _spmd_reduce_scatter(stacked, axis=g.axis)
+        tensor.value = out.value
+        return tensor
+    if g.nranks == 1:
+        if tensor_list:
+            tensor.value = tensor_list[0].value
+        return tensor
+    raise NotImplementedError(
+        "eager reduce_scatter across mesh shards: use inside shard_map")
+
+
+@register_op("c_reducescatter", differentiable=True)
+def _spmd_reduce_scatter(x, *, axis):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=False)
+
+
+def barrier(group=None):
+    """XLA executions are ordered per device; a controller-level barrier is
+    a device sync (reference: barrier op -> here effects_barrier)."""
+    jax.effects_barrier()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        v = tensor.value
+        if hasattr(v, "block_until_ready"):
+            v.block_until_ready()
+    return tensor
+
+
+def get_rank(group=None):
+    from . import env
+    return env.get_rank()
+
+
+def get_world_size(group=None):
+    from . import env
+    return env.get_world_size()
+
+
+def is_initialized():
+    return True
+
+
+# --- TP helper primitives (reference: collective.py:748-921 _c_identity,
+# _c_concat, _c_split, _mp_allreduce, _c_lookup_table) -----------------------
+
+@register_op("c_identity_op")
+def _c_identity_impl(x, *, axis):
+    # forward identity; backward all-reduces over the mp axis — implemented
+    # via custom vjp so the autograd tape gets the psum on the grad path.
+    @jax.custom_vjp
+    def ident(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis),)
+    ident.defvjp(fwd, bwd)
+    return ident(x)
+
+
+def _c_identity(tensor, group=None):
+    g = group or _default_group()
+    if not _axis_in_scope(g.axis):
+        return tensor
+    return _c_identity_impl(tensor, axis=g.axis)
+
+
+@register_op("mp_allreduce_op")
+def _mp_allreduce_impl(x, *, axis):
+    # forward allreduce; backward identity (reference c_allreduce with
+    # use_model_parallel=True)
+    @jax.custom_vjp
+    def ar(v):
+        return jax.lax.psum(v, axis)
+
+    def fwd(v):
+        return jax.lax.psum(v, axis), None
+
+    def bwd(_, g):
+        return (g,)
+    ar.defvjp(fwd, bwd)
+    return ar(x)
+
+
+def _mp_allreduce(tensor, op=ReduceOp.SUM, group=None,
+                  use_calc_stream=True, use_model_parallel=True):
+    g = group or _default_group()
+    if not _axis_in_scope(g.axis):
+        return tensor
+    return _mp_allreduce_impl(tensor, axis=g.axis)

@@ -1,0 +1,55 @@
+"""Distributed environment.
+
+Reference parity: python/paddle/fluid/dygraph/parallel.py ParallelEnv (env
+vars PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM set per-process by the
+launcher, reference fleet/launch_utils.py). TPU-native: a single controller
+process drives all local chips (SPMD), so "rank" means host process index
+(jax.process_index) and device parallelism is expressed with a Mesh, not
+one process per device.
+"""
+import os
+
+import jax
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []

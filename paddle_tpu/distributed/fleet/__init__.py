@@ -1,0 +1,12 @@
+from .fleet_base import (  # noqa: F401
+    init, distributed_model, distributed_optimizer, get_hybrid_communicate_group,
+    worker_num, worker_index, is_first_worker, barrier_worker,
+)
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from ..topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from ..utils_recompute import recompute  # noqa: F401
+
+
+class utils:
+    from ..utils_recompute import recompute  # noqa: F401

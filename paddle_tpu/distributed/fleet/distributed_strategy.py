@@ -1,0 +1,71 @@
+"""DistributedStrategy.
+
+Reference parity: python/paddle/distributed/fleet/base/distributed_strategy.py
+backed by paddle/fluid/framework/distributed_strategy.proto:159-211. Plain
+python properties instead of protobuf; the accepted keys mirror the proto
+fields so reference configs port directly.
+"""
+import copy
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # proto defaults (distributed_strategy.proto:159-211)
+        self.amp = False
+        self.amp_configs = {
+            "init_loss_scaling": 32768.0, "incr_every_n_steps": 1000,
+            "decr_every_n_nan_or_inf": 2, "incr_ratio": 2.0,
+            "decr_ratio": 0.8, "use_dynamic_loss_scaling": True,
+            "custom_white_list": [], "custom_black_list": [],
+            "use_pure_fp16": False, "use_bf16": True,
+        }
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.sharding = False
+        self.sharding_configs = {"sharding_degree": 1, "stage": 1,
+                                 "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lars = False
+        self.lars_configs = {}
+        self.lamb = False
+        self.lamb_configs = {}
+        self.dgc = False
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.elastic = False
+        self.auto = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sp_degree": 1,
+        }
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            merged = dict(self.hybrid_configs)
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+            return
+        object.__setattr__(self, key, value)
+
+    def __repr__(self):
+        flags = [k for k in ("amp", "recompute", "pipeline", "tensor_parallel",
+                             "sharding", "gradient_merge", "lars", "lamb",
+                             "dgc", "localsgd", "a_sync")
+                 if getattr(self, k)]
+        return f"DistributedStrategy(enabled={flags}, hybrid={self.hybrid_configs})"
+
+    def copy(self):
+        return copy.deepcopy(self)

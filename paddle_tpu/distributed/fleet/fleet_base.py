@@ -1,0 +1,90 @@
+"""fleet: unified distributed entry point.
+
+Reference parity: python/paddle/distributed/fleet/base/fleet_base.py
+(init:139, distributed_model:836, distributed_optimizer:783). TPU-native:
+fleet.init builds the hybrid Mesh from strategy.hybrid_configs;
+distributed_model wraps the layer per enabled strategy (DataParallel /
+TensorParallel / PipelineParallel); distributed_optimizer composes the
+HybridParallelOptimizer (clip + sharding + amp behaviors) — the analogue
+of the reference's meta-optimizer StrategyCompiler chain, except each
+"meta optimizer" is a sharding/wrapping decision instead of a program
+rewrite.
+"""
+import jax
+
+from .distributed_strategy import DistributedStrategy
+from .. import topology
+from ..env import get_rank, get_world_size
+
+_fleet_state = {"strategy": None, "hcg": None, "initialized": False}
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    if strategy is None:
+        strategy = DistributedStrategy()
+    hc = strategy.hybrid_configs
+    degrees = {k: hc.get(k, 1) for k in
+               ("dp_degree", "mp_degree", "pp_degree", "sharding_degree",
+                "sp_degree")}
+    total = 1
+    for v in degrees.values():
+        total *= v
+    if total == 1:
+        degrees["dp_degree"] = jax.device_count()
+    hcg = topology.HybridCommunicateGroup(
+        dp=degrees["dp_degree"], mp=degrees["mp_degree"],
+        pp=degrees["pp_degree"], sharding=degrees["sharding_degree"],
+        sp=degrees["sp_degree"])
+    _fleet_state.update(strategy=strategy, hcg=hcg, initialized=True)
+    return None
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+def _strategy():
+    return _fleet_state["strategy"] or DistributedStrategy()
+
+
+def distributed_model(model):
+    """Reference: fleet_base.py:836-913 — chooses the parallel wrapper."""
+    if not _fleet_state["initialized"]:
+        init()
+    hcg = _fleet_state["hcg"]
+    from .meta_parallel.parallel_wrappers import (
+        TensorParallel, PipelineParallel, ShardingParallel)
+    from ..parallel import DataParallel
+    if hcg.get_pipe_parallel_world_size() > 1:
+        return PipelineParallel(model, hcg, strategy=_strategy())
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy=_strategy())
+    if hcg.get_sharding_parallel_world_size() > 1:
+        return ShardingParallel(model, hcg, strategy=_strategy())
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Reference: fleet_base.py:783 + HybridParallelOptimizer
+    (dygraph_optimizer/hybrid_parallel_optimizer.py:89)."""
+    if strategy is not None:
+        _fleet_state["strategy"] = strategy
+    from .hybrid_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"],
+                                   _strategy())
+
+
+def worker_num():
+    return get_world_size()
+
+
+def worker_index():
+    return get_rank()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def barrier_worker():
+    jax.effects_barrier()

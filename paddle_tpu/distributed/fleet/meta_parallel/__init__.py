@@ -1,0 +1,9 @@
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .parallel_wrappers import (  # noqa: F401
+    TensorParallel, PipelineParallel, ShardingParallel,
+)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401

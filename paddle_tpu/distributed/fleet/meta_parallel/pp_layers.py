@@ -1,0 +1,124 @@
+"""Pipeline layer declaration.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py (PipelineLayer:23, LayerDesc, SharedLayerDesc:62,
+segmentation by layer count or parameter count:76).
+
+TPU-native: PipelineLayer keeps the declarative stage-partition API; the
+schedule executes micro-batches through stage segments (see
+pipeline_parallel.py). Stage placement is a mesh-axis concern, not a
+process concern: stage s parameters are tagged so the runtime can place
+them on the pp=s mesh slice.
+"""
+import numpy as np
+
+from ....nn.layer_base import Layer
+from ....nn.layer.container import LayerList
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Reference: pp_layers.py:62 — layer shared between stages (e.g. tied
+    embeddings); in the single-controller model sharing is simply the same
+    Layer object appearing in both segments."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._shared = {}
+        built = []
+        for item in layers:
+            if isinstance(item, SharedLayerDesc):
+                if item.layer_name in self._shared:
+                    layer = self._shared[item.layer_name]
+                else:
+                    layer = item.build_layer()
+                    self._shared[item.layer_name] = layer
+                built.append((layer, item.forward_func))
+            elif isinstance(item, LayerDesc):
+                built.append((item.build_layer(), None))
+            elif isinstance(item, Layer):
+                built.append((item, None))
+            elif callable(item):
+                built.append((item, "fn"))
+            else:
+                raise TypeError(f"bad pipeline item {item!r}")
+        self.run_function = built
+        self._layers_list = LayerList(
+            [l for l, tag in built if isinstance(l, Layer)])
+        self._segments = self._segment(built, self._num_stages)
+
+    def _segment(self, built, num_stages):
+        """Reference: pp_layers.py:76 — uniform or by-parameter-count."""
+        n = len(built)
+        if self._seg_method == "uniform" or num_stages == 1:
+            bounds = np.linspace(0, n, num_stages + 1).astype(int)
+        else:  # "layer:param" style: balance by parameter count
+            weights = []
+            for l, _ in built:
+                if isinstance(l, Layer):
+                    weights.append(sum(p.size for p in l.parameters()) + 1)
+                else:
+                    weights.append(1)
+            cum = np.cumsum(weights)
+            total = cum[-1]
+            bounds = [0]
+            for s in range(1, num_stages):
+                bounds.append(int(np.searchsorted(cum, total * s / num_stages)))
+            bounds.append(n)
+            bounds = np.asarray(bounds)
+        return [(int(bounds[i]), int(bounds[i + 1]))
+                for i in range(num_stages)]
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_segments(self):
+        return self._segments
+
+    def forward_stage(self, x, stage):
+        lo, hi = self._segments[stage]
+        for layer, tag in self.run_function[lo:hi]:
+            if tag == "fn":
+                x = layer(x)
+            elif tag is not None and callable(tag):
+                x = tag(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+    def forward(self, x):
+        for stage in range(self._num_stages):
+            x = self.forward_stage(x, stage)
+        return x
+
+    def loss(self, output, label):
+        if self._loss_fn is None:
+            return output
+        return self._loss_fn(output, label)

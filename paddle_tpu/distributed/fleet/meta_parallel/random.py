@@ -1,0 +1,66 @@
+"""RNG state tracking for tensor parallelism.
+
+Reference parity: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/random.py:24 RNGStatesTracker — separate RNG streams so
+dropout inside TP regions is identical across TP ranks while differing
+across DP ranks. TPU-native: a named registry of Generator states; under
+the single-controller SPMD model a dropout mask computed from one global
+key is already consistent across the mp shards of an activation, so the
+tracker mainly provides API + determinism control.
+"""
+from contextlib import contextmanager
+
+from ....core.rng import Generator
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already added")
+        if name in self.states_:
+            raise ValueError(f"state {name} already added")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    @contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} not added")
+        from ....core import rng as rng_mod
+        prev = rng_mod.default_generator
+        rng_mod.default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            rng_mod.default_generator = prev
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random
+    global _RNG_STATE_TRACKER
+    seed = seed or (random.randint(0, 1 << 30))
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, seed)

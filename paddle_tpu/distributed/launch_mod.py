@@ -1,0 +1,61 @@
+"""Launcher.
+
+Reference parity: python/paddle/distributed/fleet/launch.py:396 (process
+launcher setting PADDLE_TRAINER_ID/ENDPOINTS per proc) and
+python/paddle/distributed/spawn.py.
+
+TPU-native: one controller process drives all local chips, so there is
+nothing to spawn per device on a single host — `spawn(fn)` simply runs fn
+(nprocs>1 on one host would fight over the TPU). Multi-host launch sets
+the jax.distributed coordination env (PADDLE_COORDINATOR) per host; this
+module can be used as `python -m paddle_tpu.distributed.launch_mod script.py`
+on each host with PADDLE_TRAINER_ID set by the scheduler.
+"""
+import os
+import runpy
+import sys
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    if nprocs not in (1, -1):
+        raise RuntimeError(
+            "paddle_tpu uses single-controller SPMD: one process drives all "
+            "chips. Express device parallelism with fleet hybrid_configs / "
+            "Mesh instead of spawning per-device processes.")
+    return func(*args)
+
+
+def launch():
+    """python -m paddle_tpu.distributed.launch_mod [--coordinator host:port]
+    [--nnodes N] [--node_rank R] script.py args..."""
+    argv = sys.argv[1:]
+    coordinator = None
+    nnodes = 1
+    node_rank = 0
+    script_idx = 0
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--coordinator":
+            coordinator = argv[i + 1]
+            i += 2
+        elif a == "--nnodes":
+            nnodes = int(argv[i + 1])
+            i += 2
+        elif a == "--node_rank":
+            node_rank = int(argv[i + 1])
+            i += 2
+        else:
+            script_idx = i
+            break
+    if coordinator and nnodes > 1:
+        os.environ["PADDLE_COORDINATOR"] = coordinator
+        os.environ["PADDLE_TRAINERS_NUM"] = str(nnodes)
+        os.environ["PADDLE_TRAINER_ID"] = str(node_rank)
+    script = argv[script_idx]
+    sys.argv = argv[script_idx:]
+    runpy.run_path(script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    launch()

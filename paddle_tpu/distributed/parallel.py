@@ -1,0 +1,125 @@
+"""Data parallelism.
+
+Reference parity: python/paddle/distributed/parallel.py:58
+init_parallel_env + python/paddle/fluid/dygraph/parallel.py:382
+DataParallel over the C++ Reducer (paddle/fluid/imperative/reducer.cc).
+
+TPU-native design: there is no bucketed-allreduce Reducer. Data parallelism
+is a sharding: inputs are sharded over the mesh 'dp' axis, parameters are
+replicated, and XLA inserts the gradient all-reduce automatically when the
+backward contraction crosses the sharded batch dimension (GSPMD). This
+subsumes the Reducer's overlap behavior — XLA's latency-hiding scheduler
+overlaps the psum with remaining backward compute. `DataParallel` is
+therefore a thin wrapper that (a) ensures a mesh exists, (b) shards inputs
+over 'dp', (c) replicates parameters.
+"""
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from . import topology
+
+
+def init_parallel_env():
+    """Reference: distributed/parallel.py:58. Multi-host: initialize the
+    JAX distributed runtime from launcher-provided env vars; single host:
+    create the default dp mesh over local devices."""
+    import os
+    if "PADDLE_COORDINATOR" in os.environ:
+        jax.distributed.initialize(
+            coordinator_address=os.environ["PADDLE_COORDINATOR"],
+            num_processes=int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    if topology.get_mesh() is None:
+        topology.HybridCommunicateGroup(dp=jax.device_count())
+    from .env import ParallelEnv
+    return ParallelEnv()
+
+
+def _dp_sharding(mesh, ndim):
+    return NamedSharding(mesh, P(*(("dp",) + (None,) * (ndim - 1))))
+
+
+def _replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel wrapper (reference: fluid/dygraph/parallel.py:382).
+
+    Shards batch inputs over the 'dp' mesh axis and replicates parameters.
+    Under a compiled train step (to_static) GSPMD partitions the whole step;
+    eagerly, jax follows input shardings per op. Gradient averaging matches
+    the reference (mean loss over the global batch <=> grad mean)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        if topology.get_mesh() is None:
+            init_parallel_env()
+        self._mesh = topology.get_mesh()
+        self._replicate_params()
+
+    def _replicate_params(self):
+        rep = _replicated(self._mesh)
+        for p in self._layers.parameters():
+            p.value = jax.device_put(p.value, rep)
+        for b in self._layers.buffers():
+            b.value = jax.device_put(b.value, rep)
+
+    def scale_batch(self, x):
+        """Shard a global-batch tensor over dp."""
+        if isinstance(x, Tensor):
+            x.value = jax.device_put(
+                x.value, _dp_sharding(self._mesh, x.value.ndim))
+        return x
+
+    def forward(self, *inputs, **kwargs):
+        sharded = []
+        for x in inputs:
+            if isinstance(x, Tensor) and x.ndim >= 1 and \
+                    x.shape[0] % int(self._mesh.shape["dp"]) == 0:
+                sharded.append(self.scale_batch(x))
+            else:
+                sharded.append(x)
+        return self._layers(*sharded, **kwargs)
+
+    # delegate everything stateful to the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+
+def get_rank():
+    from .env import get_rank as _r
+    return _r()
+
+
+def get_world_size():
+    from .env import get_world_size as _w
+    return _w()

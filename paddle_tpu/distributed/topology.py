@@ -1,0 +1,199 @@
+"""Hybrid-parallel topology over a jax.sharding.Mesh.
+
+TPU-native equivalent of the reference 4-axis topology (reference:
+python/paddle/distributed/fleet/base/topology.py:36 CommunicateTopology,
+:117 HybridCommunicateGroup). The reference builds per-axis NCCL comm
+groups from a cartesian rank layout; here the cartesian layout IS a
+jax.sharding.Mesh with named axes, and "communication groups" are mesh
+axis names consumed by XLA collectives. A fifth axis `sp` (sequence/
+context parallel) is first-class — absent in the reference (SURVEY §5),
+greenfield here.
+
+Axis order (outer->inner): pp, dp, sharding, sp, mp — neighboring mp ranks
+land on adjacent devices (ICI neighbors), matching the reference's
+guidance that tensor-parallel traffic needs the fastest links.
+"""
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_HYBRID = None  # global HybridCommunicateGroup
+
+
+AXES = ("pp", "dp", "sharding", "sp", "mp")
+
+
+def build_mesh(dp=1, mp=1, pp=1, sharding=1, sp=1, devices=None):
+    devices = devices if devices is not None else jax.devices()
+    need = dp * mp * pp * sharding * sp
+    if need != len(devices):
+        if need == 1:
+            dp = len(devices)
+            need = len(devices)
+        else:
+            raise ValueError(
+                f"product of parallel degrees {need} != device count "
+                f"{len(devices)}")
+    arr = np.asarray(devices).reshape(pp, dp, sharding, sp, mp)
+    return Mesh(arr, AXES)
+
+
+class HybridCommunicateGroup:
+    """Reference: topology.py:117 — exposes rank/degree accessors per axis.
+    In the SPMD model there is no per-process 'my rank in group'; the
+    accessors report degrees and mesh handles used to build shardings."""
+
+    def __init__(self, strategy=None, mesh=None, dp=1, mp=1, pp=1,
+                 sharding=1, sp=1):
+        if strategy is not None:
+            hc = strategy.hybrid_configs
+            dp = hc.get("dp_degree", 1)
+            mp = hc.get("mp_degree", 1)
+            pp = hc.get("pp_degree", 1)
+            sharding = hc.get("sharding_degree", 1)
+            sp = hc.get("sp_degree", hc.get("sep_degree", 1))
+        self._dp_degree = dp
+        self._mp_degree = mp
+        self._pp_degree = pp
+        self._sharding_degree = sharding
+        self._sp_degree = sp
+        self.mesh = mesh if mesh is not None else build_mesh(
+            dp=dp, mp=mp, pp=pp, sharding=sharding, sp=sp)
+        global _HYBRID
+        _HYBRID = self
+
+    # degrees ------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return int(self.mesh.shape["dp"])
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sequence_parallel_world_size(self):
+        return self._sp_degree
+
+    # group handles (mesh axis names) ------------------------------------
+    def get_data_parallel_group(self):
+        from .collective import Group
+        return Group(axis="dp", mesh=self.mesh)
+
+    def get_model_parallel_group(self):
+        from .collective import Group
+        return Group(axis="mp", mesh=self.mesh)
+
+    def get_pipe_parallel_group(self):
+        from .collective import Group
+        return Group(axis="pp", mesh=self.mesh)
+
+    def get_sharding_parallel_group(self):
+        from .collective import Group
+        return Group(axis="sharding", mesh=self.mesh)
+
+    def get_sequence_parallel_group(self):
+        from .collective import Group
+        return Group(axis="sp", mesh=self.mesh)
+
+    # reference-compat rank accessors (SPMD: controller sees all ranks) --
+    def get_global_rank(self):
+        return 0
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    def topology(self):
+        return self.mesh
+
+
+def get_hybrid_communicate_group():
+    return _HYBRID
+
+
+def get_mesh():
+    if _HYBRID is not None:
+        return _HYBRID.mesh
+    return None
+
+
+def set_mesh(mesh):
+    global _HYBRID
+    if _HYBRID is None:
+        hc = HybridCommunicateGroup.__new__(HybridCommunicateGroup)
+        hc._dp_degree = int(mesh.shape.get("dp", 1))
+        hc._mp_degree = int(mesh.shape.get("mp", 1))
+        hc._pp_degree = int(mesh.shape.get("pp", 1))
+        hc._sharding_degree = int(mesh.shape.get("sharding", 1))
+        hc._sp_degree = int(mesh.shape.get("sp", 1))
+        hc.mesh = mesh
+        _HYBRID = hc
+    else:
+        _HYBRID.mesh = mesh
+    return _HYBRID
+
+
+class CommunicateTopology:
+    """Reference: topology.py:36 — cartesian coordinate helper."""
+
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coord = [kwargs[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(coord, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(int(c) for c in np.unravel_index(rank, self._dims))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = []
+        for r in range(self._world):
+            if self.get_coord(r)[axis] == index:
+                ranks.append(r)
+        return ranks
+
+    def get_dim_size(self, axis_name):
+        return self.get_dim(axis_name)
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != axis]
+        comm_list = []
+        for combo in np.ndindex(*[self._dims[i] for i in others]):
+            group = []
+            for k in range(self._dims[axis]):
+                coord = [0] * len(self._dims)
+                for i, o in enumerate(others):
+                    coord[o] = combo[i]
+                coord[axis] = k
+                group.append(int(np.ravel_multi_index(coord, self._dims)))
+            comm_list.append(group)
+        return comm_list

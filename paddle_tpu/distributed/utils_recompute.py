@@ -1,0 +1,73 @@
+"""Activation recompute (gradient checkpointing).
+
+Reference parity: python/paddle/distributed/fleet/utils/recompute.py:63
+RecomputeFunction — a PyLayer that drops intermediate activations in
+forward and replays the forward during backward with preserved RNG state.
+TPU-native: same PyLayer structure; RNG preservation snapshots the global
+generator key (functional keys make exact replay trivial — no
+cuda RNG state juggling like the reference's :171).
+"""
+from ..autograd import PyLayer
+from ..core.tensor import Tensor
+from ..core import rng as rng_mod
+from ..core.dispatch import no_grad, enable_grad
+
+
+class RecomputeFunction(PyLayer):
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+        if preserve_rng_state:
+            ctx.rng_state = rng_mod.default_generator.state.value
+        ctx.inputs = args
+        with no_grad():
+            outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        # replay forward with grad tracking, then backward through it
+        detached = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                d = a.detach()
+                d.stop_gradient = a.stop_gradient
+                detached.append(d)
+            else:
+                detached.append(a)
+        if ctx.preserve_rng_state:
+            saved = rng_mod.default_generator.state.value
+            rng_mod.default_generator.state.value = ctx.rng_state
+        try:
+            with enable_grad():
+                outputs = ctx.run_function(*detached)
+        finally:
+            if ctx.preserve_rng_state:
+                rng_mod.default_generator.state.value = saved
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        gs = list(grads)
+        from ..core.engine import run_backward
+        for o, g in zip(outs, gs):
+            if isinstance(o, Tensor) and not o.stop_gradient:
+                run_backward(o, g, retain_graph=True)
+        # one grad slot per Tensor input of apply(), aligned with the
+        # engine's node.input_tensors (None for stop_gradient inputs)
+        results = []
+        for d in detached:
+            if isinstance(d, Tensor):
+                results.append(d._grad if d._grad is not None else None)
+        return tuple(results) if len(results) > 1 else results[0]
+
+
+def recompute(function, *args, **kwargs):
+    """fleet.utils.recompute(fn, *args). preserve_rng_state kwarg honored."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    if kwargs:
+        raise ValueError(f"unexpected kwargs {list(kwargs)}")
+    # if no grad needed, just run
+    from ..core.dispatch import is_grad_enabled
+    if not is_grad_enabled() or not any(
+            isinstance(a, Tensor) and not a.stop_gradient for a in args):
+        return function(*args)
+    return RecomputeFunction.apply(function, preserve, *args)

@@ -1,0 +1,6 @@
+"""framework utilities: save/load, random seed plumbing, core types.
+Reference parity: python/paddle/framework/."""
+from . import io_utils  # noqa: F401
+from .io_utils import save, load  # noqa: F401
+from ..core.tensor import Tensor, Parameter  # noqa: F401
+from ..core.rng import seed  # noqa: F401

@@ -1,0 +1,55 @@
+"""paddle.save / paddle.load.
+
+Reference parity: python/paddle/framework/io.py:550 (save) / :766 (load) —
+pickle-based state_dict persistence. Tensors are converted to numpy for
+serialization; nested dicts/lists preserved. bfloat16 arrays are stored as
+a (uint16 bits, 'bfloat16') marker since numpy lacks the dtype natively.
+"""
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+_BF16_TAG = "__bf16__"
+
+
+def _to_picklable(obj):
+    if isinstance(obj, Tensor):
+        v = obj.value
+        if v.dtype == jnp.bfloat16:
+            return {_BF16_TAG: np.asarray(v.astype(jnp.float32))}
+        return np.asarray(v)
+    if isinstance(obj, jnp.ndarray):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_picklable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_picklable(v) for v in obj)
+    return obj
+
+
+def _from_picklable(obj):
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {_BF16_TAG}:
+            return jnp.asarray(obj[_BF16_TAG]).astype(jnp.bfloat16)
+        return {k: _from_picklable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_picklable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_picklable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_picklable(obj)

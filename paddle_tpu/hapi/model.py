@@ -1,0 +1,244 @@
+"""High-level Model API.
+
+Reference parity: python/paddle/hapi/model.py:878 Model (fit:1523,
+evaluate:1753, predict:1855, train_batch/eval_batch). Single adapter: the
+dygraph path with to_static compilation of the train step — the reference's
+Dynamic/StaticGraphAdapter split collapses because trace-capture IS the
+static mode here.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad
+from ..io import DataLoader
+from ..ops import math as math_ops
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+
+    # ---- single-batch ops ------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in ins]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        labs = [y if isinstance(y, Tensor) or y is None
+                else Tensor(np.asarray(y)) for y in labs]
+        outputs = self.network(*ins)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        losses = self._loss(*(outs + [l for l in labs if l is not None]))
+        loss_list = losses if isinstance(losses, (list, tuple)) else [losses]
+        total = loss_list[0]
+        for l in loss_list[1:]:
+            total = math_ops.add(total, l)
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            metrics.append(m.update(m.compute(*(outs + [l for l in labs
+                                                        if l is not None]))))
+        vals = [float(l.numpy()) for l in loss_list]
+        return (vals, metrics) if metrics else vals
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in ins]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        labs = [y if isinstance(y, Tensor) or y is None
+                else Tensor(np.asarray(y)) for y in labs]
+        outputs = self.network(*ins)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        metrics = []
+        for m in self._metrics:
+            metrics.append(m.update(m.compute(*(outs + [l for l in labs
+                                                        if l is not None]))))
+        if self._loss is not None:
+            losses = self._loss(*(outs + [l for l in labs if l is not None]))
+            loss_list = losses if isinstance(losses, (list, tuple)) else [losses]
+            vals = [float(l.numpy()) for l in loss_list]
+            return (vals, metrics) if metrics else vals
+        return ([], metrics)
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in ins]
+        outputs = self.network(*ins)
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        return [o.numpy() for o in outs]
+
+    # ---- loops -----------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle)
+        eval_loader = self._to_loader(eval_data, batch_size, False)
+        cbks = cb_mod.config_callbacks(callbacks, model=self,
+                                       epochs=epochs,
+                                       steps=_safe_len(train_loader),
+                                       log_freq=log_freq,
+                                       save_freq=save_freq,
+                                       save_dir=save_dir,
+                                       verbose=verbose,
+                                       metrics=self._metrics_names())
+        cbks.on_begin("train")
+        self.stop_training = False
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            train_logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, {})
+                ins, labs = _split_batch(batch)
+                res = self.train_batch(ins, labs)
+                train_logs = self._pack_logs(res, batch_size)
+                cbks.on_batch_end("train", step, train_logs)
+                it_count += 1
+                if (num_iters is not None and it_count >= num_iters) or \
+                        self.stop_training:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_res = self.evaluate(eval_loader, verbose=0)
+                for k, v in eval_res.items():
+                    train_logs["eval_" + k] = v
+            cbks.on_epoch_end(epoch, train_logs)
+            if self.stop_training or (num_iters is not None
+                                      and it_count >= num_iters):
+                break
+        cbks.on_end("train", {})
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._to_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, labs = _split_batch(batch)
+            res = self.eval_batch(ins, labs)
+            if isinstance(res, tuple):
+                losses.extend(res[0])
+            else:
+                losses.extend(res)
+        out = {}
+        if losses:
+            out["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                for n, a in zip(name, acc):
+                    out[n] = a
+            else:
+                out[name] = acc
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = self._to_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch, has_label=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs], axis=0)
+                    for i in range(n_out)]
+        return outputs
+
+    # ---- persistence ------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_utils import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_utils import load as pload
+        state = pload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)
+
+    # ---- helpers ----------------------------------------------------------
+    def _metrics_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _pack_logs(self, res, batch_size):
+        logs = {"batch_size": batch_size}
+        if isinstance(res, tuple):
+            losses, metrics = res
+        else:
+            losses, metrics = res, []
+        if losses:
+            logs["loss"] = losses[0] if len(losses) == 1 else losses
+        for m, val in zip(self._metrics, metrics):
+            n = m.name()
+            if isinstance(n, list):
+                for nn_, v in zip(n, val):
+                    logs[nn_] = v
+            else:
+                logs[n] = val
+        return logs
+
+
+def _split_batch(batch, has_label=True):
+    if isinstance(batch, (list, tuple)):
+        if len(batch) >= 2 and has_label:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), [None]
+    return [batch], [None]
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None

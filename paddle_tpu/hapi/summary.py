@@ -1,0 +1,29 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    rows = []
+    total_params = 0
+    trainable_params = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = sum(p.size for p in layer._parameters.values()
+                       if p is not None)
+        n_train = sum(p.size for p in layer._parameters.values()
+                      if p is not None and p.trainable)
+        if not layer._sub_layers:  # leaf layers only in the table
+            rows.append((name or type(layer).__name__,
+                         type(layer).__name__, n_params))
+        total_params += n_params
+        trainable_params += n_train
+    width = max([len(r[0]) for r in rows] + [10]) + 2
+    lines = [f"{'Layer':<{width}}{'Type':<24}{'Params':>12}",
+             "-" * (width + 36)]
+    for name, typ, n in rows:
+        lines.append(f"{name:<{width}}{typ:<24}{n:>12,}")
+    lines.append("-" * (width + 36))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params,
+            "trainable_params": trainable_params}

@@ -1,0 +1,16 @@
+"""paddle.io equivalent: Dataset / DataLoader / samplers.
+
+Reference parity: python/paddle/fluid/dataloader/ (Dataset, IterableDataset,
+BatchSampler, DistributedBatchSampler) and python/paddle/fluid/reader.py:146
+DataLoader. TPU-native design: instead of the reference's multiprocess
+workers + shared-memory + C++ blocking queue + buffered_reader H2D prefetch
+chain, we use a thread-pool fetcher feeding a bounded queue with
+double-buffered jax.device_put — on TPU the expensive hop is host->HBM, and
+async dispatch overlaps it with compute. (A C++ native queue backend lives
+in runtime_cpp/ for the high-throughput path.)
+"""
+from .dataset import Dataset, IterableDataset, TensorDataset, Subset, \
+    ChainDataset, ComposeDataset, random_split  # noqa: F401
+from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, \
+    DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

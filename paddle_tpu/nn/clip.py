@@ -1,0 +1,103 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/fluid/clip.py (ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Clippers operate on (param, grad)
+lists; the optimizer applies them before the update (reference:
+Optimizer._create_optimization_pass -> grad_clip).
+"""
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+
+
+@register_op("clip_by_value", differentiable=False)
+def _clip_by_value(g, *, mn, mx):
+    return jnp.clip(g, mn, mx)
+
+
+@register_op("clip_by_norm", differentiable=False)
+def _clip_by_norm(g, *, clip_norm):
+    n = jnp.sqrt(jnp.sum(jnp.square(g)))
+    factor = jnp.where(n > clip_norm, clip_norm / jnp.maximum(n, 1e-12), 1.0)
+    return g * factor.astype(g.dtype)
+
+
+@register_op("global_norm_sq", differentiable=False)
+def _global_norm_sq(*grads):
+    total = jnp.zeros((), jnp.float32)
+    for g in grads:
+        total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return total
+
+
+@register_op("global_norm_scale", differentiable=False)
+def _apply_global_scale(g, norm_sq, *, clip_norm):
+    norm = jnp.sqrt(norm_sq)
+    factor = clip_norm / jnp.maximum(norm, clip_norm)
+    return g * factor.astype(g.dtype)
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, _clip_by_value(g, mn=self.min, mx=self.max)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, _clip_by_norm(g, clip_norm=self.clip_norm)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference: fluid/clip.py ClipGradByGlobalNorm — scales all grads by
+    clip_norm/global_norm when global_norm > clip_norm."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm_sq(self, grads):
+        return _global_norm_sq(*grads)
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        norm_sq = self._global_norm_sq(grads)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, _apply_global_scale(g, norm_sq,
+                                               clip_norm=self.clip_norm)))
+        return out
+
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

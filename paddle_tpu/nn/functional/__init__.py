@@ -1,0 +1,50 @@
+"""paddle.nn.functional equivalent (reference: python/paddle/nn/functional/).
+Mostly re-exports the primitive op library."""
+from ...ops.nn_ops import (  # noqa: F401
+    relu, relu6, sigmoid, tanh, silu, swish, mish, hardswish, hardsigmoid,
+    softsign, tanhshrink, log_sigmoid, gelu, leaky_relu, elu, selu, celu,
+    hardtanh, hardshrink, softshrink, softplus, thresholded_relu, prelu,
+    softmax, log_softmax, glu,
+    linear, conv2d, conv1d, conv3d, conv2d_transpose,
+    max_pool2d, avg_pool2d, max_pool1d, avg_pool1d,
+    adaptive_avg_pool2d, adaptive_max_pool2d,
+    layer_norm, batch_norm, group_norm, instance_norm, normalize,
+    local_response_norm,
+    dropout, dropout2d, embedding, one_hot,
+    softmax_with_cross_entropy, cross_entropy, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    nll_loss, kl_div, square_error_cost, margin_ranking_loss,
+    cosine_similarity, interpolate, upsample, pixel_shuffle, label_smooth,
+    temporal_shift,
+)
+from ...ops.manipulation import pad, unfold  # noqa: F401
+from ...ops.attention import (  # noqa: F401
+    scaled_dot_product_attention, flash_attention,
+)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    import jax.numpy as jnp
+    from ...core.dispatch import register_op as _r
+    from ...ops.creation import _register_created
+    from ...core.tensor import Tensor
+    v = x.value
+    n = v.shape[-1]
+    out = jnp.zeros(v.shape + (n,), v.dtype)
+    idx = jnp.arange(n)
+    out = out.at[..., idx, idx].set(v)
+    return _register_created(Tensor(out))
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """Reference: fluid.layers.sequence_mask."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    from ...core import dtype as dtype_mod
+    from ...ops.creation import _register_created
+    lv = lengths.value
+    if maxlen is None:
+        maxlen = int(lv.max())
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lv[..., None]
+    return _register_created(Tensor(mask.astype(dtype_mod.to_jax_dtype(dtype))))

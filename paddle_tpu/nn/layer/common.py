@@ -1,0 +1,177 @@
+"""Common layers: Linear, Dropout, Embedding, Flatten, etc.
+
+Reference parity: python/paddle/nn/layer/common.py.
+"""
+from ..layer_base import Layer
+from .. import initializer as init_mod
+from ...ops import nn_ops, manipulation
+
+
+class Linear(Layer):
+    """Reference: nn.Linear — weight shape [in_features, out_features]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=init_mod.ParamAttr._to_attr(weight_attr))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=init_mod.ParamAttr._to_attr(bias_attr),
+            is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self._in_features}, out_features={self._out_features}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return nn_ops.dropout(x, p=self.p, training=self.training,
+                              mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return nn_ops.dropout2d(x, p=self.p, training=self.training)
+
+
+class Embedding(Layer):
+    """Reference: nn.Embedding over lookup_table_v2."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = padding_idx
+        attr = init_mod.ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=attr,
+            default_initializer=init_mod.Normal(0.0, 1.0) if (
+                attr is None or attr.initializer is None) else None)
+        if padding_idx is not None:
+            import jax.numpy as jnp
+            w = self.weight.value
+            pi = padding_idx if padding_idx >= 0 else num_embeddings + padding_idx
+            self.weight.value = w.at[pi].set(jnp.zeros_like(w[pi]))
+
+    def forward(self, x):
+        return nn_ops.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def extra_repr(self):
+        return f"{self._num_embeddings}, {self._embedding_dim}"
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return manipulation.flatten(x, self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return manipulation.pad(x, self.padding, self.mode, self.value,
+                                self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+
+    def forward(self, x):
+        return nn_ops.interpolate(x, self.size, self.scale_factor, self.mode,
+                                  self.align_corners)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "bilinear", True)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size, scale_factor, "nearest", False)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return nn_ops.pixel_shuffle(x, self.upscale_factor)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis = axis
+        self.eps = eps
+
+    def forward(self, x1, x2):
+        return nn_ops.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class Bilinear(Layer):
+    """Reference: nn.Bilinear — out[b,o] = x1[b,:] W[o] x2[b,:]^T + b."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            attr=init_mod.ParamAttr._to_attr(weight_attr))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (1, out_features), attr=init_mod.ParamAttr._to_attr(bias_attr),
+            is_bias=True)
+
+    def forward(self, x1, x2):
+        from ...ops import math as math_ops
+        out = math_ops.einsum("bi,oij,bj->bo", x1, self.weight, x2)
+        if self.bias is not None:
+            out = math_ops.add(out, self.bias)
+        return out

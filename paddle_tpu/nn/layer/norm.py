@@ -1,0 +1,160 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from .. import initializer as init_mod
+from ...core.tensor import Tensor
+from ...ops import nn_ops
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_features,), attr=init_mod.ParamAttr._to_attr(weight_attr),
+            default_initializer=init_mod.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=init_mod.ParamAttr._to_attr(bias_attr),
+            is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,),
+                                                       jnp.float32),
+                                             persistable=True))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,),
+                                                          jnp.float32),
+                                                 persistable=True))
+
+    def forward(self, x):
+        return nn_ops.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format,
+            use_global_stats=self._use_global_stats)
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}"
+
+
+class BatchNorm(_BatchNormBase):
+    """Legacy fluid.dygraph.BatchNorm-compatible entry."""
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, "NCL", use_global_stats, name)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr,
+                         bias_attr, data_format, use_global_stats, name)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU/SPMD, batch stats are computed over the global (sharded) batch
+    automatically when the step runs under pjit with a dp-sharded input —
+    matching reference SyncBatchNorm semantics without a special kernel
+    (reference: python/paddle/nn/layer/norm.py SyncBatchNorm over
+    sync_batch_norm op)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        n = 1
+        for s in self._normalized_shape:
+            n *= s
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (n,), attr=init_mod.ParamAttr._to_attr(weight_attr),
+            default_initializer=init_mod.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (n,), attr=init_mod.ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.layer_norm(x, self._normalized_shape, self.weight,
+                                 self.bias, self._epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_channels,), attr=init_mod.ParamAttr._to_attr(weight_attr),
+            default_initializer=init_mod.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_channels,), attr=init_mod.ParamAttr._to_attr(bias_attr),
+            is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.group_norm(x, self._num_groups, self.weight, self.bias,
+                                 self._epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = None if weight_attr is False else self.create_parameter(
+            (num_features,), attr=init_mod.ParamAttr._to_attr(weight_attr),
+            default_initializer=init_mod.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=init_mod.ParamAttr._to_attr(bias_attr),
+            is_bias=True)
+
+    def forward(self, x):
+        return nn_ops.instance_norm(x, weight=self.scale, bias=self.bias,
+                                    epsilon=self._epsilon)
+
+
+InstanceNorm1D = InstanceNorm2D
+InstanceNorm3D = InstanceNorm2D
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        return nn_ops.local_response_norm(x, self.size, self.alpha, self.beta,
+                                          self.k)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        raise NotImplementedError("SpectralNorm: planned")

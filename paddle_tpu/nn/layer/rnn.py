@@ -1,0 +1,274 @@
+"""Recurrent layers: LSTM / GRU / SimpleRNN.
+
+Reference parity: python/paddle/nn/layer/rnn.py (RNNBase over C++ cudnn
+rnn op / rnn_op.cc). TPU-native design: the time loop is a lax.scan inside
+one registered op, so XLA compiles the whole sequence into a single fused
+loop; gate matmuls batch onto the MXU. Weight layout matches paddle:
+weight_ih [gates*hidden, input], weight_hh [gates*hidden, hidden].
+"""
+import jax
+import jax.numpy as jnp
+
+from ..layer_base import Layer
+from .. import initializer as init_mod
+from ...core.dispatch import register_op
+from ...core.tensor import Tensor
+from ...ops import manipulation
+
+
+@register_op("lstm_layer")
+def _lstm_layer(x, h0, c0, w_ih, w_hh, b_ih, b_hh, *, reverse):
+    """x: [seq, batch, input] (time-major internally). Returns (y, h, c)."""
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            gates = gates + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    (h, c), ys = jax.lax.scan(step, (h0, c0), x, reverse=reverse)
+    if reverse:
+        pass  # scan(reverse=True) already emits outputs aligned to input order
+    return ys, h, c
+
+
+@register_op("gru_layer")
+def _gru_layer(x, h0, w_ih, w_hh, b_ih, b_hh, *, reverse):
+    def step(h, xt):
+        gi = xt @ w_ih.T
+        gh = h @ w_hh.T
+        if b_ih is not None:
+            gi = gi + b_ih
+            gh = gh + b_hh
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(ic + r * hc)
+        h_new = (1.0 - z) * n + z * h
+        return h_new, h_new
+
+    h, ys = jax.lax.scan(step, h0, x, reverse=reverse)
+    return ys, h
+
+
+@register_op("simple_rnn_layer")
+def _simple_rnn_layer(x, h0, w_ih, w_hh, b_ih, b_hh, *, reverse, activation):
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, xt):
+        z = xt @ w_ih.T + h @ w_hh.T
+        if b_ih is not None:
+            z = z + b_ih + b_hh
+        h_new = act(z)
+        return h_new, h_new
+
+    h, ys = jax.lax.scan(step, h0, x, reverse=reverse)
+    return ys, h
+
+
+class RNNBase(Layer):
+    GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = 2 if direction in ("bidirect", "bidirectional") else 1
+        g = self.GATES[mode]
+        std = 1.0 / (hidden_size ** 0.5)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(self.bidirect):
+                in_sz = input_size if layer == 0 else hidden_size * self.bidirect
+                suffix = "_reverse" if d else ""
+                w_ih = self.create_parameter(
+                    (g * hidden_size, in_sz), weight_ih_attr,
+                    default_initializer=init_mod.Uniform(-std, std))
+                w_hh = self.create_parameter(
+                    (g * hidden_size, hidden_size), weight_hh_attr,
+                    default_initializer=init_mod.Uniform(-std, std))
+                b_ih = self.create_parameter(
+                    (g * hidden_size,), bias_ih_attr, is_bias=True,
+                    default_initializer=init_mod.Uniform(-std, std))
+                b_hh = self.create_parameter(
+                    (g * hidden_size,), bias_hh_attr, is_bias=True,
+                    default_initializer=init_mod.Uniform(-std, std))
+                names = [f"weight_ih_l{layer}{suffix}",
+                         f"weight_hh_l{layer}{suffix}",
+                         f"bias_ih_l{layer}{suffix}",
+                         f"bias_hh_l{layer}{suffix}"]
+                for n, p in zip(names, [w_ih, w_hh, b_ih, b_hh]):
+                    self.add_parameter(n, p)
+                self._all_weights.append(names)
+
+    def _run_layer(self, x, h0, c0, names, reverse):
+        w_ih = getattr(self, names[0])
+        w_hh = getattr(self, names[1])
+        b_ih = getattr(self, names[2])
+        b_hh = getattr(self, names[3])
+        if self.mode == "LSTM":
+            return _lstm_layer(x, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                               reverse=reverse)
+        if self.mode == "GRU":
+            y, h = _gru_layer(x, h0, w_ih, w_hh, b_ih, b_hh, reverse=reverse)
+            return y, h, None
+        act = "tanh" if self.mode == "RNN_TANH" else "relu"
+        y, h = _simple_rnn_layer(x, h0, w_ih, w_hh, b_ih, b_hh,
+                                 reverse=reverse, activation=act)
+        return y, h, None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        x = inputs
+        if not self.time_major:
+            x = manipulation.transpose(x, (1, 0, 2))
+        seq, batch = x.shape[0], x.shape[1]
+        nstates = self.num_layers * self.bidirect
+        if initial_states is None:
+            h0_all = ops.creation.zeros((nstates, batch, self.hidden_size),
+                                        dtype=x.value.dtype)
+            c0_all = ops.creation.zeros((nstates, batch, self.hidden_size),
+                                        dtype=x.value.dtype) \
+                if self.mode == "LSTM" else None
+        else:
+            if self.mode == "LSTM":
+                h0_all, c0_all = initial_states
+            else:
+                h0_all, c0_all = initial_states, None
+        h_outs, c_outs = [], []
+        idx = 0
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.bidirect):
+                h0 = h0_all[idx]
+                c0 = c0_all[idx] if c0_all is not None else None
+                y, h, c = self._run_layer(x, h0, c0, self._all_weights[idx],
+                                          reverse=bool(d))
+                outs.append(y)
+                h_outs.append(h)
+                if c is not None:
+                    c_outs.append(c)
+                idx += 1
+            x = outs[0] if len(outs) == 1 else manipulation.concat(outs, axis=-1)
+            if self.dropout and layer < self.num_layers - 1:
+                x = ops.nn_ops.dropout(x, p=self.dropout,
+                                       training=self.training)
+        y = x
+        if not self.time_major:
+            y = manipulation.transpose(y, (1, 0, 2))
+        h_final = manipulation.stack(h_outs, axis=0)
+        if self.mode == "LSTM":
+            c_final = manipulation.stack(c_outs, axis=0)
+            return y, (h_final, c_final)
+        return y, h_final
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / (hidden_size ** 0.5)
+        self.weight_ih = self.create_parameter(
+            (4 * hidden_size, input_size), weight_ih_attr,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (4 * hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            (4 * hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            (4 * hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=init_mod.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ... import ops
+        if states is None:
+            b = inputs.shape[0]
+            h = ops.creation.zeros((b, self.hidden_size), inputs.value.dtype)
+            c = ops.creation.zeros((b, self.hidden_size), inputs.value.dtype)
+        else:
+            h, c = states
+        x1 = manipulation.unsqueeze(inputs, axis=0)
+        y, h_new, c_new = _lstm_layer(x1, h, c, self.weight_ih,
+                                      self.weight_hh, self.bias_ih,
+                                      self.bias_hh, reverse=False)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        std = 1.0 / (hidden_size ** 0.5)
+        self.weight_ih = self.create_parameter(
+            (3 * hidden_size, input_size), weight_ih_attr,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (3 * hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            (3 * hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            (3 * hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=init_mod.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ... import ops
+        if states is None:
+            b = inputs.shape[0]
+            states = ops.creation.zeros((b, self.hidden_size),
+                                        inputs.value.dtype)
+        x1 = manipulation.unsqueeze(inputs, axis=0)
+        y, h_new = _gru_layer(x1, states, self.weight_ih, self.weight_hh,
+                              self.bias_ih, self.bias_hh, reverse=False)
+        return h_new, h_new

@@ -1,0 +1,125 @@
+"""Op library + Tensor method/operator patching.
+
+Reference parity: python/paddle/tensor/__init__.py attaches ~300 methods to
+the Tensor type via monkey patch (reference:
+python/paddle/fluid/dygraph/math_op_patch.py for operators). We do the same
+so `x.sum()`, `x + y`, `x.reshape(...)` all work on eager Tensors.
+"""
+from . import creation, math, reduction, manipulation, logic, search, \
+    nn_ops, linalg, indexing  # noqa: F401
+from ..core.tensor import Tensor
+
+
+def _patch():
+    T = Tensor
+    m, r, mp, lg, s = math, reduction, manipulation, logic, search
+
+    # arithmetic operators
+    T.__add__ = lambda self, o: m.add(self, o)
+    T.__radd__ = lambda self, o: m.add(o, self)
+    T.__sub__ = lambda self, o: m.subtract(self, o)
+    T.__rsub__ = lambda self, o: m.subtract(o, self)
+    T.__mul__ = lambda self, o: m.multiply(self, o)
+    T.__rmul__ = lambda self, o: m.multiply(o, self)
+    T.__truediv__ = lambda self, o: m.divide(self, o)
+    T.__rtruediv__ = lambda self, o: m.divide(o, self)
+    T.__floordiv__ = lambda self, o: m.floor_divide(self, o)
+    T.__mod__ = lambda self, o: m.remainder(self, o)
+    T.__pow__ = lambda self, o: m.pow(self, o)
+    T.__rpow__ = lambda self, o: m.pow(o, self)
+    T.__neg__ = lambda self: m.neg(self)
+    T.__abs__ = lambda self: m.abs(self)
+    T.__matmul__ = lambda self, o: m.matmul(self, o)
+    T.__rmatmul__ = lambda self, o: m.matmul(o, self)
+    # comparisons
+    T.__eq__ = lambda self, o: lg.equal(self, o)
+    T.__ne__ = lambda self, o: lg.not_equal(self, o)
+    T.__lt__ = lambda self, o: lg.less_than(self, o)
+    T.__le__ = lambda self, o: lg.less_equal(self, o)
+    T.__gt__ = lambda self, o: lg.greater_than(self, o)
+    T.__ge__ = lambda self, o: lg.greater_equal(self, o)
+    T.__invert__ = lambda self: lg.logical_not(self)
+    T.__and__ = lambda self, o: lg.logical_and(self, o)
+    T.__or__ = lambda self, o: lg.logical_or(self, o)
+    T.__xor__ = lambda self, o: lg.logical_xor(self, o)
+    # indexing
+    T.__getitem__ = lambda self, idx: indexing.getitem(self, idx)
+    T.__setitem__ = lambda self, idx, v: indexing.setitem(self, idx, v)
+
+    def meth(fn):
+        def _m(self, *a, **k):
+            return fn(self, *a, **k)
+        return _m
+
+    methods = {
+        # math
+        "add": m.add, "subtract": m.subtract, "multiply": m.multiply,
+        "divide": m.divide, "matmul": m.matmul, "mm": m.matmul, "bmm": m.bmm,
+        "dot": m.dot, "mv": m.mv, "pow": m.pow, "abs": m.abs, "exp": m.exp,
+        "log": m.log, "log2": m.log2, "log10": m.log10, "log1p": m.log1p,
+        "sqrt": m.sqrt, "rsqrt": m.rsqrt, "square": m.square, "sin": m.sin,
+        "cos": m.cos, "tan": m.tan, "asin": m.asin, "acos": m.acos,
+        "atan": m.atan, "sinh": m.sinh, "cosh": m.cosh, "tanh": m.tanh,
+        "floor": m.floor, "ceil": m.ceil, "round": m.round, "trunc": m.trunc,
+        "sign": m.sign, "reciprocal": m.reciprocal, "erf": m.erf,
+        "sigmoid": m.sigmoid, "clip": m.clip, "lerp": m.lerp, "scale": m.scale,
+        "maximum": m.maximum, "minimum": m.minimum, "remainder": m.remainder,
+        "mod": m.mod, "floor_divide": m.floor_divide, "neg": m.neg,
+        "cumsum": m.cumsum, "cumprod": m.cumprod, "isnan": m.isnan,
+        "isinf": m.isinf, "isfinite": m.isfinite, "addmm": m.addmm,
+        "trace": m.trace, "diff": m.diff, "kron": m.kron, "outer": m.outer,
+        "inner": m.inner, "atan2": m.atan2, "logit": m.logit,
+        "nan_to_num": m.nan_to_num, "increment": m.increment,
+        "stanh": m.stanh, "expm1": m.expm1, "angle": m.angle, "conj": m.conj,
+        # reduction
+        "sum": r.sum, "mean": r.mean, "max": r.max, "min": r.min,
+        "prod": r.prod, "all": r.all, "any": r.any, "std": r.std,
+        "var": r.var, "median": r.median, "logsumexp": r.logsumexp,
+        "norm": r.norm, "dist": r.dist, "amax": r.max, "amin": r.min,
+        "count_nonzero": r.count_nonzero, "nansum": r.nansum,
+        "nanmean": r.nanmean, "quantile": r.quantile,
+        # manipulation
+        "reshape": mp.reshape, "reshape_": mp.reshape_,
+        "transpose": mp.transpose, "flatten": mp.flatten,
+        "squeeze": mp.squeeze, "unsqueeze": mp.unsqueeze, "tile": mp.tile,
+        "expand": mp.expand, "expand_as": mp.expand_as,
+        "broadcast_to": mp.broadcast_to, "flip": mp.flip, "roll": mp.roll,
+        "gather": mp.gather, "gather_nd": mp.gather_nd,
+        "scatter": mp.scatter, "scatter_nd_add": mp.scatter_nd_add,
+        "index_select": mp.index_select, "index_sample": mp.index_sample,
+        "masked_select": mp.masked_select, "masked_fill": mp.masked_fill,
+        "split": mp.split, "chunk": mp.chunk, "unbind": mp.unbind,
+        "slice": mp.slice, "take_along_axis": mp.take_along_axis,
+        "put_along_axis": mp.put_along_axis, "unstack": mp.unstack,
+        "repeat_interleave": mp.repeat_interleave, "pad": mp.pad,
+        "where": mp.where, "rot90": mp.rot90, "tril": creation.tril,
+        "triu": creation.triu, "diag": creation.diag,
+        # logic
+        "equal": lg.equal, "not_equal": lg.not_equal,
+        "greater_than": lg.greater_than, "greater_equal": lg.greater_equal,
+        "less_than": lg.less_than, "less_equal": lg.less_equal,
+        "logical_and": lg.logical_and, "logical_or": lg.logical_or,
+        "logical_not": lg.logical_not, "logical_xor": lg.logical_xor,
+        "isclose": lg.isclose, "allclose": lg.allclose,
+        "equal_all": lg.equal_all, "bitwise_and": lg.bitwise_and,
+        "bitwise_or": lg.bitwise_or, "bitwise_xor": lg.bitwise_xor,
+        "bitwise_not": lg.bitwise_not,
+        # search
+        "argmax": s.argmax, "argmin": s.argmin, "argsort": s.argsort,
+        "sort": s.sort, "topk": s.topk, "nonzero": s.nonzero,
+        "unique": s.unique, "kthvalue": s.kthvalue, "mode": s.mode,
+        "searchsorted": s.searchsorted,
+        # linalg
+        "cholesky": linalg.cholesky, "inverse": linalg.inv,
+        "matrix_power": linalg.matrix_power, "det": linalg.det,
+        # nn
+        "softmax": nn_ops.softmax,
+        # creation-ish
+        "zeros_like": creation.zeros_like, "ones_like": creation.ones_like,
+        "full_like": creation.full_like,
+    }
+    for name, fn in methods.items():
+        setattr(T, name, meth(fn))
+
+
+_patch()

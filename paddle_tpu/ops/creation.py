@@ -1,0 +1,279 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py (to_tensor, zeros, ones,
+full, arange, eye, ...) and python/paddle/tensor/random.py. Random ops draw
+keys from the global Generator (core/rng.py) so they are trace-safe.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+from ..core import rng as rng_mod
+from ..core import trace as trace_mod
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _jdt(dtype, default="float32"):
+    return dtype_mod.to_jax_dtype(dtype if dtype is not None else default)
+
+
+def _register_created(t):
+    ctx = trace_mod.current_trace()
+    if ctx is not None:
+        ctx.register_created(t)
+    return t
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor. Python floats/lists default to float32 (reference
+    behavior); numpy arrays keep their dtype."""
+    if isinstance(data, Tensor):
+        out = Tensor(data.value, dtype=dtype, stop_gradient=stop_gradient)
+        return _register_created(out)
+    if dtype is None:
+        if isinstance(data, (bool, np.bool_)):
+            pass
+        elif isinstance(data, (int, np.integer)):
+            dtype = "int64"
+        elif isinstance(data, float):
+            dtype = "float32"
+        elif isinstance(data, (list, tuple)):
+            a = np.asarray(data)
+            if a.dtype == np.float64:
+                dtype = "float32"
+        elif isinstance(data, np.ndarray) and data.dtype == np.float64:
+            dtype = None  # numpy keeps dtype, paddle-style
+    out = Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+    return _register_created(out)
+
+
+def zeros(shape, dtype=None, name=None):
+    return _register_created(Tensor(jnp.zeros(_norm_shape(shape), _jdt(dtype))))
+
+
+def ones(shape, dtype=None, name=None):
+    return _register_created(Tensor(jnp.ones(_norm_shape(shape), _jdt(dtype))))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _register_created(
+        Tensor(jnp.full(_norm_shape(shape), fill_value, _jdt(dtype))))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@register_op("zeros_like", differentiable=False)
+def _zeros_like(x, *, dtype):
+    return jnp.zeros(x.shape, dtype if dtype is not None else x.dtype)
+
+
+@register_op("ones_like", differentiable=False)
+def _ones_like(x, *, dtype):
+    return jnp.ones(x.shape, dtype if dtype is not None else x.dtype)
+
+
+@register_op("full_like", differentiable=False)
+def _full_like(x, *, fill_value, dtype):
+    return jnp.full(x.shape, fill_value, dtype if dtype is not None else x.dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _zeros_like(x, dtype=_jdt(dtype, None) if dtype else None)
+
+
+def ones_like(x, dtype=None, name=None):
+    return _ones_like(x, dtype=_jdt(dtype, None) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like(x, fill_value=float(fill_value),
+                      dtype=_jdt(dtype, None) if dtype else None)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange with Tensor bounds not supported")
+    if dtype is None:
+        dtype = ("float32" if any(isinstance(v, float) for v in (start, end, step))
+                 else "int64")
+    return _register_created(
+        Tensor(jnp.arange(start, end, step, dtype=_jdt(dtype))))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return _register_created(
+        Tensor(jnp.linspace(float(start), float(stop), int(num),
+                            dtype=_jdt(dtype))))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return _register_created(
+        Tensor(jnp.logspace(float(start), float(stop), int(num),
+                            base=float(base), dtype=_jdt(dtype))))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _register_created(
+        Tensor(jnp.eye(int(num_rows),
+                       int(num_columns) if num_columns is not None else None,
+                       dtype=_jdt(dtype))))
+
+
+@register_op("tril")
+def _tril(x, *, diagonal):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op("triu")
+def _triu(x, *, diagonal):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=int(diagonal))
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=int(diagonal))
+
+
+@register_op("diag")
+def _diag(x, *, offset, padding_value):
+    if x.ndim == 1:
+        out = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.eye(*out.shape, k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return jnp.diagonal(x, offset=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return _diag(x, offset=int(offset), padding_value=padding_value)
+
+
+def diagflat(x, offset=0, name=None):
+    from . import manipulation
+    return diag(manipulation.flatten(x), offset=offset)
+
+
+def assign(x, output=None):
+    """paddle.assign (reference: python/paddle/tensor/creation.py assign)."""
+    src = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return _register_created(Tensor(src))
+    output.value = src
+    return output
+
+
+def clone(x, name=None):
+    from . import math as math_ops
+    return math_ops.clone(x)
+
+
+# ---- random ---------------------------------------------------------------
+
+@register_op("uniform_random", differentiable=False)
+def _uniform(key, *, shape, dtype, minv, maxv):
+    return jax.random.uniform(key, shape, dtype=dtype, minval=minv, maxval=maxv)
+
+
+@register_op("gaussian_random", differentiable=False)
+def _normal(key, *, shape, dtype, mean, std):
+    return jax.random.normal(key, shape, dtype=dtype) * std + mean
+
+
+@register_op("randint", differentiable=False)
+def _randint(key, *, low, high, shape, dtype):
+    return jax.random.randint(key, shape, low, high, dtype=dtype)
+
+
+@register_op("randperm", differentiable=False)
+def _randperm(key, *, n, dtype):
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+@register_op("bernoulli", differentiable=False)
+def _bernoulli(x, key):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+@register_op("multinomial", differentiable=False)
+def _multinomial(x, key, *, num_samples, replacement):
+    logits = jnp.log(jnp.maximum(x, 1e-30))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1,
+                                      shape=x.shape[:-1] + (num_samples,))
+    # without replacement: gumbel top-k
+    g = jax.random.gumbel(key, x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = rng_mod.next_key()
+    return _uniform(key, shape=_norm_shape(shape), dtype=_jdt(dtype),
+                    minv=float(min), maxv=float(max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = rng_mod.next_key()
+    return _normal(key, shape=_norm_shape(shape), dtype=_jdt(None),
+                   mean=float(mean), std=float(std))
+
+
+def randn(shape, dtype=None, name=None):
+    key = rng_mod.next_key()
+    return _normal(key, shape=_norm_shape(shape), dtype=_jdt(dtype),
+                   mean=0.0, std=1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = rng_mod.next_key()
+    return _randint(key, low=int(low), high=int(high),
+                    shape=_norm_shape(shape), dtype=_jdt(dtype, "int64"))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = rng_mod.next_key()
+    return _randperm(key, n=int(n), dtype=_jdt(dtype, "int64"))
+
+
+def bernoulli(x, name=None):
+    return _bernoulli(x, rng_mod.next_key())
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _multinomial(x, rng_mod.next_key(), num_samples=int(num_samples),
+                        replacement=bool(replacement))
+
+
+def rand_like(x, dtype=None):
+    return uniform(tuple(x.shape), dtype or x.value.dtype, 0.0, 1.0)

@@ -1,0 +1,92 @@
+"""Tensor __getitem__ / __setitem__.
+
+Reference parity: pybind/imperative.cc VarBase __getitem__ slicing +
+set_value op. Static (int/slice/None/Ellipsis) index components are jit
+cache keys; Tensor index components are dynamic gather inputs.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+
+
+def _split_index(index):
+    """Returns (static_spec, dynamic_tensors). static_spec mirrors the index
+    structure with placeholders where dynamic tensors go."""
+    if not isinstance(index, tuple):
+        index = (index,)
+    spec = []
+    dyn = []
+    for it in index:
+        if isinstance(it, Tensor):
+            spec.append(("dyn", len(dyn)))
+            dyn.append(it)
+        elif isinstance(it, slice):
+            spec.append(("slice", it.start, it.stop, it.step))
+        elif it is None:
+            spec.append(("none",))
+        elif it is Ellipsis:
+            spec.append(("ellipsis",))
+        elif isinstance(it, (int, np.integer)):
+            spec.append(("int", int(it)))
+        elif isinstance(it, (list, np.ndarray)):
+            arr = np.asarray(it)
+            if arr.dtype == bool:
+                spec.append(("dyn", len(dyn)))
+                dyn.append(Tensor(jnp.asarray(arr)))
+            else:
+                spec.append(("dyn", len(dyn)))
+                dyn.append(Tensor(jnp.asarray(arr)))
+        else:
+            raise TypeError(f"unsupported index component {it!r}")
+    return tuple(spec), dyn
+
+
+def _rebuild_index(spec, dyn_arrays):
+    idx = []
+    for s in spec:
+        kind = s[0]
+        if kind == "dyn":
+            idx.append(dyn_arrays[s[1]])
+        elif kind == "slice":
+            idx.append(slice(s[1], s[2], s[3]))
+        elif kind == "none":
+            idx.append(None)
+        elif kind == "ellipsis":
+            idx.append(Ellipsis)
+        elif kind == "int":
+            idx.append(s[1])
+    return tuple(idx)
+
+
+@register_op("getitem")
+def _getitem(x, *dyn, spec):
+    idx = _rebuild_index(spec, dyn)
+    return x[idx]
+
+
+@register_op("setitem")
+def _setitem(x, v, *dyn, spec):
+    idx = _rebuild_index(spec, dyn)
+    return x.at[idx].set(v.astype(x.dtype))
+
+
+def getitem(x, index):
+    # bool mask over whole tensor -> dynamic shape, eager only
+    if isinstance(index, Tensor) and index.value.dtype == jnp.bool_:
+        from . import manipulation
+        return manipulation.masked_select(x, index)
+    spec, dyn = _split_index(index)
+    return _getitem(x, *dyn, spec=spec)
+
+
+def setitem(x, index, value):
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value, x.value.dtype))
+    spec, dyn = _split_index(index)
+    out = _setitem(x, value, *dyn, spec=spec)
+    x.value = out.value if isinstance(out, Tensor) else out
+    # __setitem__ is in-place: autograd through it is not tracked for the
+    # overwritten slots (reference set_value op behaves the same for leaf).
+    return x

@@ -1,0 +1,161 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from .math import matmul, bmm, dot, mv  # noqa: F401  re-export
+from .reduction import norm, dist  # noqa: F401
+
+
+@register_op("cholesky")
+def _cholesky(x, *, upper):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+def cholesky(x, upper=False, name=None):
+    return _cholesky(x, upper=bool(upper))
+
+
+@register_op("inverse")
+def _inv(x):
+    return jnp.linalg.inv(x)
+
+
+def inv(x, name=None):
+    return _inv(x)
+
+
+inverse = inv
+
+
+@register_op("matrix_power")
+def _matrix_power(x, *, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def matrix_power(x, n, name=None):
+    return _matrix_power(x, n=int(n))
+
+
+@register_op("det")
+def _det(x):
+    return jnp.linalg.det(x)
+
+
+def det(x, name=None):
+    return _det(x)
+
+
+@register_op("slogdet")
+def _slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+def slogdet(x, name=None):
+    return _slogdet(x)
+
+
+@register_op("solve")
+def _solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+def solve(x, y, name=None):
+    return _solve(x, y)
+
+
+@register_op("triangular_solve")
+def _triangular_solve(a, b, *, upper, transpose, unitriangular):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return _triangular_solve(x, y, upper=bool(upper), transpose=bool(transpose),
+                             unitriangular=bool(unitriangular))
+
+
+@register_op("svd", differentiable=False)
+def _svd(x, *, full_matrices):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+def svd(x, full_matrices=False, name=None):
+    return _svd(x, full_matrices=bool(full_matrices))
+
+
+@register_op("qr", differentiable=False)
+def _qr(x, *, mode):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def qr(x, mode="reduced", name=None):
+    return _qr(x, mode=mode)
+
+
+@register_op("eigh", differentiable=False)
+def _eigh(x, *, uplo):
+    return jnp.linalg.eigh(x, UPLO=uplo)
+
+
+def eigh(x, UPLO="L", name=None):
+    return _eigh(x, uplo=UPLO)
+
+
+@register_op("eigvalsh", differentiable=False)
+def _eigvalsh(x, *, uplo):
+    return jnp.linalg.eigvalsh(x, UPLO=uplo)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return _eigvalsh(x, uplo=UPLO)
+
+
+@register_op("pinv", differentiable=False)
+def _pinv(x, *, rcond):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return _pinv(x, rcond=float(rcond))
+
+
+@register_op("matrix_rank", differentiable=False)
+def _matrix_rank(x, *, tol):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return _matrix_rank(x, tol=tol)
+
+
+@register_op("lstsq", differentiable=False)
+def _lstsq(a, b):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b)
+    return sol, res, rank, sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return _lstsq(x, y)
+
+
+@register_op("multi_dot")
+def _multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+def multi_dot(x, name=None):
+    return _multi_dot(*x)
+
+
+@register_op("cond_number", differentiable=False)
+def _cond(x, *, p):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    return _cond(x, p=p)

@@ -1,0 +1,79 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from .math import _wrap_scalar
+
+
+def _cmp(name, fn):
+    op = register_op(name, differentiable=False)(fn)
+
+    def api(x, y, name=None):
+        x = _wrap_scalar(x, y)
+        y = _wrap_scalar(y, x)
+        return op(x, y)
+    api.__name__ = name
+    return api
+
+
+equal = _cmp("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _cmp("not_equal", lambda x, y: jnp.not_equal(x, y))
+greater_than = _cmp("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _cmp("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+less_than = _cmp("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _cmp("less_equal", lambda x, y: jnp.less_equal(x, y))
+logical_and = _cmp("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _cmp("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _cmp("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+bitwise_and = _cmp("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+bitwise_or = _cmp("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+bitwise_xor = _cmp("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+
+
+@register_op("logical_not", differentiable=False)
+def _logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_not(x, name=None):
+    return _logical_not(x)
+
+
+@register_op("bitwise_not", differentiable=False)
+def _bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def bitwise_not(x, name=None):
+    return _bitwise_not(x)
+
+
+@register_op("isclose", differentiable=False)
+def _isclose(x, y, *, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return _isclose(x, y, rtol=float(rtol), atol=float(atol),
+                    equal_nan=bool(equal_nan))
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    from . import reduction
+    return reduction.all(isclose(x, y, rtol, atol, equal_nan))
+
+
+def equal_all(x, y, name=None):
+    if tuple(x.shape) != tuple(y.shape):
+        return Tensor(jnp.asarray(False))
+    from . import reduction
+    return reduction.all(equal(x, y))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

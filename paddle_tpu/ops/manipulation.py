@@ -1,0 +1,488 @@
+"""Shape / layout manipulation ops.
+
+Reference parity: python/paddle/tensor/manipulation.py (reshape, transpose,
+concat, split, gather, scatter, ...) over the reference C++ ops
+(reshape_op, transpose_op, concat_op, gather_op, ...).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+
+
+def _shape_tuple(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s) for s in shape)
+
+
+@register_op("reshape")
+def _reshape(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    return _reshape(x, shape=_shape_tuple(shape))
+
+
+def reshape_(x, shape, name=None):
+    x.value = jnp.reshape(x.value, _shape_tuple(shape))
+    return x
+
+
+@register_op("transpose2")
+def _transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=tuple(int(p) for p in perm))
+
+
+@register_op("t_op")
+def _t(x):
+    return x.T
+
+
+def t(x, name=None):
+    return _t(x)
+
+
+@register_op("flatten2")
+def _flatten(x, *, start_axis, stop_axis):
+    shape = x.shape
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    so = stop_axis % nd if nd else 0
+    new_shape = shape[:sa] + (-1,) + shape[so + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+@register_op("squeeze2")
+def _squeeze(x, *, axes):
+    if not axes:
+        return jnp.squeeze(x)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return _squeeze(x, axes=())
+    if isinstance(axis, int):
+        axis = [axis]
+    return _squeeze(x, axes=tuple(int(a) for a in axis))
+
+
+@register_op("unsqueeze2")
+def _unsqueeze(x, *, axes):
+    for a in sorted(axes):
+        x = jnp.expand_dims(x, a)
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _unsqueeze(x, axes=tuple(int(a) for a in axis))
+
+
+unsqueeze_ = unsqueeze
+
+
+@register_op("concat")
+def _concat(*xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(*x, axis=int(axis))
+
+
+@register_op("stack")
+def _stack(*xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*x, axis=int(axis))
+
+
+@register_op("split")
+def _split(x, *, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, int):
+        sections = int(num_or_sections)
+    else:
+        secs = [int(s) for s in num_or_sections]
+        total = x.shape[int(axis)]
+        neg = [i for i, s in enumerate(secs) if s < 0]
+        if neg:
+            known = sum(s for s in secs if s >= 0)
+            secs[neg[0]] = total - known
+        sections = tuple(secs)
+    out = _split(x, sections=sections, axis=int(axis))
+    return list(out)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[int(axis)]
+    outs = split(x, n, axis)
+    return [squeeze(o, axis=int(axis)) for o in outs]
+
+
+@register_op("slice")
+def _slice(x, *, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    starts = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e.item()) if isinstance(e, Tensor) else int(e) for e in ends]
+    return _slice(x, axes=tuple(int(a) for a in axes), starts=tuple(starts),
+                  ends=tuple(ends), strides=(1,) * len(axes))
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    return _slice(x, axes=tuple(int(a) for a in axes),
+                  starts=tuple(int(s) for s in starts),
+                  ends=tuple(int(e) for e in ends),
+                  strides=tuple(int(s) for s in strides))
+
+
+@register_op("gather")
+def _gather(x, index, *, axis):
+    if index.ndim == 0:
+        index = index[None]
+    return jnp.take(x, index, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _gather(x, index, axis=int(axis))
+
+
+@register_op("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(x, index)
+
+
+@register_op("take_along_axis")
+def _take_along_axis(x, index, *, axis):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def take_along_axis(x, indices, axis, name=None):
+    return _take_along_axis(x, indices, axis=int(axis))
+
+
+@register_op("put_along_axis")
+def _put_along_axis(x, index, value, *, axis, reduce):
+    value_b = jnp.broadcast_to(value, index.shape).astype(x.dtype)
+    idxs = list(jnp.indices(index.shape, sparse=True))
+    idxs[axis] = index
+    if reduce == "assign":
+        return x.at[tuple(idxs)].set(value_b)
+    if reduce == "add":
+        return x.at[tuple(idxs)].add(value_b)
+    if reduce in ("mul", "multiply"):
+        return x.at[tuple(idxs)].multiply(value_b)
+    raise ValueError(reduce)
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values, x.value.dtype))
+    return _put_along_axis(x, indices, values, axis=int(axis), reduce=reduce)
+
+
+@register_op("index_select")
+def _index_select(x, index, *, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, axis=int(axis))
+
+
+@register_op("index_sample")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index):
+    return _index_sample(x, index)
+
+
+@register_op("scatter")
+def _scatter(x, index, updates, *, overwrite):
+    if index.ndim == 2:
+        index = index[:, 0]
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle scatter with overwrite=False sums duplicates after zeroing
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, overwrite=bool(overwrite))
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(x, index, updates)
+
+
+@register_op("tile")
+def _tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    return _tile(x, repeat_times=_shape_tuple(repeat_times))
+
+
+@register_op("expand_v2")
+def _expand(x, *, shape):
+    offset = len(shape) - x.ndim
+    full = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - offset] if i >= offset else 1)
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, tuple(full))
+
+
+def expand(x, shape, name=None):
+    return _expand(x, shape=_shape_tuple(shape))
+
+
+def expand_as(x, y, name=None):
+    return _expand(x, shape=tuple(y.shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+@register_op("broadcast_tensors")
+def _broadcast_tensors(*xs):
+    return tuple(jnp.broadcast_arrays(*xs))
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(_broadcast_tensors(*inputs))
+
+
+@register_op("flip")
+def _flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def flip(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _flip(x, axis=tuple(int(a) for a in axis))
+
+
+@register_op("roll")
+def _roll(x, *, shifts, axis):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    else:
+        shifts = int(shifts)
+    if axis is not None:
+        axis = tuple(int(a) for a in axis) if isinstance(axis, (list, tuple)) else int(axis)
+    return _roll(x, shifts=shifts, axis=axis)
+
+
+@register_op("rot90")
+def _rot90(x, *, k, axes):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return _rot90(x, k=int(k), axes=tuple(axes))
+
+
+@register_op("repeat_interleave")
+def _repeat_interleave(x, *, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return _repeat_interleave(x, repeats=int(repeats),
+                              axis=None if axis is None else int(axis))
+
+
+@register_op("pad3d")
+def _pad(x, *, paddings, mode, value):
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, paddings, mode=jmode, constant_values=value)
+    return jnp.pad(x, paddings, mode=jmode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """paddle.nn.functional.pad. `pad` is [left,right,top,bottom,...] pairs on
+    trailing dims (paddle convention) or full per-dim list."""
+    pad = [int(p) for p in (pad.tolist() if isinstance(pad, Tensor) else pad)]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        paddings = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        npairs = len(pad) // 2
+        paddings = [(0, 0)] * nd
+        if data_format.endswith("C") and nd >= 3:  # NHWC-style: pad spatial dims
+            dims = range(1, 1 + npairs)
+        else:  # NCHW-style: pad trailing dims, reversed pair order
+            dims = range(nd - 1, nd - 1 - npairs, -1)
+        for i, d in enumerate(dims):
+            paddings[d] = (pad[2 * i], pad[2 * i + 1])
+        paddings = tuple(paddings)
+    return _pad(x, paddings=paddings, mode=mode, value=float(value))
+
+
+@register_op("where_op")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from . import search
+        return search.nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+@register_op("masked_select")
+def _masked_select(x, mask):
+    # dynamic-size output: fall back to host (reference returns dynamic shape;
+    # on XLA this is inherently a sync point)
+    return x[mask]
+
+
+def masked_select(x, mask, name=None):
+    import jax.core as jcore
+    if isinstance(x.value, jcore.Tracer):
+        raise RuntimeError("masked_select has data-dependent shape and cannot "
+                           "be used inside jit; use paddle.where instead")
+    return Tensor(x.value[mask.value])
+
+
+def masked_fill(x, mask, value, name=None):
+    if isinstance(value, Tensor):
+        value = value.item()
+    vals = jnp.asarray(value, x.value.dtype)
+    return _where(mask, Tensor(jnp.broadcast_to(vals, ())), x)
+
+
+@register_op("meshgrid")
+def _meshgrid(*xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    return list(_meshgrid(*args))
+
+
+@register_op("shard_index", differentiable=False)
+def _shard_index(x, *, index_num, nshards, shard_id, ignore_value):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (x >= lo) & (x < hi)
+    return jnp.where(in_shard, x - lo, ignore_value)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    """Reference: operators/shard_index_op (used by TP vocab sharding)."""
+    return _shard_index(input, index_num=int(index_num), nshards=int(nshards),
+                        shard_id=int(shard_id), ignore_value=int(ignore_value))
+
+
+def numel(x):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(x.aval_shape(), jnp.int32))
+
+
+@register_op("unstack")
+def _unstack(x, *, axis, num):
+    return tuple(jnp.squeeze(s, axis) for s in jnp.split(x, num, axis=axis))
+
+
+def unstack(x, axis=0, num=None):
+    num = num or x.shape[axis]
+    return list(_unstack(x, axis=int(axis), num=int(num)))
+
+
+@register_op("unfold")
+def _unfold(x, *, kernel_sizes, strides, paddings, dilations):
+    n, c, h, w = x.shape
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + oh * sh:sh, j * dw:j * dw + ow * sw:sw]
+            cols.append(patch)
+    out = jnp.stack(cols, axis=2)  # N, C, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    return _unfold(x, kernel_sizes=_pair(kernel_sizes), strides=_pair(strides),
+                   paddings=_pair(paddings), dilations=_pair(dilations))

@@ -1,0 +1,330 @@
+"""Elementwise & general math ops.
+
+Reference parity: python/paddle/tensor/math.py and the reference C++
+elementwise/activation op family (paddle/fluid/operators/elementwise/,
+activation_op.cc). Each op is a pure jax function; broadcasting follows
+numpy semantics like the reference's elementwise ops with axis=-1.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+
+
+def _wrap_scalar(x, other):
+    """Convert python scalar to the dtype of the other operand (paddle
+    semantics: scalar adopts the tensor's dtype)."""
+    if isinstance(x, Tensor):
+        return x
+    dt = other.value.dtype if isinstance(other, Tensor) else None
+    arr = jnp.asarray(x, dtype=dt)
+    return Tensor(arr)
+
+
+def _binary(name, fn, differentiable=True):
+    op = register_op(name, differentiable=differentiable)(fn)
+
+    def api(x, y, name=None):
+        x = _wrap_scalar(x, y)
+        y = _wrap_scalar(y, x)
+        return op(x, y)
+    api.__name__ = name
+    return api
+
+
+def _unary(name, fn, differentiable=True):
+    op = register_op(name, differentiable=differentiable)(fn)
+
+    def api(x, name=None):
+        return op(x)
+    api.__name__ = name
+    return api
+
+
+add = _binary("elementwise_add", lambda x, y: jnp.add(x, y))
+subtract = _binary("elementwise_sub", lambda x, y: jnp.subtract(x, y))
+multiply = _binary("elementwise_mul", lambda x, y: jnp.multiply(x, y))
+divide = _binary("elementwise_div", lambda x, y: jnp.divide(x, y))
+floor_divide = _binary("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y),
+                       differentiable=False)
+remainder = _binary("elementwise_mod", lambda x, y: jnp.mod(x, y),
+                    differentiable=False)
+mod = remainder
+floor_mod = remainder
+maximum = _binary("elementwise_max", lambda x, y: jnp.maximum(x, y))
+minimum = _binary("elementwise_min", lambda x, y: jnp.minimum(x, y))
+fmax = _binary("elementwise_fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _binary("elementwise_fmin", lambda x, y: jnp.fmin(x, y))
+pow_ = _binary("elementwise_pow", lambda x, y: jnp.power(x, y))
+atan2 = _binary("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _binary("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _binary("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+heaviside = _binary("heaviside", lambda x, y: jnp.heaviside(x, y),
+                    differentiable=False)
+inner = _binary("inner_product", lambda x, y: jnp.inner(x, y))
+outer = _binary("outer", lambda x, y: jnp.outer(x, y))
+kron = _binary("kron", lambda x, y: jnp.kron(x, y))
+
+
+def pow(x, y, name=None):  # noqa: A001
+    return pow_(x, y)
+
+
+def divide_no_nan(x, y):
+    return _divide_no_nan(x, y)
+
+
+_divide_no_nan = register_op("divide_no_nan")(
+    lambda x, y: jnp.where(y == 0, jnp.zeros_like(x), x / jnp.where(y == 0, jnp.ones_like(y), y)))
+
+
+abs = _unary("abs", lambda x: jnp.abs(x))  # noqa: A001
+neg = _unary("neg", lambda x: jnp.negative(x))
+negative = neg
+exp = _unary("exp", lambda x: jnp.exp(x))
+expm1 = _unary("expm1", lambda x: jnp.expm1(x))
+log = _unary("log", lambda x: jnp.log(x))
+log2 = _unary("log2", lambda x: jnp.log2(x))
+log10 = _unary("log10", lambda x: jnp.log10(x))
+log1p = _unary("log1p", lambda x: jnp.log1p(x))
+sqrt = _unary("sqrt", lambda x: jnp.sqrt(x))
+rsqrt = _unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _unary("square", lambda x: jnp.square(x))
+sin = _unary("sin", lambda x: jnp.sin(x))
+cos = _unary("cos", lambda x: jnp.cos(x))
+tan = _unary("tan", lambda x: jnp.tan(x))
+asin = _unary("asin", lambda x: jnp.arcsin(x))
+acos = _unary("acos", lambda x: jnp.arccos(x))
+atan = _unary("atan", lambda x: jnp.arctan(x))
+sinh = _unary("sinh", lambda x: jnp.sinh(x))
+cosh = _unary("cosh", lambda x: jnp.cosh(x))
+tanh = _unary("tanh", lambda x: jnp.tanh(x))
+asinh = _unary("asinh", lambda x: jnp.arcsinh(x))
+acosh = _unary("acosh", lambda x: jnp.arccosh(x))
+atanh = _unary("atanh", lambda x: jnp.arctanh(x))
+floor = _unary("floor", lambda x: jnp.floor(x), differentiable=False)
+ceil = _unary("ceil", lambda x: jnp.ceil(x), differentiable=False)
+round = _unary("round", lambda x: jnp.round(x), differentiable=False)  # noqa: A001
+trunc = _unary("trunc", lambda x: jnp.trunc(x), differentiable=False)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sign = _unary("sign", lambda x: jnp.sign(x), differentiable=False)
+reciprocal = _unary("reciprocal", lambda x: jnp.reciprocal(x))
+erf = _unary("erf", lambda x: jax.scipy.special.erf(x))
+erfinv = _unary("erfinv", lambda x: jax.scipy.special.erfinv(x))
+lgamma = _unary("lgamma", lambda x: jax.scipy.special.gammaln(x))
+digamma = _unary("digamma", lambda x: jax.scipy.special.digamma(x))
+sigmoid = _unary("sigmoid", lambda x: jax.nn.sigmoid(x))
+i0 = _unary("i0", lambda x: jax.scipy.special.i0(x))
+angle = _unary("angle", lambda x: jnp.angle(x))
+conj = _unary("conj", lambda x: jnp.conjugate(x))
+real = _unary("real", lambda x: jnp.real(x))
+imag = _unary("imag", lambda x: jnp.imag(x))
+deg2rad = _unary("deg2rad", lambda x: jnp.deg2rad(x))
+rad2deg = _unary("rad2deg", lambda x: jnp.rad2deg(x))
+logit = _unary("logit", lambda x: jnp.log(x / (1 - x)))
+nan_to_num = _unary("nan_to_num", lambda x: jnp.nan_to_num(x))
+
+isnan = _unary("isnan", lambda x: jnp.isnan(x), differentiable=False)
+isinf = _unary("isinf", lambda x: jnp.isinf(x), differentiable=False)
+isfinite = _unary("isfinite", lambda x: jnp.isfinite(x), differentiable=False)
+
+
+@register_op("clone")
+def _clone(x):
+    return x + jnp.zeros((), x.dtype)
+
+
+def clone(x, name=None):
+    return _clone(x)
+
+
+@register_op("cast")
+def _cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return _cast(x, dtype=dtype_mod.to_jax_dtype(dtype))
+
+
+@register_op("scale")
+def _scale(x, *, scale, bias, bias_after_scale):
+    s = jnp.asarray(scale, x.dtype)
+    b = jnp.asarray(bias, x.dtype)
+    if bias_after_scale:
+        return x * s + b
+    return (x + b) * s
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """Reference: paddle.scale (operators/scale_op.cc)."""
+    if isinstance(scale, Tensor):
+        scale = float(scale.item())
+    out = _scale(x, scale=float(scale), bias=float(bias),
+                 bias_after_scale=bool(bias_after_scale))
+    if act is not None:
+        from . import nn_ops
+        out = getattr(nn_ops, act)(out)
+    return out
+
+
+@register_op("clip")
+def _clip(x, mn, mx):
+    return jnp.clip(x, mn, mx)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    mn = min.value if isinstance(min, Tensor) else (min if min is not None else -np.inf)
+    mx = max.value if isinstance(max, Tensor) else (max if max is not None else np.inf)
+    mn = jnp.asarray(mn, x.value.dtype)
+    mx = jnp.asarray(mx, x.value.dtype)
+    return _clip(x, Tensor(mn), Tensor(mx))
+
+
+@register_op("lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    if not isinstance(weight, Tensor):
+        weight = Tensor(jnp.asarray(weight, x.value.dtype))
+    return _lerp(x, y, weight)
+
+
+@register_op("matmul_v2")
+def _matmul(x, y, *, transpose_x, transpose_y):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _matmul(x, y, transpose_x=bool(transpose_x),
+                   transpose_y=bool(transpose_y))
+
+
+mm = matmul
+
+
+@register_op("bmm")
+def _bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return _bmm(x, y)
+
+
+@register_op("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def dot(x, y, name=None):
+    return _dot(x, y)
+
+
+@register_op("addmm")
+def _addmm(inp, x, y, *, beta, alpha):
+    return beta * inp + alpha * jnp.matmul(x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return _addmm(input, x, y, beta=float(beta), alpha=float(alpha))
+
+
+@register_op("mv")
+def _mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def mv(x, vec, name=None):
+    return _mv(x, vec)
+
+
+@register_op("cumsum")
+def _cumsum(x, *, axis):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _cumsum(x, axis=axis if axis is None else int(axis))
+    if dtype is not None:
+        out = cast(out, dtype)
+    return out
+
+
+@register_op("cumprod")
+def _cumprod(x, *, dim):
+    return jnp.cumprod(x, axis=dim)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod(x, dim=int(dim))
+    if dtype is not None:
+        out = cast(out, dtype)
+    return out
+
+
+@register_op("cummax", differentiable=False)
+def _cummax(x, *, axis):
+    return jax.lax.cummax(x, axis=axis)
+
+
+def cummax(x, axis=-1):
+    return _cummax(x, axis=int(axis))
+
+
+@register_op("stanh")
+def _stanh(x, *, scale_a, scale_b):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(x, scale_a=float(scale_a), scale_b=float(scale_b))
+
+
+def increment(x, value=1.0, name=None):
+    """In-place increment (reference: operators/increment_op)."""
+    x.value = x.value + jnp.asarray(value, x.value.dtype)
+    return x
+
+
+@register_op("einsum")
+def _einsum(*arrays, equation):
+    return jnp.einsum(equation, *arrays)
+
+
+def einsum(equation, *operands):
+    return _einsum(*operands, equation=equation)
+
+
+@register_op("trace_op")
+def _trace(x, *, offset, axis1, axis2):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+@register_op("diff")
+def _diff(x, *, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, name=None):
+    return _diff(x, n=int(n), axis=int(axis))
+
+
+def rsqrt_(x):
+    x.value = jax.lax.rsqrt(x.value)
+    return x

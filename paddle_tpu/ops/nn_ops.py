@@ -1,0 +1,977 @@
+"""Neural-net primitive ops.
+
+Reference parity: paddle/fluid/operators/ conv2d / pool2d / batch_norm /
+layer_norm / dropout / softmax_with_cross_entropy / activation families and
+python/paddle/nn/functional/. All are pure jax functions lowered through
+XLA's convolution/reduce-window/dot primitives, which map directly onto the
+TPU MXU / VPU — there is no cuDNN analogue to call; XLA *is* the vendor
+library on TPU.
+
+Layout note: paddle defaults to NCHW. XLA TPU internally prefers NHWC but
+`jax.lax.conv_general_dilated` takes dimension_numbers, letting XLA pick
+the optimal internal layout; we keep the user-visible NCHW contract.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..core import rng as rng_mod
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+# ---- activations -----------------------------------------------------------
+
+def _act(name, fn):
+    op = register_op(name)(fn)
+
+    def api(x, name=None):
+        return op(x)
+    api.__name__ = name
+    return api
+
+
+relu = _act("relu", lambda x: jax.nn.relu(x))
+relu6 = _act("relu6", lambda x: jax.nn.relu6(x))
+sigmoid = _act("sigmoid_act", lambda x: jax.nn.sigmoid(x))
+tanh = _act("tanh_act", lambda x: jnp.tanh(x))
+softplus_ = _act("softplus", lambda x: jax.nn.softplus(x))
+softsign = _act("softsign", lambda x: jax.nn.soft_sign(x))
+silu = _act("silu", lambda x: jax.nn.silu(x))
+swish = silu
+mish = _act("mish", lambda x: jax.nn.mish(x))
+hardswish = _act("hard_swish", lambda x: jax.nn.hard_swish(x))
+hardsigmoid = _act("hard_sigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+tanhshrink = _act("tanh_shrink", lambda x: x - jnp.tanh(x))
+log_sigmoid = _act("logsigmoid", lambda x: jax.nn.log_sigmoid(x))
+
+
+@register_op("gelu")
+def _gelu(x, *, approximate):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(x, approximate=bool(approximate))
+
+
+@register_op("leaky_relu")
+def _leaky_relu(x, *, alpha):
+    return jax.nn.leaky_relu(x, negative_slope=alpha)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(x, alpha=float(negative_slope))
+
+
+@register_op("elu")
+def _elu(x, *, alpha):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(x, alpha=float(alpha))
+
+
+@register_op("selu")
+def _selu(x, *, scale, alpha):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(x, scale=float(scale), alpha=float(alpha))
+
+
+@register_op("celu")
+def _celu(x, *, alpha):
+    return jax.nn.celu(x, alpha=alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(x, alpha=float(alpha))
+
+
+@register_op("hardtanh")
+def _hardtanh(x, *, mn, mx):
+    return jnp.clip(x, mn, mx)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return _hardtanh(x, mn=float(min), mx=float(max))
+
+
+@register_op("hard_shrink")
+def _hardshrink(x, *, threshold):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(x, threshold=float(threshold))
+
+
+@register_op("soft_shrink")
+def _softshrink(x, *, threshold):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, jnp.zeros_like(x)))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(x, threshold=float(threshold))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _softplus_full(x, beta=float(beta), threshold=float(threshold))
+
+
+@register_op("softplus_full")
+def _softplus_full(x, *, beta, threshold):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@register_op("thresholded_relu")
+def _thresholded_relu(x, *, threshold):
+    return jnp.where(x > threshold, x, jnp.zeros_like(x))
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _thresholded_relu(x, threshold=float(threshold))
+
+
+@register_op("prelu")
+def _prelu(x, weight, *, channel_axis):
+    shape = [1] * x.ndim
+    if weight.size > 1:
+        shape[channel_axis] = weight.size
+    w = weight.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    axis = 1 if data_format[1] == "C" else x.ndim - 1
+    return _prelu(x, weight, channel_axis=axis)
+
+
+@register_op("softmax")
+def _softmax(x, *, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = _softmax(x, axis=int(axis))
+    if dtype is not None:
+        from . import math as math_ops
+        out = math_ops.cast(out, dtype)
+    return out
+
+
+@register_op("log_softmax")
+def _log_softmax(x, *, axis):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _log_softmax(x, axis=int(axis))
+
+
+@register_op("glu")
+def _glu(x, *, axis):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(x, axis=int(axis))
+
+
+# ---- linear / conv ---------------------------------------------------------
+
+@register_op("linear")
+def _linear(x, w, b):
+    out = jnp.matmul(x, w)
+    if b is not None:
+        out = out + b
+    return out
+
+
+def linear(x, weight, bias=None, name=None):
+    """Reference: python/paddle/nn/functional/common.py:1398 (weight is
+    [in_features, out_features], NOT transposed — paddle convention)."""
+    return _linear(x, weight, bias)
+
+
+@register_op("conv2d")
+def _conv2d(x, w, b, *, strides, paddings, dilations, groups, data_format):
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"))
+    if isinstance(paddings, str):
+        pad = paddings  # SAME / VALID
+    else:
+        pad = tuple((p, p) for p in paddings) if len(paddings) == 2 else \
+            tuple((paddings[2 * i], paddings[2 * i + 1]) for i in range(2))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if b is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + b.reshape(bshape)
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """Reference: operators/conv_op.cc semantics; weight OIHW."""
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _pair(padding) if not (isinstance(padding, (list, tuple)) and len(padding) == 4) \
+            else tuple(int(p) for p in padding)
+    return _conv2d(x, weight, bias, strides=_pair(stride), paddings=pad,
+                   dilations=_pair(dilation), groups=int(groups),
+                   data_format=data_format)
+
+
+@register_op("conv1d")
+def _conv1d(x, w, b, *, stride, padding, dilation, groups):
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCH", "OIH", "NCH"))
+    pad = padding if isinstance(padding, str) else ((padding, padding),)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=pad, rhs_dilation=(dilation,),
+        dimension_numbers=dn, feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    pad = padding.upper() if isinstance(padding, str) else int(padding)
+    return _conv1d(x, weight, bias, stride=int(stride), padding=pad,
+                   dilation=int(dilation), groups=int(groups))
+
+
+@register_op("conv3d")
+def _conv3d(x, w, b, *, strides, paddings, dilations, groups):
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    pad = paddings if isinstance(paddings, str) else tuple((p, p) for p in paddings)
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=pad, rhs_dilation=dilations,
+        dimension_numbers=dn, feature_group_count=groups)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    pad = padding.upper() if isinstance(padding, str) else _pair(padding, 3)
+    return _conv3d(x, weight, bias, strides=_pair(stride, 3), paddings=pad,
+                   dilations=_pair(dilation, 3), groups=int(groups))
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(x, w, b, *, strides, paddings, output_padding, dilations,
+                      groups):
+    # paddle weight layout for transpose conv: [in, out/groups, kh, kw]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "IOHW", "NCHW"))
+    kh = (w.shape[2] - 1) * dilations[0] + 1
+    kw = (w.shape[3] - 1) * dilations[1] + 1
+    ph, pw = paddings
+    pad = ((kh - 1 - ph, kh - 1 - ph + output_padding[0]),
+           (kw - 1 - pw, kw - 1 - pw + output_padding[1]))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups, transpose_kernel=True)
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv2d_transpose(x, weight, bias, strides=_pair(stride),
+                             paddings=_pair(padding),
+                             output_padding=_pair(output_padding),
+                             dilations=_pair(dilation), groups=int(groups))
+
+
+# ---- pooling ---------------------------------------------------------------
+
+def _pool_windows(x, ksize, strides, paddings, pad_value):
+    """Yield the kh*kw strided window slices of x (differentiable pooling
+    building block: slice + elementwise reduce only — fuses well on TPU and
+    avoids reduce_window, whose vjp does not lower under jit on this
+    backend)."""
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    if ph or pw:
+        x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                    constant_values=pad_value)
+    h, w = x.shape[2], x.shape[3]
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    for i in range(kh):
+        for j in range(kw):
+            yield x[:, :, i:i + (oh - 1) * sh + 1:sh,
+                    j:j + (ow - 1) * sw + 1:sw]
+
+
+@register_op("pool2d_max")
+def _max_pool2d(x, *, ksize, strides, paddings, ceil_mode):
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    out = None
+    for win in _pool_windows(x, ksize, strides, paddings, neg):
+        out = win if out is None else jnp.maximum(out, win)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    out = _max_pool2d(x, ksize=ks, strides=st, paddings=_pair(padding),
+                      ceil_mode=bool(ceil_mode))
+    if return_mask:
+        raise NotImplementedError("return_mask not supported yet")
+    return out
+
+
+@register_op("pool2d_avg")
+def _avg_pool2d(x, *, ksize, strides, paddings, exclusive):
+    summed = None
+    for win in _pool_windows(x, ksize, strides, paddings, 0):
+        summed = win if summed is None else summed + win
+    if exclusive and (paddings[0] or paddings[1]):
+        # per-position valid-element counts are static: compute with numpy
+        kh, kw = ksize
+        sh, sw = strides
+        ph, pw = paddings
+        h, w = x.shape[2], x.shape[3]
+        ones = np.ones((1, 1, h, w), np.float32)
+        ones = np.pad(ones, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        oh = (h + 2 * ph - kh) // sh + 1
+        ow = (w + 2 * pw - kw) // sw + 1
+        counts = np.zeros((1, 1, oh, ow), np.float32)
+        for i in range(kh):
+            for j in range(kw):
+                counts += ones[:, :, i:i + (oh - 1) * sh + 1:sh,
+                               j:j + (ow - 1) * sw + 1:sw]
+        return summed / jnp.asarray(counts, x.dtype)
+    return summed / (ksize[0] * ksize[1])
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    return _avg_pool2d(x, ksize=ks, strides=st, paddings=_pair(padding),
+                       exclusive=bool(exclusive))
+
+
+@register_op("adaptive_avg_pool2d")
+def _adaptive_avg_pool2d(x, *, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    if h % oh == 0 and w % ow == 0:
+        x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return x4.mean(axis=(3, 5))
+    # general case: interpolate-style pooling
+    out = jax.image.resize(x, (n, c, oh, ow), method="linear")
+    return out
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_avg_pool2d(x, output_size=_pair(output_size))
+
+
+@register_op("adaptive_max_pool2d")
+def _adaptive_max_pool2d(x, *, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    assert h % oh == 0 and w % ow == 0, "adaptive_max_pool needs divisible sizes"
+    x4 = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    return x4.max(axis=(3, 5))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_max_pool2d(x, output_size=_pair(output_size))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, name=None):
+    from . import manipulation
+    x4 = manipulation.unsqueeze(x, axis=2)
+    out = max_pool2d(x4, (1, kernel_size), (1, stride or kernel_size),
+                     (0, padding if isinstance(padding, int) else padding[0]))
+    return manipulation.squeeze(out, axis=2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    from . import manipulation
+    x4 = manipulation.unsqueeze(x, axis=2)
+    out = avg_pool2d(x4, (1, kernel_size), (1, stride or kernel_size),
+                     (0, padding if isinstance(padding, int) else padding[0]),
+                     exclusive=exclusive)
+    return manipulation.squeeze(out, axis=2)
+
+
+# ---- normalization ---------------------------------------------------------
+
+@register_op("layer_norm")
+def _layer_norm(x, scale, bias, *, epsilon, begin_norm_axis):
+    axes = tuple(range(begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    inv = jax.lax.rsqrt(var + epsilon)
+    out = (x - mean) * inv
+    if scale is not None:
+        out = out * scale.reshape(x.shape[begin_norm_axis:])
+    if bias is not None:
+        out = out + bias.reshape(x.shape[begin_norm_axis:])
+    return out
+
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    """Reference: operators/layer_norm_op.cc; normalizes trailing dims."""
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n_norm = len(normalized_shape) if normalized_shape else 1
+    begin = x.ndim - n_norm
+    return _layer_norm(x, weight, bias, epsilon=float(epsilon),
+                       begin_norm_axis=int(begin))
+
+
+@register_op("batch_norm_infer")
+def _batch_norm_infer(x, mean, var, scale, bias, *, epsilon, channel_axis):
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op("batch_norm_train")
+def _batch_norm_train(x, scale, bias, *, epsilon, channel_axis):
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    inv = jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = (x - mean.reshape(shape)) * inv
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Reference: operators/batch_norm_op.cc. In training mode the running
+    stats tensors are updated in place (observable by the trace context)."""
+    ch_axis = 1 if data_format[1] == "C" or data_format == "NCL" else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if not training or use_global_stats:
+        return _batch_norm_infer(x, running_mean, running_var, weight, bias,
+                                 epsilon=float(epsilon), channel_axis=ch_axis)
+    out, batch_mean, batch_var = _batch_norm_train(
+        x, weight, bias, epsilon=float(epsilon), channel_axis=ch_axis)
+    if running_mean is not None:
+        m = float(momentum)
+        running_mean.value = running_mean.value * m + batch_mean.value * (1 - m)
+        running_var.value = running_var.value * m + batch_var.value * (1 - m)
+    return out
+
+
+@register_op("group_norm")
+def _group_norm(x, scale, bias, *, groups, epsilon):
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape((n, groups, c // groups) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = (1, c) + (1,) * len(spatial)
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    return _group_norm(x, weight, bias, groups=int(num_groups),
+                       epsilon=float(epsilon))
+
+
+@register_op("instance_norm")
+def _instance_norm(x, scale, bias, *, epsilon):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, training=True, momentum=0.9, epsilon=1e-5,
+                  data_format="NCHW", name=None):
+    return _instance_norm(x, weight, bias, epsilon=float(epsilon))
+
+
+@register_op("l2_normalize")
+def _normalize(x, *, p, axis, epsilon):
+    if p == 2.0:
+        nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    else:
+        nrm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(nrm, epsilon)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, p=float(p), axis=int(axis), epsilon=float(epsilon))
+
+
+@register_op("local_response_norm")
+def _lrn(x, *, size, alpha, beta, k):
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0)] * x.ndim
+    pad[1] = (half, size - half - 1)
+    sq = jnp.pad(sq, pad)
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + jax.lax.dynamic_slice_in_dim(sq, i, x.shape[1], axis=1)
+    return x / jnp.power(k + alpha * acc, beta)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    return _lrn(x, size=int(size), alpha=float(alpha), beta=float(beta),
+                k=float(k))
+
+
+# ---- dropout / embedding ---------------------------------------------------
+
+@register_op("dropout")
+def _dropout(x, key, *, p, upscale):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if upscale:
+        return jnp.where(mask, x / keep, jnp.zeros_like(x))
+    return jnp.where(mask, x, jnp.zeros_like(x))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """Reference: operators/dropout_op.cc; default mode upscale_in_train."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from . import math as math_ops
+            return math_ops.scale(x, scale=1.0 - p)
+        return x
+    key = rng_mod.next_key()
+    return _dropout(x, key, p=float(p), upscale=(mode == "upscale_in_train"))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    key = rng_mod.next_key()
+    return _dropout2d(x, key, p=float(p))
+
+
+@register_op("dropout2d")
+def _dropout2d(x, key, *, p):
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape[:2] + (1, 1))
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+@register_op("lookup_table_v2")
+def _embedding(ids, weight, *, padding_idx):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, jnp.zeros_like(out))
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: operators/lookup_table_v2_op. `sparse` (SelectedRows grads)
+    is a no-op here: XLA handles scatter-add gradients densely and efficiently."""
+    pi = -1 if padding_idx is None else int(padding_idx)
+    if pi < 0 and padding_idx is not None:
+        pi = weight.shape[0] + int(padding_idx)
+    return _embedding(x, weight, padding_idx=pi if padding_idx is not None else None)
+
+
+@register_op("one_hot_v2", differentiable=False)
+def _one_hot(x, *, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def one_hot(x, num_classes, name=None):
+    return _one_hot(x, num_classes=int(num_classes))
+
+
+# ---- losses ----------------------------------------------------------------
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_ce(logits, label, *, soft_label, axis, ignore_index):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim:
+            lab = jnp.squeeze(lab, axis=axis)
+        safe_lab = jnp.where(lab == ignore_index, jnp.zeros_like(lab), lab)
+        gathered = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_lab, axis).astype(jnp.int32), axis=axis)
+        loss = -gathered
+        mask = jnp.expand_dims(lab, axis) != ignore_index
+        loss = jnp.where(mask, loss, jnp.zeros_like(loss))
+    return loss
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False, name=None):
+    loss = _softmax_with_ce(logits, label, soft_label=bool(soft_label),
+                            axis=int(axis), ignore_index=int(ignore_index))
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    """Reference: python/paddle/nn/functional/loss.py cross_entropy."""
+    from . import math as math_ops, reduction as red_ops
+    if use_softmax:
+        loss = softmax_with_cross_entropy(input, label, soft_label=soft_label,
+                                          ignore_index=ignore_index, axis=axis)
+    else:
+        loss = _nll_from_probs(input, label, axis=int(axis))
+    from . import manipulation
+    loss = manipulation.squeeze(loss, axis=int(axis))
+    if weight is not None:
+        w = _gather_weight(weight, label, soft_label, axis)
+        loss = math_ops.multiply(loss, w)
+    if reduction == "mean":
+        if not soft_label:
+            # mean over non-ignored positions; weighted mean divides by the
+            # sum of gathered weights (reference: nn/functional/loss.py)
+            valid = _valid_mask(label, ignore_index, axis)
+            s = red_ops.sum(loss)
+            if weight is not None:
+                w = math_ops.multiply(
+                    _gather_weight(weight, label, soft_label, axis), valid)
+                n = red_ops.sum(w)
+            else:
+                n = red_ops.sum(valid)
+            return math_ops.divide(s, math_ops.maximum(n, 1e-12))
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("nll_from_probs")
+def _nll_from_probs(probs, label, *, axis):
+    logp = jnp.log(jnp.maximum(probs, 1e-30))
+    lab = label
+    if lab.ndim == probs.ndim:
+        lab = jnp.squeeze(lab, axis=axis)
+    return -jnp.take_along_axis(logp, jnp.expand_dims(lab, axis).astype(jnp.int32),
+                                axis=axis)
+
+
+@register_op("valid_mask", differentiable=False)
+def _valid_mask_op(label, *, ignore_index):
+    return (label != ignore_index).astype(jnp.float32)
+
+
+def _valid_mask(label, ignore_index, axis):
+    return _valid_mask_op(label, ignore_index=int(ignore_index))
+
+
+def _gather_weight(weight, label, soft_label, axis):
+    from . import manipulation
+    if soft_label:
+        raise NotImplementedError
+    return manipulation.gather(weight, label)
+
+
+@register_op("mse_loss")
+def _mse(x, y):
+    return jnp.square(x - y)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    from . import reduction as red_ops
+    loss = _mse(input, label)
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("l1_loss")
+def _l1(x, y):
+    return jnp.abs(x - y)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    from . import reduction as red_ops
+    loss = _l1(input, label)
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(x, y, *, delta):
+    diff = jnp.abs(x - y)
+    return jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    from . import reduction as red_ops
+    loss = _smooth_l1(input, label, delta=float(delta))
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("bce_with_logits")
+def _bce_logits(logits, label, pos_weight):
+    # stable: max(x,0) - x*z + log(1 + exp(-|x|)), with optional pos_weight
+    softplus_term = jnp.maximum(logits, 0.0) - logits * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    if pos_weight is None:
+        return softplus_term
+    log_weight = (pos_weight - 1.0) * label + 1.0
+    return (1.0 - label) * logits + log_weight * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits))) + jnp.maximum(-logits, 0.0))
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    from . import math as math_ops, reduction as red_ops
+    loss = _bce_logits(logit, label, pos_weight)
+    if weight is not None:
+        loss = math_ops.multiply(loss, weight)
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("bce")
+def _bce(x, label):
+    x = jnp.clip(x, 1e-12, 1.0 - 1e-12)
+    return -(label * jnp.log(x) + (1.0 - label) * jnp.log1p(-x))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    from . import math as math_ops, reduction as red_ops
+    loss = _bce(input, label)
+    if weight is not None:
+        loss = math_ops.multiply(loss, weight)
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("nll_loss")
+def _nll_loss(logp, label, *, ignore_index):
+    safe = jnp.where(label == ignore_index, jnp.zeros_like(label), label)
+    g = jnp.take_along_axis(logp, safe[:, None].astype(jnp.int32), axis=1)
+    loss = -jnp.squeeze(g, axis=1)
+    loss = jnp.where(label != ignore_index, loss, jnp.zeros_like(loss))
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",  # noqa: A002
+             name=None):
+    from . import reduction as red_ops
+    loss = _nll_loss(input, label, ignore_index=int(ignore_index))
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("kldiv_loss")
+def _kl_div(x, label):
+    return label * (jnp.log(jnp.maximum(label, 1e-30)) - x)
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    from . import reduction as red_ops
+    loss = _kl_div(input, label)
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    if reduction == "batchmean":
+        from . import math as math_ops
+        return math_ops.divide(red_ops.sum(loss), float(input.shape[0]))
+    return loss
+
+
+@register_op("square_error_cost")
+def _square_error(x, y):
+    return jnp.square(x - y)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    return _square_error(input, label)
+
+
+@register_op("margin_ranking_loss")
+def _margin_rank(x, y, label, *, margin):
+    return jnp.maximum(-label * (x - y) + margin, 0.0)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    from . import reduction as red_ops
+    loss = _margin_rank(input, other, label, margin=float(margin))
+    if reduction == "mean":
+        return red_ops.mean(loss)
+    if reduction == "sum":
+        return red_ops.sum(loss)
+    return loss
+
+
+@register_op("cosine_similarity")
+def _cos_sim(x1, x2, *, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cos_sim(x1, x2, axis=int(axis), eps=float(eps))
+
+
+# ---- misc ------------------------------------------------------------------
+
+@register_op("interpolate_nearest")
+def _interp(x, *, size, method, align_corners):
+    n, c = x.shape[:2]
+    out_shape = (n, c) + size
+    jmethod = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+               "trilinear": "linear", "linear": "linear"}[method]
+    return jax.image.resize(x, out_shape, method=jmethod)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    if size is None:
+        spatial = x.shape[2:]
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = tuple(int(s * f) for s, f in zip(spatial, scale_factor))
+    else:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        size = tuple(int(s) for s in size)
+    return _interp(x, size=size, method=mode, align_corners=bool(align_corners))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners)
+
+
+@register_op("pixel_shuffle")
+def _pixel_shuffle(x, *, upscale_factor):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(x, upscale_factor=int(upscale_factor))
+
+
+@register_op("label_smooth")
+def _label_smooth(label, *, epsilon):
+    n = label.shape[-1]
+    return label * (1.0 - epsilon) + epsilon / n
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    return _label_smooth(label, epsilon=float(epsilon))
+
+
+@register_op("temporal_shift")
+def _temporal_shift(x, *, seg_num, shift_ratio):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                             xr[:, :-1, fold:2 * fold]], axis=1)
+    rest = xr[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _temporal_shift(x, seg_num=int(seg_num), shift_ratio=float(shift_ratio))

@@ -1,0 +1,132 @@
+"""Reduction ops.
+
+Reference parity: paddle/fluid/operators/reduce_ops/ and
+python/paddle/tensor/math.py sum/mean/... + stat.py std/var/median.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core import dtype as dtype_mod
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, jfn, differentiable=True):
+    @register_op(name, differentiable=differentiable)
+    def _op(x, *, axis, keepdim):
+        return jfn(x, axis=axis, keepdims=keepdim)
+
+    def api(x, axis=None, keepdim=False, name=None, dtype=None):
+        out = _op(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+        if dtype is not None:
+            from . import math as math_ops
+            out = math_ops.cast(out, dtype)
+        return out
+    api.__name__ = name
+    return api
+
+
+sum = _make_reduce("reduce_sum", jnp.sum)  # noqa: A001
+mean = _make_reduce("reduce_mean", jnp.mean)
+max = _make_reduce("reduce_max", jnp.max)  # noqa: A001
+min = _make_reduce("reduce_min", jnp.min)  # noqa: A001
+prod = _make_reduce("reduce_prod", jnp.prod)
+all = _make_reduce("reduce_all", jnp.all, differentiable=False)  # noqa: A001
+any = _make_reduce("reduce_any", jnp.any, differentiable=False)  # noqa: A001
+amax = max
+amin = min
+nansum = _make_reduce("reduce_nansum", jnp.nansum)
+nanmean = _make_reduce("reduce_nanmean", jnp.nanmean)
+
+
+@register_op("reduce_std")
+def _std(x, *, axis, keepdim, unbiased):
+    return jnp.std(x, axis=axis, keepdims=keepdim, ddof=1 if unbiased else 0)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_norm_axis(axis), keepdim=bool(keepdim),
+                unbiased=bool(unbiased))
+
+
+@register_op("reduce_var")
+def _var(x, *, axis, keepdim, unbiased):
+    return jnp.var(x, axis=axis, keepdims=keepdim, ddof=1 if unbiased else 0)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_norm_axis(axis), keepdim=bool(keepdim),
+                unbiased=bool(unbiased))
+
+
+@register_op("median")
+def _median(x, *, axis, keepdim):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _median(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@register_op("quantile")
+def _quantile(x, *, q, axis, keepdim):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return _quantile(x, q=float(q) if not isinstance(q, (list, tuple)) else tuple(q),
+                     axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@register_op("logsumexp")
+def _logsumexp(x, *, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@register_op("count_nonzero", differentiable=False)
+def _count_nonzero(x, *, axis, keepdim):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _count_nonzero(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@register_op("p_norm")
+def _p_norm(x, *, p, axis, keepdim):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@register_op("frobenius_norm")
+def _fro_norm(x, *, axis, keepdim):
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    """paddle.linalg.norm subset: fro, p-norms along axis."""
+    if p == "fro":
+        ax = _norm_axis(axis)
+        if isinstance(ax, int):
+            ax = (ax,)
+        return _fro_norm(x, axis=ax, keepdim=bool(keepdim))
+    return _p_norm(x, p=float(p), axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+def dist(x, y, p=2.0):
+    from . import math as math_ops
+    return norm(math_ops.subtract(x, y), p=float(p))

@@ -1,0 +1,159 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+
+
+@register_op("arg_max", differentiable=False)
+def _argmax(x, *, axis, keepdim, flatten):
+    if flatten:
+        return jnp.argmax(x.reshape(-1))
+    out = jnp.argmax(x, axis=axis)
+    if keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(x, axis=axis if axis is None else int(axis),
+                   keepdim=bool(keepdim), flatten=axis is None)
+
+
+@register_op("arg_min", differentiable=False)
+def _argmin(x, *, axis, keepdim, flatten):
+    if flatten:
+        return jnp.argmin(x.reshape(-1))
+    out = jnp.argmin(x, axis=axis)
+    if keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(x, axis=axis if axis is None else int(axis),
+                   keepdim=bool(keepdim), flatten=axis is None)
+
+
+@register_op("top_k_v2")
+def _topk(x, *, k, axis, largest, sorted_):
+    if not largest:
+        neg_vals, idx = jax.lax.top_k(jnp.moveaxis(-x, axis, -1), k)
+        vals = -neg_vals
+    else:
+        vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    vals, idx = _topk(x, k=int(k), axis=int(axis), largest=bool(largest),
+                      sorted_=bool(sorted))
+    return vals, idx
+
+
+@register_op("argsort", differentiable=False)
+def _argsort(x, *, axis, descending):
+    idx = jnp.argsort(x, axis=axis, descending=descending)
+    return idx
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _argsort(x, axis=int(axis), descending=bool(descending))
+
+
+@register_op("sort")
+def _sort(x, *, axis, descending):
+    out = jnp.sort(x, axis=axis, descending=descending)
+    return out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _sort(x, axis=int(axis), descending=bool(descending))
+
+
+def nonzero(x, as_tuple=False):
+    """Data-dependent output shape: eager-only (sync point), like the
+    reference's dynamic-shape where_index op."""
+    import jax.core as jcore
+    if isinstance(x.value, jcore.Tracer):
+        raise RuntimeError("nonzero has data-dependent shape; not usable in jit")
+    idx = jnp.nonzero(x.value)
+    if as_tuple:
+        return tuple(Tensor(i) for i in idx)
+    return Tensor(jnp.stack(idx, axis=1))
+
+
+@register_op("searchsorted", differentiable=False)
+def _searchsorted(sorted_seq, values, *, right):
+    return jnp.searchsorted(sorted_seq, values, side="right" if right else "left")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    return _searchsorted(sorted_sequence, values, right=bool(right))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Eager-only (dynamic output shape), like reference unique op."""
+    import jax.core as jcore
+    if isinstance(x.value, jcore.Tracer):
+        raise RuntimeError("unique has data-dependent shape; not usable in jit")
+    res = jnp.unique(x.value, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+@register_op("kthvalue")
+def _kthvalue(x, *, k, axis, keepdim):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    take = jax.lax.index_in_dim(vals, k - 1, axis, keepdims=keepdim)
+    take_i = jax.lax.index_in_dim(idxs, k - 1, axis, keepdims=keepdim)
+    return take, take_i
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    return _kthvalue(x, k=int(k), axis=int(axis), keepdim=bool(keepdim))
+
+
+@register_op("mode")
+def _mode(x, *, axis, keepdim):
+    sorted_x = jnp.sort(x, axis=axis)
+    # mode = most frequent; approximate via median of sorted for floats is
+    # wrong, so do a proper count along axis using broadcasting
+    def mode_1d(v):
+        vals, counts = jnp.unique_counts(v, size=v.shape[0])
+        return vals[jnp.argmax(counts)]
+    moved = jnp.moveaxis(sorted_x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    modes = jax.vmap(mode_1d)(flat)
+    out = modes.reshape(moved.shape[:-1])
+    if keepdim:
+        out = jnp.expand_dims(out, axis)
+    return out
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    vals = _mode(x, axis=int(axis), keepdim=bool(keepdim))
+    return vals
+
+
+def masked_select(x, mask, name=None):
+    from . import manipulation
+    return manipulation.masked_select(x, mask)
+
+
+def index_sample(x, index):
+    from . import manipulation
+    return manipulation.index_sample(x, index)
+
+
+def where(condition, x=None, y=None, name=None):
+    from . import manipulation
+    return manipulation.where(condition, x, y, name)

@@ -1,0 +1,6 @@
+"""paddle.optimizer equivalent (reference: python/paddle/optimizer/)."""
+from .optimizer import Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Lamb,
+)
+from . import lr  # noqa: F401

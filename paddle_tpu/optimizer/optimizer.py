@@ -1,0 +1,146 @@
+"""Optimizer base.
+
+Reference parity: python/paddle/optimizer/optimizer.py (Optimizer.step /
+minimize / clear_grad, accumulator management) with the reference design
+point that the update IS an op and optimizer state tensors are framework
+Variables (reference: paddle/fluid/operators/optimizers/*). Here each
+optimizer's update rule is one fused jax op per parameter; state moments
+are state Tensors so compiled training steps thread them functionally.
+
+The learning rate is a state Tensor (not a python float) so LR schedules
+don't force recompilation of traced steps: scheduler.step() mutates the
+tensor outside the trace.
+"""
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.dispatch import no_grad
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be given in dygraph mode (pass "
+                "model.parameters())")
+        self._param_groups = list(parameters)
+        self._grad_clip = grad_clip
+        self._name = name
+        self._accumulators = {}
+        if isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+            self._decay_mode = "l2"  # L2Decay: grad += wd * param
+        elif weight_decay is None:
+            self._weight_decay = 0.0
+            self._decay_mode = "none"
+        else:  # regularizer object
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay,
+                                                       "coeff", 0.0)))
+            self._decay_mode = "l2"
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            lr0 = learning_rate()
+        else:
+            self._lr_scheduler = None
+            lr0 = float(learning_rate)
+        self._lr_tensor = Tensor(jnp.asarray(lr0, jnp.float32),
+                                 name="learning_rate", persistable=True)
+        if self._lr_scheduler is not None:
+            self._lr_scheduler._bind(self._lr_tensor)
+
+    # -- public API --------------------------------------------------------
+    def get_lr(self):
+        return float(self._lr_tensor.numpy())
+
+    def set_lr(self, value):
+        self._lr_tensor.value = jnp.asarray(float(value), jnp.float32)
+
+    def _parameter_list(self):
+        params = []
+        for g in self._param_groups:
+            if isinstance(g, dict):
+                params.extend(g["params"])
+            else:
+                params.append(g)
+        return params
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p._grad) for p in self._parameter_list()
+                        if p._grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            self._apply_one(p, g)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # -- state -------------------------------------------------------------
+    def _acc(self, kind, param, init=None, shape=None, dtype=None):
+        store = self._accumulators.setdefault(kind, {})
+        key = id(param)
+        if key not in store:
+            if init is None:
+                v = jnp.zeros(shape if shape is not None
+                              else tuple(param.aval_shape()),
+                              dtype or param._value.dtype
+                              if param._value is not None else jnp.float32)
+            else:
+                v = init
+            store[key] = Tensor(v, name=f"{param.name}_{kind}",
+                                persistable=True)
+        return store[key]
+
+    def state_dict(self):
+        sd = {}
+        params = self._parameter_list()
+        id_to_name = {id(p): p.name for p in params}
+        for kind, store in self._accumulators.items():
+            for pid, t in store.items():
+                pname = id_to_name.get(pid, str(pid))
+                sd[f"{pname}_{kind}"] = t
+        sd["LR_Scheduler"] = {"last_lr": self.get_lr()}
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"].update(self._lr_scheduler.state_dict())
+        return sd
+
+    def set_state_dict(self, state_dict):
+        params = self._parameter_list()
+        # longest-name-first so a param name that prefixes another's
+        # ("fc" vs "fc_w") cannot steal the longer param's accumulator
+        by_len = sorted(((p.name, id(p)) for p in params),
+                        key=lambda kv: -len(kv[0]))
+        for key, val in state_dict.items():
+            if key == "LR_Scheduler":
+                if self._lr_scheduler is not None and "last_epoch" in val:
+                    self._lr_scheduler.last_epoch = val["last_epoch"]
+                if "last_lr" in val:
+                    self.set_lr(val["last_lr"])
+                continue
+            for pname, pid in by_len:
+                if key.startswith(pname + "_"):
+                    kind = key[len(pname) + 1:]
+                    store = self._accumulators.setdefault(kind, {})
+                    arr = val.value if isinstance(val, Tensor) else jnp.asarray(val)
+                    if pid in store:
+                        store[pid].value = arr
+                    else:
+                        store[pid] = Tensor(arr, persistable=True)
+                    break
+
+    # -- to be implemented by subclasses -----------------------------------
+    def _apply_one(self, param, grad):
+        raise NotImplementedError

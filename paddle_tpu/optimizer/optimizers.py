@@ -1,0 +1,302 @@
+"""Concrete optimizers: SGD, Momentum, Adam, AdamW, Adamax, Adagrad,
+RMSProp, Lamb.
+
+Reference parity: paddle/fluid/operators/optimizers/{sgd,momentum,adam,
+adamw,adamax,adagrad,rmsprop,lamb}_op and python/paddle/optimizer/*.py.
+Each update rule is one fused jax op (XLA fuses the whole elementwise
+chain into a single kernel per parameter — the analogue of the reference's
+fused CUDA optimizer kernels).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from .optimizer import Optimizer
+
+
+@register_op("sgd_update", differentiable=False)
+def _sgd(param, grad, lr, *, wd):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd:
+        g = g + wd * p32
+    new_p = p32 - lr * g
+    return new_p.astype(param.dtype)
+
+
+@register_op("momentum_update", differentiable=False)
+def _momentum(param, grad, velocity, lr, *, mu, wd, use_nesterov):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd:
+        g = g + wd * p32
+    v_new = mu * velocity + g
+    if use_nesterov:
+        new_p = p32 - lr * (g + mu * v_new)
+    else:
+        new_p = p32 - lr * v_new
+    return new_p.astype(param.dtype), v_new
+
+
+@register_op("adam_update", differentiable=False)
+def _adam(param, grad, m, v, beta1_pow, beta2_pow, lr, *,
+          beta1, beta2, epsilon, wd, decoupled, lazy):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd and not decoupled:
+        g = g + wd * p32
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    m_hat = m_new / (1.0 - b1p)
+    v_hat = v_new / (1.0 - b2p)
+    update = m_hat / (jnp.sqrt(v_hat) + epsilon)
+    if wd and decoupled:
+        update = update + wd * p32
+    new_p = p32 - lr * update
+    return new_p.astype(param.dtype), m_new, v_new, b1p, b2p
+
+
+@register_op("adamax_update", differentiable=False)
+def _adamax(param, grad, m, inf_norm, beta1_pow, lr, *,
+            beta1, beta2, epsilon, wd):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd:
+        g = g + wd * p32
+    m_new = beta1 * m + (1.0 - beta1) * g
+    u_new = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    b1p = beta1_pow * beta1
+    new_p = p32 - (lr / (1.0 - b1p)) * m_new / (u_new + epsilon)
+    return new_p.astype(param.dtype), m_new, u_new, b1p
+
+
+@register_op("adagrad_update", differentiable=False)
+def _adagrad(param, grad, moment, lr, *, epsilon, wd):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd:
+        g = g + wd * p32
+    mom_new = moment + g * g
+    new_p = p32 - lr * g / (jnp.sqrt(mom_new) + epsilon)
+    return new_p.astype(param.dtype), mom_new
+
+
+@register_op("rmsprop_update", differentiable=False)
+def _rmsprop(param, grad, mean_square, mean_grad, moment, lr, *,
+             rho, epsilon, momentum, centered, wd):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd:
+        g = g + wd * p32
+    ms_new = rho * mean_square + (1.0 - rho) * g * g
+    if centered:
+        mg_new = rho * mean_grad + (1.0 - rho) * g
+        denom = jnp.sqrt(ms_new - mg_new * mg_new + epsilon)
+    else:
+        mg_new = mean_grad
+        denom = jnp.sqrt(ms_new + epsilon)
+    mom_new = momentum * moment + lr * g / denom
+    new_p = p32 - mom_new
+    return new_p.astype(param.dtype), ms_new, mg_new, mom_new
+
+
+@register_op("lamb_update", differentiable=False)
+def _lamb(param, grad, m, v, beta1_pow, beta2_pow, lr, *,
+          beta1, beta2, epsilon, wd):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    m_hat = m_new / (1.0 - b1p)
+    v_hat = v_new / (1.0 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * p32
+    w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    new_p = p32 - lr * trust * r
+    return new_p.astype(param.dtype), m_new, v_new, b1p, b2p
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _apply_one(self, p, g):
+        new_p = _sgd(p, g, self._lr_tensor, wd=self._weight_decay)
+        p.value = new_p.value
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+
+    def _apply_one(self, p, g):
+        vel = self._acc("velocity", p, shape=tuple(p.aval_shape()),
+                        dtype=jnp.float32)
+        new_p, new_v = _momentum(p, g, vel, self._lr_tensor,
+                                 mu=self._momentum, wd=self._weight_decay,
+                                 use_nesterov=self._use_nesterov)
+        p.value = new_p.value
+        vel.value = new_v.value
+
+
+class Adam(Optimizer):
+    _decoupled = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = float(beta1)
+        self._beta2 = float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _apply_one(self, p, g):
+        shape = tuple(p.aval_shape())
+        m = self._acc("moment1", p, shape=shape, dtype=jnp.float32)
+        v = self._acc("moment2", p, shape=shape, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        new_p, m_n, v_n, b1n, b2n = _adam(
+            p, g, m, v, b1p, b2p, self._lr_tensor,
+            beta1=self._beta1, beta2=self._beta2, epsilon=self._epsilon,
+            wd=self._weight_decay, decoupled=self._decoupled, lazy=False)
+        p.value = new_p.value
+        m.value = m_n.value
+        v.value = v_n.value
+        b1p.value = b1n.value
+        b2p.value = b2n.value
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: operators/optimizers/adamw_op)."""
+    _decoupled = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lr_ratio=None, apply_decay_param_fun=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay if weight_decay else None, grad_clip,
+                         lazy_mode, multi_precision, name)
+        self._weight_decay = float(weight_decay or 0.0)
+        self._decay_mode = "decoupled"
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_one(self, p, g):
+        wd_save = self._weight_decay
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            self._weight_decay = 0.0
+        try:
+            super()._apply_one(p, g)
+        finally:
+            self._weight_decay = wd_save
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+
+    def _apply_one(self, p, g):
+        shape = tuple(p.aval_shape())
+        m = self._acc("moment", p, shape=shape, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, shape=shape, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        new_p, m_n, u_n, b1n = _adamax(
+            p, g, m, u, b1p, self._lr_tensor, beta1=self._beta1,
+            beta2=self._beta2, epsilon=self._epsilon, wd=self._weight_decay)
+        p.value = new_p.value
+        m.value = m_n.value
+        u.value = u_n.value
+        b1p.value = b1n.value
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = float(epsilon)
+        self._init_acc = float(initial_accumulator_value)
+
+    def _apply_one(self, p, g):
+        mom = self._acc("moment", p,
+                        init=jnp.full(tuple(p.aval_shape()), self._init_acc,
+                                      jnp.float32))
+        new_p, mom_n = _adagrad(p, g, mom, self._lr_tensor,
+                                epsilon=self._epsilon, wd=self._weight_decay)
+        p.value = new_p.value
+        mom.value = mom_n.value
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+        self._momentum, self._centered = float(momentum), bool(centered)
+
+    def _apply_one(self, p, g):
+        shape = tuple(p.aval_shape())
+        ms = self._acc("mean_square", p, shape=shape, dtype=jnp.float32)
+        mg = self._acc("mean_grad", p, shape=shape, dtype=jnp.float32)
+        mom = self._acc("momentum_acc", p, shape=shape, dtype=jnp.float32)
+        new_p, ms_n, mg_n, mom_n = _rmsprop(
+            p, g, ms, mg, mom, self._lr_tensor, rho=self._rho,
+            epsilon=self._epsilon, momentum=self._momentum,
+            centered=self._centered, wd=self._weight_decay)
+        p.value = new_p.value
+        ms.value = ms_n.value
+        mg.value = mg_n.value
+        mom.value = mom_n.value
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2 = float(beta1), float(beta2)
+        self._epsilon = float(epsilon)
+        self._lamb_wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g):
+        shape = tuple(p.aval_shape())
+        m = self._acc("moment1", p, shape=shape, dtype=jnp.float32)
+        v = self._acc("moment2", p, shape=shape, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        new_p, m_n, v_n, b1n, b2n = _lamb(
+            p, g, m, v, b1p, b2p, self._lr_tensor, beta1=self._beta1,
+            beta2=self._beta2, epsilon=self._epsilon, wd=wd)
+        p.value = new_p.value
+        m.value = m_n.value
+        v.value = v_n.value
+        b1p.value = b1n.value
+        b2p.value = b2n.value

@@ -1,0 +1,105 @@
+"""Profiler.
+
+Reference parity: paddle/fluid/platform/profiler.h:127 RecordEvent /
+:213 EnableProfiler + python/paddle/fluid/profiler.py:314. TPU-native:
+jax.profiler (XPlane) captures real device timelines viewable in
+TensorBoard / Perfetto; RecordEvent lowers to jax.profiler.TraceAnnotation
++ jax.named_scope so op metadata reaches the XLA trace, the analogue of
+the reference's NVTX/CUPTI annotations.
+"""
+import contextlib
+import time
+
+import jax
+
+
+class RecordEvent:
+    """RAII scope annotation (reference: profiler.h:127)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._cm = None
+
+    def __enter__(self):
+        self._cm = contextlib.ExitStack()
+        self._cm.enter_context(jax.profiler.TraceAnnotation(self.name))
+        self._cm.enter_context(jax.named_scope(self.name))
+        return self
+
+    def __exit__(self, *exc):
+        self._cm.close()
+        return False
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__()
+
+
+class Profiler:
+    """paddle.profiler.Profiler-style API over jax.profiler traces."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 log_dir="./profiler_log", timer_only=False):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self._started = False
+        self._step_times = []
+        self._t0 = None
+
+    def start(self):
+        if not self.timer_only:
+            jax.profiler.start_trace(self.log_dir)
+        self._started = True
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._started and not self.timer_only:
+            jax.profiler.stop_trace()
+        self._started = False
+
+    def step(self):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        arr = np.asarray(self._step_times[1:] or self._step_times)
+        return (f"avg step {arr.mean() * 1000:.3f} ms, "
+                f"min {arr.min() * 1000:.3f} ms, max {arr.max() * 1000:.3f} ms")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, **kwargs):
+        return self.step_info()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None):
+    """Legacy fluid.profiler.profiler context (reference:
+    python/paddle/fluid/profiler.py:314)."""
+    p = Profiler(log_dir=profile_path or "./profiler_log")
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def start_profiler(state="All", tracer_option=None):
+    jax.profiler.start_trace("./profiler_log")
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()

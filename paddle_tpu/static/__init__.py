@@ -1,0 +1,77 @@
+"""paddle.static equivalent (functional subset).
+
+Reference parity: python/paddle/static/ (InputSpec, Program/Executor,
+program_guard). The reference's static graph is a ProgramDesc interpreted
+by the C++ Executor (executor.cc:166); the TPU-native equivalent of a
+static program is a traced-and-compiled XLA computation (jit.to_static).
+This module provides InputSpec plus a thin Program/Executor facade over
+the trace machinery so `paddle.static`-style code has a migration path;
+new code should use paddle_tpu.jit.to_static directly.
+"""
+from .input_spec import InputSpec  # noqa: F401
+
+_static_mode = [False]
+
+
+def _enable():
+    _static_mode[0] = True
+
+
+class Program:
+    """Facade: holds a python callable captured via to_static."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def clone(self, for_test=False):
+        return Program(self.fn)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+class Executor:
+    """Facade over direct eager/compiled execution. `run(fn, feed, fetch)`
+    executes a python function (the 'program')."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None):
+        if callable(program):
+            out = program(**(feed or {}))
+        elif isinstance(program, Program) and callable(program.fn):
+            out = program.fn(**(feed or {}))
+        else:
+            raise TypeError(
+                "paddle_tpu.static.Executor runs python callables; build "
+                "models with nn.Layer + jit.to_static instead of op-desc "
+                "programs")
+        return out if isinstance(out, (list, tuple)) else [out]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+from ..amp import auto_cast as amp  # noqa: F401,E402

@@ -1,0 +1,248 @@
+"""BERT / GPT model families.
+
+Reference anchors: BERT-base pretraining and GPT-3 1.3B hybrid-parallel
+configs (BASELINE.md #3/#5; reference TP layers
+fleet/meta_parallel/parallel_layers/mp_layers.py). Models are built from
+paddle_tpu.nn layers; when a hybrid mesh is active, linear/embedding
+layers use the tensor-parallel variants so GSPMD shards them over 'mp'.
+"""
+import math
+
+from .. import nn
+from ..ops import creation, manipulation, math as math_ops, nn_ops
+from ..distributed import topology
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    shard_constraint,
+)
+
+
+class TransformerLMConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, intermediate_size=None, max_seq_len=1024,
+                 dropout=0.1, use_mp=False, tie_embeddings=True,
+                 use_flash_attention=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size or hidden_size * 4
+        self.max_seq_len = max_seq_len
+        self.dropout = dropout
+        self.use_mp = use_mp
+        self.tie_embeddings = tie_embeddings
+        self.use_flash_attention = use_flash_attention
+
+
+def _mp_active():
+    mesh = topology.get_mesh()
+    return mesh is not None and int(mesh.shape.get("mp", 1)) > 1
+
+
+class SelfAttention(nn.Layer):
+    """Fused-QKV attention; column-parallel QKV + row-parallel output when
+    TP is active (the Megatron split, reference mp_layers.py)."""
+
+    def __init__(self, cfg, causal):
+        super().__init__()
+        h = cfg.hidden_size
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.causal = causal
+        self.dropout = cfg.dropout
+        self.use_flash = cfg.use_flash_attention
+        use_mp = cfg.use_mp and _mp_active()
+        if use_mp:
+            self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+            self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.out = nn.Linear(h, h)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv(x)
+        qkv = manipulation.reshape(qkv, (b, s, 3, self.num_heads,
+                                         self.head_dim))
+        qkv = manipulation.transpose(qkv, (2, 0, 3, 1, 4))
+        q, k, v = manipulation.unbind(qkv, axis=0)
+        from ..ops import attention as attn_ops
+        o = attn_ops.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=self.causal)
+        o = manipulation.transpose(o, (0, 2, 1, 3))
+        o = manipulation.reshape(o, (b, s, h))
+        o = self.out(o)
+        if self.dropout:
+            o = nn_ops.dropout(o, p=self.dropout, training=self.training)
+        return o
+
+
+class MLP(nn.Layer):
+    def __init__(self, cfg, activation="gelu"):
+        super().__init__()
+        h, inter = cfg.hidden_size, cfg.intermediate_size
+        use_mp = cfg.use_mp and _mp_active()
+        if use_mp:
+            self.fc1 = ColumnParallelLinear(h, inter, gather_output=False)
+            self.fc2 = RowParallelLinear(inter, h, input_is_parallel=True)
+        else:
+            self.fc1 = nn.Linear(h, inter)
+            self.fc2 = nn.Linear(inter, h)
+        self.act = activation
+        self.dropout = cfg.dropout
+
+    def forward(self, x):
+        x = self.fc1(x)
+        x = nn_ops.gelu(x, approximate=True) if self.act == "gelu" else \
+            nn_ops.relu(x)
+        x = self.fc2(x)
+        if self.dropout:
+            x = nn_ops.dropout(x, p=self.dropout, training=self.training)
+        return x
+
+
+class Block(nn.Layer):
+    def __init__(self, cfg, causal, pre_norm=True):
+        super().__init__()
+        self.pre_norm = pre_norm
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = SelfAttention(cfg, causal)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.mlp = MLP(cfg)
+
+    def forward(self, x, attn_mask=None):
+        if self.pre_norm:  # GPT style
+            x = math_ops.add(x, self.attn(self.ln1(x), attn_mask))
+            x = math_ops.add(x, self.mlp(self.ln2(x)))
+        else:  # BERT style post-norm
+            x = self.ln1(math_ops.add(x, self.attn(x, attn_mask)))
+            x = self.ln2(math_ops.add(x, self.mlp(x)))
+        return x
+
+
+class _TransformerCore(nn.Layer):
+    def __init__(self, cfg, causal, pre_norm, with_token_type=False):
+        super().__init__()
+        self.cfg = cfg
+        use_mp = cfg.use_mp and _mp_active()
+        if use_mp:
+            self.word_embeddings = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size)
+        else:
+            self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                                cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_seq_len,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(2, cfg.hidden_size) \
+            if with_token_type else None
+        self.blocks = nn.LayerList(
+            [Block(cfg, causal, pre_norm) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        self.pre_norm = pre_norm
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        s = input_ids.shape[1]
+        pos = creation.arange(0, s, dtype="int64")
+        x = self.word_embeddings(input_ids)
+        x = math_ops.add(x, self.position_embeddings(pos))
+        if self.token_type_embeddings is not None and token_type_ids is not None:
+            x = math_ops.add(x, self.token_type_embeddings(token_type_ids))
+        if self.cfg.dropout:
+            x = nn_ops.dropout(x, p=self.cfg.dropout, training=self.training)
+        for blk in self.blocks:
+            x = blk(x, attn_mask)
+        if self.pre_norm:
+            x = self.ln_f(x)
+        return x
+
+
+class GPTModel(_TransformerCore):
+    """Decoder-only causal LM core (GPT-3 style: pre-norm)."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg, causal=True, pre_norm=True)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        if self.cfg.tie_embeddings:
+            logits = math_ops.matmul(h, self.gpt.word_embeddings.weight,
+                                     transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if labels is None:
+            return logits
+        loss = nn_ops.cross_entropy(
+            manipulation.reshape(logits, (-1, self.cfg.vocab_size)),
+            manipulation.reshape(labels, (-1,)))
+        return loss
+
+
+class BertModel(_TransformerCore):
+    """Encoder core (BERT style: post-norm, token types)."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg, causal=False, pre_norm=False,
+                         with_token_type=True)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+        h = super().forward(input_ids, token_type_ids, attn_mask)
+        pooled = nn_ops.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference pretraining objective for config 3)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_ln = nn.LayerNorm(cfg.hidden_size)
+        self.nsp_head = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, masked_lm_labels=None,
+                next_sentence_labels=None):
+        h, pooled = self.bert(input_ids, token_type_ids)
+        t = nn_ops.gelu(self.mlm_transform(h), approximate=True)
+        t = self.mlm_ln(t)
+        logits = math_ops.matmul(t, self.bert.word_embeddings.weight,
+                                 transpose_y=True)
+        if masked_lm_labels is None:
+            return logits
+        mlm_loss = nn_ops.cross_entropy(
+            manipulation.reshape(logits, (-1, self.cfg.vocab_size)),
+            manipulation.reshape(masked_lm_labels, (-1,)),
+            ignore_index=-1)
+        if next_sentence_labels is not None:
+            nsp_logits = self.nsp_head(pooled)
+            nsp_loss = nn_ops.cross_entropy(
+                nsp_logits, manipulation.reshape(next_sentence_labels, (-1,)))
+            return math_ops.add(mlm_loss, nsp_loss)
+        return mlm_loss
+
+
+def bert_base(vocab_size=30522, **kwargs):
+    cfg = TransformerLMConfig(vocab_size=vocab_size, hidden_size=768,
+                              num_layers=12, num_heads=12, max_seq_len=512,
+                              **kwargs)
+    return BertForPretraining(cfg)
+
+
+def gpt3_1p3b(vocab_size=50304, max_seq_len=1024, **kwargs):
+    """GPT-3 1.3B: 24 layers, hidden 2048, 16 heads (BASELINE config 5)."""
+    cfg = TransformerLMConfig(vocab_size=vocab_size, hidden_size=2048,
+                              num_layers=24, num_heads=16,
+                              max_seq_len=max_seq_len, **kwargs)
+    return GPTForCausalLM(cfg)

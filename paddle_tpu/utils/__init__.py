@@ -1,0 +1,28 @@
+"""paddle.utils equivalent."""
+from . import download  # noqa: F401
+
+
+def try_import(name):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"optional dependency {name} is unavailable") from e
+
+
+def run_check():
+    """paddle.utils.run_check analogue: verify the runtime works."""
+    import jax
+    import jax.numpy as jnp
+    from .. import to_tensor, matmul
+    x = to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    y = matmul(x, x)
+    assert y.shape == [2, 2]
+    print(f"paddle_tpu runs on {jax.default_backend()} "
+          f"({jax.device_count()} device(s)). All checks passed.")
+
+
+def deprecated(since=None, update_to=None, reason=None):
+    def deco(fn):
+        return fn
+    return deco

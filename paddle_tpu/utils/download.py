@@ -1,0 +1,30 @@
+"""Dataset/weight download helper (reference:
+python/paddle/dataset/common.py + utils/download.py). Zero-egress
+environment: downloads are disabled; files must exist locally."""
+import hashlib
+import os
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/dataset")
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    root_dir = root_dir or DATA_HOME
+    fname = os.path.join(root_dir, os.path.basename(url))
+    if os.path.exists(fname):
+        return fname
+    raise RuntimeError(
+        f"network access is disabled; place {os.path.basename(url)} under "
+        f"{root_dir} manually (wanted from {url})")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

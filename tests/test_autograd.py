@@ -1,0 +1,179 @@
+"""Autograd engine tests — analytic grads vs numeric finite differences,
+mirroring the reference OpTest.check_grad strategy (op_test.py:1409)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = fn(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        fm = fn(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(paddle_fn, x_np, rtol=1e-2, atol=1e-3):
+    x = paddle.to_tensor(x_np.astype("float64"), stop_gradient=False)
+    out = paddle_fn(x)
+    loss = out.sum()
+    loss.backward()
+    analytic = x.grad.numpy()
+
+    def f(a):
+        t = paddle.to_tensor(a)
+        return float(paddle_fn(t).sum().numpy())
+    numeric = numeric_grad(f, x_np.astype("float64"))
+    np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("fn_name", [
+    "exp", "tanh", "sigmoid", "sqrt_abs", "square", "relu_like", "log_abs",
+])
+def test_unary_grads(fn_name):
+    x = np.random.uniform(0.5, 2.0, (3, 4))
+    fns = {
+        "exp": paddle.exp, "tanh": paddle.tanh,
+        "sigmoid": paddle.sigmoid,
+        "sqrt_abs": paddle.sqrt, "square": paddle.square,
+        "relu_like": F.relu, "log_abs": paddle.log,
+    }
+    check_grad(fns[fn_name], x)
+
+
+def test_matmul_grad():
+    a_np = np.random.randn(3, 4)
+    b_np = np.random.randn(4, 5)
+    a = paddle.to_tensor(a_np, stop_gradient=False)
+    b = paddle.to_tensor(b_np, stop_gradient=False)
+    out = paddle.matmul(a, b)
+    out.backward(paddle.ones_like(out))
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 5)) @ b_np.T, rtol=1e-6)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a_np.T @ np.ones((3, 5)), rtol=1e-6)
+
+
+def test_softmax_cross_entropy_grad():
+    logits = np.random.randn(4, 10)
+    labels = np.random.randint(0, 10, (4,))
+
+    def fn(x):
+        return F.cross_entropy(x, paddle.to_tensor(labels))
+    check_grad(fn, logits)
+
+
+def test_conv2d_grad():
+    x_np = np.random.randn(1, 2, 6, 6)
+    w = paddle.to_tensor(np.random.randn(3, 2, 3, 3), stop_gradient=False)
+
+    def fn(x):
+        return F.conv2d(x, w)
+    check_grad(fn, x_np, rtol=2e-2, atol=1e-2)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_cut():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])  # only through z=y*x
+
+
+def test_backward_twice_raises_without_retain():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * x * x
+    y.backward(retain_graph=True)
+    y.backward()  # retain allowed it once more
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.random.randn(5).astype("float64"),
+                         stop_gradient=False)
+    vals, idx = paddle.topk(x, k=2)
+    vals.sum().backward()
+    g = x.grad.numpy()
+    top2 = np.argsort(-x.numpy())[:2]
+    expected = np.zeros(5)
+    expected[top2] = 1
+    np.testing.assert_allclose(g, expected)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    np.testing.assert_allclose(g.numpy(), [4.0])
+    assert x.grad is None  # no side effect on .grad
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 2)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+    h.remove()
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+
+
+def test_embedding_grad_scatter():
+    w = paddle.to_tensor(np.random.randn(10, 4), stop_gradient=False)
+    ids = paddle.to_tensor(np.array([1, 1, 3]))
+    out = F.embedding(ids, w)
+    out.sum().backward()
+    g = w.grad.numpy()
+    assert g[1].sum() == pytest.approx(8.0)  # row 1 hit twice
+    assert g[3].sum() == pytest.approx(4.0)
+    assert g[0].sum() == 0
