@@ -1,0 +1,73 @@
+"""Headline benchmark: ResNet-50 training step, single chip (BASELINE.md
+config 2). Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured samples/sec divided by 0.9x of a published-class
+A100 ResNet-50 fp16 training throughput (~1500 img/s single GPU), i.e. the
+BASELINE.md north-star target (>=0.9x A100+NCCL); >1.0 means target met.
+Runs bf16 compute via AMP autocast, whole step compiled with to_static
+(the reference's static-graph mode).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    opt = paddle.optimizer.Momentum(0.1, momentum=0.9,
+                                    parameters=net.parameters(),
+                                    weight_decay=1e-4)
+    loss_fn = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def train_step(x, y):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = net(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x_np = np.random.randn(batch, 3, 224, 224).astype("float32")
+    y_np = np.random.randint(0, 1000, (batch,)).astype("int64")
+    x = paddle.to_tensor(x_np)
+    y = paddle.to_tensor(y_np)
+
+    # warmup: eager, record, first compiled execution (compile happens here)
+    for _ in range(4):
+        loss = train_step(x, y)
+    float(loss.numpy())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train_step(x, y)
+    float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    step_ms = dt / steps * 1000.0
+    ips = batch * steps / dt
+    target = 0.9 * 1500.0  # 0.9x A100-class ResNet-50 fp16 throughput
+    print(json.dumps({
+        "metric": "resnet50_train_samples_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "samples/sec",
+        "vs_baseline": round(ips / target, 4),
+    }))
+    print(f"# step_time={step_ms:.2f} ms batch={batch} "
+          f"final_loss={float(loss.numpy()):.4f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,8 @@ def _auto_name(prefix="tensor"):
 
 class Tensor:
     __slots__ = ("_value", "name", "stop_gradient", "persistable",
-                 "_grad", "_grad_node", "trainable", "_hooks", "__weakref__")
+                 "_grad", "_grad_node", "trainable", "_hooks", "tp_spec",
+                 "__weakref__")
 
     def __init__(self, value, dtype=None, place=None, stop_gradient=True,
                  name=None, persistable=False):
@@ -46,6 +47,7 @@ class Tensor:
         self._grad = None
         self._grad_node = None
         self._hooks = None
+        self.tp_spec = None
 
     # ---- value plumbing (trace-aware) -----------------------------------
     @property

@@ -44,27 +44,26 @@ class HybridParallelOptimizer:
 
     def _apply_state_sharding(self):
         """ZeRO-1: shard optimizer moment tensors over the 'sharding' axis.
-        In GSPMD this is a placement annotation — XLA generates the
-        reduce-scatter/all-gather traffic (reference:
-        sharding_optimizer.py:43 does this with explicit c_ops)."""
+        Applied as sharding constraints inside the (traced) step, so GSPMD
+        generates the reduce-scatter/all-gather traffic when the step
+        compiles (reference: sharding_optimizer.py:43 does this with
+        explicit c_ops); eager phases stay unsharded."""
+        from .meta_parallel.mp_layers import shard_constraint
         mesh = self._hcg.mesh if self._hcg else topology.get_mesh()
         if mesh is None:
             return
+        deg = int(mesh.shape["sharding"])
         for kind, store in self._inner_opt._accumulators.items():
             for t in store.values():
-                v = t._value
-                if v is None or v.ndim == 0:
+                shape = t.aval_shape()
+                if not shape:
                     continue
-                # shard the largest dim divisible by the sharding degree
-                deg = int(mesh.shape["sharding"])
-                spec = [None] * v.ndim
-                for i, s in enumerate(v.shape):
-                    if s % deg == 0:
+                spec = [None] * len(shape)
+                for i, s in enumerate(shape):
+                    if s % deg == 0 and s >= deg:
                         spec[i] = "sharding"
                         break
                 if any(spec):
-                    try:
-                        t._value = jax.device_put(
-                            v, NamedSharding(mesh, P(*spec)))
-                    except (ValueError, RuntimeError):
-                        pass
+                    out = shard_constraint(t, spec)
+                    if out is not t:
+                        t.value = out.value

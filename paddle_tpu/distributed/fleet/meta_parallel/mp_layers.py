@@ -28,17 +28,22 @@ from ... import topology
 @register_op("sharding_constraint")
 def _constraint(x, *, spec, mesh_id):
     mesh = _MESH_REGISTRY[mesh_id]
-    try:
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, P(*spec)))
-    except (ValueError, RuntimeError):
-        return x  # outside jit on incompatible platform: no-op
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
 _MESH_REGISTRY = {}
 
 
 def shard_constraint(t, spec, mesh=None):
+    """Annotate a tensor with a mesh sharding INSIDE a compiled (to_static)
+    graph. Outside a jit trace this is a no-op by design: eager phases stay
+    single-device so no eager sub-group collectives are ever launched (the
+    CPU backend deadlocks on those, and on TPU they would serialize);
+    GSPMD materializes all sharding when the step compiles."""
+    from ....core import trace as trace_mod
+    ctx = trace_mod.current_trace()
+    if ctx is None or ctx.mode != "jit":
+        return t
     mesh = mesh or topology.get_mesh()
     if mesh is None:
         return t
@@ -48,10 +53,9 @@ def shard_constraint(t, spec, mesh=None):
 
 
 def _shard_param(param, spec, mesh=None):
-    mesh = mesh or topology.get_mesh()
-    if mesh is None:
-        return param
-    param.value = jax.device_put(param.value, NamedSharding(mesh, P(*spec)))
+    """Record the parameter's tensor-parallel placement; applied as a
+    sharding constraint in the layer's forward when the step compiles."""
+    param.tp_spec = tuple(spec)
     return param
 
 
@@ -70,7 +74,8 @@ class VocabParallelEmbedding(Layer):
         _shard_param(self.weight, ("mp", None))
 
     def forward(self, x):
-        out = nn_ops.embedding(x, self.weight)
+        w = shard_constraint(self.weight, self.weight.tp_spec)
+        out = nn_ops.embedding(x, w)
         return out
 
 
@@ -96,7 +101,10 @@ class ColumnParallelLinear(Layer):
             _shard_param(self.bias, ("mp",))
 
     def forward(self, x):
-        out = nn_ops.linear(x, self.weight, self.bias)
+        w = shard_constraint(self.weight, self.weight.tp_spec)
+        b = None if self.bias is None else \
+            shard_constraint(self.bias, self.bias.tp_spec)
+        out = nn_ops.linear(x, w, b)
         if self.gather_output:
             out = shard_constraint(out, (None,) * len(out.shape))
         else:
@@ -126,7 +134,8 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.input_is_parallel:
             x = shard_constraint(x, (None,) * (len(x.shape) - 1) + ("mp",))
-        out = nn_ops.linear(x, self.weight, self.bias)
+        w = shard_constraint(self.weight, self.weight.tp_spec)
+        out = nn_ops.linear(x, w, self.bias)
         out = shard_constraint(out, (None,) * len(out.shape))
         return out
 

@@ -48,37 +48,22 @@ class _MetaParallelBase(Layer):
 
 class TensorParallel(_MetaParallelBase):
     """Reference: meta_parallel/tensor_parallel.py — broadcasts non-TP
-    params inside the mp group. TPU-native: non-sharded params get an
-    explicitly replicated sharding over the mesh; mp_layers' params keep
-    their mp shardings set at construction."""
-
-    def __init__(self, layers, hcg, strategy=None):
-        super().__init__(layers, hcg, strategy)
-        mesh = hcg.mesh
-        rep = NamedSharding(mesh, P())
-        for p in layers.parameters():
-            if _is_unsharded(p.value):
-                p.value = jax.device_put(p.value, rep)
+    params inside the mp group. TPU-native: in the single-controller model
+    all params are already consistent; TP placement comes from the
+    mp_layers' sharding constraints when the step compiles, and the batch
+    gets a dp constraint here. Eager phases run unsharded by design (see
+    mp_layers.shard_constraint)."""
 
     def forward(self, *inputs, **kwargs):
+        from .mp_layers import shard_constraint
         mesh = self._hcg.mesh
-        sharded = []
         dp = int(mesh.shape["dp"])
+        sharded = []
         for x in inputs:
             if isinstance(x, Tensor) and x.ndim >= 1 and x.shape[0] % dp == 0:
-                x.value = jax.device_put(
-                    x.value,
-                    NamedSharding(mesh, P(*(("dp",) + (None,) * (x.ndim - 1)))))
+                x = shard_constraint(x, ("dp",) + (None,) * (x.ndim - 1))
             sharded.append(x)
         return self._layers(*sharded, **kwargs)
-
-
-def _is_unsharded(arr):
-    try:
-        spec = arr.sharding.spec
-        return all(s is None for s in spec)
-    except AttributeError:
-        return True
 
 
 class ShardingParallel(_MetaParallelBase):

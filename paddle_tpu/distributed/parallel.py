@@ -61,20 +61,14 @@ class DataParallel(Layer):
         if topology.get_mesh() is None:
             init_parallel_env()
         self._mesh = topology.get_mesh()
-        self._replicate_params()
-
-    def _replicate_params(self):
-        rep = _replicated(self._mesh)
-        for p in self._layers.parameters():
-            p.value = jax.device_put(p.value, rep)
-        for b in self._layers.buffers():
-            b.value = jax.device_put(b.value, rep)
 
     def scale_batch(self, x):
-        """Shard a global-batch tensor over dp."""
+        """Annotate a global-batch tensor as dp-sharded (materializes when
+        the step compiles; eager stays single-device by design)."""
+        from .fleet.meta_parallel.mp_layers import shard_constraint
         if isinstance(x, Tensor):
-            x.value = jax.device_put(
-                x.value, _dp_sharding(self._mesh, x.value.ndim))
+            return shard_constraint(x, ("dp",) + (None,) * (x.ndim - 1),
+                                    mesh=self._mesh)
         return x
 
     def forward(self, *inputs, **kwargs):

@@ -41,26 +41,31 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
         return model, optimizer, scaler
     deg = int(mesh.shape["sharding"])
 
+    from ..fleet.meta_parallel.mp_layers import shard_constraint
     shard_params = level == "p_g_os"
-
-    if shard_params:
-        for p in model.parameters():
-            spec = _shard_spec(p.aval_shape(), deg)
-            if any(spec):
-                p.value = _try_place(p.value, mesh, spec)
-
     orig_step = optimizer.step
 
     def sharded_step():
         orig_step()
+        # sharding constraints materialize when the step compiles; eager
+        # phases stay single-device (see mp_layers.shard_constraint)
         for kind, store in optimizer._accumulators.items():
             for t in store.values():
-                v = t._value
-                if v is None or v.ndim == 0:
+                shape = t.aval_shape()
+                if not shape:
                     continue
-                spec = _shard_spec(v.shape, deg)
+                spec = _shard_spec(shape, deg)
                 if any(spec):
-                    t._value = _try_place(v, mesh, spec)
+                    out = shard_constraint(t, spec, mesh=mesh)
+                    if out is not t:
+                        t.value = out.value
+        if shard_params:
+            for p in model.parameters():
+                spec = _shard_spec(p.aval_shape(), deg)
+                if any(spec):
+                    out = shard_constraint(p, spec, mesh=mesh)
+                    if out is not p:
+                        p.value = out.value
 
     optimizer.step = sharded_step
     return model, optimizer, scaler
