@@ -91,6 +91,10 @@ def _accumulate_into_leaf(tensor, grad_array):
     if tensor._grad is None:
         tensor._grad = Tensor(grad_array, stop_gradient=True,
                               name=tensor.name + "@GRAD")
+        from . import trace as trace_mod
+        ctx = trace_mod.current_trace()
+        if ctx is not None:
+            ctx.register_created(tensor._grad)
     else:
         # keep the same Tensor object so traced steps functionalize correctly
         tensor._grad.value = tensor._grad.value + grad_array

@@ -1,0 +1,60 @@
+"""Sequence-parallel attention layers over the 'sp' mesh axis.
+
+Greenfield (SURVEY §5/§7 step 8): exposes ring attention / Ulysses through
+the framework op dispatcher so eager Tensors and to_static traces both
+work. `sp_degree` in fleet hybrid_configs sizes the axis.
+"""
+import jax
+
+from ....core.dispatch import register_op
+from ....core.tensor import Tensor
+from ....ops import ring_attention as ra
+from ... import topology
+
+_MESHES = {}
+
+
+@register_op("ring_attention")
+def _ring_op(q, k, v, *, mesh_id, causal, scale):
+    return ra.ring_attention(q, k, v, _MESHES[mesh_id], causal=causal,
+                             scale=scale)
+
+
+@register_op("ulysses_attention")
+def _ulysses_op(q, k, v, *, mesh_id, causal, scale):
+    return ra.ulysses_attention(q, k, v, _MESHES[mesh_id], causal=causal,
+                                scale=scale)
+
+
+def _dispatch(op, q, k, v, causal, scale, mesh):
+    mesh = mesh or topology.get_mesh()
+    if mesh is None or int(mesh.shape.get("sp", 1)) == 1:
+        from ....ops.attention import scaled_dot_product_attention
+        return scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                            scale=scale)
+    _MESHES[id(mesh)] = mesh
+    return op(q, k, v, mesh_id=id(mesh), causal=bool(causal), scale=scale)
+
+
+def ring_attention(q, k, v, causal=True, scale=None, mesh=None):
+    """Context-parallel attention; q/k/v logical [B, H, S, D], sequence
+    sharded over 'sp'. O(S/sp) HBM per chip; K/V ride the ICI ring."""
+    return _dispatch(_ring_op, q, k, v, causal, scale, mesh)
+
+
+def ulysses_attention(q, k, v, causal=True, scale=None, mesh=None):
+    """All-to-all sequence parallelism (heads must divide sp)."""
+    return _dispatch(_ulysses_op, q, k, v, causal, scale, mesh)
+
+
+class SequenceParallelAttention:
+    """Config-selectable SP attention kernel for model code."""
+
+    def __init__(self, mode="ring", causal=True):
+        assert mode in ("ring", "ulysses")
+        self.mode = mode
+        self.causal = causal
+
+    def __call__(self, q, k, v, scale=None):
+        fn = ring_attention if self.mode == "ring" else ulysses_attention
+        return fn(q, k, v, causal=self.causal, scale=scale)

@@ -171,8 +171,11 @@ class Model:
                 stack_outputs=False, callbacks=None, verbose=1):
         loader = self._to_loader(test_data, batch_size, False)
         outputs = []
+        # datasets that yield (inputs, label) pairs: drop the label column
+        # when a loss was configured (reference Model tracks _inputs/_labels
+        # specs; we infer from prepare())
         for batch in loader:
-            ins, _ = _split_batch(batch, has_label=False)
+            ins, _ = _split_batch(batch, has_label=self._loss is not None)
             outputs.append(self.predict_batch(ins))
         if stack_outputs:
             n_out = len(outputs[0])

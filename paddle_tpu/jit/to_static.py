@@ -128,12 +128,14 @@ class TracedFunction:
         out_leaves = []
         out_struct = _flatten(out, out_leaves)
         fn = self._fn
+        grad_owners = []  # captured tensors whose .grad is created in-trace
 
         def compiled_fn(arg_arrays, mut_cap_arrays, ro_cap_arrays):
             jctx = trace_mod.TraceContext("jit")
             mut_caps = [captured[i] for i in mutated_in_captured]
             ro_caps = [t for i, t in enumerate(captured)
                        if i not in set(mutated_in_captured)]
+            grad_owners.clear()
             with trace_mod.trace_guard(jctx):
                 for t, a in zip(mut_caps, mut_cap_arrays):
                     jctx.bind(t, a)
@@ -149,7 +151,17 @@ class TracedFunction:
                 _flatten(result, res_leaves)
                 out_arrays = [t.value for t in res_leaves]
                 mut_arrays = [jctx.final_value(t) for t in mutated]
-            return out_arrays, mut_arrays
+                # Gradients created during the trace that remain attached to
+                # captured tensors (the "backward inside, clear outside"
+                # pattern): emit their final values so callers can read
+                # .grad after a compiled step.
+                grad_arrays = []
+                for t in captured:
+                    g = t._grad
+                    if isinstance(g, Tensor) and id(g) in jctx.created:
+                        grad_owners.append(t)
+                        grad_arrays.append(jctx.final_value(g))
+            return out_arrays, mut_arrays, grad_arrays
 
         jitted = jax.jit(compiled_fn, donate_argnums=(1,))
         entry["compiled"] = {
@@ -158,6 +170,7 @@ class TracedFunction:
             "mutated": mutated,
             "mut_cap_idx": mutated_in_captured,
             "out_struct": out_struct,
+            "grad_owners": grad_owners,
         }
         entry["record"] = None
         return out
@@ -170,9 +183,12 @@ class TracedFunction:
         mut_caps = [captured[i].value for i in c["mut_cap_idx"]]
         ro_caps = [t.value for i, t in enumerate(captured) if i not in mset]
         arg_arrays = [t.value for t in leaves]
-        out_arrays, mut_arrays = c["jitted"](arg_arrays, mut_caps, ro_caps)
+        out_arrays, mut_arrays, grad_arrays = c["jitted"](
+            arg_arrays, mut_caps, ro_caps)
         for t, v in zip(c["mutated"], mut_arrays):
             t._value = v
+        for t, g in zip(c["grad_owners"], grad_arrays):
+            t._grad = Tensor(g, stop_gradient=True)
         out_tensors = iter([Tensor(a) for a in out_arrays])
         return _rebuild(c["out_struct"], out_tensors)
 

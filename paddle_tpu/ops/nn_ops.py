@@ -284,18 +284,25 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 @register_op("conv2d_transpose")
 def _conv2d_transpose(x, w, b, *, strides, paddings, output_padding, dilations,
                       groups):
-    # paddle weight layout for transpose conv: [in, out/groups, kh, kw]
-    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
-                                        ("NCHW", "IOHW", "NCHW"))
-    kh = (w.shape[2] - 1) * dilations[0] + 1
-    kw = (w.shape[3] - 1) * dilations[1] + 1
+    # paddle weight layout for transpose conv: [in, out/groups, kh, kw].
+    # Express as a fractionally-strided conv: spatially flip the kernel and
+    # swap I/O (per group) to OIHW, then conv with lhs_dilation=stride.
+    in_c, out_pg, kh_, kw_ = w.shape
+    wf = jnp.flip(w, axis=(2, 3))
+    wf = wf.reshape(groups, in_c // groups, out_pg, kh_, kw_)
+    wf = wf.transpose(0, 2, 1, 3, 4).reshape(
+        groups * out_pg, in_c // groups, kh_, kw_)
+    dn = jax.lax.conv_dimension_numbers(x.shape, wf.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    kh = (kh_ - 1) * dilations[0] + 1
+    kw = (kw_ - 1) * dilations[1] + 1
     ph, pw = paddings
     pad = ((kh - 1 - ph, kh - 1 - ph + output_padding[0]),
            (kw - 1 - pw, kw - 1 - pw + output_padding[1]))
     out = jax.lax.conv_general_dilated(
-        x, w, window_strides=(1, 1), padding=pad,
+        x, wf, window_strides=(1, 1), padding=pad,
         lhs_dilation=strides, rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups, transpose_kernel=True)
+        feature_group_count=groups)
     if b is not None:
         out = out + b.reshape(1, -1, 1, 1)
     return out

@@ -1,0 +1,95 @@
+"""AMP autocast + GradScaler (reference: unittests/test_imperative_auto_mixed_precision.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_autocast_o1_white_black():
+    a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    b = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = paddle.matmul(a, b)       # white list -> bf16
+        s = paddle.nn.functional.softmax(a)  # black list -> fp32
+    assert out.dtype == paddle.bfloat16
+    assert s.dtype == paddle.float32
+    # outside context everything back to fp32 math
+    assert paddle.matmul(a, b).dtype == paddle.float32
+
+
+def test_autocast_o2():
+    a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        out = paddle.add(a, a)  # gray op also low precision in O2
+    assert out.dtype == paddle.bfloat16
+
+
+def test_custom_lists():
+    a = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with paddle.amp.auto_cast(custom_black_list={"matmul_v2"},
+                              dtype="bfloat16"):
+        out = paddle.matmul(a, a)
+    assert out.dtype == paddle.float32
+
+
+def test_grad_scaler_scales_and_unscales():
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    loss = net(x).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled.numpy()) == pytest.approx(float(loss.numpy()) * 128,
+                                                  rel=1e-5)
+    scaled.backward()
+    w_before = net.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    # after unscale, the applied grad magnitude matches unscaled gradient
+    assert not np.allclose(net.weight.numpy(), w_before)
+    assert np.isfinite(net.weight.numpy()).all()
+
+
+def test_grad_scaler_skips_on_overflow():
+    p = paddle.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(1.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   decr_every_n_nan_or_inf=1)
+    from paddle_tpu.core.tensor import Tensor
+    p._grad = Tensor(np.array([np.inf, 1.0], np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_array_equal(p.numpy(), [1.0, 1.0])  # update skipped
+    assert scaler.get_loss_scaling().numpy() == pytest.approx(2.0)  # decayed
+
+
+def test_grad_scaler_grows_after_n_good_steps():
+    p = paddle.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(0.0, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   incr_every_n_steps=2, incr_ratio=2.0)
+    from paddle_tpu.core.tensor import Tensor
+    for i in range(2):
+        p._grad = Tensor(np.ones(2, np.float32))
+        scaler.step(opt)
+        scaler.update()
+    assert scaler.get_loss_scaling().numpy() == pytest.approx(8.0)
+
+
+def test_amp_training_loop_bf16():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 2, (16,)))
+    losses = []
+    for _ in range(5):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]

@@ -1,0 +1,86 @@
+"""Checkpoint / serialization (reference: unittests/test_paddle_save_load.py,
+test_jit_save_load.py)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_save_load_state_dict(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), path)
+    loaded = paddle.load(path)
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    net2.set_state_dict(loaded)
+    for (n1, p1), (n2, p2) in zip(net.named_parameters(),
+                                  net2.named_parameters()):
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+def test_save_load_bfloat16(tmp_path):
+    net = nn.Linear(3, 3)
+    net.to(dtype="bfloat16")
+    path = str(tmp_path / "bf16.pdparams")
+    paddle.save(net.state_dict(), path)
+    loaded = paddle.load(path)
+    assert str(loaded["weight"].dtype) == "bfloat16"
+
+
+def test_save_load_nested(tmp_path):
+    obj = {"a": paddle.ones([2]), "b": [paddle.zeros([3]), 7], "c": "str"}
+    path = str(tmp_path / "obj.pkl")
+    paddle.save(obj, path)
+    loaded = paddle.load(path)
+    np.testing.assert_array_equal(np.asarray(loaded["a"]), [1, 1])
+    assert loaded["b"][1] == 7 and loaded["c"] == "str"
+
+
+def test_optimizer_checkpoint_resume(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    for p in net.parameters():
+        p.name = "p_" + p.name
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    net(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+    paddle.save(net.state_dict(), str(tmp_path / "net.pdparams"))
+
+    state = paddle.load(str(tmp_path / "opt.pdopt"))
+    opt2 = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    opt2.set_state_dict(state)
+    m1 = list(opt._accumulators["moment1"].values())[0].numpy()
+    m2 = list(opt2._accumulators["moment1"].values())[0].numpy()
+    np.testing.assert_array_equal(m1, m2)
+
+
+def test_jit_save_load_inference(tmp_path):
+    from paddle_tpu.static import InputSpec
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    net.eval()
+    path = str(tmp_path / "infer")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(), atol=1e-5)
+
+
+def test_hapi_model_save_load(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-3,
+                                        parameters=net.parameters()),
+                  nn.CrossEntropyLoss())
+    path = str(tmp_path / "ck")
+    model.save(path)
+    w = net.fc[0].weight.numpy().copy()
+    net.fc[0].weight.set_value(np.zeros_like(w))
+    model.load(path)
+    np.testing.assert_array_equal(net.fc[0].weight.numpy(), w)

@@ -1,0 +1,90 @@
+"""paddle.io pipeline (reference: unittests/test_dataloader_*)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (DataLoader, Dataset, IterableDataset,
+                           TensorDataset, BatchSampler,
+                           DistributedBatchSampler, Subset, random_split)
+
+
+class _Sq(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batching():
+    dl = DataLoader(_Sq(), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4, 1] and y.shape == [4]
+    np.testing.assert_array_equal(x.numpy().ravel(), [0, 1, 2, 3])
+
+
+def test_dataloader_drop_last_and_shuffle():
+    dl = DataLoader(_Sq(10), batch_size=3, drop_last=True)
+    assert len(dl) == 3
+    dl2 = DataLoader(_Sq(10), batch_size=3, shuffle=True)
+    seen = np.concatenate([b[0].numpy().ravel() for b in dl2])
+    assert sorted(seen.tolist()) == list(range(10))
+
+
+def test_dataloader_workers_threaded():
+    dl = DataLoader(_Sq(16), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+
+
+def test_iterable_dataset():
+    class It(IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.float32([i])
+
+    dl = DataLoader(It(), batch_size=3)
+    shapes = [b[0].shape[0] for b in dl]
+    assert shapes == [3, 3, 1]
+
+
+def test_tensor_dataset_subset_split():
+    td = TensorDataset([np.arange(10), np.arange(10) * 2])
+    a, b = td[3]
+    assert a == 3 and b == 6
+    sub = Subset(td, [1, 2])
+    assert len(sub) == 2
+    parts = random_split(td, [7, 3])
+    assert len(parts[0]) == 7 and len(parts[1]) == 3
+
+
+def test_distributed_batch_sampler_partition():
+    ds = _Sq(16)
+    samplers = [DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                        rank=r) for r in range(4)]
+    all_idx = []
+    for s in samplers:
+        for batch in s:
+            all_idx.extend(batch)
+    assert sorted(all_idx) == list(range(16))
+    assert len(samplers[0]) == 2  # 4 samples per rank / bs 2
+
+
+def test_distributed_batch_sampler_shuffle_epoch():
+    ds = _Sq(16)
+    s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                shuffle=True)
+    s.set_epoch(0)
+    e0 = [i for b in s for i in b]
+    s.set_epoch(1)
+    e1 = [i for b in s for i in b]
+    assert e0 != e1
+
+
+def test_batch_sampler_custom():
+    bs = BatchSampler(dataset=_Sq(10), batch_size=5)
+    assert len(bs) == 2

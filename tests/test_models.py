@@ -1,0 +1,108 @@
+"""Model zoo forwards + training smoke (reference: unittests book/ e2e
+tests; vision model tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_lenet_forward():
+    from paddle_tpu.vision.models import LeNet
+    net = LeNet()
+    out = net(t(np.random.randn(2, 1, 28, 28)))
+    assert out.shape == [2, 10]
+
+
+def test_resnet18_forward_and_step():
+    from paddle_tpu.vision.models import resnet18
+    net = resnet18(num_classes=10)
+    x = t(np.random.randn(2, 3, 32, 32))
+    out = net(x)
+    assert out.shape == [2, 10]
+    loss = nn.CrossEntropyLoss()(out, paddle.to_tensor(np.array([1, 2])))
+    loss.backward()
+    grads = [p.grad for p in net.parameters() if p.grad is not None]
+    assert len(grads) > 50
+
+
+def test_mobilenet_vgg_forward():
+    from paddle_tpu.vision.models import mobilenet_v2, vgg11
+    assert mobilenet_v2(num_classes=5)(
+        t(np.random.randn(1, 3, 32, 32))).shape == [1, 5]
+    assert vgg11(num_classes=4)(
+        t(np.random.randn(1, 3, 224, 224))).shape == [1, 4]
+
+
+def test_gpt_loss_decreases():
+    from paddle_tpu.text.models import TransformerLMConfig, GPTForCausalLM
+    paddle.seed(0)
+    cfg = TransformerLMConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=16, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    ids = paddle.to_tensor(np.random.randint(0, 64, (4, 16)))
+    labels = paddle.to_tensor(np.random.randint(0, 64, (4, 16)))
+
+    @paddle.jit.to_static
+    def step(i, l):
+        loss = model(i, l)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(ids, labels).numpy()) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+
+
+def test_bert_pretraining_forward():
+    from paddle_tpu.text.models import TransformerLMConfig, BertForPretraining
+    cfg = TransformerLMConfig(vocab_size=100, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=16, dropout=0.0)
+    model = BertForPretraining(cfg)
+    ids = paddle.to_tensor(np.random.randint(0, 100, (2, 16)))
+    seg = paddle.to_tensor(np.random.randint(0, 2, (2, 16)))
+    mlm = np.random.randint(0, 100, (2, 16))
+    mlm[:, ::2] = -1  # ignored positions
+    nsp = paddle.to_tensor(np.array([0, 1]))
+    loss = model(ids, seg, paddle.to_tensor(mlm), nsp)
+    assert loss.shape == []
+    loss.backward()
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_gpt_generation_shapes():
+    from paddle_tpu.text.models import TransformerLMConfig, GPTForCausalLM
+    cfg = TransformerLMConfig(vocab_size=50, hidden_size=32, num_layers=1,
+                              num_heads=2, max_seq_len=8, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    logits = model(paddle.to_tensor(np.random.randint(0, 50, (1, 8))))
+    assert logits.shape == [1, 8, 50]
+
+
+def test_hapi_fit_evaluate_predict():
+    from paddle_tpu.vision.models import LeNet
+    from paddle_tpu.vision.datasets import FakeData
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    data = FakeData(num_samples=32)
+    model.fit(data, batch_size=8, epochs=1, verbose=0)
+    res = model.evaluate(data, batch_size=8)
+    assert "loss" in res and "acc" in res
+    preds = model.predict(data, batch_size=8, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+
+
+def test_summary():
+    from paddle_tpu.vision.models import LeNet
+    info = paddle.summary(LeNet())
+    assert info["total_params"] > 60000

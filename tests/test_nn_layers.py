@@ -1,0 +1,179 @@
+"""nn.Layer system + layer forwards (reference: unittests/test_layers.py,
+test_imperative_* suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32))
+
+
+class TestLayerBase:
+    def test_registration(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 3)
+                self.w = paddle.Parameter(np.ones((2, 2), np.float32))
+                self.register_buffer("buf", paddle.ones([2]))
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        names = dict(net.named_parameters())
+        assert "w" in names and "fc.weight" in names and "fc.bias" in names
+        assert len(net.parameters()) == 3
+        assert len(net.buffers()) == 1
+        assert net.fc is net._sub_layers["fc"]
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(3, 2)
+        sd = net.state_dict()
+        assert set(sd) == {"weight", "bias"}
+        net2 = nn.Linear(3, 2)
+        net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      net.weight.numpy())
+
+    def test_train_eval_propagates(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        net = nn.Linear(2, 2)
+        calls = []
+        h = net.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        net(t(np.zeros((1, 2))))
+        assert calls == [1]
+        h.remove()
+        net(t(np.zeros((1, 2))))
+        assert calls == [1]
+
+    def test_apply_and_to_dtype(self):
+        net = nn.Linear(2, 2)
+        net.to(dtype="bfloat16")
+        assert net.weight.dtype == paddle.bfloat16
+
+    def test_containers(self):
+        seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU(), nn.Linear(4, 1))
+        out = seq(t(np.ones((3, 2))))
+        assert out.shape == [3, 1]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3 and len(ll.parameters()) == 6
+        ll.append(nn.Linear(2, 2))
+        assert len(ll) == 4
+        pl = nn.ParameterList([paddle.Parameter(np.zeros(2, np.float32))])
+        assert len(pl.parameters()) == 1
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+
+class TestLayers:
+    def test_linear_semantics(self):
+        # paddle weight layout: [in, out], y = x W + b
+        fc = nn.Linear(3, 2)
+        x = np.random.randn(4, 3).astype("float32")
+        ref = x @ fc.weight.numpy() + fc.bias.numpy()
+        np.testing.assert_allclose(fc(t(x)).numpy(), ref, rtol=1e-5)
+
+    def test_conv_shapes(self):
+        x = t(np.random.randn(2, 3, 8, 8))
+        assert nn.Conv2D(3, 6, 3)(x).shape == [2, 6, 6, 6]
+        assert nn.Conv2D(3, 6, 3, padding=1)(x).shape == [2, 6, 8, 8]
+        assert nn.Conv2D(3, 6, 3, stride=2, padding=1)(x).shape == [2, 6, 4, 4]
+        assert nn.Conv2D(3, 3, 3, groups=3, padding=1)(x).shape == [2, 3, 8, 8]
+        assert nn.Conv2DTranspose(3, 4, 2, stride=2)(x).shape == [2, 4, 16, 16]
+        x1 = t(np.random.randn(2, 3, 10))
+        assert nn.Conv1D(3, 5, 3)(x1).shape == [2, 5, 8]
+
+    def test_norm_layers(self):
+        x = t(np.random.randn(2, 4, 3, 3))
+        assert nn.BatchNorm2D(4)(x).shape == [2, 4, 3, 3]
+        assert nn.GroupNorm(2, 4)(x).shape == [2, 4, 3, 3]
+        assert nn.InstanceNorm2D(4)(x).shape == [2, 4, 3, 3]
+        ln = nn.LayerNorm([4, 3, 3])
+        out = ln(x).numpy()
+        assert abs(out.mean()) < 1e-5
+        seq = t(np.random.randn(2, 5, 8))
+        assert nn.LayerNorm(8)(seq).shape == [2, 5, 8]
+
+    def test_activations(self):
+        x = t(np.random.randn(5))
+        for L in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.LeakyReLU,
+                  nn.Silu, nn.Hardswish, nn.ELU, nn.Softplus, nn.Mish]:
+            assert L()(x).shape == [5]
+        assert nn.Softmax()(t(np.random.randn(2, 3))).numpy().sum() == \
+            pytest.approx(2.0, rel=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        out = emb(paddle.to_tensor(np.array([[1, 2], [3, 4]])))
+        assert out.shape == [2, 2, 4]
+
+    def test_losses(self):
+        pred = t(np.random.randn(4, 3))
+        label = paddle.to_tensor(np.array([0, 1, 2, 1]))
+        assert nn.CrossEntropyLoss()(pred, label).shape == []
+        assert nn.MSELoss()(pred, t(np.random.randn(4, 3))).shape == []
+        assert nn.L1Loss("none")(pred, pred).shape == [4, 3]
+
+    def test_rnn_lstm_gru(self):
+        x = t(np.random.randn(2, 5, 4))  # [batch, seq, feat]
+        lstm = nn.LSTM(4, 8)
+        y, (h, c) = lstm(x)
+        assert y.shape == [2, 5, 8]
+        assert h.shape == [1, 2, 8] and c.shape == [1, 2, 8]
+        gru = nn.GRU(4, 8, num_layers=2)
+        y, h = gru(x)
+        assert y.shape == [2, 5, 8] and h.shape == [2, 2, 8]
+        bi = nn.LSTM(4, 8, direction="bidirect")
+        y, (h, c) = bi(x)
+        assert y.shape == [2, 5, 16] and h.shape == [2, 2, 8]
+
+    def test_lstm_grad_flows(self):
+        lstm = nn.LSTM(4, 8)
+        x = t(np.random.randn(2, 5, 4))
+        y, _ = lstm(x)
+        y.sum().backward()
+        assert lstm.weight_ih_l0.grad is not None
+        assert np.isfinite(lstm.weight_ih_l0.grad.numpy()).all()
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.randn(2, 6, 16))
+        assert enc(x).shape == [2, 6, 16]
+
+    def test_multihead_attention_mask(self):
+        mha = nn.MultiHeadAttention(16, 4, dropout=0.0)
+        x = t(np.random.randn(2, 5, 16))
+        mask = paddle.to_tensor(np.tril(np.ones((5, 5), bool)))
+        out = mha(x, x, x, attn_mask=mask)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32,
+                               dropout=0.0)
+        src = t(np.random.randn(2, 4, 16))
+        tgt = t(np.random.randn(2, 3, 16))
+        assert model(src, tgt).shape == [2, 3, 16]
+
+    def test_grad_clip(self):
+        p = paddle.Parameter(np.ones(4, np.float32))
+        (p * 100).sum().backward()
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        out = clip([(p, p.grad)])
+        norm = np.linalg.norm(out[0][1].numpy())
+        assert norm == pytest.approx(1.0, rel=1e-4)
+        clip2 = nn.ClipGradByValue(0.5)
+        out2 = clip2([(p, p.grad)])
+        assert out2[0][1].numpy().max() <= 0.5

@@ -1,0 +1,342 @@
+"""Op golden tests vs numpy — the reference OpTest.check_output pattern
+(python/paddle/fluid/tests/unittests/op_test.py:1078)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestCreation:
+    def test_basic(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        np.testing.assert_array_equal(paddle.full([2], 7).numpy(), [7, 7])
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_array_equal(paddle.arange(1, 10, 3).numpy(),
+                                      np.arange(1, 10, 3))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5))
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+
+    def test_like(self):
+        x = paddle.ones([2, 2])
+        assert paddle.zeros_like(x).numpy().sum() == 0
+        assert paddle.full_like(x, 3).numpy().sum() == 12
+
+    def test_tril_triu_diag(self):
+        a = np.random.randn(4, 4)
+        np.testing.assert_allclose(paddle.tril(t(a)).numpy(), np.tril(a))
+        np.testing.assert_allclose(paddle.triu(t(a), 1).numpy(),
+                                   np.triu(a, 1))
+        v = np.array([1.0, 2.0, 3.0])
+        np.testing.assert_allclose(paddle.diag(t(v)).numpy(), np.diag(v))
+
+    def test_random_shapes_and_determinism(self):
+        paddle.seed(5)
+        a = paddle.rand([3, 3]).numpy()
+        paddle.seed(5)
+        b = paddle.rand([3, 3]).numpy()
+        np.testing.assert_array_equal(a, b)
+        c = paddle.rand([3, 3]).numpy()
+        assert not np.array_equal(b, c)  # state advanced
+        assert paddle.randn([4]).shape == [4]
+        r = paddle.randint(0, 5, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 5
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestMath:
+    def test_binary_golden(self):
+        a = np.random.randn(3, 4)
+        b = np.random.rand(3, 4) + 0.5
+        cases = [
+            (paddle.add, np.add), (paddle.subtract, np.subtract),
+            (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+            (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+            (paddle.pow, np.power) if (a > 0).all() else (paddle.add, np.add),
+            (paddle.atan2, np.arctan2),
+        ]
+        for pf, nf in cases:
+            np.testing.assert_allclose(pf(t(np.abs(a) + 1), t(b)).numpy(),
+                                       nf(np.abs(a) + 1, b), rtol=1e-6)
+
+    def test_broadcasting(self):
+        a = np.random.randn(3, 1, 4)
+        b = np.random.randn(1, 5, 4)
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b)
+
+    def test_unary_golden(self):
+        x = np.random.rand(10) + 0.1
+        for pf, nf in [(paddle.exp, np.exp), (paddle.log, np.log),
+                       (paddle.sqrt, np.sqrt), (paddle.sin, np.sin),
+                       (paddle.cos, np.cos), (paddle.tanh, np.tanh),
+                       (paddle.floor, np.floor), (paddle.ceil, np.ceil),
+                       (paddle.abs, np.abs), (paddle.square, np.square)]:
+            np.testing.assert_allclose(pf(t(x)).numpy(), nf(x), rtol=1e-6)
+
+    def test_matmul_variants(self):
+        a = np.random.randn(2, 3, 4)
+        b = np.random.randn(2, 4, 5)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.transpose(0, 2, 1)),
+                          transpose_y=True).numpy(), a @ b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.bmm(t(a), t(b)).numpy(), a @ b,
+                                   rtol=1e-6)
+
+    def test_clip_scale_lerp(self):
+        x = np.array([-2.0, 0.5, 3.0])
+        np.testing.assert_allclose(paddle.clip(t(x), -1, 1).numpy(),
+                                   np.clip(x, -1, 1))
+        np.testing.assert_allclose(paddle.scale(t(x), 2.0, 1.0).numpy(),
+                                   x * 2 + 1)
+        np.testing.assert_allclose(
+            paddle.lerp(t(x), t(x + 2), 0.5).numpy(), x + 1)
+
+    def test_cumsum_einsum(self):
+        x = np.random.randn(3, 4)
+        np.testing.assert_allclose(paddle.cumsum(t(x), 1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-6)
+        y = np.random.randn(4, 5)
+        np.testing.assert_allclose(
+            paddle.einsum("ij,jk->ik", t(x), t(y)).numpy(), x @ y, rtol=1e-5)
+
+
+class TestReduction:
+    def test_golden(self):
+        x = np.random.randn(3, 4, 5)
+        np.testing.assert_allclose(paddle.sum(t(x)).numpy(), x.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(x), axis=1).numpy(),
+                                   x.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.max(t(x), axis=[0, 2]).numpy(), x.max((0, 2)))
+        np.testing.assert_allclose(
+            paddle.sum(t(x), axis=1, keepdim=True).numpy(),
+            x.sum(1, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(paddle.std(t(x)).numpy(),
+                                   x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.logsumexp(t(x), axis=0).numpy(),
+                                   np.log(np.exp(x).sum(0)), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.norm(t(x), p=2, axis=1).numpy(),
+            np.linalg.norm(x, axis=1), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_shapes(self):
+        x = np.arange(24).reshape(2, 3, 4).astype("float32")
+        np.testing.assert_array_equal(
+            paddle.reshape(t(x), [4, 6]).numpy(), x.reshape(4, 6))
+        np.testing.assert_array_equal(
+            paddle.transpose(t(x), [2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+        np.testing.assert_array_equal(
+            paddle.concat([t(x), t(x)], axis=1).numpy(),
+            np.concatenate([x, x], 1))
+        np.testing.assert_array_equal(
+            paddle.stack([t(x), t(x)]).numpy(), np.stack([x, x]))
+        parts = paddle.split(t(x), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+        parts = paddle.split(t(x), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2, 4]
+        np.testing.assert_array_equal(paddle.flip(t(x), [0]).numpy(),
+                                      x[::-1])
+        np.testing.assert_array_equal(paddle.tile(t(x), [1, 2, 1]).numpy(),
+                                      np.tile(x, (1, 2, 1)))
+        np.testing.assert_array_equal(
+            paddle.expand(paddle.ones([1, 3]), [4, 3]).numpy(),
+            np.ones((4, 3)))
+
+    def test_gather_scatter(self):
+        x = np.random.randn(5, 3).astype("float32")
+        idx = np.array([0, 2, 4])
+        np.testing.assert_array_equal(
+            paddle.gather(t(x), t(idx)).numpy(), x[idx])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle.scatter(t(x), t(np.array([0, 1])), t(upd)).numpy()
+        np.testing.assert_array_equal(out[:2], upd)
+        np.testing.assert_array_equal(out[2:], x[2:])
+        # gather_nd
+        gx = paddle.gather_nd(t(x), t(np.array([[0, 1], [2, 2]])))
+        np.testing.assert_array_equal(gx.numpy(), [x[0, 1], x[2, 2]])
+
+    def test_put_along_axis_add_duplicates(self):
+        x = paddle.zeros([3, 1])
+        idx = t(np.array([[0], [0]]))
+        vals = t(np.array([[1.0], [2.0]]))
+        out = paddle.put_along_axis(x, idx, vals, axis=0, reduce="add")
+        assert out.numpy()[0, 0] == pytest.approx(3.0)
+
+    def test_where_masked(self):
+        x = np.random.randn(4)
+        cond = x > 0
+        np.testing.assert_array_equal(
+            paddle.where(t(cond), t(x), t(-x)).numpy(), np.abs(x))
+        sel = paddle.masked_select(t(x), t(cond))
+        np.testing.assert_array_equal(sel.numpy(), x[cond])
+
+    def test_pad(self):
+        x = np.random.randn(1, 1, 3, 3).astype("float32")
+        out = paddle.nn.functional.pad(t(x), [1, 1, 2, 2])
+        assert out.shape == [1, 1, 7, 5]
+
+    def test_squeeze_unsqueeze_roll(self):
+        x = np.random.randn(1, 3, 1).astype("float32")
+        assert paddle.squeeze(t(x)).shape == [3]
+        assert paddle.squeeze(t(x), axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t(x), [0, 4]).shape == [1, 1, 3, 1, 1]
+        v = np.arange(5)
+        np.testing.assert_array_equal(paddle.roll(t(v), 2).numpy(),
+                                      np.roll(v, 2))
+
+
+class TestSearch:
+    def test_argmax_topk_sort(self):
+        x = np.random.randn(4, 6)
+        np.testing.assert_array_equal(paddle.argmax(t(x), axis=1).numpy(),
+                                      x.argmax(1))
+        np.testing.assert_array_equal(paddle.argmin(t(x)).numpy(),
+                                      x.argmin())
+        vals, idx = paddle.topk(t(x), 3, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :3],
+                                   rtol=1e-6)
+        np.testing.assert_array_equal(paddle.sort(t(x), axis=1).numpy(),
+                                      np.sort(x, 1))
+        nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+        u = paddle.unique(t(np.array([3, 1, 3, 2])))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+
+
+class TestLogic:
+    def test_all(self):
+        a = np.array([1.0, 2.0])
+        assert bool(paddle.allclose(t(a), t(a + 1e-9)).numpy())
+        assert bool(paddle.equal_all(t(a), t(a)).numpy())
+        assert not bool(paddle.equal_all(t(a), t(a + 1)).numpy())
+        np.testing.assert_array_equal(
+            paddle.logical_and(t(np.array([True, False])),
+                               t(np.array([True, True]))).numpy(),
+            [True, False])
+
+
+class TestLinalg:
+    def test_golden(self):
+        a = np.random.randn(3, 3)
+        spd = a @ a.T + 3 * np.eye(3)
+        np.testing.assert_allclose(paddle.linalg.cholesky(t(spd)).numpy(),
+                                   np.linalg.cholesky(spd), rtol=1e-5)
+        np.testing.assert_allclose(paddle.linalg.inv(t(spd)).numpy(),
+                                   np.linalg.inv(spd), rtol=1e-4)
+        np.testing.assert_allclose(paddle.linalg.det(t(spd)).numpy(),
+                                   np.linalg.det(spd), rtol=1e-5)
+        b = np.random.randn(3, 2)
+        np.testing.assert_allclose(paddle.linalg.solve(t(spd), t(b)).numpy(),
+                                   np.linalg.solve(spd, b), rtol=1e-4)
+
+
+class TestNNOps:
+    def test_softmax_golden(self):
+        x = np.random.randn(3, 5)
+        e = np.exp(x - x.max(1, keepdims=True))
+        np.testing.assert_allclose(F.softmax(t(x)).numpy(),
+                                   e / e.sum(1, keepdims=True), rtol=1e-5)
+
+    def test_conv2d_golden_vs_scipy(self):
+        x = np.random.randn(1, 1, 5, 5).astype("float64")
+        w = np.random.randn(1, 1, 3, 3).astype("float64")
+        out = F.conv2d(t(x), t(w)).numpy()
+        from scipy.signal import correlate2d
+        ref = correlate2d(x[0, 0], w[0, 0], mode="valid")
+        np.testing.assert_allclose(out[0, 0], ref, rtol=1e-6)
+
+    def test_pool_golden(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        out = F.max_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_array_equal(out[0, 0], [[5, 7], [13, 15]])
+        avg = F.avg_pool2d(t(x), 2, 2).numpy()
+        np.testing.assert_allclose(avg[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_pool_padding(self):
+        x = np.random.randn(1, 1, 4, 4).astype("float32")
+        out = F.max_pool2d(t(x), 3, 1, padding=1)
+        assert out.shape == [1, 1, 4, 4]
+
+    def test_layer_norm_golden(self):
+        x = np.random.randn(2, 5).astype("float64")
+        out = F.layer_norm(t(x), 5).numpy()
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_batch_norm_train_updates_stats(self):
+        import paddle_tpu.nn as nn
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.randn(4, 3, 2, 2).astype("float32") * 2 + 1)
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        y = bn(x)
+        assert y.shape == [4, 3, 2, 2]
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5)
+        labels = np.array([1, -100, 2, -100])
+        loss = F.cross_entropy(t(logits), t(labels), ignore_index=-100)
+        # manual: mean over the 2 valid rows
+        logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+        ref = -(logp[0, 1] + logp[2, 2]) / 2
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+    def test_bce_with_logits_golden(self):
+        x = np.random.randn(6)
+        z = (np.random.rand(6) > 0.5).astype("float64")
+        out = F.binary_cross_entropy_with_logits(
+            t(x), t(z), reduction="none").numpy()
+        ref = np.maximum(x, 0) - x * z + np.log1p(np.exp(-np.abs(x)))
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_dropout_train_eval(self):
+        x = paddle.ones([1000])
+        paddle.seed(3)
+        out = F.dropout(x, p=0.5, training=True)
+        kept = (out.numpy() != 0)
+        assert 0.3 < kept.mean() < 0.7
+        np.testing.assert_allclose(out.numpy()[kept], 2.0)  # upscale
+        np.testing.assert_array_equal(
+            F.dropout(x, p=0.5, training=False).numpy(), x.numpy())
+
+    def test_embedding_padding_idx(self):
+        w = t(np.random.randn(5, 3).astype("float32"))
+        out = F.embedding(t(np.array([0, 2])), w, padding_idx=2)
+        assert np.allclose(out.numpy()[1], 0)
+
+    def test_interpolate(self):
+        x = t(np.random.randn(1, 2, 4, 4).astype("float32"))
+        assert F.interpolate(x, scale_factor=2, mode="nearest").shape == \
+            [1, 2, 8, 8]
+        assert F.interpolate(x, size=[2, 2], mode="bilinear").shape == \
+            [1, 2, 2, 2]
+
+    def test_attention_parity(self):
+        q = np.random.randn(2, 4, 16, 8).astype("float32")
+        k = np.random.randn(2, 4, 16, 8).astype("float32")
+        v = np.random.randn(2, 4, 16, 8).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(k), t(v),
+                                             is_causal=True).numpy()
+        # manual reference
+        s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(8)
+        mask = np.tril(np.ones((16, 16), bool))
+        s = np.where(mask, s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = p @ v
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
