@@ -1,0 +1,191 @@
+"""Fleet datasets: InMemoryDataset / QueueDataset.
+
+Reference parity: python/paddle/distributed/fleet/dataset/dataset.py:259
+(InMemoryDataset) / :1099 (QueueDataset) configuring the C++ Dataset/
+DataFeed (framework/data_feed.cc MultiSlotDataFeed, data_set.cc channels +
+preload threads, global shuffle via brpc).
+
+TPU-native design: the slot parsing and the sample channel are native C++
+(runtime_cpp: ptd_parse_multislot threaded parser + BlockingQueue), driven
+by Python file-loader threads; "global shuffle" is an in-memory permutation
+(single-controller — no brpc exchange needed). Batches pad ragged sparse
+slots to the bucketized max length so shapes stay static for XLA.
+"""
+import os
+import threading
+
+import numpy as np
+
+from ...core import native
+
+
+class DatasetBase:
+    def __init__(self):
+        self._use_var = []
+        self._pipe_command = None
+        self._batch_size = 1
+        self._thread_num = 4
+        self._filelist = []
+
+    def init(self, batch_size=1, thread_num=4, use_var=None,
+             pipe_command=None, input_type=0, fs_name="", fs_ugi="",
+             download_cmd="cat", **kwargs):
+        self._batch_size = batch_size
+        self._thread_num = thread_num
+        self._use_var = use_var or []
+        self._pipe_command = pipe_command
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self._thread_num = thread_num
+
+    def set_use_var(self, use_var):
+        self._use_var = use_var
+
+
+class InMemoryDataset(DatasetBase):
+    """Loads slot-format text files into memory with threaded native
+    parsing; supports global shuffle and batch iteration with padded
+    sparse slots."""
+
+    def __init__(self):
+        super().__init__()
+        self._slots = None  # list of (values, offsets) per slot
+        self._num_samples = 0
+        self._num_slots = 0
+        self._slot_is_dense = []
+
+    def init(self, batch_size=1, thread_num=4, use_var=None, **kwargs):
+        super().init(batch_size, thread_num, use_var, **kwargs)
+        self._num_slots = len(self._use_var) if self._use_var else 0
+
+    def load_into_memory(self):
+        texts = []
+        lock = threading.Lock()
+
+        def read(path):
+            with open(path, "r") as f:
+                data = f.read()
+            with lock:
+                texts.append(data)
+
+        threads = [threading.Thread(target=read, args=(p,))
+                   for p in self._filelist]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        text = "".join(texts)
+        if not self._num_slots:
+            # infer from first line: count of "<n> values..." groups
+            first = text.split("\n", 1)[0].split()
+            i = 0
+            n = 0
+            while i < len(first):
+                cnt = int(first[i])
+                i += cnt + 1
+                n += 1
+            self._num_slots = n
+        if native.available():
+            self._slots = native.parse_multislot(
+                text, self._num_slots, self._thread_num)
+        else:
+            self._slots = _py_parse_multislot(text, self._num_slots)
+        self._num_samples = len(self._slots[0][1]) - 1
+        self._slot_is_dense = [
+            bool(np.all(np.diff(offs) == (offs[1] - offs[0])))
+            for _, offs in self._slots]
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Single-controller equivalent of the reference's brpc global
+        shuffle: permute samples in memory."""
+        perm = np.random.permutation(self._num_samples)
+        new_slots = []
+        for vals, offs in self._slots:
+            counts = np.diff(offs)
+            new_counts = counts[perm]
+            new_offs = np.zeros(len(offs), np.int64)
+            np.cumsum(new_counts, out=new_offs[1:])
+            new_vals = np.empty_like(vals)
+            pos = 0
+            for i, src in enumerate(perm):
+                c = counts[src]
+                new_vals[pos:pos + c] = vals[offs[src]:offs[src] + c]
+                pos += c
+            new_slots.append((new_vals, new_offs))
+        self._slots = new_slots
+
+    def local_shuffle(self):
+        self.global_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        return self._num_samples
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self._num_samples
+
+    def release_memory(self):
+        self._slots = None
+        self._num_samples = 0
+
+    def __iter__(self):
+        """Yields per-batch lists: dense slots -> [B] or [B, k] arrays;
+        sparse slots -> (padded [B, maxlen] int64, [B] lengths)."""
+        bs = self._batch_size
+        for start in range(0, self._num_samples - bs + 1, bs):
+            batch = []
+            for (vals, offs), dense in zip(self._slots,
+                                           self._slot_is_dense):
+                counts = np.diff(offs[start:start + bs + 1])
+                if dense:
+                    k = counts[0]
+                    arr = vals[offs[start]:offs[start + bs]].reshape(bs, k)
+                    batch.append(arr.copy())
+                else:
+                    maxlen = int(counts.max())
+                    pad = np.zeros((bs, maxlen), np.int64)
+                    for i in range(bs):
+                        c = counts[i]
+                        o = offs[start + i]
+                        pad[i, :c] = vals[o:o + c].astype(np.int64)
+                    batch.append((pad, counts.astype(np.int64)))
+            yield batch
+
+
+def _py_parse_multislot(text, num_slots):
+    values = [[] for _ in range(num_slots)]
+    offsets = [[0] for _ in range(num_slots)]
+    for line in text.splitlines():
+        toks = line.split()
+        i = 0
+        for s in range(num_slots):
+            cnt = int(toks[i])
+            i += 1
+            values[s].extend(float(t) for t in toks[i:i + cnt])
+            i += cnt
+            offsets[s].append(offsets[s][-1] + cnt)
+    return [(np.asarray(v, np.float32), np.asarray(o, np.int64))
+            for v, o in zip(values, offsets)]
+
+
+class QueueDataset(DatasetBase):
+    """Streaming variant: files parsed on the fly through the native
+    blocking queue (reference QueueDataset semantics — one pass, no
+    global shuffle)."""
+
+    def __iter__(self):
+        inner = InMemoryDataset()
+        inner._use_var = self._use_var
+        inner._batch_size = self._batch_size
+        inner._thread_num = self._thread_num
+        for path in self._filelist:
+            inner.set_filelist([path])
+            inner._num_slots = len(self._use_var) if self._use_var else 0
+            inner.load_into_memory()
+            yield from inner
+            inner.release_memory()

@@ -1,0 +1,117 @@
+"""Distributed (parameter-server-equivalent) embedding tables.
+
+Reference: the brpc parameter server's sparse tables
+(paddle/fluid/distributed/table/common_sparse_table.h, SSDSparseTable) +
+distributed_lookup_table op (operators/pscore/distributed_lookup_table_op)
+serve huge embeddings from CPU-cluster RAM with pull/push RPC.
+
+TPU-native design (SURVEY §7 hard part 7 — reduced-scope equivalent):
+- DistributedEmbedding: table row-sharded over a mesh axis (HBM across
+  chips); lookup is a GSPMD-sharded gather — XLA emits the all-to-all the
+  PS pull performed explicitly. Scales table size with chip count.
+- HostEmbeddingTable: table lives in host RAM as numpy (the "CPU parameter
+  server" role on one host); pull gathers rows to device, push applies
+  sparse SGD updates host-side. For tables larger than HBM.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op, no_grad
+from ...core.tensor import Tensor, Parameter
+from ...nn.layer_base import Layer
+from ...nn import initializer as init_mod
+from ...ops import nn_ops
+from .meta_parallel.mp_layers import shard_constraint
+
+
+class DistributedEmbedding(Layer):
+    """HBM-sharded embedding: rows sharded over the 'mp' axis (or a given
+    axis); gradient is a dense scatter-add XLA handles sharded."""
+
+    def __init__(self, num_embeddings, embedding_dim, axis="mp",
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._axis = axis
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            attr=init_mod.ParamAttr._to_attr(weight_attr),
+            default_initializer=init_mod.Normal(0.0, 0.01))
+        self.weight.tp_spec = (axis, None)
+
+    def forward(self, ids):
+        w = shard_constraint(self.weight, self.weight.tp_spec)
+        return nn_ops.embedding(ids, w)
+
+
+class HostEmbeddingTable:
+    """Host-RAM table with pull/push API (the PS worker's view).
+
+    pull(ids)  -> device Tensor of rows (forward)
+    push(ids, grads, lr) -> sparse host-side update (backward apply)
+    The (pull, autograd-cut, push) pattern matches the reference's
+    DownpourWorker pull/push cycle (framework/fleet/fleet_wrapper.h:69).
+    """
+
+    def __init__(self, num_embeddings, embedding_dim, init_std=0.01,
+                 optimizer="sgd", seed=0):
+        rs = np.random.RandomState(seed)
+        self.table = (rs.randn(num_embeddings, embedding_dim)
+                      .astype(np.float32) * init_std)
+        self.embedding_dim = embedding_dim
+        self.optimizer = optimizer
+        self._adagrad_acc = None
+        if optimizer == "adagrad":
+            self._adagrad_acc = np.zeros(num_embeddings, np.float32)
+
+    def pull(self, ids):
+        ids_np = ids.numpy() if isinstance(ids, Tensor) else np.asarray(ids)
+        rows = self.table[ids_np.reshape(-1)].reshape(
+            ids_np.shape + (self.embedding_dim,))
+        return Tensor(jnp.asarray(rows))
+
+    @no_grad()
+    def push(self, ids, grads, lr=0.01):
+        ids_np = (ids.numpy() if isinstance(ids, Tensor)
+                  else np.asarray(ids)).reshape(-1)
+        g = (grads.numpy() if isinstance(grads, Tensor)
+             else np.asarray(grads)).reshape(-1, self.embedding_dim)
+        if self.optimizer == "adagrad":
+            sq = (g * g).mean(axis=1)
+            np.add.at(self._adagrad_acc, ids_np, sq)
+            scale = lr / (np.sqrt(self._adagrad_acc[ids_np]) + 1e-6)
+            np.subtract.at(self.table, ids_np, g * scale[:, None])
+        else:
+            np.subtract.at(self.table, ids_np, lr * g)
+
+    def save(self, path):
+        np.save(path, self.table)
+
+    def load(self, path):
+        self.table = np.load(path)
+
+
+class HostEmbedding(Layer):
+    """Layer wrapper over HostEmbeddingTable: forward pulls rows; backward
+    grads accumulate on the pulled Tensor and `apply_push(lr)` pushes them
+    back — one pull/push round per step, like the reference's async PS
+    worker loop."""
+
+    def __init__(self, num_embeddings, embedding_dim, **kwargs):
+        super().__init__()
+        self.table = HostEmbeddingTable(num_embeddings, embedding_dim,
+                                        **kwargs)
+        self._last = None  # (ids, pulled tensor)
+
+    def forward(self, ids):
+        pulled = self.table.pull(ids)
+        pulled.stop_gradient = False
+        self._last = (ids, pulled)
+        return pulled
+
+    def apply_push(self, lr=0.01):
+        if self._last is None:
+            return
+        ids, pulled = self._last
+        if pulled._grad is not None:
+            self.table.push(ids, pulled._grad, lr)
+        self._last = None

@@ -1,0 +1,327 @@
+// paddle_tpu native runtime: host-side components that stay CPU-bound in a
+// TPU framework — the XLA/PjRt runtime owns device execution, so the native
+// layer covers what feeds and observes it.
+//
+// Components (reference analogues):
+//  - BlockingQueue: MPMC bounded byte-buffer queue
+//      (reference: paddle/fluid/operators/reader/blocking_queue.h +
+//       LoDTensorBlockingQueue feeding buffered_reader)
+//  - Arena: aligned host-memory slab allocator with stats
+//      (reference: paddle/fluid/memory/allocation/auto_growth_best_fit_
+//       allocator.h — here host staging buffers for H2D transfer)
+//  - TraceCollector: lock-striped host event recorder with chrome-trace
+//      JSON export (reference: paddle/fluid/platform/profiler.h RecordEvent
+//      + tools/timeline.py)
+//  - MultiSlot parser: threaded parser for slot-format text samples
+//      (reference: paddle/fluid/framework/data_feed.cc MultiSlotDataFeed)
+//
+// C ABI only (consumed via ctypes; pybind11 not available in this image).
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- queue --
+struct Buffer {
+  std::vector<uint8_t> data;
+};
+
+struct BlockingQueue {
+  explicit BlockingQueue(size_t cap) : capacity(cap), closed(false) {}
+  size_t capacity;
+  bool closed;
+  std::deque<Buffer*> items;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+};
+
+void* ptq_queue_create(size_t capacity) {
+  return new BlockingQueue(capacity);
+}
+
+void ptq_queue_close(void* q_) {
+  auto* q = static_cast<BlockingQueue*>(q_);
+  {
+    std::lock_guard<std::mutex> g(q->mu);
+    q->closed = true;
+  }
+  q->not_empty.notify_all();
+  q->not_full.notify_all();
+}
+
+void ptq_queue_destroy(void* q_) {
+  auto* q = static_cast<BlockingQueue*>(q_);
+  for (auto* b : q->items) delete b;
+  delete q;
+}
+
+// returns 0 on success, -1 if closed
+int ptq_queue_put(void* q_, const uint8_t* data, size_t size) {
+  auto* q = static_cast<BlockingQueue*>(q_);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_full.wait(lk, [&] { return q->items.size() < q->capacity || q->closed; });
+  if (q->closed) return -1;
+  auto* b = new Buffer();
+  b->data.assign(data, data + size);
+  q->items.push_back(b);
+  lk.unlock();
+  q->not_empty.notify_one();
+  return 0;
+}
+
+// blocks; returns size (copied into out up to out_cap), -1 if closed+empty,
+// -2 if out_cap too small (item left in queue)
+int64_t ptq_queue_get(void* q_, uint8_t* out, size_t out_cap) {
+  auto* q = static_cast<BlockingQueue*>(q_);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [&] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return -1;
+  Buffer* b = q->items.front();
+  if (b->data.size() > out_cap) return -2;
+  q->items.pop_front();
+  lk.unlock();
+  q->not_full.notify_one();
+  int64_t n = static_cast<int64_t>(b->data.size());
+  std::memcpy(out, b->data.data(), b->data.size());
+  delete b;
+  return n;
+}
+
+// peek size of the front item without removing (-1 if closed+empty)
+int64_t ptq_queue_front_size(void* q_) {
+  auto* q = static_cast<BlockingQueue*>(q_);
+  std::unique_lock<std::mutex> lk(q->mu);
+  q->not_empty.wait(lk, [&] { return !q->items.empty() || q->closed; });
+  if (q->items.empty()) return -1;
+  return static_cast<int64_t>(q->items.front()->data.size());
+}
+
+size_t ptq_queue_size(void* q_) {
+  auto* q = static_cast<BlockingQueue*>(q_);
+  std::lock_guard<std::mutex> g(q->mu);
+  return q->items.size();
+}
+
+// ---------------------------------------------------------------- arena --
+struct Arena {
+  std::mutex mu;
+  // free lists by size class (power of two)
+  std::map<size_t, std::vector<void*>> free_lists;
+  std::atomic<size_t> allocated{0};
+  std::atomic<size_t> in_use{0};
+  std::atomic<size_t> alloc_calls{0};
+  std::atomic<size_t> cache_hits{0};
+};
+
+static size_t round_pow2(size_t n) {
+  size_t p = 64;
+  while (p < n) p <<= 1;
+  return p;
+}
+
+void* pta_arena_create() { return new Arena(); }
+
+void* pta_arena_alloc(void* a_, size_t size) {
+  auto* a = static_cast<Arena*>(a_);
+  size_t cls = round_pow2(size);
+  a->alloc_calls++;
+  {
+    std::lock_guard<std::mutex> g(a->mu);
+    auto it = a->free_lists.find(cls);
+    if (it != a->free_lists.end() && !it->second.empty()) {
+      void* p = it->second.back();
+      it->second.pop_back();
+      a->in_use += cls;
+      a->cache_hits++;
+      return p;
+    }
+  }
+  void* p = nullptr;
+  if (posix_memalign(&p, 64, cls) != 0) return nullptr;
+  a->allocated += cls;
+  a->in_use += cls;
+  return p;
+}
+
+void pta_arena_free(void* a_, void* p, size_t size) {
+  auto* a = static_cast<Arena*>(a_);
+  size_t cls = round_pow2(size);
+  std::lock_guard<std::mutex> g(a->mu);
+  a->free_lists[cls].push_back(p);
+  a->in_use -= cls;
+}
+
+void pta_arena_stats(void* a_, size_t* allocated, size_t* in_use,
+                     size_t* alloc_calls, size_t* cache_hits) {
+  auto* a = static_cast<Arena*>(a_);
+  *allocated = a->allocated.load();
+  *in_use = a->in_use.load();
+  *alloc_calls = a->alloc_calls.load();
+  *cache_hits = a->cache_hits.load();
+}
+
+void pta_arena_destroy(void* a_) {
+  auto* a = static_cast<Arena*>(a_);
+  for (auto& kv : a->free_lists)
+    for (void* p : kv.second) free(p);
+  delete a;
+}
+
+// ---------------------------------------------------------------- trace --
+struct TraceEvent {
+  std::string name;
+  int64_t ts_us;
+  int64_t dur_us;
+  int tid;
+};
+
+struct TraceCollector {
+  std::mutex mu;
+  std::vector<TraceEvent> events;
+  std::chrono::steady_clock::time_point t0 = std::chrono::steady_clock::now();
+};
+
+void* ptt_trace_create() { return new TraceCollector(); }
+
+int64_t ptt_trace_now_us(void* t_) {
+  auto* t = static_cast<TraceCollector*>(t_);
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - t->t0)
+      .count();
+}
+
+void ptt_trace_record(void* t_, const char* name, int64_t ts_us,
+                      int64_t dur_us, int tid) {
+  auto* t = static_cast<TraceCollector*>(t_);
+  std::lock_guard<std::mutex> g(t->mu);
+  t->events.push_back({name, ts_us, dur_us, tid});
+}
+
+// writes chrome://tracing JSON; returns number of events
+int64_t ptt_trace_dump(void* t_, const char* path) {
+  auto* t = static_cast<TraceCollector*>(t_);
+  std::lock_guard<std::mutex> g(t->mu);
+  FILE* f = fopen(path, "w");
+  if (!f) return -1;
+  fputs("{\"traceEvents\":[", f);
+  for (size_t i = 0; i < t->events.size(); ++i) {
+    const auto& e = t->events[i];
+    fprintf(f,
+            "%s{\"name\":\"%s\",\"ph\":\"X\",\"ts\":%lld,\"dur\":%lld,"
+            "\"pid\":0,\"tid\":%d}",
+            i ? "," : "", e.name.c_str(), static_cast<long long>(e.ts_us),
+            static_cast<long long>(e.dur_us), e.tid);
+  }
+  fputs("]}", f);
+  fclose(f);
+  return static_cast<int64_t>(t->events.size());
+}
+
+void ptt_trace_destroy(void* t_) { delete static_cast<TraceCollector*>(t_); }
+
+// ----------------------------------------------------- multislot parser --
+// Parses the slot text format (one sample per line):
+//   <num><sp><v1>..<vnum>  repeated per slot
+// into contiguous float buffers per slot, using worker threads.
+// Returns per-slot flattened values + per-sample offsets (CSR layout).
+struct ParsedSlots {
+  std::vector<std::vector<float>> values;   // [slot][flat values]
+  std::vector<std::vector<int64_t>> offsets;  // [slot][n_samples+1]
+};
+
+void* ptd_parse_multislot(const char* text, int64_t text_len, int num_slots,
+                          int num_threads) {
+  // split lines first
+  std::vector<std::pair<const char*, const char*>> lines;
+  const char* p = text;
+  const char* end = text + text_len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (!nl) nl = end;
+    if (nl > p) lines.emplace_back(p, nl);
+    p = nl + 1;
+  }
+  size_t n = lines.size();
+  auto* out = new ParsedSlots();
+  out->values.resize(num_slots);
+  out->offsets.assign(num_slots, std::vector<int64_t>(n + 1, 0));
+  std::vector<ParsedSlots> partial(num_threads);
+
+  int nt = num_threads < 1 ? 1 : num_threads;
+  std::vector<std::thread> threads;
+  std::vector<std::vector<std::vector<float>>> tvals(
+      nt, std::vector<std::vector<float>>(num_slots));
+  std::vector<std::vector<std::vector<int64_t>>> tcounts(
+      nt, std::vector<std::vector<int64_t>>(num_slots));
+
+  auto work = [&](int ti) {
+    for (size_t i = ti; i < n; i += nt) {
+      const char* q = lines[i].first;
+      const char* e = lines[i].second;
+      for (int s = 0; s < num_slots && q < e; ++s) {
+        char* next = nullptr;
+        long cnt = strtol(q, &next, 10);
+        q = next;
+        tcounts[ti][s].push_back(cnt);
+        for (long j = 0; j < cnt && q < e; ++j) {
+          float v = strtof(q, &next);
+          q = next;
+          tvals[ti][s].push_back(v);
+        }
+      }
+    }
+  };
+  for (int ti = 0; ti < nt; ++ti) threads.emplace_back(work, ti);
+  for (auto& th : threads) th.join();
+
+  // stitch in original sample order
+  std::vector<size_t> tpos(nt, 0);
+  std::vector<std::vector<size_t>> vpos(nt, std::vector<size_t>(num_slots, 0));
+  for (size_t i = 0; i < n; ++i) {
+    int ti = static_cast<int>(i % nt);
+    for (int s = 0; s < num_slots; ++s) {
+      int64_t cnt = tcounts[ti][s][tpos[ti]];
+      out->offsets[s][i + 1] = out->offsets[s][i] + cnt;
+      auto& src = tvals[ti][s];
+      size_t& vp = vpos[ti][s];
+      out->values[s].insert(out->values[s].end(), src.begin() + vp,
+                            src.begin() + vp + cnt);
+      vp += cnt;
+    }
+    tpos[ti]++;
+  }
+  return out;
+}
+
+int64_t ptd_slot_num_values(void* ps_, int slot) {
+  auto* ps = static_cast<ParsedSlots*>(ps_);
+  return static_cast<int64_t>(ps->values[slot].size());
+}
+
+int64_t ptd_slot_num_samples(void* ps_, int slot) {
+  auto* ps = static_cast<ParsedSlots*>(ps_);
+  return static_cast<int64_t>(ps->offsets[slot].size()) - 1;
+}
+
+void ptd_slot_copy(void* ps_, int slot, float* values_out,
+                   int64_t* offsets_out) {
+  auto* ps = static_cast<ParsedSlots*>(ps_);
+  std::memcpy(values_out, ps->values[slot].data(),
+              ps->values[slot].size() * sizeof(float));
+  std::memcpy(offsets_out, ps->offsets[slot].data(),
+              ps->offsets[slot].size() * sizeof(int64_t));
+}
+
+void ptd_parsed_destroy(void* ps_) { delete static_cast<ParsedSlots*>(ps_); }
+
+}  // extern "C"

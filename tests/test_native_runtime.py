@@ -1,0 +1,116 @@
+"""Native C++ runtime components (runtime_cpp/runtime.cc via ctypes)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime not built")
+
+
+def test_blocking_queue_roundtrip():
+    q = native.NativeBlockingQueue(capacity=4)
+    arr = np.arange(10, dtype=np.float32)
+    q.put_array(arr)
+    out = np.frombuffer(q.get_bytes(), np.float32)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_blocking_queue_producer_consumer():
+    q = native.NativeBlockingQueue(capacity=2)
+    results = []
+
+    def producer():
+        for i in range(20):
+            q.put_bytes(bytes([i]))
+        q.close()
+
+    def consumer():
+        while True:
+            b = q.get_bytes()
+            if b is None:
+                break
+            results.append(b[0])
+
+    tp = threading.Thread(target=producer)
+    tc = threading.Thread(target=consumer)
+    tp.start()
+    tc.start()
+    tp.join()
+    tc.join()
+    assert results == list(range(20))
+
+
+def test_queue_blocks_at_capacity():
+    q = native.NativeBlockingQueue(capacity=1)
+    q.put_bytes(b"a")
+    done = []
+
+    def blocked_put():
+        q.put_bytes(b"b")
+        done.append(1)
+
+    th = threading.Thread(target=blocked_put)
+    th.start()
+    th.join(timeout=0.2)
+    assert not done  # still blocked (queue full)
+    assert q.get_bytes() == b"a"
+    th.join(timeout=2)
+    assert done
+
+
+def test_arena_reuse_and_stats():
+    a = native.NativeArena()
+    buf, rel = a.buffer(1000)
+    assert buf.shape == (1000,)
+    buf[:] = 7
+    rel()
+    buf2, rel2 = a.buffer(900)  # same size class (1024) -> cache hit
+    stats = a.stats()
+    assert stats["alloc_calls"] == 2
+    assert stats["cache_hits"] == 1
+    rel2()
+
+
+def test_trace_dump(tmp_path):
+    tr = native.NativeTrace()
+    t0 = tr.now_us()
+    tr.record("step", t0, 100, tid=1)
+    tr.record("h2d", t0 + 50, 20, tid=2)
+    path = str(tmp_path / "trace.json")
+    n = tr.dump(path)
+    assert n == 2
+    import json
+    with open(path) as f:
+        data = json.load(f)
+    assert len(data["traceEvents"]) == 2
+    assert data["traceEvents"][0]["name"] == "step"
+
+
+def test_multislot_parser():
+    # two slots per line: dense slot (1 value) + sparse id list
+    text = "1 0.5 3 1 2 3\n1 1.5 2 7 8\n"
+    slots = native.parse_multislot(text, num_slots=2, num_threads=2)
+    vals0, offs0 = slots[0]
+    np.testing.assert_allclose(vals0, [0.5, 1.5])
+    np.testing.assert_array_equal(offs0, [0, 1, 2])
+    vals1, offs1 = slots[1]
+    np.testing.assert_allclose(vals1, [1, 2, 3, 7, 8])
+    np.testing.assert_array_equal(offs1, [0, 3, 5])
+
+
+def test_multislot_parser_many_lines_threaded():
+    rng = np.random.RandomState(0)
+    lines = []
+    expect = []
+    for i in range(257):
+        n = rng.randint(1, 5)
+        vals = rng.randint(0, 100, n)
+        expect.append(vals)
+        lines.append(f"{n} " + " ".join(map(str, vals)))
+    text = "\n".join(lines)
+    (vals, offs), = native.parse_multislot(text, num_slots=1, num_threads=4)
+    for i, e in enumerate(expect):
+        np.testing.assert_allclose(vals[offs[i]:offs[i + 1]], e)
