@@ -85,6 +85,8 @@ from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import distribution  # noqa: F401
+from . import incubate  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401
 

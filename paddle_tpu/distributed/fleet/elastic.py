@@ -1,0 +1,153 @@
+"""Elastic training manager.
+
+Reference parity: python/paddle/distributed/fleet/elastic.py:101
+ElasticManager — etcd3 node registry (:144-147), membership watchers
+(:173-206), relaunch of local procs with updated endpoints; launcher child
+monitoring (LauncherInterface._check_procs :75).
+
+TPU-native reduction: coordination runs over a shared-filesystem heartbeat
+store (a directory visible to all hosts — on cloud TPU pods typically GCS
+or NFS; etcd is not part of this image). Each node writes a heartbeat file;
+the watcher detects joins/leaves by scanning heartbeats; on membership
+change the registered callback re-initializes jax.distributed and resumes
+from the latest auto-checkpoint. Scale-in/out = world size change between
+restarts, which jax.distributed.initialize handles by re-forming the
+coordination service.
+"""
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class FileStore:
+    """Heartbeat registry on a shared filesystem (etcd stand-in)."""
+
+    def __init__(self, root, ttl=10.0):
+        self.root = root
+        self.ttl = ttl
+        os.makedirs(root, exist_ok=True)
+
+    def register(self, node_id, info=None):
+        path = os.path.join(self.root, f"{node_id}.hb")
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "info": info or {}}, f)
+
+    def heartbeat(self, node_id):
+        self.register(node_id)
+
+    def alive_nodes(self):
+        now = time.time()
+        nodes = []
+        for fn in os.listdir(self.root):
+            if not fn.endswith(".hb"):
+                continue
+            try:
+                with open(os.path.join(self.root, fn)) as f:
+                    data = json.load(f)
+                if now - data["ts"] <= self.ttl:
+                    nodes.append(fn[:-3])
+            except (OSError, ValueError):
+                continue
+        return sorted(nodes)
+
+    def deregister(self, node_id):
+        try:
+            os.remove(os.path.join(self.root, f"{node_id}.hb"))
+        except OSError:
+            pass
+
+
+class ElasticManager:
+    def __init__(self, node_id=None, store=None, store_root=None,
+                 heartbeat_interval=2.0, on_membership_change=None):
+        self.node_id = node_id or f"node-{os.getpid()}"
+        self.store = store or FileStore(store_root or "/tmp/paddle_tpu_elastic")
+        self.interval = heartbeat_interval
+        self.on_membership_change = on_membership_change
+        self._members = []
+        self._stop = threading.Event()
+        self._thread = None
+        self._procs = []
+
+    # -- membership --------------------------------------------------------
+    def start(self):
+        self.store.register(self.node_id)
+        self._members = self.store.alive_nodes()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self._members
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self.store.heartbeat(self.node_id)
+            current = self.store.alive_nodes()
+            if current != self._members:
+                old, self._members = self._members, current
+                if self.on_membership_change is not None:
+                    self.on_membership_change(old, current)
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.store.deregister(self.node_id)
+
+    def world(self):
+        return list(self._members)
+
+    # -- child process supervision (launcher role) ------------------------
+    def launch(self, cmd, env=None):
+        e = dict(os.environ)
+        if env:
+            e.update(env)
+        p = subprocess.Popen(cmd, env=e)
+        self._procs.append(p)
+        return p
+
+    def check_procs(self):
+        """Reference: LauncherInterface._check_procs — returns
+        (all_done, failed_list)."""
+        failed = []
+        alive = False
+        for p in self._procs:
+            rc = p.poll()
+            if rc is None:
+                alive = True
+            elif rc != 0:
+                failed.append((p.pid, rc))
+        return (not alive), failed
+
+    def kill_children(self):
+        for p in self._procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self._procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._procs = []
+
+    def relaunch(self, cmd, env=None):
+        """Membership changed: kill current children, restart with updated
+        world info (reference relaunch with new DISTRIBUTED_TRAINER_ENDPOINTS)."""
+        self.kill_children()
+        world = ",".join(self.world())
+        e = {"PADDLE_ELASTIC_WORLD": world,
+             "PADDLE_TRAINERS_NUM": str(len(self.world()))}
+        if env:
+            e.update(env)
+        return self.launch(cmd, env=e)

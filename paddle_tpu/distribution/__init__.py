@@ -1,0 +1,151 @@
+"""paddle.distribution equivalent (reference: python/paddle/distribution.py
+— Distribution, Uniform, Normal, Categorical). Sampling draws from the
+global generator; math is pure jax."""
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import rng as rng_mod
+from ..core.dispatch import register_op
+from ..ops.creation import _register_created
+
+
+def _arr(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x.value.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        from ..ops import math as math_ops
+        return math_ops.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+@register_op("dist_normal_sample", differentiable=False)
+def _normal_sample(loc, scale, key, *, shape):
+    return loc + scale * jax.random.normal(key, shape, loc.dtype)
+
+
+@register_op("dist_uniform_sample", differentiable=False)
+def _uniform_sample(low, high, key, *, shape):
+    return low + (high - low) * jax.random.uniform(key, shape, low.dtype)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = Tensor(_arr(loc))
+        self.scale = Tensor(_arr(scale))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(np.broadcast_shapes(
+            self.loc.aval_shape(), self.scale.aval_shape()))
+        key = rng_mod.next_key()
+        return _normal_sample(self.loc, self.scale, key, shape=shape)
+
+    def log_prob(self, value):
+        from ..ops import math as math_ops
+        var = math_ops.multiply(self.scale, self.scale)
+        diff = math_ops.subtract(value, self.loc)
+        t1 = math_ops.divide(math_ops.multiply(diff, diff),
+                             math_ops.scale(var, 2.0))
+        return math_ops.scale(
+            math_ops.add(t1, math_ops.log(
+                math_ops.scale(self.scale, math.sqrt(2 * math.pi)))), -1.0)
+
+    def entropy(self):
+        from ..ops import math as math_ops
+        return math_ops.add(
+            math_ops.log(self.scale),
+            float(0.5 * math.log(2 * math.pi) + 0.5))
+
+    def kl_divergence(self, other):
+        from ..ops import math as math_ops
+        var_ratio = math_ops.divide(self.scale, other.scale)
+        var_ratio = math_ops.multiply(var_ratio, var_ratio)
+        t1 = math_ops.divide(math_ops.subtract(self.loc, other.loc),
+                             other.scale)
+        t1 = math_ops.multiply(t1, t1)
+        return math_ops.scale(
+            math_ops.subtract(
+                math_ops.add(var_ratio, t1),
+                math_ops.add(math_ops.log(var_ratio), 1.0)), 0.5)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = Tensor(_arr(low))
+        self.high = Tensor(_arr(high))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(np.broadcast_shapes(
+            self.low.aval_shape(), self.high.aval_shape()))
+        key = rng_mod.next_key()
+        return _uniform_sample(self.low, self.high, key, shape=shape)
+
+    def log_prob(self, value):
+        from ..ops import math as math_ops, logic
+        span = math_ops.subtract(self.high, self.low)
+        inside = logic.logical_and(logic.greater_equal(value, self.low),
+                                   logic.less_than(value, self.high))
+        from ..ops import manipulation
+        lp = math_ops.scale(math_ops.log(span), -1.0)
+        neg_inf = Tensor(jnp.full(np.broadcast_shapes(
+            tuple(value.aval_shape()), tuple(lp.aval_shape())), -np.inf,
+            jnp.float32))
+        return manipulation.where(inside, lp, neg_inf)
+
+    def entropy(self):
+        from ..ops import math as math_ops
+        return math_ops.log(math_ops.subtract(self.high, self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) else \
+            Tensor(_arr(logits))
+
+    def sample(self, shape=(), seed=0):
+        key = rng_mod.next_key()
+        return _categorical_sample(self.logits, key, shape=tuple(shape))
+
+    def log_prob(self, value):
+        from ..ops import nn_ops, manipulation, math as math_ops
+        logp = nn_ops.log_softmax(self.logits, axis=-1)
+        idx = math_ops.cast(value, "int32")
+        if logp.ndim == 1:
+            return manipulation.gather(logp, idx)
+        return manipulation.take_along_axis(
+            logp, manipulation.unsqueeze(idx, axis=-1), axis=-1)
+
+    def entropy(self):
+        from ..ops import nn_ops, math as math_ops, reduction
+        logp = nn_ops.log_softmax(self.logits, axis=-1)
+        p = nn_ops.softmax(self.logits, axis=-1)
+        return math_ops.scale(
+            reduction.sum(math_ops.multiply(p, logp), axis=-1), -1.0)
+
+
+@register_op("dist_categorical_sample", differentiable=False)
+def _categorical_sample(logits, key, *, shape):
+    return jax.random.categorical(key, logits, axis=-1,
+                                  shape=shape + logits.shape[:-1])
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

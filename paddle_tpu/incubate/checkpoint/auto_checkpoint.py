@@ -1,0 +1,76 @@
+"""Auto checkpoint for train-loop resumability.
+
+Reference parity: python/paddle/fluid/incubate/checkpoint/
+auto_checkpoint.py:265 TrainEpochRange — an epoch-range context that
+snapshots training state keyed by job id so a relaunched job resumes at
+the last completed epoch (:598 save logic; reference stores to HDFS, we
+store to a local/shared directory).
+"""
+import json
+import os
+import time
+
+from ...framework.io_utils import save as psave, load as pload
+
+_job_id = os.environ.get("PADDLE_JOB_ID", "default_job")
+_root = os.environ.get("PADDLE_CHECKPOINT_DIR", "/tmp/paddle_tpu_auto_ckpt")
+
+
+def set_checkpoint_dir(path):
+    global _root
+    _root = path
+
+
+class TrainEpochRange:
+    """for epoch in TrainEpochRange(n, name).get(): train(...)
+
+    Register model/optimizer with .add(); each completed epoch snapshots
+    their state; on restart, iteration resumes after the last completed
+    epoch with states restored."""
+
+    def __init__(self, max_epoch_num, name, checkpoint_inter=None,
+                 save_checkpoint=True):
+        self.max_epoch_num = max_epoch_num
+        self.name = name
+        self.save_checkpoint = save_checkpoint
+        self._dir = os.path.join(_root, _job_id, name)
+        os.makedirs(self._dir, exist_ok=True)
+        self._saveables = {}
+        self._meta_path = os.path.join(self._dir, "meta.json")
+        self._start_epoch = 0
+        if os.path.exists(self._meta_path):
+            try:
+                with open(self._meta_path) as f:
+                    meta = json.load(f)
+                self._start_epoch = meta.get("last_completed", -1) + 1
+            except (OSError, ValueError):
+                self._start_epoch = 0
+
+    def add(self, name, obj):
+        """Register anything with state_dict()/set_state_dict()."""
+        self._saveables[name] = obj
+        state_path = os.path.join(self._dir, f"{name}.pdparams")
+        if self._start_epoch > 0 and os.path.exists(state_path):
+            obj.set_state_dict(pload(state_path))
+        return self
+
+    @property
+    def restored_from(self):
+        return self._start_epoch
+
+    def get(self):
+        for epoch in range(self._start_epoch, self.max_epoch_num):
+            yield epoch
+            if self.save_checkpoint:
+                self._snapshot(epoch)
+
+    def _snapshot(self, epoch):
+        for name, obj in self._saveables.items():
+            psave(obj.state_dict(),
+                  os.path.join(self._dir, f"{name}.pdparams"))
+        with open(self._meta_path, "w") as f:
+            json.dump({"last_completed": epoch, "ts": time.time()}, f)
+
+    def clean(self):
+        import shutil
+        shutil.rmtree(self._dir, ignore_errors=True)

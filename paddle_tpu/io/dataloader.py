@@ -47,6 +47,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
         self.return_list = return_list
+        self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -101,6 +102,10 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._make_batches()
             return
+        from ..core import native
+        if self.use_buffer_reader and native.available():
+            yield from self._iter_native()
+            return
         # threaded prefetch pipeline: workers collate, main thread yields
         q = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
         sentinel = object()
@@ -122,5 +127,41 @@ class DataLoader:
             if item is sentinel:
                 break
             yield item
+        if err:
+            raise err[0]
+
+    def _iter_native(self):
+        """Batches flow through the C++ blocking queue (runtime_cpp) — the
+        analogue of the reference's LoDTensorBlockingQueue between workers
+        and the buffered reader."""
+        import pickle
+        from ..core import native
+        from ..core.tensor import Tensor
+        q = native.NativeBlockingQueue(
+            capacity=self.prefetch_factor * self.num_workers)
+        err = []
+
+        def producer():
+            try:
+                for b in self._make_batches():
+                    payload = [t.numpy() if isinstance(t, Tensor) else t
+                               for t in b] if isinstance(b, list) else b
+                    q.put_bytes(pickle.dumps(payload, protocol=4))
+            except BaseException as e:
+                err.append(e)
+            finally:
+                q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            raw = q.get_bytes()
+            if raw is None:
+                break
+            batch = pickle.loads(raw)
+            if isinstance(batch, list):
+                batch = [Tensor(a) if isinstance(a, np.ndarray) else a
+                         for a in batch]
+            yield batch
         if err:
             raise err[0]

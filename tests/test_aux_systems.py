@@ -1,0 +1,126 @@
+"""Aux subsystems: distribution, elastic, auto-checkpoint, flags, profiler
+(SURVEY §5 parity)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestDistribution:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal
+        paddle.seed(0)
+        d = Normal(0.0, 1.0)
+        s = d.sample([2000])
+        assert abs(float(s.numpy().mean())) < 0.1
+        lp = d.log_prob(paddle.to_tensor(0.0))
+        assert float(lp.numpy()) == pytest.approx(-0.9189, rel=1e-3)
+        assert float(d.entropy().numpy()) == pytest.approx(1.4189, rel=1e-3)
+        kl = d.kl_divergence(Normal(1.0, 1.0))
+        assert float(kl.numpy()) == pytest.approx(0.5, rel=1e-4)
+
+    def test_uniform(self):
+        from paddle_tpu.distribution import Uniform
+        paddle.seed(0)
+        d = Uniform(2.0, 4.0)
+        s = d.sample([500])
+        assert 2.0 <= float(s.numpy().min()) and float(s.numpy().max()) < 4.0
+        lp = d.log_prob(paddle.to_tensor(3.0))
+        assert float(lp.numpy()) == pytest.approx(-np.log(2), rel=1e-4)
+        outside = d.log_prob(paddle.to_tensor(5.0))
+        assert np.isneginf(outside.numpy())
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical
+        paddle.seed(0)
+        d = Categorical(paddle.to_tensor(np.log([0.7, 0.2, 0.1])
+                                         .astype("float32")))
+        s = d.sample([2000]).numpy()
+        assert (s == 0).mean() > 0.55
+        lp = d.log_prob(paddle.to_tensor(np.array([0])))
+        assert float(lp.numpy()) == pytest.approx(np.log(0.7), rel=1e-3)
+        assert float(d.entropy().numpy()) > 0
+
+
+class TestElastic:
+    def test_membership_watch(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          FileStore)
+        store = FileStore(str(tmp_path), ttl=5.0)
+        changes = []
+        m1 = ElasticManager("n1", store=store, heartbeat_interval=0.05,
+                            on_membership_change=lambda o, n: changes.append(n))
+        m1.start()
+        m2 = ElasticManager("n2", store=store, heartbeat_interval=0.05)
+        m2.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and "n2" not in m1.world():
+            time.sleep(0.05)
+        assert "n2" in m1.world()
+        m2.stop()
+        m1.stop()
+        assert any("n2" in c for c in changes)
+
+    def test_child_supervision(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                          FileStore)
+        m = ElasticManager("sup", store=FileStore(str(tmp_path)))
+        m.launch(["python", "-c", "import sys; sys.exit(0)"])
+        m.launch(["python", "-c", "import sys; sys.exit(3)"])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            done, failed = m.check_procs()
+            if done:
+                break
+            time.sleep(0.1)
+        assert done
+        assert len(failed) == 1 and failed[0][1] == 3
+
+
+class TestAutoCheckpoint:
+    def test_resume_skips_completed_epochs(self, tmp_path):
+        from paddle_tpu.incubate.checkpoint import auto_checkpoint as ac
+        ac.set_checkpoint_dir(str(tmp_path))
+        net = nn.Linear(2, 2)
+        r = ac.TrainEpochRange(5, "job_a")
+        r.add("model", net)
+        seen = []
+        for epoch in r.get():
+            seen.append(epoch)
+            net.weight.set_value(np.full((2, 2), epoch, np.float32))
+            if epoch == 2:
+                break  # simulate crash after completing epochs 0..1 (+2 saved)
+        assert seen == [0, 1, 2]
+        # restart
+        net2 = nn.Linear(2, 2)
+        r2 = ac.TrainEpochRange(5, "job_a")
+        r2.add("model", net2)
+        resumed = list(r2.get())
+        assert resumed[0] == 2 or resumed[0] == 3  # resumes after last snap
+        # weights restored from snapshot
+        assert net2.weight.numpy()[0, 0] in (1.0, 2.0)
+
+
+class TestProfiler:
+    def test_record_event_and_profiler(self):
+        from paddle_tpu.profiler import RecordEvent, Profiler
+        p = Profiler(timer_only=True)
+        p.start()
+        with RecordEvent("train_step"):
+            paddle.ones([4]).sum().numpy()
+        p.step()
+        p.step()
+        info = p.step_info()
+        assert "avg step" in info
+        p.stop()
+
+
+class TestFlags:
+    def test_set_get(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
