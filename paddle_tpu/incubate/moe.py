@@ -1,0 +1,119 @@
+"""Mixture-of-Experts with expert parallelism.
+
+The reference has NO MoE framework support — only the raw alltoall
+collective primitive exists (SURVEY §2.9 EP row:
+operators/collective/alltoall_op.cc). This is the greenfield capability
+built on it, TPU-native:
+
+- MoELayer: top-k gating + expert FFNs. Experts are stacked on a leading
+  axis sharded over a mesh axis ('mp' by default — expert parallelism);
+  tokens route to experts with a capacity-bounded dense dispatch (static
+  shapes for XLA: einsum with a one-hot dispatch mask, the standard TPU
+  MoE formulation) and GSPMD turns the dispatch/combine einsums into the
+  all_to_all traffic over ICI.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..nn.layer_base import Layer
+from ..nn import initializer as init_mod
+from ..distributed.fleet.meta_parallel.mp_layers import shard_constraint
+from ..distributed import topology
+
+
+@register_op("moe_forward")
+def _moe_forward(x, gate_w, w1, b1, w2, b2, *, top_k, capacity_factor,
+                 activation):
+    """x: [tokens, d]; gate_w: [d, E]; w1: [E, d, hidden]; b1: [E, hidden];
+    w2: [E, hidden, d]; b2: [E, d]."""
+    tokens, d = x.shape
+    e = gate_w.shape[1]
+    capacity = int(max(1, capacity_factor * tokens * top_k / e))
+
+    logits = x @ gate_w                                   # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)     # [T, K]
+    # renormalize selected gates
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # capacity-bounded dispatch mask [T, E, C]
+    dispatch = jnp.zeros((tokens, e, capacity), x.dtype)
+    combine = jnp.zeros((tokens, e, capacity), x.dtype)
+    # position of each token within its expert's buffer, per k choice
+    for k in range(top_k):
+        idx_k = gate_idx[:, k]                            # [T]
+        onehot = jax.nn.one_hot(idx_k, e, dtype=jnp.int32)  # [T, E]
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1     # [T, E] slot or -1
+        pos_tok = jnp.sum(pos * onehot, axis=1)           # [T]
+        keep = (pos_tok >= 0) & (pos_tok < capacity)
+        pos_c = jnp.clip(pos_tok, 0, capacity - 1)
+        sel = jax.nn.one_hot(pos_c, capacity, dtype=x.dtype) * \
+            keep[:, None].astype(x.dtype)                 # [T, C]
+        d_k = onehot.astype(x.dtype)[:, :, None] * sel[:, None, :]
+        dispatch = dispatch + d_k
+        combine = combine + d_k * gate_vals[:, k][:, None, None]
+
+    # dispatch tokens to expert buffers: [E, C, d]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)
+    h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :]
+    h = jax.nn.gelu(h) if activation == "gelu" else jax.nn.relu(h)
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+
+    # load-balancing aux loss (Switch-style): E * sum(frac_tokens * frac_prob)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=x.dtype), axis=0)
+    aux = e * jnp.sum(me * ce)
+    return out, aux
+
+
+class MoELayer(Layer):
+    """Expert-parallel FFN block. Use inside a transformer in place of the
+    MLP; add `layer.aux_loss` to the training loss."""
+
+    def __init__(self, d_model, d_hidden, num_experts, top_k=2,
+                 capacity_factor=1.25, activation="gelu", ep_axis="mp",
+                 gate_attr=None, name=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.gate = self.create_parameter(
+            (d_model, num_experts),
+            attr=init_mod.ParamAttr._to_attr(gate_attr))
+        self.w1 = self.create_parameter(
+            (num_experts, d_model, d_hidden),
+            default_initializer=init_mod.XavierNormal())
+        self.b1 = self.create_parameter((num_experts, d_hidden), is_bias=True)
+        self.w2 = self.create_parameter(
+            (num_experts, d_hidden, d_model),
+            default_initializer=init_mod.XavierNormal())
+        self.b2 = self.create_parameter((num_experts, d_model), is_bias=True)
+        # expert-parallel placement: experts sharded over the ep axis
+        mesh = topology.get_mesh()
+        if mesh is not None and int(mesh.shape.get(ep_axis, 1)) > 1 and \
+                num_experts % int(mesh.shape[ep_axis]) == 0:
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                p.tp_spec = (ep_axis,) + (None,) * (p.ndim - 1)
+        self.aux_loss = None
+
+    def forward(self, x):
+        from ..ops import manipulation
+        orig_shape = list(x.shape)
+        d = orig_shape[-1]
+        flat = manipulation.reshape(x, (-1, d))
+        w1, b1, w2, b2 = self.w1, self.b1, self.w2, self.b2
+        if self.w1.tp_spec is not None:
+            w1 = shard_constraint(w1, self.w1.tp_spec)
+            b1 = shard_constraint(b1, self.b1.tp_spec)
+            w2 = shard_constraint(w2, self.w2.tp_spec)
+            b2 = shard_constraint(b2, self.b2.tp_spec)
+        out, aux = _moe_forward(flat, self.gate, w1, b1, w2, b2,
+                                top_k=self.top_k,
+                                capacity_factor=float(self.capacity_factor),
+                                activation=self.activation)
+        self.aux_loss = aux
+        return manipulation.reshape(out, orig_shape)

@@ -1,0 +1,113 @@
+"""paddle.inference equivalent.
+
+Reference parity: paddle/fluid/inference/api/analysis_predictor.h:82
+AnalysisPredictor + paddle_infer Python API (Config, create_predictor,
+zero-copy input/output handles). TPU-native: a saved model is a serialized
+StableHLO program + params (jit.save format); the predictor executes the
+deserialized XLA executable — the analysis pass pipeline (fusions, memory
+optimize) is XLA compilation itself.
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit.save_load import load as _jit_load
+
+
+class Config:
+    """Reference: AnalysisConfig. Model path + execution knobs; GPU/TRT
+    options accepted for compat and ignored (XLA owns optimization)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self._model_prefix = prog_file
+        self._enable_memory_optim = True
+
+    def set_prog_file(self, path):
+        self._model_prefix = path[:-len(".pdmodel")] \
+            if path.endswith(".pdmodel") else path
+
+    def model_dir(self):
+        return self._model_prefix
+
+    def enable_use_gpu(self, *a, **k):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+
+class _IOHandle:
+    def __init__(self, predictor, name, is_input):
+        self._p = predictor
+        self.name = name
+        self._is_input = is_input
+
+    def reshape(self, shape):
+        pass
+
+    def copy_from_cpu(self, arr):
+        self._p._inputs[self.name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return self._p._outputs[self.name]
+
+    def share_external_data(self, arr):
+        self.copy_from_cpu(arr)
+
+
+class Predictor:
+    def __init__(self, config):
+        self._layer = _jit_load(config.model_dir())
+        n_in = 0
+        import pickle
+        with open(config.model_dir() + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+        self._input_names = [f"x{i}" for i in range(meta["num_inputs"])]
+        self._inputs = {}
+        self._outputs = {}
+        self._output_names = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return _IOHandle(self, name, True)
+
+    def run(self, inputs=None):
+        if inputs is not None:  # direct call style
+            arrs = [np.asarray(a) for a in inputs]
+        else:
+            arrs = [self._inputs[n] for n in self._input_names]
+        out = self._layer(*[Tensor(a) for a in arrs])
+        outs = out if isinstance(out, tuple) else (out,)
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {n: o.numpy() for n, o in
+                         zip(self._output_names, outs)}
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return True
+
+    def get_output_names(self):
+        return list(self._output_names) or ["out0"]
+
+    def get_output_handle(self, name):
+        return _IOHandle(self, name, False)
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1,
+                                           "Bfloat16": 2, "Int8": 3})
+PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "TPU": 4})

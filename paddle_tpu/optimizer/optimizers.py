@@ -300,3 +300,45 @@ class Lamb(Optimizer):
         v.value = v_n.value
         b1p.value = b1n.value
         b2p.value = b2n.value
+
+
+@register_op("lars_update", differentiable=False)
+def _lars(param, grad, velocity, lr, *, mu, lars_coeff, wd, epsilon):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lars_coeff * p_norm / (g_norm + wd * p_norm + epsilon), 1.0)
+    v_new = mu * velocity + lr * local_lr * (g + wd * p32)
+    new_p = p32 - v_new
+    return new_p.astype(param.dtype), v_new
+
+
+class LarsMomentum(Optimizer):
+    """Layer-wise adaptive rate scaling (reference:
+    operators/optimizers/lars_momentum_op.cc + fleet lars_optimizer.py)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9, name=None,
+                 exclude_from_weight_decay=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = float(momentum)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._epsilon = float(epsilon)
+        self._exclude = exclude_from_weight_decay or []
+
+    def _apply_one(self, p, g):
+        vel = self._acc("velocity", p, shape=tuple(p.aval_shape()),
+                        dtype=jnp.float32)
+        wd = self._lars_wd
+        if any(tag in p.name for tag in self._exclude):
+            wd = 0.0
+        new_p, new_v = _lars(p, g, vel, self._lr_tensor, mu=self._momentum,
+                             lars_coeff=self._lars_coeff, wd=wd,
+                             epsilon=self._epsilon)
+        p.value = new_p.value
+        vel.value = new_v.value

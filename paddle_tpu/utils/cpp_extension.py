@@ -1,0 +1,108 @@
+"""Custom op extension API.
+
+Reference parity: paddle/fluid/extension/ (PD_BUILD_OP stable ABI) +
+python/paddle/utils/cpp_extension/cpp_extension.py (JIT `load`).
+
+TPU-native design: custom DEVICE kernels are written in Python as jax/
+Pallas functions and registered with `register_op` — no ABI needed, they
+compile into the same XLA program as built-in ops. Custom HOST ops (C++
+CPU code: tokenizers, samplers, feature extractors) compile via this
+module into a shared library and run inside the graph through
+jax.pure_callback — the host-side analogue of the reference's custom CPU
+kernels.
+
+C++ contract (C ABI): void op(const float** ins, const int64_t* in_sizes,
+int n_in, float* out, int64_t out_size).
+"""
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op as _register_op
+from ..core.tensor import Tensor
+
+_BUILD_ROOT = os.path.expanduser("~/.cache/paddle_tpu/extensions")
+
+
+def register_custom_op(name, fn, differentiable=True):
+    """Register a pure jax/Pallas function as a framework op (device path).
+    Returns a callable taking/returning Tensors."""
+    return _register_op(name, differentiable=differentiable)(fn)
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """JIT-compile C++ sources into a host-op library (reference:
+    cpp_extension.load). Returns a module-like object whose attribute
+    lookups resolve exported op symbols as python callables."""
+    build_dir = build_directory or _BUILD_ROOT
+    os.makedirs(build_dir, exist_ok=True)
+    tag = hashlib.md5("".join(sources).encode()).hexdigest()[:12]
+    so_path = os.path.join(build_dir, f"{name}_{tag}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+               "-o", so_path] + list(sources) + (extra_cxx_cflags or [])
+        res = subprocess.run(cmd, capture_output=True, text=True)
+        if res.returncode != 0:
+            raise RuntimeError(f"extension build failed:\n{res.stderr}")
+        if verbose:
+            print(f"built {so_path}")
+    lib = ctypes.CDLL(so_path)
+
+    class _Module:
+        def __getattr__(self, sym):
+            cfn = getattr(lib, sym)
+            cfn.restype = None
+            cfn.argtypes = [
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+            def host_call(*arrays):
+                arrs = [np.ascontiguousarray(a, np.float32) for a in arrays]
+                out = np.empty_like(arrs[0])
+                ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrs))(
+                    *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+                      for a in arrs])
+                sizes = (ctypes.c_int64 * len(arrs))(*[a.size for a in arrs])
+                cfn(ptrs, sizes, len(arrs),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    out.size)
+                return out
+
+            def op_fn(*xs):
+                shape_dtype = jax.ShapeDtypeStruct(xs[0].shape, jnp.float32)
+                return jax.pure_callback(
+                    host_call, shape_dtype,
+                    *[x.astype(jnp.float32) for x in xs])
+
+            wrapped = _register_op(f"custom_{name}_{sym}",
+                                   differentiable=False)(op_fn)
+
+            def api(*tensors):
+                return wrapped(*tensors)
+            api.__name__ = sym
+            return api
+
+    return _Module()
+
+
+class CppExtension:
+    """setup()-style descriptor (reference CppExtension); consumed by
+    `load` in this runtime."""
+
+    def __init__(self, sources, **kwargs):
+        self.sources = sources
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDA extensions do not exist on TPU; write device kernels as "
+        "jax/Pallas functions and register with register_custom_op, or "
+        "host C++ ops via cpp_extension.load")

@@ -1,0 +1,63 @@
+"""Custom op extension + inference predictor API."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_register_custom_device_op():
+    import jax.numpy as jnp
+    from paddle_tpu.utils.cpp_extension import register_custom_op
+
+    op = register_custom_op("my_gelu_like", lambda x: x * jnp.tanh(x))
+    x = paddle.to_tensor(np.array([1.0, -1.0], np.float32),
+                         stop_gradient=False)
+    out = op(x)
+    np.testing.assert_allclose(out.numpy(), [np.tanh(1), np.tanh(1)],
+                               rtol=1e-6)
+    out.sum().backward()  # differentiable via vjp
+    assert x.grad is not None
+
+
+def test_cpp_host_extension(tmp_path):
+    from paddle_tpu.utils.cpp_extension import load
+    src = tmp_path / "myop.cc"
+    src.write_text(r"""
+#include <cstdint>
+extern "C" void scaled_sum(const float** ins, const int64_t* sizes,
+                           int n_in, float* out, int64_t out_size) {
+  for (int64_t i = 0; i < out_size; ++i) {
+    float acc = 0;
+    for (int j = 0; j < n_in; ++j) acc += ins[j][i];
+    out[i] = acc * 2.0f;
+  }
+}
+""")
+    mod = load("testext", [str(src)])
+    a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    b = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+    out = mod.scaled_sum(a, b)
+    np.testing.assert_allclose(out.numpy(), [8.0, 12.0])
+
+
+def test_inference_predictor_api(tmp_path):
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+    net = nn.Sequential(nn.Linear(4, 3), nn.Softmax())
+    net.eval()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 4], "float32")])
+
+    config = inference.Config(path + ".pdmodel")
+    predictor = inference.create_predictor(config)
+    names = predictor.get_input_names()
+    assert len(names) == 1
+    x = np.random.randn(1, 4).astype("float32")
+    predictor.get_input_handle(names[0]).copy_from_cpu(x)
+    assert predictor.run()
+    out_name = predictor.get_output_names()[0]
+    result = predictor.get_output_handle(out_name).copy_to_cpu()
+    np.testing.assert_allclose(result, net(paddle.to_tensor(x)).numpy(),
+                               atol=1e-5)
+    assert result.sum() == pytest.approx(1.0, rel=1e-4)
