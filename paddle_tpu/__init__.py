@@ -131,3 +131,116 @@ def is_grad_enabled_():
 
 def rank(x):
     return to_tensor(_np.asarray(x.ndim if isinstance(x, Tensor) else _np.ndim(x)))
+
+
+# --- remaining reference top-level surface (python/paddle/__init__.py) ---
+from .ops.math import add_n, cross, histogram, floor_mod, tanh_  # noqa: F401,E402
+from .ops.manipulation import (  # noqa: F401,E402
+    diagonal, multiplex, reverse, crop, crop_tensor, scatter_nd, scatter_,
+    squeeze_, reshape_, unsqueeze_, tolist, broadcast_shape,
+)
+from .ops.creation import standard_normal, create_parameter  # noqa: F401,E402
+from .ops.linalg import cholesky, inverse  # noqa: F401,E402
+from .nn.initializer import ParamAttr  # noqa: F401,E402
+from .core.device import (  # noqa: F401,E402
+    CUDAPlace, CUDAPinnedPlace, XPUPlace, NPUPlace,
+    is_compiled_with_xpu, is_compiled_with_npu, is_compiled_with_rocm,
+)
+
+VarBase = Tensor  # reference alias: paddle/fluid/imperative VarBase
+dtype = _dtype_mod.DType  # paddle.dtype class alias
+
+
+def enable_dygraph(place=None):
+    return disable_static(place)
+
+
+def disable_dygraph():
+    return enable_static()
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()
+
+
+def set_grad_enabled(mode):
+    """Context manager toggling autograd (reference:
+    python/paddle/framework/random.py area / torch-parity API)."""
+    return enable_grad() if mode else no_grad()
+
+
+_print_options = {"precision": 8, "threshold": 1000, "edgeitems": 3,
+                  "linewidth": 80, "sci_mode": None}
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Reference: python/paddle/tensor/to_string.py set_printoptions."""
+    kw = {}
+    if precision is not None:
+        _print_options["precision"] = precision
+        kw["precision"] = precision
+    if threshold is not None:
+        _print_options["threshold"] = threshold
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        _print_options["edgeitems"] = edgeitems
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        _print_options["linewidth"] = linewidth
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        _print_options["sci_mode"] = sci_mode
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def get_cuda_rng_state():
+    """CUDA shim: TPU RNG is stateless PRNG keys; returns the current seed
+    state for checkpoint parity."""
+    from .core import rng as _rng
+    return [_rng.get_state()]
+
+
+def set_cuda_rng_state(state):
+    from .core import rng as _rng
+    if state:
+        _rng.set_state(state[0])
+
+
+def monkey_patch_math_varbase():
+    """No-op: Tensor operators are patched at import (ops/__init__.py)."""
+    return None
+
+
+def monkey_patch_variable():
+    return None
+
+
+def check_shape(shape):
+    """Static-graph shape validation helper (reference:
+    python/paddle/fluid/layers/utils.py check_shape)."""
+    for s in shape if not isinstance(shape, (int,)) else [shape]:
+        if isinstance(s, int) and s < -1:
+            raise ValueError(f"invalid dim {s} in shape {shape}")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch a sample reader into a batch reader (reference:
+    python/paddle/fluid/io.py batch / python/paddle/batch)."""
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batch_reader
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.summary import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)

@@ -115,3 +115,38 @@ def is_compiled_with_tpu():
 
 def device_count():
     return jax.device_count()
+
+
+class CUDAPlace(Place):
+    """Shim: maps to the accelerator (TPU) device for API parity with the
+    reference's CUDAPlace (paddle/fluid/platform/place.h)."""
+
+    def __init__(self, device_id=0):
+        super().__init__(_accelerator_platform() or "cpu", device_id)
+
+
+class CUDAPinnedPlace(Place):
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class XPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__(_accelerator_platform() or "cpu", device_id)
+
+
+class NPUPlace(Place):
+    def __init__(self, device_id=0):
+        super().__init__(_accelerator_platform() or "cpu", device_id)
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_npu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False

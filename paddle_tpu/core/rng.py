@@ -64,3 +64,15 @@ def seed(s):
 
 def next_key():
     return default_generator.next_key()
+
+
+def get_state():
+    """Snapshot of the default generator state (for checkpoint/RNG-state
+    save parity with get_cuda_rng_state)."""
+    import numpy as np
+    return np.asarray(default_generator.state.value).copy()
+
+
+def set_state(state):
+    import jax.numpy as jnp
+    default_generator.state.value = jnp.asarray(state)

@@ -27,3 +27,75 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
     print("\n".join(lines))
     return {"total_params": total_params,
             "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Analytic FLOPs count for a network (reference:
+    python/paddle/hapi/dynamic_flops.py flops). Counted per leaf layer from
+    layer hyper-parameters; custom_ops maps layer class -> fn(layer, in, out)
+    returning flops."""
+    import numpy as np
+    from .. import nn
+
+    if isinstance(input_size, (list, tuple)) and input_size and \
+            isinstance(input_size[0], int):
+        shapes = [tuple(input_size)]
+    else:
+        shapes = [tuple(s) for s in input_size]
+
+    total = 0
+    rows = []
+    # run a forward with shape hooks to learn per-layer IO shapes
+    import paddle_tpu as paddle
+    xs = [paddle.zeros(list(s)) for s in shapes]
+    records = []
+
+    hooks = []
+
+    def make_hook(layer):
+        def hook(lyr, inputs, output):
+            records.append((lyr, inputs, output))
+        return hook
+
+    for _, layer in net.named_sublayers(include_self=True):
+        if not layer._sub_layers:
+            hooks.append(layer.register_forward_post_hook(make_hook(layer)))
+    was_training = net.training
+    net.eval()
+    try:
+        with paddle.no_grad():
+            net(*xs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    for layer, inputs, output in records:
+        f = 0
+        out = output[0] if isinstance(output, (list, tuple)) else output
+        o_numel = int(np.prod(out.shape)) if hasattr(out, "shape") else 0
+        if custom_ops and type(layer) in custom_ops:
+            f = custom_ops[type(layer)](layer, inputs, output)
+        elif isinstance(layer, nn.Conv2D):
+            kh, kw = layer._kernel_size
+            cin = layer._in_channels
+            f = o_numel * cin // layer._groups * kh * kw * 2
+        elif isinstance(layer, nn.Linear):
+            f = o_numel * layer.weight.shape[0] * 2
+        elif isinstance(layer, (nn.BatchNorm2D, nn.BatchNorm1D, nn.BatchNorm,
+                                nn.LayerNorm)):
+            f = o_numel * 2
+        elif isinstance(layer, (nn.ReLU, nn.Sigmoid, nn.Tanh, nn.GELU)):
+            f = o_numel
+        elif isinstance(layer, (nn.AvgPool2D, nn.MaxPool2D,
+                                nn.AdaptiveAvgPool2D)):
+            f = o_numel
+        total += f
+        if print_detail:
+            rows.append((type(layer).__name__, f))
+    if print_detail:
+        for name, f in rows:
+            print(f"{name:<28}{f:>16,}")
+    print(f"Total Flops: {total}")
+    return total

@@ -277,3 +277,22 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 
 def rand_like(x, dtype=None):
     return uniform(tuple(x.shape), dtype or x.value.dtype, 0.0, 1.0)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    """randn alias with paddle's standard_normal name."""
+    return randn(shape, dtype=dtype)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: python/paddle/fluid/layers/tensor.py create_parameter."""
+    from ..core.tensor import Parameter
+    from ..nn import initializer as I
+    init = default_initializer
+    if init is None and attr is not None and getattr(attr, "initializer", None):
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierUniform()
+    val = init(tuple(int(s) for s in shape), dtype or "float32")
+    return Parameter(val, name=name)

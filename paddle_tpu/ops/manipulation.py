@@ -93,7 +93,11 @@ def unsqueeze(x, axis, name=None):
     return _unsqueeze(x, axes=tuple(int(a) for a in axis))
 
 
-unsqueeze_ = unsqueeze
+def unsqueeze_(x, axis, name=None):
+    x.value = _unsqueeze(
+        x, axes=tuple(axis) if isinstance(axis, (list, tuple)) else (int(axis),)
+    ).value
+    return x
 
 
 @register_op("concat")
@@ -486,3 +490,86 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return (v, v) if isinstance(v, int) else tuple(v)
     return _unfold(x, kernel_sizes=_pair(kernel_sizes), strides=_pair(strides),
                    paddings=_pair(paddings), dilations=_pair(dilations))
+
+
+@register_op("diagonal")
+def _diagonal(x, *, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Reference: python/paddle/tensor/math.py diagonal op."""
+    return _diagonal(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+@register_op("multiplex")
+def _multiplex(index, *xs):
+    stacked = jnp.stack(xs, axis=0)  # [num_candidates, batch, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors (reference:
+    paddle/fluid/operators/multiplex_op.cc)."""
+    return _multiplex(index, *inputs)
+
+
+@register_op("reverse")
+def _reverse(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def reverse(x, axis, name=None):
+    if isinstance(axis, int):
+        axis = [axis]
+    return _reverse(x, axis=tuple(int(a) for a in axis))
+
+
+@register_op("crop_tensor")
+def _crop(x, *, offsets, shape):
+    return jax.lax.dynamic_slice(x, offsets, shape)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Reference: paddle/fluid/operators/crop_tensor_op.cc. A shape entry of
+    -1 means "everything from the offset to the end of that dim"."""
+    off = list(_shape_tuple(offsets)) if offsets is not None else [0] * x.ndim
+    shp = list(shape) if shape is not None else [-1] * x.ndim
+    shp = [x.shape[i] - off[i] if s in (-1, None) else int(s)
+           for i, s in enumerate(shp)]
+    return _crop(x, offsets=tuple(off), shape=tuple(shp))
+
+
+crop_tensor = crop
+
+
+@register_op("scatter_nd")
+def _scatter_nd(index, updates, *, shape):
+    zeros = jnp.zeros(shape, updates.dtype)
+    return zeros.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Reference: paddle/fluid/operators/scatter_nd_add_op.cc (zero base)."""
+    return _scatter_nd(index, updates, shape=_shape_tuple(shape))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x.value = scatter(x, index, updates, overwrite=overwrite).value
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    x.value = squeeze(x, axis=axis).value
+    return x
+
+
+def tolist(x):
+    return x.value.tolist() if hasattr(x, "value") else list(x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

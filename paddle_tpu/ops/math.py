@@ -328,3 +328,54 @@ def diff(x, n=1, axis=-1, name=None):
 def rsqrt_(x):
     x.value = jax.lax.rsqrt(x.value)
     return x
+
+
+@register_op("sum_op_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference: paddle/fluid/operators/sum_op.cc)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(*inputs)
+
+
+@register_op("cross")
+def _cross(x, y, *, axis):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cross(x, y, axis=None, name=None):
+    """Reference: paddle/fluid/operators/cross_op.cc (default: first axis
+    with dim 3)."""
+    if axis is None:
+        axis = next((i for i, s in enumerate(x.shape) if s == 3), None)
+        if axis is None:
+            raise ValueError(
+                f"cross: no dimension of size 3 in input shape {x.shape}")
+    return _cross(x, y, axis=int(axis))
+
+
+@register_op("histogram", differentiable=False)
+def _histogram(x, *, bins, min, max):
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = jnp.min(x).astype(jnp.float32), jnp.max(x).astype(jnp.float32)
+        hi = jnp.where(hi > lo, hi, lo + 1.0)
+    h, _ = jnp.histogram(x.astype(jnp.float32).reshape(-1), bins=bins,
+                         range=(lo, hi))
+    return h.astype(jnp.int64)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    return _histogram(input, bins=int(bins), min=min, max=max)
+
+
+def tanh_(x, name=None):
+    x.value = jnp.tanh(x.value)
+    return x

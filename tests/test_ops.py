@@ -340,3 +340,84 @@ class TestNNOps:
         p = p / p.sum(-1, keepdims=True)
         ref = p @ v
         np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+
+class TestTopLevelParity:
+    """New top-level surface parity (reference python/paddle/__init__.py):
+    add_n, cross, diagonal, histogram, multiplex, reverse, crop, scatter_nd,
+    inplace variants, printoptions, rng-state shims."""
+
+    def test_add_n(self):
+        a = t(np.arange(6, dtype='float32').reshape(2, 3))
+        np.testing.assert_allclose(paddle.add_n([a, a, a]).numpy(),
+                                   3 * a.numpy())
+
+    def test_cross_diagonal(self):
+        x = t(np.array([1., 0, 0], 'float32'))
+        y = t(np.array([0., 1, 0], 'float32'))
+        np.testing.assert_allclose(paddle.cross(x, y).numpy(), [0, 0, 1])
+        a = t(np.arange(12, dtype='float32').reshape(3, 4))
+        np.testing.assert_allclose(paddle.diagonal(a).numpy(), [0, 5, 10])
+        np.testing.assert_allclose(paddle.diagonal(a, offset=1).numpy(),
+                                   np.diagonal(a.numpy(), offset=1))
+
+    def test_histogram(self):
+        a = t(np.arange(12, dtype='float32'))
+        h = paddle.histogram(a, bins=4, min=0, max=12)
+        assert int(h.numpy().sum()) == 12
+
+    def test_multiplex_reverse_crop(self):
+        idx = t(np.array([[0], [1]], 'int32'))
+        cands = [t(np.ones((2, 3), 'float32')),
+                 t(np.full((2, 3), 2., 'float32'))]
+        m = paddle.multiplex(cands, idx)
+        np.testing.assert_allclose(m.numpy(), [[1, 1, 1], [2, 2, 2]])
+        a = t(np.arange(12, dtype='float32').reshape(3, 4))
+        assert paddle.reverse(a, [0]).numpy()[0, 0] == 8
+        c = paddle.crop(a, shape=[2, 2], offsets=[1, 1])
+        np.testing.assert_allclose(c.numpy(), [[5, 6], [9, 10]])
+
+    def test_scatter_nd(self):
+        out = paddle.scatter_nd(t(np.array([[1], [2]], 'int64')),
+                                t(np.array([9., 8.], 'float32')), [4])
+        np.testing.assert_allclose(out.numpy(), [0, 9, 8, 0])
+
+    def test_inplace_variants(self):
+        b = t(np.ones((2, 2), 'float32'))
+        paddle.tanh_(b)
+        np.testing.assert_allclose(b.numpy(), np.tanh(np.ones((2, 2))),
+                                   rtol=1e-6)
+        b2 = t(np.ones((1, 2, 2), 'float32'))
+        paddle.squeeze_(b2, 0)
+        assert b2.shape == [2, 2]
+        paddle.reshape_(b2, [4])
+        assert b2.shape == [4]
+        sc = t(np.zeros((3, 2), 'float32'))
+        paddle.scatter_(sc, t(np.array([1], 'int64')),
+                        t(np.array([[5., 5.]], 'float32')))
+        assert sc.numpy()[1, 0] == 5
+
+    def test_misc_shims(self):
+        assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+        a = t(np.arange(4, dtype='float32'))
+        assert paddle.tolist(a) == [0, 1, 2, 3]
+        p = paddle.create_parameter([3, 4], 'float32')
+        assert p.shape == [3, 4] and not p.stop_gradient
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        assert isinstance(paddle.CUDAPlace(0), paddle.Place)
+        assert not paddle.is_compiled_with_rocm()
+        with paddle.set_grad_enabled(False):
+            assert not paddle.is_grad_enabled()
+        assert paddle.is_grad_enabled()
+        paddle.set_printoptions(precision=4)
+        assert paddle.in_dygraph_mode()
+        assert paddle.VarBase is paddle.Tensor
+        sn = paddle.standard_normal([2, 3])
+        assert sn.shape == [2, 3]
+
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(5)), 2)
+        assert [len(b) for b in r()] == [2, 2, 1]
+        r2 = paddle.batch(lambda: iter(range(5)), 2, drop_last=True)
+        assert [len(b) for b in r2()] == [2, 2]
