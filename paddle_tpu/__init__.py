@@ -87,6 +87,7 @@ from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
+from . import quantization  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401
 

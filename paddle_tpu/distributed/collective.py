@@ -166,6 +166,8 @@ def _spmd_allreduce(x, *, axis, op):
         return jax.lax.pmax(x, axis)
     if op == "min":
         return jax.lax.pmin(x, axis)
+    if op == "avg":
+        return jax.lax.pmean(x, axis)
     raise ValueError(op)
 
 

@@ -5,6 +5,11 @@ from .fleet_base import (  # noqa: F401
 from .distributed_strategy import DistributedStrategy  # noqa: F401
 from ..topology import HybridCommunicateGroup, CommunicateTopology  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .meta_optimizers import (  # noqa: F401
+    GradientMergeOptimizer, LocalSGDOptimizer, AdaptiveLocalSGDOptimizer,
+    DGCMomentumOptimizer, FP16AllReduceOptimizer,
+)
 from ..utils_recompute import recompute  # noqa: F401
 
 

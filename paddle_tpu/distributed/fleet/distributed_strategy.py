@@ -36,8 +36,13 @@ class DistributedStrategy:
         self.lamb = False
         self.lamb_configs = {}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
+        self.fp16_allreduce = False
         self.localsgd = False
-        self.localsgd_configs = {"k_steps": 1}
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1, "begin_step": 1}
         self.a_sync = False
         self.a_sync_configs = {}
         self.elastic = False

@@ -70,6 +70,8 @@ def distributed_optimizer(optimizer, strategy=None):
     if strategy is not None:
         _fleet_state["strategy"] = strategy
     from .hybrid_optimizer import HybridParallelOptimizer
+    from .meta_optimizers import apply_meta_optimizers
+    optimizer = apply_meta_optimizers(optimizer, _strategy())
     return HybridParallelOptimizer(optimizer, _fleet_state["hcg"],
                                    _strategy())
 

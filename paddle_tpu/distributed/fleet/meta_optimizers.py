@@ -1,0 +1,272 @@
+"""Fleet meta-optimizers: GradientMerge, LocalSGD, DGC, FP16-allreduce.
+
+Reference parity: python/paddle/distributed/fleet/meta_optimizers/
+{gradient_merge_optimizer.py, localsgd_optimizer.py, dgc_optimizer.py,
+fp16_allreduce_optimizer.py} and operators/optimizers/dgc_momentum_op.cc.
+The reference implements these as ProgramDesc rewrites; here each one is a
+gradient/step transform wrapping the inner optimizer — the compiled step
+traces through the wrapper, so XLA fuses the extra work into the update
+and GSPMD inserts the collective traffic where the mesh requires it.
+"""
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import register_op, no_grad
+from ...optimizer.optimizers import Momentum
+from ...optimizer.optimizer import WrappedOptimizer as _WrappedOptimizer
+
+
+class GradientMergeOptimizer(_WrappedOptimizer):
+    """Accumulate grads for k_steps before one real update (reference:
+    gradient_merge_optimizer.py — static rewrite w/ cond block +
+    GradMergeAllReduceOpHandle; here: carry a merge buffer per param and
+    gate the inner step on step%k)."""
+
+    def __init__(self, inner_opt, k_steps=1, avg=True):
+        super().__init__(inner_opt)
+        self._k = max(1, int(k_steps))
+        self._avg = bool(avg)
+        self._step_idx = 0
+        self._buffers = {}
+
+    @no_grad()
+    def step(self):
+        from ...core.tensor import Tensor
+        self._step_idx += 1
+        params = self._inner_opt._parameter_list()
+        final = self._step_idx % self._k == 0
+        for p in params:
+            if p._grad is None or not p.trainable:
+                continue
+            g = p._grad.value.astype(jnp.float32)
+            acc = self._buffers.get(id(p))
+            acc = g if acc is None else acc + g
+            if final:
+                merged = acc / self._k if self._avg else acc
+                p._grad.value = merged.astype(p._grad.value.dtype)
+                self._buffers.pop(id(p), None)
+            else:
+                self._buffers[id(p)] = acc
+        if final:
+            # flush buffers of params that saw grads earlier in the cycle
+            # but have none this step — a leftover buffer must not leak
+            # into the next cycle (it would merge a stale cycle's grads)
+            if self._buffers:
+                by_id = {id(p): p for p in params}
+                for pid, acc in list(self._buffers.items()):
+                    p = by_id.get(pid)
+                    if p is not None:
+                        merged = acc / self._k if self._avg else acc
+                        p._grad = Tensor(merged)
+                self._buffers.clear()
+            self._inner_opt.step()
+
+
+class LocalSGDOptimizer(_WrappedOptimizer):
+    """Step locally every iteration; average parameters across the data-
+    parallel group every k_steps (reference: localsgd_optimizer.py inserts
+    c_allreduce on params inside a cond block). begin_step delays the
+    first sync like the reference's `begin_step` config."""
+
+    def __init__(self, inner_opt, k_steps=1, begin_step=1, group=None):
+        super().__init__(inner_opt)
+        self._k = max(1, int(k_steps))
+        self._begin = int(begin_step)
+        self._group = group
+        self._step_idx = 0
+
+    @no_grad()
+    def step(self):
+        self._inner_opt.step()
+        self._step_idx += 1
+        if self._step_idx >= self._begin and self._step_idx % self._k == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        """In single-controller SPMD, dp replicas of a parameter are
+        bitwise equal by construction (GSPMD psums grads inside the step),
+        so the reference's c_allreduce(param)/nranks sync is the identity
+        — device-sharded params (ZeRO-3 / expert weights) hold DISTINCT
+        logical rows per shard and must never be averaged across them.
+        The averaging is only a real operation in multi-process
+        (jax.distributed) runs where each process owns an independent
+        replica of the addressable values."""
+        import jax as _jax
+        if _jax.process_count() <= 1:
+            return
+        from .. import collective
+        for p in self._inner_opt._parameter_list():
+            if not p.trainable or p._value is None:
+                continue
+            sharding = getattr(p._value, "sharding", None)
+            if sharding is not None and not getattr(
+                    sharding, "is_fully_replicated", True):
+                continue  # distinct shards per device — never average
+            collective.all_reduce(p, op="avg", group=self._group)
+
+
+class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
+    """Adaptive sync interval (reference: localsgd_optimizer.py
+    AdaptiveLocalSGDOptimizer — interval adapts to training-loss
+    progress). minimize() observes the loss: while the loss is still
+    improving the interval stays short; when progress stalls relative to
+    the best seen, syncing more often cannot help and k grows (capped).
+    Plain step() calls (no loss visible) keep the current interval."""
+
+    def __init__(self, inner_opt, init_k_steps=1, begin_step=1, group=None,
+                 max_k_steps=16):
+        super().__init__(inner_opt, init_k_steps, begin_step, group)
+        self._max_k = int(max_k_steps)
+        self._best_loss = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        cur = float(loss.numpy())
+        if self._best_loss is None or cur < self._best_loss * 0.999:
+            self._best_loss = min(cur, self._best_loss or cur)
+        else:  # progress stalled → lengthen the interval
+            self._k = min(self._max_k, self._k * 2)
+        return None, None
+
+
+class FP16AllReduceOptimizer(_WrappedOptimizer):
+    """Compress gradients to 16-bit before the data-parallel reduction
+    (reference: fp16_allreduce_optimizer.py casts grads fp32→fp16 around
+    c_allreduce). On TPU the natural wire format is bfloat16."""
+
+    def __init__(self, inner_opt, dtype="bfloat16"):
+        super().__init__(inner_opt)
+        self._wire_dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+
+    @no_grad()
+    def step(self):
+        for p in self._inner_opt._parameter_list():
+            if p._grad is not None and p.trainable:
+                g = p._grad.value
+                p._grad.value = g.astype(self._wire_dtype).astype(g.dtype)
+        self._inner_opt.step()
+
+
+def _dgc_sparsity(global_step, rampup_begin_step, rampup_step, sparsity):
+    """Reference dgc.py get_sparsity: step through the sparsity list over
+    the rampup window, then hold the final value."""
+    if global_step < rampup_begin_step:
+        return 0.0
+    progress = global_step - rampup_begin_step
+    if rampup_step <= 0 or progress >= rampup_step:
+        return float(sparsity[-1])
+    idx = int(progress * len(sparsity) / rampup_step)
+    return float(sparsity[min(idx, len(sparsity) - 1)])
+
+
+@register_op("dgc_momentum_update", differentiable=False)
+def _dgc_update(param, grad, u, v, lr, *, mu, ratio, wd):
+    """DGC: momentum correction + top-k sparsification. The kept top-k
+    fraction (`ratio` = 1 - sparsity) is exchanged; the residual stays in
+    the local velocity accumulators (reference: dgc_op + dgc_momentum_op).
+    Under GSPMD the sparse exchange becomes a dense psum of the masked
+    tensor — semantics (residual accumulation / delayed updates) match."""
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    if wd:
+        g = g + wd * p32
+    u_new = mu * u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new).ravel()
+    k = max(1, int(flat.shape[0] * ratio))
+    thr = jax.lax.top_k(flat, k)[0][-1]
+    mask = (jnp.abs(v_new) >= thr).astype(jnp.float32)
+    encoded = v_new * mask
+    v_out = v_new * (1.0 - mask)
+    u_out = u_new * (1.0 - mask)
+    new_p = p32 - lr * encoded
+    return new_p.astype(param.dtype), u_out, v_out
+
+
+class DGCMomentumOptimizer(Momentum):
+    """Deep-gradient-compression momentum (reference: dgc_optimizer.py
+    swaps user Momentum for DGCMomentumOptimizer when strategy.dgc;
+    operators/optimizers/dgc_momentum_op). Before rampup_begin_step it is
+    exactly Momentum; after, top-k sparsified updates with residual
+    accumulation."""
+
+    def __init__(self, learning_rate, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 rampup_begin_step=0, rampup_step=1, sparsity=(0.999,),
+                 name=None):
+        super().__init__(learning_rate, momentum=momentum,
+                         parameters=parameters, use_nesterov=use_nesterov,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        self._rampup_begin = int(rampup_begin_step)
+        self._rampup_step = int(rampup_step)
+        self._sparsity = list(sparsity)
+        self._global_step = 0
+
+    @no_grad()
+    def step(self):
+        super().step()
+        self._global_step += 1
+
+    def _apply_one(self, p, g):
+        s = _dgc_sparsity(self._global_step, self._rampup_begin,
+                          self._rampup_step, self._sparsity)
+        numel = 1
+        for d in p.aval_shape():
+            numel *= int(d)
+        if s <= 0.0 or numel < 16:
+            # warmup / tiny params: vanilla momentum (reference keeps
+            # small tensors dense too)
+            return super()._apply_one(p, g)
+        shape = tuple(p.aval_shape())
+        u = self._acc("dgc_u", p, shape=shape, dtype=jnp.float32)
+        v = self._acc("dgc_v", p, shape=shape, dtype=jnp.float32)
+        new_p, u_n, v_n = _dgc_update(p, g, u, v, self._lr_tensor,
+                                      mu=self._momentum, ratio=1.0 - s,
+                                      wd=self._weight_decay)
+        p.value = new_p.value
+        u.value = u_n.value
+        v.value = v_n.value
+
+
+def apply_meta_optimizers(optimizer, strategy):
+    """StrategyCompiler equivalent (reference:
+    fleet/base/strategy_compiler.py): pick and chain the meta-optimizers
+    the strategy enables. Order (innermost first): dgc swap → fp16
+    allreduce → gradient merge → localsgd."""
+    if strategy is None:
+        return optimizer
+    if getattr(strategy, "dgc", False) and isinstance(optimizer, Momentum) \
+            and not isinstance(optimizer, DGCMomentumOptimizer):
+        cfg = getattr(strategy, "dgc_configs", {}) or {}
+        dgc = DGCMomentumOptimizer(
+            optimizer._lr_scheduler or float(optimizer.get_lr()),
+            momentum=optimizer._momentum,
+            parameters=optimizer._param_groups,
+            use_nesterov=optimizer._use_nesterov,
+            weight_decay=optimizer._weight_decay or None,
+            grad_clip=optimizer._grad_clip,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0),
+            rampup_step=cfg.get("rampup_step", 1),
+            sparsity=cfg.get("sparsity", [0.999]))
+        optimizer = dgc
+    if getattr(strategy, "fp16_allreduce", False):
+        optimizer = FP16AllReduceOptimizer(optimizer)
+    if getattr(strategy, "gradient_merge", False):
+        cfg = strategy.gradient_merge_configs
+        optimizer = GradientMergeOptimizer(optimizer,
+                                           k_steps=cfg.get("k_steps", 1),
+                                           avg=cfg.get("avg", True))
+    if getattr(strategy, "localsgd", False):
+        cfg = strategy.localsgd_configs
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      k_steps=cfg.get("k_steps", 1),
+                                      begin_step=cfg.get("begin_step", 1))
+    elif getattr(strategy, "adaptive_localsgd", False):
+        cfg = getattr(strategy, "adaptive_localsgd_configs", {}) or {}
+        optimizer = AdaptiveLocalSGDOptimizer(
+            optimizer, init_k_steps=cfg.get("init_k_steps", 1),
+            begin_step=cfg.get("begin_step", 1))
+    return optimizer

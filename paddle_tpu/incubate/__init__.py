@@ -1,0 +1,4 @@
+"""paddle.incubate equivalents: MoE, ASP sparsity, auto-checkpoint."""
+from . import asp  # noqa: F401
+from . import moe  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,142 @@
+"""ASP — automatic structured (n:m, default 2:4) sparsity.
+
+Reference parity: python/paddle/fluid/contrib/sparsity/{asp.py, utils.py}
+(ASPHelper.decorate/prune_model, get_mask_1d/get_mask_2d_greedy,
+check_mask_1d, calculate_density). The reference rewrites the static
+program to multiply masks after each optimizer op; here `decorate` wraps
+the dygraph optimizer and re-applies the masks after every step — on TPU
+the mask multiply fuses into the update kernel under jit.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import no_grad
+from ..optimizer.optimizer import WrappedOptimizer
+
+
+def calculate_density(x):
+    """Fraction of nonzeros (reference: utils.py:86)."""
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / max(1, x.size)
+
+
+def _reshape_1d(mat, m):
+    """Pad cols to a multiple of m and view as rows of m (utils.py:108)."""
+    mat = np.asarray(mat)
+    if mat.shape[1] % m != 0:
+        pad = m - mat.shape[1] % m
+        mat = np.concatenate(
+            [mat, np.zeros((mat.shape[0], pad), mat.dtype)], axis=1)
+    return mat.reshape(-1, m), mat.shape
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest |values| in every group of m consecutive
+    elements along rows (reference: utils.py:180)."""
+    mat = np.asarray(mat)
+    orig_shape = mat.shape
+    mat2d = mat.reshape(orig_shape[0], -1) if mat.ndim > 1 else \
+        mat.reshape(1, -1)
+    groups, padded_shape = _reshape_1d(mat2d, m)
+    idx = np.argsort(np.abs(groups), axis=1)[:, : m - n]
+    mask = np.ones_like(groups)
+    np.put_along_axis(mask, idx, 0.0, axis=1)
+    mask = mask.reshape(padded_shape)[:, : mat2d.shape[1]]
+    return mask.reshape(orig_shape)
+
+
+def check_mask_1d(mat, n, m):
+    """True iff every m-group has at most n nonzeros (utils.py:136)."""
+    mat2d = np.asarray(mat)
+    mat2d = mat2d.reshape(mat2d.shape[0], -1) if mat2d.ndim > 1 else \
+        mat2d.reshape(1, -1)
+    groups, _ = _reshape_1d(mat2d, m)
+    return bool(np.all(np.count_nonzero(groups, axis=1) <= n))
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Greedy m x m block mask keeping n per row and column
+    (reference: utils.py:313)."""
+    mat = np.asarray(mat)
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    padded = np.pad(np.abs(mat), ((0, ph), (0, pw)))
+    mask = np.zeros_like(padded)
+    for bi in range(0, padded.shape[0], m):
+        for bj in range(0, padded.shape[1], m):
+            block = padded[bi:bi + m, bj:bj + m]
+            bmask = np.zeros((m, m))
+            order = np.argsort(-block.ravel())
+            rows = np.zeros(m, np.int64)
+            cols = np.zeros(m, np.int64)
+            for f in order:
+                r, c = divmod(int(f), m)
+                if rows[r] < n and cols[c] < n:
+                    bmask[r, c] = 1.0
+                    rows[r] += 1
+                    cols[c] += 1
+            mask[bi:bi + m, bj:bj + m] = bmask
+    return mask[:h, :w]
+
+
+_MASK_ALGOS = {"mask_1d": get_mask_1d, "mask_2d_greedy": get_mask_2d_greedy}
+
+# per-model mask registry: param name -> numpy mask
+_asp_state = {"masks": {}, "excluded": set()}
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _asp_state["excluded"].update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _asp_state["excluded"].clear()
+
+
+def _supported(param):
+    shape = tuple(param.aval_shape())
+    if len(shape) < 2:
+        return False
+    if param.name in _asp_state["excluded"]:
+        return False
+    # reference ASPHelper supports fc/conv weights with inner dims % 4 == 0
+    flat_cols = int(np.prod(shape[1:]))
+    return shape[0] % 4 == 0 or flat_cols % 4 == 0
+
+
+@no_grad()
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported weights to n:m sparsity and register the masks
+    (reference: asp.py prune_model:95)."""
+    algo = _MASK_ALGOS[mask_algo]
+    masks = {}
+    for name, p in model.named_parameters():
+        if not p.trainable or not _supported(p):
+            continue
+        w = np.asarray(p.numpy(), np.float32)
+        mat = w.reshape(w.shape[0], -1)
+        mask = algo(mat, n, m).reshape(w.shape).astype(w.dtype)
+        p.value = jnp.asarray(w * mask)
+        if with_mask:
+            masks[name] = mask
+            # keyed by the parameter's unique framework name (reference
+            # ASPHelper keys masks by param name too); no id() reuse hazard
+            _asp_state["masks"][p.name] = jnp.asarray(mask)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee(WrappedOptimizer):
+    """Reference: asp.py decorate:55 — after every optimizer step,
+    multiply masked params by their masks so pruned weights stay zero."""
+
+    @no_grad()
+    def step(self):
+        self._inner_opt.step()
+        for p in self._inner_opt._parameter_list():
+            mask = _asp_state["masks"].get(p.name)
+            if mask is not None and tuple(mask.shape) == tuple(p.aval_shape()):
+                p.value = p.value * mask.astype(p.value.dtype)
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

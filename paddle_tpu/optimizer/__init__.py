@@ -2,6 +2,6 @@
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (  # noqa: F401
     SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Lamb,
-    LarsMomentum,
+    LarsMomentum, Adadelta, Ftrl,
 )
 from . import lr  # noqa: F401

@@ -144,3 +144,29 @@ class Optimizer:
     # -- to be implemented by subclasses -----------------------------------
     def _apply_one(self, param, grad):
         raise NotImplementedError
+
+
+class WrappedOptimizer:
+    """Base for optimizer-wrapping transforms (meta-optimizers, ASP
+    sparsity guarantee): delegates everything to the inner optimizer via
+    __getattr__; subclasses override step()."""
+
+    def __init__(self, inner_opt):
+        self._inner_opt = inner_opt
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad

@@ -342,3 +342,81 @@ class LarsMomentum(Optimizer):
                              epsilon=self._epsilon)
         p.value = new_p.value
         vel.value = new_v.value
+
+
+@register_op("adadelta_update", differentiable=False)
+def _adadelta(param, grad, avg_sq_grad, avg_sq_update, *, rho, epsilon):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    asg_new = rho * avg_sq_grad + (1.0 - rho) * g * g
+    update = -jnp.sqrt((avg_sq_update + epsilon) / (asg_new + epsilon)) * g
+    asu_new = rho * avg_sq_update + (1.0 - rho) * update * update
+    new_p = p32 + update
+    return new_p.astype(param.dtype), asg_new, asu_new
+
+
+@register_op("ftrl_update", differentiable=False)
+def _ftrl(param, grad, sq_accum, lin_accum, lr, *, l1, l2, lr_power):
+    g = grad.astype(jnp.float32)
+    p32 = param.astype(jnp.float32)
+    new_accum = sq_accum + g * g
+    if lr_power == -0.5:
+        lin_new = lin_accum + g - (jnp.sqrt(new_accum)
+                                   - jnp.sqrt(sq_accum)) / lr * p32
+        y = jnp.sqrt(new_accum) / lr + 2.0 * l2
+    else:
+        lin_new = lin_accum + g - (new_accum ** (-lr_power)
+                                   - sq_accum ** (-lr_power)) / lr * p32
+        y = new_accum ** (-lr_power) / lr + 2.0 * l2
+    x = l1 * jnp.sign(lin_new) - lin_new
+    pre_shrink = x / y
+    new_p = jnp.where(jnp.abs(lin_new) > l1, pre_shrink, 0.0)
+    return new_p.astype(param.dtype), new_accum, lin_new
+
+
+class Adadelta(Optimizer):
+    """Reference: operators/optimizers/adadelta_op.h (update has no LR
+    factor — param += update directly) + python/paddle/optimizer/adadelta.py."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = float(rho), float(epsilon)
+
+    def _apply_one(self, p, g):
+        shape = tuple(p.aval_shape())
+        asg = self._acc("avg_squared_grad", p, shape=shape, dtype=jnp.float32)
+        asu = self._acc("avg_squared_update", p, shape=shape,
+                        dtype=jnp.float32)
+        if self._weight_decay:
+            g = g + self._weight_decay * p
+        new_p, asg_n, asu_n = _adadelta(p, g, asg, asu, rho=self._rho,
+                                        epsilon=self._epsilon)
+        p.value = new_p.value
+        asg.value = asg_n.value
+        asu.value = asu_n.value
+
+
+class Ftrl(Optimizer):
+    """Follow-the-regularized-leader (reference:
+    operators/optimizers/ftrl_op.h)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._l1 = float(l1) + 1e-10  # reference op adds epsilon to avoid 0
+        self._l2 = float(l2) + 1e-10
+        self._lr_power = float(lr_power)
+
+    def _apply_one(self, p, g):
+        shape = tuple(p.aval_shape())
+        sq = self._acc("squared_accum", p, shape=shape, dtype=jnp.float32)
+        lin = self._acc("linear_accum", p, shape=shape, dtype=jnp.float32)
+        new_p, sq_n, lin_n = _ftrl(p, g, sq, lin, self._lr_tensor,
+                                   l1=self._l1, l2=self._l2,
+                                   lr_power=self._lr_power)
+        p.value = new_p.value
+        sq.value = sq_n.value
+        lin.value = lin_n.value

@@ -1,0 +1,280 @@
+"""Quantization: QAT fake-quant layers + post-training calibration.
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/ —
+ImperativeQuantAware (imperative/qat.py:42, dygraph QAT swapping
+Conv2D/Linear for quantized wrappers), fake_quantize ops
+(paddle/fluid/operators/fake_quantize_op.cc: abs_max,
+moving_average_abs_max, channel_wise_abs_max) and
+PostTrainingQuantization (post_training_quantization.py).
+
+TPU-native design: fake quant-dequant is a pure jax op with a
+straight-through-estimator custom VJP; under jit the q/dq chain fuses
+into the surrounding matmul, and on TPU the int8 simulation runs in the
+MXU-friendly fp domain (scale * round(x/scale)).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..ops import nn_ops
+
+
+# ---------------------------------------------------------------------------
+# fake quant-dequant primitives (STE gradient)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _qdq_ste(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _qdq_fwd(x, scale, qmax):
+    return _qdq_ste(x, scale, qmax), None
+
+
+def _qdq_bwd(_, g):
+    return (g, None, None)  # straight-through: pass grad, no scale grad
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+@register_op("fake_quantize_dequantize_abs_max")
+def _fake_qdq_abs_max(x, *, bits):
+    """Reference: fake_quantize_dequantize_abs_max op — per-tensor scale
+    from the current batch's abs-max."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    return _qdq_ste(x, scale, qmax)
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max")
+def _fake_qdq_channel(x, *, bits, axis):
+    """Reference: fake_channel_wise_quantize_dequantize_abs_max — one
+    scale per output channel (weights)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    return _qdq_ste(x, scale, qmax)
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max")
+def _fake_qdq_moving(x, in_scale, *, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    return _qdq_ste(x, in_scale, qmax)
+
+
+@register_op("moving_average_scale_update", differentiable=False)
+def _ma_update(x, scale, accum, state, *, rate, algo):
+    """Reference: moving_average_abs_max_scale op (EMA of batch abs-max);
+    algo="abs_max" keeps the running max instead — the PTQ calibration
+    rule (post_training_quantization.py abs_max algo)."""
+    cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    state_n = rate * state + 1.0
+    if algo == "abs_max":
+        scale_n = jnp.maximum(scale, cur)
+        accum_n = scale_n
+    else:
+        accum_n = rate * accum + cur
+        scale_n = accum_n / state_n
+    return scale_n, accum_n, state_n
+
+
+def quant_dequant_abs_max(x, bits=8):
+    return _fake_qdq_abs_max(x, bits=bits)
+
+
+def quant_dequant_channel_wise(x, bits=8, axis=0):
+    return _fake_qdq_channel(x, bits=bits, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# QAT layers (reference: python/paddle/nn/quant/quant_layers.py)
+# ---------------------------------------------------------------------------
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation quantizer: EMA abs-max scale updated in training,
+    frozen in eval (reference: quant_layers.FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, bits=8, moving_rate=0.9, algo="ema", name=None):
+        super().__init__()
+        self._bits = bits
+        self._rate = float(moving_rate)
+        self._algo = algo
+        # python-side flag, NOT a device read: eval-mode forward must stay
+        # traceable (jit.save) and free of per-layer host syncs
+        self._calibrated = False
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            s, a, st = _ma_update(x, self.scale, self.accum, self.state,
+                                  rate=self._rate, algo=self._algo)
+            self.scale.value = s.value
+            self.accum.value = a.value
+            self.state.value = st.value
+            self._calibrated = True
+        elif not self._calibrated:
+            # never calibrated: dynamic per-batch scale instead of the
+            # uninitialized observer (which would collapse activations)
+            return _fake_qdq_abs_max(x, bits=self._bits)
+        return _fake_qdq_moving(x, self.scale, bits=self._bits)
+
+
+class QuantizedLinear(Layer):
+    """Linear with fake-quantized weight (channel-wise abs-max) and
+    activation (moving-average abs-max)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 act_algo="ema"):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._wbits = weight_bits
+        self._wtype = weight_quantize_type
+        self._act_quant = FakeQuantMovingAverageAbsMax(activation_bits,
+                                                       moving_rate, act_algo)
+
+    def forward(self, x):
+        x = self._act_quant(x)
+        if self._wtype == "abs_max":
+            w = _fake_qdq_abs_max(self.weight, bits=self._wbits)
+        else:
+            w = _fake_qdq_channel(self.weight, bits=self._wbits, axis=1)
+        return nn_ops.linear(x, w, self.bias)
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 act_algo="ema"):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._stride = layer._stride
+        self._padding = layer._padding
+        self._dilation = layer._dilation
+        self._groups = layer._groups
+        self._data_format = layer._data_format
+        self._wbits = weight_bits
+        self._wtype = weight_quantize_type
+        self._act_quant = FakeQuantMovingAverageAbsMax(activation_bits,
+                                                       moving_rate, act_algo)
+
+    def forward(self, x):
+        x = self._act_quant(x)
+        if self._wtype == "abs_max":
+            w = _fake_qdq_abs_max(self.weight, bits=self._wbits)
+        else:
+            w = _fake_qdq_channel(self.weight, bits=self._wbits, axis=0)
+        return nn_ops.conv2d(x, w, self.bias, self._stride, self._padding,
+                             self._dilation, self._groups, self._data_format)
+
+
+_QUANT_WRAPPERS = {"Linear": (Linear, QuantizedLinear),
+                   "Conv2D": (Conv2D, QuantizedConv2D)}
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT driver (reference: imperative/qat.py:42): walks the
+    model, swaps quantizable layers for quantized wrappers in place."""
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        unsupported = [t for t in quantizable_layer_type
+                       if t not in _QUANT_WRAPPERS]
+        if unsupported:
+            raise ValueError(
+                f"unsupported quantizable_layer_type {unsupported}; "
+                f"supported: {sorted(_QUANT_WRAPPERS)}")
+        self._types = tuple(quantizable_layer_type)
+        self._wtype = weight_quantize_type
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._act_algo = ("abs_max"
+                          if activation_quantize_type == "abs_max" else "ema")
+
+    def quantize(self, model):
+        self._quantize_sublayers(model)
+        return model
+
+    def _quantize_sublayers(self, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            replaced = False
+            for tname in self._types:
+                base, wrapper = _QUANT_WRAPPERS[tname]
+                if isinstance(sub, base):
+                    layer._sub_layers[name] = wrapper(
+                        sub, self._wbits, self._abits, self._rate,
+                        self._wtype, self._act_algo)
+                    replaced = True
+                    break
+            if not replaced:
+                self._quantize_sublayers(sub)
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)
+
+
+class PostTrainingQuantization:
+    """PTQ calibration (reference: post_training_quantization.py, abs-max
+    algo): feed calibration batches, collect per-layer activation scales,
+    then freeze them into quantized wrappers."""
+
+    def __init__(self, model, quantizable_layer_type=("Conv2D", "Linear"),
+                 weight_bits=8, activation_bits=8, algo="abs_max"):
+        self._model = model
+        self._types = tuple(quantizable_layer_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._algo = algo
+        self._qat = ImperativeQuantAware(
+            quantizable_layer_type=quantizable_layer_type,
+            activation_quantize_type=("abs_max" if algo == "abs_max"
+                                      else "moving_average_abs_max"),
+            weight_bits=weight_bits, activation_bits=activation_bits)
+
+    def sample(self, *batches):
+        """Run calibration forwards with the MODEL in inference mode
+        (dropout off, batch-norm frozen — reference PTQ runs inference
+        passes) while only the quant observers update."""
+        if not getattr(self, "_quantized", False):
+            self._qat.quantize(self._model)
+            self._quantized = True
+        self._model.eval()
+        for obs in self._observers(self._model):
+            obs.training = True
+        try:
+            outs = [self._model(b) for b in batches]
+        finally:
+            for obs in self._observers(self._model):
+                obs.training = False
+        return outs
+
+    @staticmethod
+    def _observers(layer):
+        found = []
+        for sub in layer._sub_layers.values():
+            if isinstance(sub, FakeQuantMovingAverageAbsMax):
+                found.append(sub)
+            found.extend(PostTrainingQuantization._observers(sub))
+        return found
+
+    def convert(self):
+        """Freeze observers: eval mode stops scale updates."""
+        self._model.eval()
+        return self._model

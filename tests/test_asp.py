@@ -1,0 +1,60 @@
+"""ASP 2:4 structured sparsity (reference:
+fluid/contrib/sparsity/{asp.py,utils.py})."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+def test_get_mask_1d_is_2_of_4():
+    m = np.random.randn(8, 16).astype("float32")
+    mask = asp.get_mask_1d(m, 2, 4)
+    assert asp.check_mask_1d(mask * m, 2, 4)
+    assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+    # keeps the largest two of each group
+    groups = np.abs(m.reshape(-1, 4))
+    kept = (mask.reshape(-1, 4) > 0)
+    for g, k in zip(groups, kept):
+        assert set(np.argsort(-g)[:2]) == set(np.where(k)[0])
+
+
+def test_get_mask_2d_greedy_row_col_bound():
+    m = np.random.randn(8, 8).astype("float32")
+    mask = asp.get_mask_2d_greedy(m, 2, 4)
+    for bi in range(0, 8, 4):
+        for bj in range(0, 8, 4):
+            b = mask[bi:bi + 4, bj:bj + 4]
+            assert np.all(b.sum(axis=0) <= 2) and np.all(b.sum(axis=1) <= 2)
+
+
+def test_prune_model_and_decorate_keeps_sparsity():
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    masks = asp.prune_model(model, n=2, m=4)
+    assert len(masks) == 2
+    for _, p in model.named_parameters():
+        if len(p.shape) == 2:
+            assert abs(asp.calculate_density(p.numpy()) - 0.5) < 0.01
+    opt = asp.decorate(paddle.optimizer.SGD(0.1,
+                                            parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+    for _ in range(3):
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity pattern survives training steps
+    for _, p in model.named_parameters():
+        if len(p.shape) == 2:
+            w = p.numpy().reshape(p.shape[0], -1)
+            assert asp.check_mask_1d(w, 2, 4)
+
+
+def test_excluded_layers():
+    asp.reset_excluded_layers()
+    model = nn.Linear(8, 8)
+    asp.set_excluded_layers([model.weight.name])
+    masks = asp.prune_model(model)
+    assert len(masks) == 0
+    asp.reset_excluded_layers()

@@ -1,0 +1,161 @@
+"""Meta-optimizers (GradientMerge/LocalSGD/DGC/FP16AllReduce) + Ftrl /
+Adadelta numerics (reference: fleet/meta_optimizers/*.py,
+operators/optimizers/{ftrl,adadelta,dgc_momentum}_op)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    GradientMergeOptimizer, LocalSGDOptimizer, FP16AllReduceOptimizer,
+    DGCMomentumOptimizer, apply_meta_optimizers, _dgc_sparsity,
+)
+
+
+def _param(shape=(4,), val=None):
+    w = val if val is not None else np.random.randn(*shape).astype("float32")
+    return paddle.Parameter(w.copy()), w
+
+
+def _set_grad(p, g):
+    p._grad = Tensor(np.asarray(g, np.float32))
+
+
+def test_adadelta_matches_reference_formula():
+    p, w = _param()
+    opt = paddle.optimizer.Adadelta(learning_rate=0.01, rho=0.95,
+                                    epsilon=1e-6, parameters=[p])
+    g = np.random.randn(4).astype("float32")
+    _set_grad(p, g)
+    opt.step()
+    # reference adadelta_op.h: no LR factor in the update
+    asg = 0.05 * g * g
+    update = -np.sqrt((0.0 + 1e-6) / (asg + 1e-6)) * g
+    np.testing.assert_allclose(p.numpy(), w + update, rtol=1e-5)
+
+
+def test_ftrl_matches_reference_formula():
+    p, w = _param()
+    lr, l1, l2 = 0.1, 0.01 + 1e-10, 0.02 + 1e-10
+    opt = paddle.optimizer.Ftrl(learning_rate=lr, l1=0.01, l2=0.02,
+                                parameters=[p])
+    g = np.random.randn(4).astype("float32")
+    _set_grad(p, g)
+    opt.step()
+    new_accum = g * g
+    lin = g - (np.sqrt(new_accum) - 0.0) / lr * w
+    x = l1 * np.sign(lin) - lin
+    y = np.sqrt(new_accum) / lr + 2.0 * l2
+    expect = np.where(np.abs(lin) > l1, x / y, 0.0)
+    np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_equals_merged_step():
+    g1 = np.full(4, 0.5, np.float32)
+    g2 = np.full(4, 1.5, np.float32)
+    # merged run: k=2, avg
+    p, w = _param(val=np.ones(4, np.float32))
+    gm = GradientMergeOptimizer(paddle.optimizer.SGD(0.1, parameters=[p]),
+                                k_steps=2, avg=True)
+    _set_grad(p, g1)
+    gm.step()
+    np.testing.assert_allclose(p.numpy(), w)  # no update yet
+    _set_grad(p, g2)
+    gm.step()
+    np.testing.assert_allclose(p.numpy(), w - 0.1 * (g1 + g2) / 2, rtol=1e-6)
+
+
+def test_localsgd_single_process_runs():
+    p, w = _param()
+    opt = LocalSGDOptimizer(paddle.optimizer.SGD(0.1, parameters=[p]),
+                            k_steps=2)
+    for _ in range(4):
+        _set_grad(p, np.ones(4, np.float32))
+        opt.step()
+    np.testing.assert_allclose(p.numpy(), w - 0.4, rtol=1e-4, atol=1e-5)
+
+
+def test_fp16_allreduce_compresses_grad():
+    p, w = _param(val=np.zeros(4, np.float32))
+    opt = FP16AllReduceOptimizer(paddle.optimizer.SGD(1.0, parameters=[p]))
+    g = np.array([1.0 + 2 ** -10, 1.0, 2.0, 3.0], np.float32)
+    _set_grad(p, g)
+    opt.step()
+    expect = -np.asarray(g, np.float32).astype("bfloat16").astype("float32")
+    np.testing.assert_allclose(p.numpy(), expect, rtol=1e-6)
+
+
+def test_dgc_warmup_is_plain_momentum():
+    g = np.random.randn(8).astype("float32")
+    p1, w = _param((8,))
+    p2, _ = _param((8,), val=w.copy())
+    dgc = DGCMomentumOptimizer(0.1, momentum=0.9, parameters=[p1],
+                               rampup_begin_step=100)
+    mom = paddle.optimizer.Momentum(0.1, momentum=0.9, parameters=[p2])
+    _set_grad(p1, g)
+    _set_grad(p2, g)
+    dgc.step()
+    mom.step()
+    np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6)
+
+
+def test_dgc_sparsifies_update():
+    w = np.zeros(64, np.float32)
+    p, _ = _param((64,), val=w)
+    dgc = DGCMomentumOptimizer(1.0, momentum=0.0, parameters=[p],
+                               rampup_begin_step=0, rampup_step=1,
+                               sparsity=[0.75])
+    g = np.arange(64, dtype=np.float32) + 1.0
+    dgc._global_step = 1  # past rampup begin
+    _set_grad(p, g)
+    dgc.step()
+    delta = p.numpy() - w
+    # top 25% of |v| (largest 16 grads) applied; rest kept as residual
+    assert np.count_nonzero(delta) == 16
+    assert np.all(delta[-16:] != 0) and np.all(delta[:48] == 0)
+    # residual accumulates: next step with zero grad still flushes top-k
+    _set_grad(p, np.zeros(64, np.float32))
+    before = p.numpy().copy()
+    dgc.step()
+    assert np.count_nonzero(p.numpy() - before) > 0
+
+
+def test_dgc_sparsity_schedule():
+    assert _dgc_sparsity(0, 5, 4, [0.75, 0.9375]) == 0.0
+    assert _dgc_sparsity(5, 5, 4, [0.75, 0.9375]) == 0.75
+    assert _dgc_sparsity(7, 5, 4, [0.75, 0.9375]) == 0.9375
+    assert _dgc_sparsity(100, 5, 4, [0.75, 0.9375]) == 0.9375
+
+
+def test_strategy_compiler_chains_wrappers():
+    strat = paddle.distributed.fleet.DistributedStrategy()
+    strat.dgc = True
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    strat.localsgd = True
+    p, _ = _param()
+    inner = paddle.optimizer.Momentum(0.1, momentum=0.9, parameters=[p])
+    opt = apply_meta_optimizers(inner, strat)
+    assert isinstance(opt, LocalSGDOptimizer)
+    assert isinstance(opt._inner_opt, GradientMergeOptimizer)
+    assert isinstance(opt._inner_opt._inner_opt, DGCMomentumOptimizer)
+    for _ in range(2):
+        _set_grad(p, np.ones(4, np.float32))
+        opt.step()
+    assert np.all(np.isfinite(p.numpy()))
+
+
+def test_fleet_distributed_optimizer_applies_strategy():
+    fleet = paddle.distributed.fleet
+    strat = fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": False}
+    fleet.init(is_collective=True, strategy=strat)
+    p, w = _param(val=np.ones(4, np.float32))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=[p]), strategy=strat)
+    _set_grad(p, np.ones(4, np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), w)  # merged, not yet applied
+    _set_grad(p, np.ones(4, np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), w - 0.2, rtol=1e-6)

@@ -1,0 +1,123 @@
+"""Quantization: fake-quant numerics vs numpy golden, QAT layer swap,
+STE gradient, PTQ calibration (reference:
+fluid/contrib/slim/quantization, operators/fake_quantize_op.cc)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    quant_dequant_abs_max, quant_dequant_channel_wise,
+    ImperativeQuantAware, PostTrainingQuantization,
+    QuantizedLinear, QuantizedConv2D, FakeQuantMovingAverageAbsMax,
+)
+
+
+def _qdq_np(x, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    scale = np.max(np.abs(x))
+    if scale < 1e-8:
+        scale = 1e-8
+    return np.clip(np.round(x / scale * qmax), -qmax, qmax) * scale / qmax
+
+
+def test_abs_max_qdq_matches_numpy():
+    x = np.random.randn(16, 8).astype("float32")
+    out = quant_dequant_abs_max(paddle.to_tensor(x), bits=8)
+    np.testing.assert_allclose(out.numpy(), _qdq_np(x), atol=1e-6)
+
+
+def test_channel_wise_qdq():
+    w = np.random.randn(4, 8).astype("float32") * np.array(
+        [[1.0], [10.0], [0.1], [5.0]], np.float32)
+    out = quant_dequant_channel_wise(paddle.to_tensor(w), bits=8, axis=0)
+    expect = np.stack([_qdq_np(w[i]) for i in range(4)])
+    np.testing.assert_allclose(out.numpy(), expect, atol=1e-6)
+
+
+def test_ste_gradient_passes_through():
+    x = paddle.to_tensor(np.random.randn(8).astype("float32"))
+    x.stop_gradient = False
+    y = quant_dequant_abs_max(x, bits=8)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(8, np.float32))
+
+
+def test_moving_average_observer_updates_in_train_only():
+    q = FakeQuantMovingAverageAbsMax(bits=8, moving_rate=0.9)
+    x = paddle.to_tensor(np.full((4,), 2.0, np.float32))
+    q.train()
+    q(x)
+    s1 = float(q.scale.numpy())
+    assert s1 > 0
+    q.eval()
+    q(paddle.to_tensor(np.full((4,), 100.0, np.float32)))
+    assert float(q.scale.numpy()) == s1  # frozen in eval
+
+
+def test_imperative_quant_aware_swaps_layers():
+    model = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+        nn.Flatten(), nn.Linear(8 * 4 * 4, 10))
+    ImperativeQuantAware().quantize(model)
+    kinds = [type(l).__name__ for l in model._sub_layers.values()]
+    assert "QuantizedConv2D" in kinds and "QuantizedLinear" in kinds
+    x = paddle.to_tensor(np.random.randn(2, 3, 4, 4).astype("float32"))
+    model.train()
+    out = model(x)
+    assert tuple(out.shape) == (2, 10)
+    # QAT backward works end-to-end
+    out.sum().backward()
+    for p in model.parameters():
+        if p.trainable:
+            assert p.grad is not None
+
+
+def test_qat_training_converges_on_toy_regression():
+    paddle.seed(7)
+    model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    ImperativeQuantAware().quantize(model)
+    opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    x_np = np.random.randn(64, 4).astype("float32")
+    y_np = x_np @ w_true
+    x, y = paddle.to_tensor(x_np), paddle.to_tensor(y_np)
+    model.train()
+    first = None
+    for i in range(60):
+        loss = ((model(x) - y) ** 2).mean()
+        if first is None:
+            first = float(loss.numpy())
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss.numpy()) < first * 0.2
+
+
+def test_post_training_quantization():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    ptq = PostTrainingQuantization(model)
+    data = [paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+            for _ in range(3)]
+    ptq.sample(*data)
+    qmodel = ptq.convert()
+    assert not qmodel.training
+    out = qmodel(data[0])
+    assert np.all(np.isfinite(out.numpy()))
+    # activation scales were calibrated
+    for sub in qmodel._sub_layers.values():
+        if isinstance(sub, QuantizedLinear):
+            assert float(sub._act_quant.scale.numpy()) > 0
+
+
+def test_ptq_abs_max_takes_max_over_batches():
+    model = nn.Sequential(nn.Linear(4, 4, bias_attr=False))
+    ptq = PostTrainingQuantization(model, algo="abs_max")
+    big = paddle.to_tensor(np.full((2, 4), 100.0, np.float32))
+    small = paddle.to_tensor(np.full((2, 4), 1.0, np.float32))
+    ptq.sample(big)
+    ptq.sample(small)  # later small batch must not shrink the scale
+    ptq.convert()
+    quantized = [sub for sub in model._sub_layers.values()
+                 if isinstance(sub, QuantizedLinear)]
+    assert len(quantized) == 1
+    assert float(quantized[0]._act_quant.scale.numpy()) >= 100.0
