@@ -88,6 +88,13 @@ from . import utils  # noqa: F401
 from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import quantization  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
+from . import reader  # noqa: F401
+from . import fluid  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .ops import linalg  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi import summary  # noqa: F401
 

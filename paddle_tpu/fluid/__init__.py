@@ -1,0 +1,70 @@
+"""paddle.fluid compat namespace.
+
+Reference parity: python/paddle/fluid/ — the pre-2.0 API layer that much
+existing user code still imports (fluid.dygraph.guard, fluid.layers.*,
+fluid.Executor, fluid.ParamAttr, ...). Everything here forwards to the
+modern paddle_tpu modules; it exists so reference-era scripts port
+without rewrites. New code should use the top-level API.
+"""
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace,
+)
+from ..nn.initializer import ParamAttr  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..static import (  # noqa: F401
+    Executor, Program, default_main_program, default_startup_program,
+    program_guard, data,
+)
+from ..core.dispatch import no_grad  # noqa: F401
+from .. import optimizer  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import layers  # noqa: F401
+from . import io  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from ..nn.clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def get_flags(flags):
+    from ..core import flags as flags_mod
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: flags_mod.get_flag(f) for f in flags}
+
+
+def set_flags(flags):
+    from ..core import flags as flags_mod
+    for k, v in flags.items():
+        flags_mod.set_flag(k, v)
+
+
+class CompiledProgram:
+    """Reference: fluid/compiler.py CompiledProgram — on TPU every traced
+    program is already 'compiled' (XLA); with_data_parallel maps to GSPMD
+    batch sharding, so both are identity wrappers."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class ExecutionStrategy:
+    num_threads = 1
+    num_iteration_per_drop_scope = 100
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+    reduce_strategy = ReduceStrategy.AllReduce
+    fuse_all_reduce_ops = True
+    memory_optimize = True

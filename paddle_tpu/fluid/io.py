@@ -1,0 +1,35 @@
+"""fluid.io compat (reference: python/paddle/fluid/io.py:
+save_persistables/save_inference_model/load_inference_model + the
+reader decorators re-exported). Forwards to modern save/load and
+jit.save/load."""
+from ..framework.io_utils import save, load  # noqa: F401
+from ..reader import (  # noqa: F401
+    map_readers, shuffle, chain, compose, buffered, firstn, cache,
+    xmap_readers,
+)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """The reference walks the program's persistable vars; here model/
+    optimizer state_dicts are the persistables — use paddle.save on
+    state_dict() (this shim exists for source compat)."""
+    raise NotImplementedError(
+        "save_persistables requires a ProgramDesc; in the TPU build save "
+        "state_dicts: paddle.save(model.state_dict(), path)")
+
+
+def save_inference_model(dirname, feeded_var_names=None, target_vars=None,
+                         executor=None, main_program=None, model=None,
+                         input_spec=None, **kwargs):
+    from .. import jit
+    if model is None:
+        raise NotImplementedError(
+            "pass model= (an nn.Layer): the TPU build exports traced "
+            "programs via jit.save, not ProgramDesc files")
+    return jit.save(model, dirname, input_spec=input_spec)
+
+
+def load_inference_model(dirname, executor=None, **kwargs):
+    from .. import jit
+    return jit.load(dirname)

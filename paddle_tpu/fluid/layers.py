@@ -1,0 +1,163 @@
+"""fluid.layers compat — the op-assembly API (reference:
+python/paddle/fluid/layers/nn.py 36k LoC). The heavily-used subset
+forwards to the modern functional ops; names keep fluid's signatures
+(e.g. fc(input, size), reduce_mean, cross_entropy with soft labels off).
+"""
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops import (creation, linalg, manipulation, math as math_ops,
+                   nn_ops, reduction)
+from ..static import data  # noqa: F401
+
+
+_layer_cache = {}
+
+
+def _reuse_key(name, config):
+    """Parameter reuse for the eager replay of fluid code: the reference
+    builds each layers.* call ONCE into a program; eager loops re-execute
+    the python line each step, so the same call site (or explicit `name`)
+    must map to the same parameters or nothing trains. Key: user name if
+    given, else caller's (file, lineno) + config."""
+    if name is not None:
+        return ("name", name) + config
+    import sys
+    f = sys._getframe(2)
+    return (f.f_code.co_filename, f.f_lineno) + config
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Reference: fluid/layers/nn.py fc — creates (or reuses, see
+    _reuse_key) a Linear over the flattened trailing dims."""
+    from ..nn.layer.common import Linear
+    in_features = int(np.prod(input.shape[num_flatten_dims:]))
+    key = _reuse_key(name, ("fc", in_features, size))
+    layer = _layer_cache.get(key)
+    if layer is None:
+        layer = Linear(in_features, size, weight_attr=param_attr,
+                       bias_attr=bias_attr)
+        _layer_cache[key] = layer
+    x = manipulation.reshape(input, list(input.shape[:num_flatten_dims])
+                             + [in_features])
+    out = layer(x)
+    if act == "relu":
+        out = nn_ops.relu(out)
+    elif act == "softmax":
+        out = nn_ops.softmax(out)
+    elif act == "tanh":
+        out = math_ops.tanh(out)
+    return out
+
+
+def relu(x, name=None):
+    return nn_ops.relu(x)
+
+
+def softmax(x, axis=-1, name=None):
+    return nn_ops.softmax(x, axis=axis)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    out = linalg.matmul(x, y, transpose_x, transpose_y)
+    if alpha != 1.0:
+        out = out * alpha
+    return out
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return reduction.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return reduction.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return reduction.max(input, axis=dim, keepdim=keep_dim)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    return nn_ops.cross_entropy(input, label, soft_label=soft_label,
+                                ignore_index=ignore_index,
+                                use_softmax=False, reduction="none")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = nn_ops.cross_entropy(logits, label, soft_label=soft_label,
+                                ignore_index=ignore_index,
+                                reduction="none")
+    if return_softmax:
+        return loss, nn_ops.softmax(logits, axis=axis)
+    return loss
+
+
+def mean(x, name=None):
+    return reduction.mean(x)
+
+
+def concat(input, axis=0, name=None):
+    return manipulation.concat(input, axis=axis)
+
+
+def reshape(x, shape, name=None):
+    return manipulation.reshape(x, shape)
+
+
+def transpose(x, perm, name=None):
+    return manipulation.transpose(x, perm)
+
+
+def fill_constant(shape, dtype, value, name=None):
+    return creation.full(shape, value, dtype=dtype)
+
+
+def zeros(shape, dtype="float32", name=None):
+    return creation.zeros(shape, dtype=dtype)
+
+
+def ones(shape, dtype="float32", name=None):
+    return creation.ones(shape, dtype=dtype)
+
+
+def assign(input, output=None):
+    t = Tensor(np.asarray(input)) if not isinstance(input, Tensor) \
+        else input.clone()
+    if output is not None:
+        output.value = t.value
+        return output
+    return t
+
+
+def cast(x, dtype):
+    from ..ops.math import cast as _cast
+    return _cast(x, dtype)
+
+
+def embedding(input, size, is_sparse=False, param_attr=None,
+              dtype="float32", name=None):
+    from ..nn.layer.common import Embedding
+    key = _reuse_key(name, ("embedding", int(size[0]), int(size[1])))
+    layer = _layer_cache.get(key)
+    if layer is None:
+        layer = Embedding(size[0], size[1], weight_attr=param_attr)
+        _layer_cache[key] = layer
+    return layer(input)
+
+
+def dropout(x, dropout_prob, is_test=False,
+            dropout_implementation="downgrade_in_infer"):
+    mode = ("upscale_in_train"
+            if dropout_implementation == "upscale_in_train"
+            else "downscale_in_infer")
+    return nn_ops.dropout(x, p=dropout_prob, training=not is_test,
+                          mode=mode)
+
+
+def accuracy(input, label, k=1):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)

@@ -1,0 +1,20 @@
+"""paddle.onnx equivalent (reference: python/paddle/onnx/ — export
+delegates to the external paddle2onnx package).
+
+ONNX graph emission is not implemented; the TPU-native interchange format
+is the StableHLO/jit program (what the inference Predictor and jit.load
+consume), and `export` always produces that artifact. A warning makes the
+format explicit so downstream ONNX tooling fails at export time, not
+later on a missing .onnx file.
+"""
+import warnings
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    from . import jit
+    jit.save(layer, path, input_spec=input_spec)
+    warnings.warn(
+        "paddle_tpu.onnx.export emits a StableHLO/jit program at "
+        f"{path} (loadable by paddle_tpu.jit.load / inference Predictor); "
+        ".onnx graph emission is not supported in this build")
+    return path

@@ -35,11 +35,16 @@ class Optimizer:
         elif weight_decay is None:
             self._weight_decay = 0.0
             self._decay_mode = "none"
-        else:  # regularizer object
+        else:  # regularizer object (paddle.regularizer.L1Decay/L2Decay)
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay,
                                                        "coeff", 0.0)))
-            self._decay_mode = "l2"
+            self._decay_mode = getattr(weight_decay, "_mode", "l2")
+            if self._decay_mode == "l1":
+                # L1 is applied as a grad pre-transform in step(); the
+                # update kernels' wd slot implements L2 only
+                self._l1_coeff = self._weight_decay
+                self._weight_decay = 0.0
         if isinstance(learning_rate, LRScheduler):
             self._lr_scheduler = learning_rate
             lr0 = learning_rate()
@@ -79,6 +84,15 @@ class Optimizer:
                         if p._grad is not None and p.trainable]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
+        if self._decay_mode == "l1" and getattr(self, "_l1_coeff", 0.0):
+            # reference order: clip first, then append_regularization_ops;
+            # L1Decay adds coeff * sign(param) to the clipped gradient
+            # (L2 is applied inside the update kernels, also post-clip)
+            coeff = self._l1_coeff
+            params_grads = [
+                (p, Tensor(g.value + coeff * jnp.sign(
+                    p.value.astype(g.value.dtype))))
+                for p, g in params_grads]
         for p, g in params_grads:
             self._apply_one(p, g)
 

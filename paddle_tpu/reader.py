@@ -1,0 +1,148 @@
+"""paddle.reader decorators (reference: python/paddle/reader/decorator.py:
+map_readers, shuffle, chain, compose, buffered, firstn, cache,
+xmap_readers). A "reader" is a zero-arg callable returning an iterable of
+samples — the pre-2.0 data API still used by fleet dataset pipelines.
+"""
+import itertools
+import queue
+import random as _random
+import threading
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        _random.shuffle(buf)
+        yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+    return chained
+
+
+class ComposeNotAligned(ValueError):
+    """Reference: reader/decorator.py ComposeNotAligned — raised when
+    composed readers yield different numbers of samples."""
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.get("check_alignment", True)
+    _end = object()
+
+    def composed():
+        rs = [iter(r()) for r in readers]
+        while True:
+            vals = [next(it, _end) for it in rs]
+            if all(v is _end for v in vals):
+                return
+            if any(v is _end for v in vals):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "readers yield different sample counts")
+                return  # unchecked: stop at the shortest reader
+            out = ()
+            for v in vals:
+                out += v if isinstance(v, tuple) else (v,)
+            yield out
+    return composed
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples (reference
+    decorator.py buffered — the python-side analogue of the C++
+    buffered_reader double-buffering)."""
+    end = object()
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+                q.put(end)
+            except BaseException as e:  # propagate to the consumer
+                q.put(e)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            if isinstance(s, BaseException):
+                raise s
+            yield s
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+    return firstn_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        return iter(all_data)
+    return cached
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-pool map over a reader (reference decorator.py xmap_readers);
+    order=True preserves input order."""
+    def xreader():
+        samples = list(reader())
+        if order:
+            yield from map(mapper, samples)
+            return
+        results_q = queue.Queue()
+        it = iter(samples)
+        lock = threading.Lock()
+
+        def work():
+            while True:
+                with lock:
+                    try:
+                        s = next(it)
+                    except StopIteration:
+                        return
+                try:
+                    results_q.put(mapper(s))
+                except BaseException as e:
+                    results_q.put(e)  # deliver, never deadlock the consumer
+        threads = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for t in threads:
+            t.start()
+        for _ in range(len(samples)):
+            r = results_q.get()
+            if isinstance(r, BaseException):
+                raise r
+            yield r
+        for t in threads:
+            t.join()
+    return xreader

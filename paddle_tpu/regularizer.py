@@ -1,0 +1,25 @@
+"""paddle.regularizer equivalent.
+
+Reference parity: python/paddle/regularizer.py (L1Decay:20, L2Decay:82)
+over fluid/regularizer.py. The optimizer consumes these through its
+weight_decay argument: L2 adds coeff*param to the gradient, L1 adds
+coeff*sign(param) — matching the reference's append_regularization_ops.
+"""
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self._mode = "l1"
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+        self._mode = "l2"
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"

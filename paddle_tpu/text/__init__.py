@@ -9,3 +9,7 @@ from .models import (  # noqa: F401
     BertModel, BertForPretraining, GPTModel, GPTForCausalLM, gpt3_1p3b,
     bert_base, TransformerLMConfig,
 )
+from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)

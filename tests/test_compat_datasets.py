@@ -1,0 +1,249 @@
+"""Datasets (vision/text), hub, regularizer, reader decorators, fluid
+compat shim (reference: python/paddle/{vision,text}/datasets, hub.py,
+regularizer.py, reader/decorator.py, fluid/)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+# -- regularizer ------------------------------------------------------------
+
+def test_l2_decay_matches_manual():
+    w = np.ones(4, np.float32)
+    p = paddle.Parameter(w.copy())
+    opt = paddle.optimizer.SGD(0.1, parameters=[p],
+                               weight_decay=paddle.regularizer.L2Decay(0.5))
+    p._grad = Tensor(np.zeros(4, np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), w - 0.1 * 0.5 * w, rtol=1e-6)
+
+
+def test_l1_decay_adds_sign_to_grad():
+    w = np.array([1.0, -2.0, 3.0, -4.0], np.float32)
+    p = paddle.Parameter(w.copy())
+    opt = paddle.optimizer.SGD(0.1, parameters=[p],
+                               weight_decay=paddle.regularizer.L1Decay(0.5))
+    p._grad = Tensor(np.zeros(4, np.float32))
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), w - 0.1 * 0.5 * np.sign(w),
+                               rtol=1e-6)
+
+
+# -- datasets ---------------------------------------------------------------
+
+def test_vision_datasets_shapes():
+    from paddle_tpu.vision.datasets import (MNIST, FashionMNIST, Cifar10,
+                                            Cifar100, Flowers, VOC2012)
+    img, lab = MNIST(mode="test")[0]
+    assert img.shape == (1, 28, 28)
+    img, lab = FashionMNIST(mode="test")[0]
+    assert img.shape == (1, 28, 28)
+    img, lab = Cifar10(mode="test")[5]
+    assert img.shape == (3, 32, 32) and 0 <= int(lab) < 10
+    img, lab = Cifar100(mode="test")[5]
+    assert img.shape == (3, 32, 32) and 0 <= int(lab) < 100
+    img, lab = Flowers(mode="test")[0]
+    assert img.shape == (3, 224, 224) and 0 <= int(lab) < 102
+    img, mask = VOC2012()[0]
+    assert img.shape == (3, 64, 64) and mask.shape == (64, 64)
+
+
+def test_dataset_folder_and_image_folder():
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    with tempfile.TemporaryDirectory() as root:
+        for cls in ("cat", "dog"):
+            os.makedirs(os.path.join(root, cls))
+            for i in range(3):
+                np.save(os.path.join(root, cls, f"{i}.npy"),
+                        np.zeros((3, 8, 8), np.float32))
+        ds = DatasetFolder(root)
+        assert ds.classes == ["cat", "dog"] and len(ds) == 6
+        img, target = ds[0]
+        assert img.shape == (3, 8, 8) and target == 0
+        flat = ImageFolder(root)
+        assert len(flat) == 6 and flat[0][0].shape == (3, 8, 8)
+
+
+def test_text_datasets_structure():
+    from paddle_tpu.text.datasets import (Imdb, Imikolov, UCIHousing,
+                                          WMT14, Conll05st)
+    doc, label = Imdb()[0]
+    assert doc.dtype == np.int64 and int(label) in (0, 1)
+    gram = Imikolov(window_size=5)[0]
+    assert len(gram) == 5
+    x, y = UCIHousing(mode="train")[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # train/test split disjoint sizes 80/20 of 506
+    assert len(UCIHousing("nonexistent", "train")) == 404
+    assert len(UCIHousing("nonexistent", "test")) == 102
+    src, trg, trg_next = WMT14()[0]
+    assert src.dtype == np.int64 and len(trg) == len(trg_next)
+    sample = Conll05st()[0]
+    assert len(sample) == 9 and all(len(s) == len(sample[0]) for s in sample)
+
+
+def test_dataloader_over_text_dataset():
+    from paddle_tpu.text.datasets import UCIHousing
+    loader = paddle.io.DataLoader(UCIHousing(mode="test"), batch_size=16,
+                                  drop_last=True)
+    xb, yb = next(iter(loader))
+    assert tuple(xb.shape) == (16, 13) and tuple(yb.shape) == (16, 1)
+
+
+# -- hub --------------------------------------------------------------------
+
+def test_hub_local_dir_and_module():
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "hubconf.py"), "w") as f:
+            f.write("def toy(width=2):\n"
+                    "    'docstring here'\n"
+                    "    return {'width': width}\n")
+        assert "toy" in paddle.hub.list(d)
+        assert "docstring" in paddle.hub.help(d, "toy")
+        assert paddle.hub.load(d, "toy", width=5) == {"width": 5}
+    models = paddle.hub.list("paddle_tpu.vision.models")
+    assert "resnet18" in models or "resnet50" in models
+    with pytest.raises(RuntimeError):
+        paddle.hub.load("user/repo", "x", source="github")
+
+
+# -- reader decorators ------------------------------------------------------
+
+def test_reader_decorators():
+    from paddle_tpu import reader as rd
+
+    def r():
+        return iter(range(10))
+
+    assert list(rd.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(rd.shuffle(r, 4)()) == list(range(10))
+    assert list(rd.chain(r, r)()) == list(range(10)) * 2
+    assert list(rd.map_readers(lambda a, b: a + b, r, r)()) == \
+        [2 * i for i in range(10)]
+    assert list(rd.buffered(r, 2)()) == list(range(10))
+    cached = rd.cache(r)
+    assert list(cached()) == list(range(10)) == list(cached())
+    assert sorted(rd.xmap_readers(lambda s: s * 2, r, 2, 4)()) == \
+        [2 * i for i in range(10)]
+    assert list(rd.xmap_readers(lambda s: s * 2, r, 2, 4, order=True)()) == \
+        [2 * i for i in range(10)]
+    composed = rd.compose(r, r)
+    assert list(composed())[0] == (0, 0)
+
+
+# -- fluid compat -----------------------------------------------------------
+
+def test_fluid_layers_subset():
+    from paddle_tpu import fluid
+    from paddle_tpu.fluid import layers, dygraph
+    with dygraph.guard():
+        x = dygraph.to_variable(np.random.randn(4, 6).astype("float32"))
+        out = layers.fc(x, 10, act="relu")
+        assert out.shape == [4, 10] and float(out.min()) >= 0.0
+        lab = paddle.to_tensor(
+            np.random.randint(0, 10, (4, 1)).astype("int64"))
+        loss = layers.softmax_with_cross_entropy(out, lab)
+        assert np.all(np.isfinite(loss.numpy()))
+        assert layers.reduce_sum(layers.ones([2, 3])).numpy() == 6.0
+    assert fluid.is_compiled_with_cuda() is False
+    prog = fluid.CompiledProgram(None).with_data_parallel()
+    assert isinstance(prog, fluid.CompiledProgram)
+
+
+def test_fluid_io_roundtrip():
+    from paddle_tpu import fluid
+    import paddle_tpu.nn as nn
+    model = nn.Linear(4, 2)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m")
+        fluid.io.save(model.state_dict(), path + ".pdparams")
+        sd = fluid.io.load(path + ".pdparams")
+        assert set(sd) == set(model.state_dict())
+
+
+def test_onnx_export_produces_jit_artifact():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static.input_spec import InputSpec
+    model = nn.Linear(4, 2)
+    model.eval()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m")
+        paddle.onnx.export(model, path,
+                           input_spec=[InputSpec([2, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_fluid_fc_reuses_params_across_loop_iterations():
+    from paddle_tpu.fluid import layers
+    x = paddle.to_tensor(np.random.randn(4, 6).astype("float32"))
+    out1 = layers.fc(x, 3, name="reuse_fc")
+    out2 = layers.fc(x, 3, name="reuse_fc")
+    np.testing.assert_allclose(out1.numpy(), out2.numpy())
+    # call-site keyed reuse without a name
+    outs = [layers.fc(x, 3).numpy() for _ in range(2)]
+    np.testing.assert_allclose(outs[0], outs[1])
+
+
+def test_compose_misaligned_raises():
+    from paddle_tpu import reader as rd
+
+    def r10():
+        return iter(range(10))
+
+    def r8():
+        return iter(range(8))
+
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(r10, r8)())
+    assert len(list(rd.compose(r10, r8, check_alignment=False)())) == 8
+
+
+def test_reader_exceptions_propagate():
+    from paddle_tpu import reader as rd
+
+    def bad_reader():
+        yield 1
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(rd.buffered(lambda: bad_reader(), 2)())
+
+    def r():
+        return iter(range(4))
+
+    def bad_mapper(s):
+        raise ValueError("mapfail")
+
+    with pytest.raises(ValueError, match="mapfail"):
+        list(rd.xmap_readers(bad_mapper, r, 2, 4)())
+
+
+def test_wmt16_target_ids_respect_trg_dict_size():
+    from paddle_tpu.text.datasets import WMT16
+    ds = WMT16(src_dict_size=30000, trg_dict_size=50)
+    for src, trg, trg_next in ds.data[:32]:
+        assert trg.max() < 50 and trg_next.max() < 50
+    assert len(ds.get_dict(lang="de")) == 50
+
+
+def test_l1_decay_applied_after_clip():
+    import paddle_tpu.nn as nn
+    w = np.array([2.0, -2.0], np.float32)
+    p = paddle.Parameter(w.copy())
+    opt = paddle.optimizer.SGD(
+        1.0, parameters=[p],
+        weight_decay=paddle.regularizer.L1Decay(0.5),
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    p._grad = Tensor(np.array([3.0, 4.0], np.float32))  # norm 5 -> /5
+    opt.step()
+    clipped = np.array([0.6, 0.8], np.float32)
+    expect = w - (clipped + 0.5 * np.sign(w))
+    np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
