@@ -7,8 +7,15 @@ A100 ResNet-50 fp16 training throughput (~1500 img/s single GPU), i.e. the
 BASELINE.md north-star target (>=0.9x A100+NCCL); >1.0 means target met.
 Runs bf16 compute via AMP autocast, whole step compiled with to_static
 (the reference's static-graph mode).
+
+Warmup: the to_static protocol (eager -> record -> compiled) runs both
+pre-compile passes at the bench batch so the record pass reuses every
+per-op executable the eager pass compiled. The persistent XLA compilation
+cache (/tmp/jax_comp_cache) makes repeat runs skip the per-op and
+whole-program compiles entirely.
 """
 import json
+import os
 import sys
 import time
 
@@ -16,6 +23,11 @@ import numpy as np
 
 
 def main():
+    import jax
+    os.makedirs("/tmp/jax_comp_cache", exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
     from paddle_tpu.vision.models import resnet50
@@ -30,8 +42,7 @@ def main():
                                     weight_decay=1e-4)
     loss_fn = nn.CrossEntropyLoss()
 
-    @paddle.jit.to_static
-    def train_step(x, y):
+    def train_step_fn(x, y):
         with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
             out = net(x)
             loss = loss_fn(out, y)
@@ -40,15 +51,21 @@ def main():
         opt.clear_grad()
         return loss
 
+    train_step = paddle.jit.to_static(train_step_fn)
+
     x_np = np.random.randn(batch, 3, 224, 224).astype("float32")
     y_np = np.random.randint(0, 1000, (batch,)).astype("int64")
     x = paddle.to_tensor(x_np)
     y = paddle.to_tensor(y_np)
 
-    # warmup: eager, record, first compiled execution (compile happens here)
-    for _ in range(4):
+    # call 1 eager (per-op compiles), call 2 record (per-op cache hits),
+    # call 3 whole-program compile + first compiled execution
+    for phase in ("eager", "record", "compile", "steady"):
+        t_p = time.perf_counter()
         loss = train_step(x, y)
-    float(loss.numpy())
+        float(loss.numpy())
+        print(f"# {phase}: {time.perf_counter() - t_p:.1f}s",
+              file=sys.stderr)
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -58,7 +75,7 @@ def main():
 
     step_ms = dt / steps * 1000.0
     ips = batch * steps / dt
-    target = 0.9 * 1500.0  # 0.9x A100-class ResNet-50 fp16 throughput
+    target = 0.9 * 1500.0  # 0.9x A100-class ResNet-50 fp16 training throughput
     print(json.dumps({
         "metric": "resnet50_train_samples_per_sec_per_chip",
         "value": round(ips, 2),

@@ -192,7 +192,20 @@ class Op:
 
     def vjp_fn(self, key, closure):
         def bwd_impl(arrays, cts):
-            _, vjp = jax.vjp(closure, *arrays)
+            primals, vjp = jax.vjp(closure, *arrays)
+            # under AMP the closure's outputs may be bf16/fp16 while the
+            # downstream cotangent is fp32 (a later op ran in fp32, e.g.
+            # blacklisted reductions); align ct dtype with the primal out
+            # or the transpose rules see mixed dtypes
+            def _align(ct, p):
+                if hasattr(ct, "dtype") and hasattr(p, "dtype") \
+                        and ct.dtype != p.dtype:
+                    return ct.astype(p.dtype)
+                return ct
+            if isinstance(primals, (tuple, list)):
+                cts = type(cts)(_align(c, p) for c, p in zip(cts, primals))
+            else:
+                cts = _align(cts, primals)
             return vjp(cts)
         ctx = trace_mod.current_trace()
         if ctx is not None and ctx.mode == "jit":

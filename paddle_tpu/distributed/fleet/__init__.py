@@ -13,5 +13,10 @@ from .meta_optimizers import (  # noqa: F401
 from ..utils_recompute import recompute  # noqa: F401
 
 
+from . import utils_fs  # noqa: F401
+
+
 class utils:
     from ..utils_recompute import recompute  # noqa: F401
+    from . import utils_fs as fs  # noqa: F401
+    from .utils_fs import LocalFS, HDFSClient  # noqa: F401

@@ -1,0 +1,7 @@
+"""CLI entry matching the reference `python -m paddle.distributed.launch`
+(reference: python/paddle/distributed/fleet/launch.py:396). Forwards to
+launch_mod.launch()."""
+from .launch_mod import launch
+
+if __name__ == "__main__":
+    launch()

@@ -30,17 +30,7 @@ def is_compiled_with_cuda():
     return False
 
 
-def get_flags(flags):
-    from ..core import flags as flags_mod
-    if isinstance(flags, str):
-        flags = [flags]
-    return {f: flags_mod.get_flag(f) for f in flags}
-
-
-def set_flags(flags):
-    from ..core import flags as flags_mod
-    for k, v in flags.items():
-        flags_mod.set_flag(k, v)
+from ..core.flags import get_flags, set_flags  # noqa: F401,E402
 
 
 class CompiledProgram:

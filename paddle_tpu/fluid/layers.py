@@ -14,17 +14,29 @@ from ..static import data  # noqa: F401
 _layer_cache = {}
 
 
+def clear_layer_cache():
+    """Drop all implicitly-created fluid.layers parameters (frees them and
+    resets call-site reuse — call between independent model builds)."""
+    _layer_cache.clear()
+
+
 def _reuse_key(name, config):
     """Parameter reuse for the eager replay of fluid code: the reference
     builds each layers.* call ONCE into a program; eager loops re-execute
     the python line each step, so the same call site (or explicit `name`)
     must map to the same parameters or nothing trains. Key: user name if
-    given, else caller's (file, lineno) + config."""
+    given, else the full user call stack + config — two logically distinct
+    layers built through a shared helper differ in an outer frame, so they
+    do not alias. Pass `name` to share parameters deliberately."""
     if name is not None:
         return ("name", name) + config
     import sys
+    frames = []
     f = sys._getframe(2)
-    return (f.f_code.co_filename, f.f_lineno) + config
+    while f is not None:
+        frames.append((f.f_code.co_filename, f.f_lineno))
+        f = f.f_back
+    return (tuple(frames),) + config
 
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,

@@ -28,17 +28,37 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (example inputs or "
                          "InputSpec list) in paddle_tpu")
-    examples = []
+    from ..core.dtype import to_jax_dtype
+    examples = []       # concrete fallback args
+    poly_examples = []  # symbolic-dim args (dynamic batch etc.)
+    n_sym = 0
     for spec in input_spec:
         if isinstance(spec, Tensor):
             examples.append(spec.value)
+            poly_examples.append(spec.value)
         elif isinstance(spec, InputSpec):
+            dtype = to_jax_dtype(spec.dtype)
             shape = tuple(1 if (s is None or s < 0) else int(s)
                           for s in spec.shape)
-            from ..core.dtype import to_jax_dtype
-            examples.append(jnp.zeros(shape, to_jax_dtype(spec.dtype)))
+            examples.append(jnp.zeros(shape, dtype))
+            if any(s is None or s < 0 for s in spec.shape):
+                # dynamic dims -> jax.export symbolic dimensions, so the
+                # loaded program accepts any size (reference ProgramDesc
+                # keeps -1 dims; StableHLO equivalent is shape polymorphism)
+                dims = []
+                for s in spec.shape:
+                    if s is None or s < 0:
+                        dims.append(f"_d{n_sym}")
+                        n_sym += 1
+                    else:
+                        dims.append(str(int(s)))
+                sym = jax.export.symbolic_shape(",".join(dims))
+                poly_examples.append(jax.ShapeDtypeStruct(sym, dtype))
+            else:
+                poly_examples.append(jnp.zeros(shape, dtype))
         else:
             examples.append(jnp.asarray(spec))
+            poly_examples.append(jnp.asarray(spec))
 
     fwd = layer.forward
     if isinstance(fwd, TracedFunction):
@@ -63,7 +83,19 @@ def save(layer, path, input_spec=None, **configs):
             return [o.value for o in outs]
 
     jitted = jax.jit(pure_fn)
-    exported = jax.export.export(jitted)(values, *examples)
+    if n_sym:
+        try:
+            exported = jax.export.export(jitted)(values, *poly_examples)
+        except Exception:
+            # shape-polymorphic tracing can fail on programs with
+            # size-dependent constants (reshape to literal sizes, etc.);
+            # fall back to the concrete example shapes
+            import warnings
+            warnings.warn("jit.save: dynamic-dim export failed; saving "
+                          "with concrete example shapes instead")
+            exported = jax.export.export(jitted)(values, *examples)
+    else:
+        exported = jax.export.export(jitted)(values, *examples)
     blob = exported.serialize()
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

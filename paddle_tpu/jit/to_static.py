@@ -69,7 +69,10 @@ class TracedFunction:
     def __init__(self, fn, input_spec=None, warmup=1):
         self._fn = fn
         self._input_spec = input_spec
-        self._warmup = max(1, warmup)
+        # warmup=0: skip the eager pass and record on call 1 — valid when
+        # all lazily-created state (optimizer moments, BN stats) already
+        # exists, e.g. after one eager step at any batch size
+        self._warmup = max(0, warmup)
         self._entries = {}  # signature -> dict(state)
         functools.update_wrapper(self, fn)
         self._bound_instance = None
@@ -197,15 +200,16 @@ class TracedFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              property=False):  # noqa: A002
+              property=False, warmup=1):  # noqa: A002
     """paddle.jit.to_static equivalent."""
     def deco(fn):
         from ..nn.layer_base import Layer
         if isinstance(fn, Layer):
             layer = fn
-            layer.forward = TracedFunction(layer.forward, input_spec)
+            layer.forward = TracedFunction(layer.forward, input_spec,
+                                           warmup=warmup)
             return layer
-        return TracedFunction(fn, input_spec)
+        return TracedFunction(fn, input_spec, warmup=warmup)
     if function is not None:
         return deco(function)
     return deco

@@ -48,3 +48,7 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
     row = jnp.arange(maxlen)
     mask = row[None, :] < lv[..., None]
     return _register_created(Tensor(mask.astype(dtype_mod.to_jax_dtype(dtype))))
+from ...ops.sequence import (  # noqa: F401,E402
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_expand, sequence_reverse,
+)

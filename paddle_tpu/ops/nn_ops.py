@@ -216,13 +216,13 @@ def _conv2d(x, w, b, *, strides, paddings, dilations, groups, data_format):
     else:
         pad = tuple((p, p) for p in paddings) if len(paddings) == 2 else \
             tuple((paddings[2 * i], paddings[2 * i + 1]) for i in range(2))
+    # no preferred_element_type: the TPU MXU accumulates bf16 convs in
+    # f32 in hardware already, and an f32-output annotation makes the
+    # conv transpose rule see mixed bf16/f32 operands in the vjp
     out = jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
-    if out.dtype != x.dtype:
-        out = out.astype(x.dtype)
+        feature_group_count=groups)
     if b is not None:
         bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
         out = out + b.reshape(bshape)

@@ -1,0 +1,123 @@
+"""Sequence (LoD-equivalent) ops.
+
+Reference parity: paddle/fluid/operators/sequence_ops/ (sequence_pad,
+sequence_unpad, sequence_pool, sequence_expand, sequence_softmax,
+sequence_mask over LoDTensor ragged offsets).
+
+TPU-native design (SURVEY §7 hard-part 3): XLA needs static shapes, so
+ragged sequences are carried as (padded_tensor, lengths) pairs — every op
+here is a masked dense computation; no LoD offsets exist. sequence_pad
+turns a python list of variable-length arrays into that representation at
+the host boundary (the only place raggedness can exist).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+
+
+def sequence_pad(x, pad_value=0.0, maxlen=None, dtype="float32"):
+    """Host boundary: list of [len_i, ...] arrays -> (padded [N, L, ...],
+    lengths [N]) (reference: sequence_pad_op)."""
+    arrs = [np.asarray(a.numpy() if isinstance(a, Tensor) else a)
+            for a in x]
+    lens = np.asarray([len(a) for a in arrs], "int64")
+    L = int(maxlen) if maxlen is not None else int(lens.max())
+    tail = arrs[0].shape[1:]
+    out = np.full((len(arrs), L) + tail, pad_value,
+                  arrs[0].dtype if arrs[0].dtype != np.int64 else "int64")
+    for i, a in enumerate(arrs):
+        out[i, :min(len(a), L)] = a[:L]
+    return Tensor(out), Tensor(lens)
+
+
+def sequence_unpad(x, length):
+    """Padded [N,L,...] + lengths -> list of [len_i, ...] arrays
+    (reference: sequence_unpad_op). Host boundary op."""
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    lens = np.asarray(length.numpy() if isinstance(length, Tensor)
+                      else length).astype("int64")
+    return [Tensor(arr[i, :lens[i]].copy()) for i in range(len(lens))]
+
+
+def _mask(lengths, L):
+    return (jnp.arange(L)[None, :] < lengths[:, None])
+
+
+@register_op("sequence_pool")
+def _sequence_pool(x, lengths, *, pool_type):
+    """Masked pooling over the time axis (reference: sequence_pool_op
+    SUM/AVERAGE/MAX/SQRT/LAST/FIRST)."""
+    n, L = x.shape[0], x.shape[1]
+    m = _mask(lengths, L)
+    shape = (n, L) + (1,) * (x.ndim - 2)
+    mf = m.reshape(shape).astype(x.dtype)
+    pt = pool_type.upper()
+    if pt == "SUM":
+        return (x * mf).sum(axis=1)
+    if pt == "AVERAGE":
+        return (x * mf).sum(axis=1) / jnp.maximum(
+            lengths.reshape((n,) + (1,) * (x.ndim - 2)).astype(x.dtype), 1)
+    if pt == "SQRT":
+        return (x * mf).sum(axis=1) / jnp.sqrt(jnp.maximum(
+            lengths.reshape((n,) + (1,) * (x.ndim - 2)).astype(x.dtype), 1))
+    if pt == "MAX":
+        neg = jnp.where(m.reshape(shape), x,
+                        jnp.asarray(-jnp.inf, x.dtype))
+        return neg.max(axis=1)
+    if pt == "LAST":
+        idx = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+        return jnp.take_along_axis(
+            x, idx.reshape((n, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    if pt == "FIRST":
+        return x[:, 0]
+    raise ValueError(pool_type)
+
+
+def sequence_pool(x, lengths, pool_type="SUM"):
+    return _sequence_pool(x, lengths, pool_type=pool_type)
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(x, lengths):
+    """Masked softmax over time (reference: sequence_softmax_op)."""
+    L = x.shape[1]
+    m = _mask(lengths, L)
+    while m.ndim < x.ndim:
+        m = m[..., None]
+    z = jnp.where(m, x, -jnp.inf)
+    z = z - jnp.max(z, axis=1, keepdims=True)
+    e = jnp.exp(z) * m.astype(x.dtype)
+    return e / jnp.maximum(e.sum(axis=1, keepdims=True), 1e-9)
+
+
+def sequence_softmax(x, lengths):
+    return _sequence_softmax(x, lengths)
+
+
+def sequence_expand(x, y_lengths):
+    """Repeat each row i of x y_lengths[i] times (reference:
+    sequence_expand_op with ref_level LoD). Host-computed repeat counts
+    keep the output shape static for XLA."""
+    reps = np.asarray(y_lengths.numpy() if isinstance(y_lengths, Tensor)
+                      else y_lengths).astype("int32")
+    arr = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    out = jnp.repeat(arr, jnp.asarray(reps), axis=0,
+                     total_repeat_length=int(reps.sum()))
+    return Tensor(out)
+
+
+def sequence_reverse(x, lengths):
+    """Reverse each sequence within its valid prefix (reference:
+    sequence_reverse_op)."""
+    arr = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    lens = lengths.value if isinstance(lengths, Tensor) else \
+        jnp.asarray(lengths)
+    L = arr.shape[1]
+    pos = jnp.arange(L)[None, :]
+    src = jnp.where(pos < lens[:, None], lens[:, None] - 1 - pos, pos)
+    out = jnp.take_along_axis(
+        arr, src.reshape(src.shape + (1,) * (arr.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    return Tensor(out)

@@ -112,37 +112,55 @@ def cache(reader):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Thread-pool map over a reader (reference decorator.py xmap_readers);
-    order=True preserves input order."""
+    """Thread-pool map over a reader, streaming with at most buffer_size
+    samples in flight (reference decorator.py xmap_readers); order=True
+    preserves input order."""
+    if order:
+        def ordered():
+            return map(mapper, reader())
+        return ordered
+
+    _end = object()
+
     def xreader():
-        samples = list(reader())
-        if order:
-            yield from map(mapper, samples)
-            return
-        results_q = queue.Queue()
-        it = iter(samples)
-        lock = threading.Lock()
+        in_q = queue.Queue(maxsize=max(1, buffer_size))
+        out_q = queue.Queue(maxsize=max(1, buffer_size))
+
+        def feed():
+            try:
+                for s in reader():
+                    in_q.put(s)
+            except BaseException as e:
+                in_q.put(e)
+            for _ in range(process_num):
+                in_q.put(_end)
 
         def work():
             while True:
-                with lock:
-                    try:
-                        s = next(it)
-                    except StopIteration:
-                        return
+                s = in_q.get()
+                if s is _end:
+                    out_q.put(_end)
+                    return
+                if isinstance(s, BaseException):
+                    out_q.put(s)
+                    return
                 try:
-                    results_q.put(mapper(s))
+                    out_q.put(mapper(s))
                 except BaseException as e:
-                    results_q.put(e)  # deliver, never deadlock the consumer
-        threads = [threading.Thread(target=work, daemon=True)
+                    out_q.put(e)  # deliver, never deadlock the consumer
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
                    for _ in range(process_num)]
-        for t in threads:
+        for t in workers:
             t.start()
-        for _ in range(len(samples)):
-            r = results_q.get()
+        finished = 0
+        while finished < process_num:
+            r = out_q.get()
+            if r is _end:
+                finished += 1
+                continue
             if isinstance(r, BaseException):
                 raise r
             yield r
-        for t in threads:
-            t.join()
     return xreader

@@ -75,3 +75,4 @@ class program_guard:
 
 
 from ..amp import auto_cast as amp  # noqa: F401,E402
+from . import nn  # noqa: F401,E402

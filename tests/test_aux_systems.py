@@ -124,3 +124,43 @@ class TestFlags:
         paddle.set_flags({"FLAGS_check_nan_inf": True})
         assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
         paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_fleet_localfs():
+    import os
+    import tempfile
+    from paddle_tpu.distributed.fleet.utils_fs import (LocalFS,
+                                                       FSFileExistsError)
+    fs = LocalFS()
+    with tempfile.TemporaryDirectory() as d:
+        sub = os.path.join(d, "a", "b")
+        fs.mkdirs(sub)
+        assert fs.is_dir(sub) and fs.is_exist(sub)
+        f = os.path.join(sub, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        with open(f, "w") as fh:
+            fh.write("hello")
+        assert fs.cat(f) == "hello"
+        dirs, files = fs.ls_dir(sub)
+        assert files == ["x.txt"]
+        fs.rename(f, f + ".2")
+        assert fs.is_file(f + ".2")
+        try:
+            fs.touch(f + ".2", exist_ok=False)
+            raise AssertionError("expected FSFileExistsError")
+        except FSFileExistsError:
+            pass
+        fs.delete(sub)
+        assert not fs.is_exist(sub)
+    assert not fs.need_upload_download()
+
+
+def test_hdfs_client_gated():
+    import pytest
+    from paddle_tpu.distributed.fleet.utils_fs import HDFSClient, ExecuteError
+    import shutil as _sh
+    if _sh.which("hadoop"):
+        pytest.skip("hadoop present")
+    with pytest.raises(ExecuteError):
+        HDFSClient()

@@ -1,0 +1,61 @@
+"""Sequence ops: padded+lengths design vs numpy golden (reference:
+operators/sequence_ops/)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _ragged():
+    np.random.seed(4)
+    return [np.random.randn(n, 3).astype("float32") for n in (2, 4, 1)]
+
+
+def test_sequence_pad_unpad_roundtrip():
+    seqs = _ragged()
+    padded, lens = F.sequence_pad(seqs, pad_value=0.0)
+    assert tuple(padded.shape) == (3, 4, 3)
+    assert lens.numpy().tolist() == [2, 4, 1]
+    assert np.all(padded.numpy()[0, 2:] == 0)
+    back = F.sequence_unpad(padded, lens)
+    for a, b in zip(seqs, back):
+        np.testing.assert_allclose(a, b.numpy())
+
+
+def test_sequence_pool_golden():
+    seqs = _ragged()
+    padded, lens = F.sequence_pad(seqs)
+    for pt, ref in [
+        ("SUM", [s.sum(0) for s in seqs]),
+        ("AVERAGE", [s.mean(0) for s in seqs]),
+        ("MAX", [s.max(0) for s in seqs]),
+        ("SQRT", [s.sum(0) / np.sqrt(len(s)) for s in seqs]),
+        ("LAST", [s[-1] for s in seqs]),
+        ("FIRST", [s[0] for s in seqs]),
+    ]:
+        out = F.sequence_pool(padded, lens, pt)
+        np.testing.assert_allclose(out.numpy(), np.stack(ref), rtol=1e-5,
+                                   atol=1e-6, err_msg=pt)
+
+
+def test_sequence_softmax_masked():
+    seqs = _ragged()
+    padded, lens = F.sequence_pad(seqs, pad_value=99.0)  # pad must not leak
+    out = F.sequence_softmax(padded, lens).numpy()
+    for i, s in enumerate(seqs):
+        e = np.exp(s - s.max(0, keepdims=True))
+        np.testing.assert_allclose(out[i, :len(s)], e / e.sum(0), rtol=1e-4)
+        assert np.all(out[i, len(s):] == 0)
+
+
+def test_sequence_expand_and_reverse():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(3, 2))
+    out = F.sequence_expand(x, np.array([2, 0, 3]))
+    expect = np.array([[0, 1], [0, 1], [4, 5], [4, 5], [4, 5]], "float32")
+    np.testing.assert_allclose(out.numpy(), expect)
+
+    seqs = _ragged()
+    padded, lens = F.sequence_pad(seqs)
+    rev = F.sequence_reverse(padded, lens).numpy()
+    for i, s in enumerate(seqs):
+        np.testing.assert_allclose(rev[i, :len(s)], s[::-1])

@@ -145,3 +145,74 @@ def test_lr_schedule_no_recompile():
         sched.step()  # outside the compiled step
     assert len(step.entries) == 1
     assert opt.get_lr() == pytest.approx(0.1 * 0.5 ** 5)
+
+
+def test_jit_save_dynamic_batch_dim():
+    """InputSpec([None, d]) must produce a loaded program accepting any
+    batch size (jax.export shape polymorphism)."""
+    import tempfile, os
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static.input_spec import InputSpec
+
+    model = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 3))
+    model.eval()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "dyn")
+        paddle.jit.save(model, path,
+                        input_spec=[InputSpec([None, 6], "float32")])
+        loaded = paddle.jit.load(path)
+        for bs in (1, 2, 7):
+            x = paddle.to_tensor(np.random.randn(bs, 6).astype("float32"))
+            np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_control_flow_cond_while_switch():
+    """static.nn control flow lowers to lax.cond/while_loop/switch
+    (reference: fluid/layers/control_flow.py, conditional_block_op)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import nn as snn
+
+    a = paddle.to_tensor(np.float32(2.0))
+    b = paddle.to_tensor(np.float32(5.0))
+    out = snn.cond(a < b, lambda: a + b, lambda: a - b)
+    assert float(out.numpy()) == 7.0
+
+    # while_loop: sum 0..9
+    i = paddle.to_tensor(np.int32(0))
+    s = paddle.to_tensor(np.float32(0.0))
+    i_f, s_f = snn.while_loop(lambda i, s: i < 10,
+                              lambda i, s: (i + 1, s + paddle.cast(i, "float32")),
+                              [i, s])
+    assert int(i_f.numpy()) == 10 and float(s_f.numpy()) == 45.0
+
+    idx = paddle.to_tensor(np.int32(1))
+    out = snn.switch_case(idx, [lambda: a * 1, lambda: a * 10,
+                                lambda: a * 100])
+    assert float(out.numpy()) == 20.0
+    out = snn.switch_case(paddle.to_tensor(np.int32(99)),
+                          {1: lambda: a * 10, 3: lambda: a * 100})
+    assert float(out.numpy()) == 200.0  # default = last branch
+
+    out = snn.case([(a > b, lambda: a), (b > a, lambda: b)])
+    assert float(out.numpy()) == 5.0
+
+
+def test_cond_inside_to_static():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.static import nn as snn
+
+    @paddle.jit.to_static
+    def f(x):
+        return snn.cond(x.sum() > 0, lambda: x * 2, lambda: x - 1)
+
+    xp = np.ones((4,), np.float32)
+    for _ in range(3):  # eager -> record -> compiled
+        out = f(paddle.to_tensor(xp))
+    np.testing.assert_allclose(out.numpy(), xp * 2)
+    out = f(paddle.to_tensor(-xp))
+    np.testing.assert_allclose(out.numpy(), -xp - 1)

@@ -1,0 +1,146 @@
+"""Detection ops: yolo_box vs numpy golden, nms golden, roi_align,
+deform_conv2d degenerate==conv2d (reference:
+operators/detection/{yolo_box_op.h,yolov3_loss_op.h,roi_align_op.h},
+operators/deformable_conv_op.h, python/paddle/vision/ops.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def _sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def _yolo_box_np(x, img_size, anchors, class_num, conf_thresh,
+                 downsample, clip_bbox=True, scale=1.0):
+    n, c, h, w = x.shape
+    an_num = len(anchors) // 2
+    bias = -0.5 * (scale - 1.0)
+    input_h, input_w = downsample * h, downsample * w
+    boxes = np.zeros((n, an_num * h * w, 4), np.float32)
+    scores = np.zeros((n, an_num * h * w, class_num), np.float32)
+    pred = x.reshape(n, an_num, 5 + class_num, h, w)
+    for b in range(n):
+        img_h, img_w = img_size[b]
+        idx = 0
+        for k in range(an_num):
+            for i in range(h):
+                for j in range(w):
+                    conf = _sig(pred[b, k, 4, i, j])
+                    if conf >= conf_thresh:
+                        cx = (j + _sig(pred[b, k, 0, i, j]) * scale
+                              + bias) * img_w / w
+                        cy = (i + _sig(pred[b, k, 1, i, j]) * scale
+                              + bias) * img_h / h
+                        bw = (np.exp(pred[b, k, 2, i, j]) * anchors[2 * k]
+                              * img_w / input_w)
+                        bh = (np.exp(pred[b, k, 3, i, j])
+                              * anchors[2 * k + 1] * img_h / input_h)
+                        x1, y1 = cx - bw / 2, cy - bh / 2
+                        x2, y2 = cx + bw / 2, cy + bh / 2
+                        if clip_bbox:
+                            x1, y1 = max(x1, 0), max(y1, 0)
+                            x2 = min(x2, img_w - 1)
+                            y2 = min(y2, img_h - 1)
+                        boxes[b, idx] = [x1, y1, x2, y2]
+                        scores[b, idx] = conf * _sig(pred[b, k, 5:, i, j])
+                    idx += 1
+    return boxes, scores
+
+
+def test_yolo_box_matches_numpy():
+    np.random.seed(0)
+    anchors = [10, 13, 16, 30]
+    class_num = 3
+    x = np.random.randn(2, 2 * (5 + class_num), 4, 4).astype("float32")
+    img_size = np.array([[128, 128], [96, 64]], "int64")
+    boxes, scores = vops.yolo_box(
+        paddle.to_tensor(x), paddle.to_tensor(img_size), anchors, class_num,
+        conf_thresh=0.3, downsample_ratio=32)
+    eb, es = _yolo_box_np(x, img_size, anchors, class_num, 0.3, 32)
+    # our kernel orders [an, h, w]; golden uses the same order
+    np.testing.assert_allclose(boxes.numpy(), eb, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(scores.numpy(), es, rtol=1e-4, atol=1e-5)
+
+
+def test_yolo_loss_finite_and_sensitive_to_targets():
+    np.random.seed(1)
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    class_num = 4
+    x = paddle.to_tensor(
+        np.random.randn(2, 3 * (5 + class_num), 8, 8).astype("float32"))
+    gt_box = np.zeros((2, 5, 4), "float32")
+    gt_box[:, 0] = [0.5, 0.5, 0.3, 0.4]  # one real box per sample
+    gt_label = np.zeros((2, 5), "int64")
+    loss = vops.yolo_loss(x, paddle.to_tensor(gt_box),
+                          paddle.to_tensor(gt_label), anchors, mask,
+                          class_num, ignore_thresh=0.7,
+                          downsample_ratio=32)
+    assert loss.shape == [2] and np.all(np.isfinite(loss.numpy()))
+    # no gt at all -> only objectness-negative loss, must differ
+    loss0 = vops.yolo_loss(x, paddle.to_tensor(np.zeros((2, 5, 4), "float32")),
+                           paddle.to_tensor(gt_label), anchors, mask,
+                           class_num, ignore_thresh=0.7,
+                           downsample_ratio=32)
+    assert not np.allclose(loss.numpy(), loss0.numpy())
+
+
+def test_nms_golden():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [0, 0, 9.8, 10]], "float32")
+    scores = np.array([0.9, 0.8, 0.7, 0.95], "float32")
+    keep = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores))
+    assert keep.numpy().tolist() == [3, 2]  # 0,1 suppressed by 3
+    # category-aware: same boxes, different classes -> no suppression
+    cats = np.array([0, 1, 2, 3], "int64")
+    keep2 = vops.nms(paddle.to_tensor(boxes), 0.5,
+                     paddle.to_tensor(scores), paddle.to_tensor(cats),
+                     categories=[0, 1, 2, 3])
+    assert sorted(keep2.numpy().tolist()) == [0, 1, 2, 3]
+
+
+def test_roi_align_constant_map():
+    # constant feature map -> every aligned bin averages to the constant
+    x = np.full((1, 2, 8, 8), 7.0, np.float32)
+    boxes = np.array([[0, 0, 8, 8], [2, 2, 6, 6]], "float32")
+    out = vops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([2], "int32")),
+                         output_size=2, spatial_scale=1.0, aligned=False)
+    assert tuple(out.shape) == (2, 2, 2, 2)
+    np.testing.assert_allclose(out.numpy(), 7.0, rtol=1e-5)
+
+
+def test_deform_conv2d_zero_offsets_equals_conv2d():
+    import paddle_tpu.nn.functional as F
+    np.random.seed(2)
+    x = np.random.randn(2, 4, 6, 6).astype("float32")
+    w = np.random.randn(8, 4, 3, 3).astype("float32")
+    offset = np.zeros((2, 2 * 1 * 9, 6, 6), "float32")
+    out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                             paddle.to_tensor(w), stride=1, padding=1)
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), None,
+                   1, 1, 1, 1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_mask():
+    layer = vops.DeformConv2D(4, 8, 3, padding=1, deformable_groups=1)
+    x = paddle.to_tensor(np.random.randn(1, 4, 5, 5).astype("float32"))
+    offset = paddle.to_tensor(
+        0.1 * np.random.randn(1, 18, 5, 5).astype("float32"))
+    mask = paddle.to_tensor(np.ones((1, 9, 5, 5), "float32"))
+    out = layer(x, offset, mask)
+    assert tuple(out.shape) == (1, 8, 5, 5)
+    out.sum().backward()
+    assert layer.weight.grad is not None
+
+
+def test_read_file_roundtrip(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(16)))
+    t = vops.read_file(str(p))
+    assert t.numpy().tolist() == list(range(16))
