@@ -111,8 +111,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          no_grad_vars=None):
     """paddle.grad — compute grads of outputs wrt inputs without touching
     .grad of other leaves (reference: partial_grad_engine.cc)."""
-    if create_graph:
-        raise NotImplementedError("double grad (create_graph) not yet supported")
     outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     # Snapshot .grad of every reachable leaf plus the requested inputs, zero
@@ -127,16 +125,21 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else \
         [grad_outputs] * len(outs)
     retain = retain_graph if retain_graph is not None else create_graph
-    for o, g in zip(outs, gouts):
-        run_backward(o, g, retain_graph=bool(retain))
-    results = []
-    for t in ins:
-        if t._grad is None and not allow_unused:
-            raise RuntimeError(f"input {t.name} unused in graph "
-                               "(pass allow_unused=True)")
-        results.append(t._grad)
-    for t, g in snapshot.values():
-        t._grad = g
+    try:
+        for o, g in zip(outs, gouts):
+            run_backward(o, g, retain_graph=bool(retain),
+                         create_graph=bool(create_graph))
+        results = []
+        for t in ins:
+            if t._grad is None and not allow_unused:
+                raise RuntimeError(f"input {t.name} unused in graph "
+                                   "(pass allow_unused=True)")
+            results.append(t._grad)
+    finally:
+        # restore user-visible .grad even when backward raises — paddle.grad
+        # must never wipe accumulated gradients
+        for t, g in snapshot.values():
+            t._grad = g
     return results
 
 

@@ -9,3 +9,5 @@ jax.config.update("jax_enable_x64", True)
 from . import dtype, device, flags, trace, dispatch, tensor, engine, rng  # noqa: E402,F401
 from .tensor import Tensor, Parameter  # noqa: E402,F401
 from .dispatch import no_grad, enable_grad, is_grad_enabled, register_op  # noqa: E402,F401
+
+flags.init_compilation_cache()

@@ -85,8 +85,23 @@ def _zero_ct(shape, dt):
     return np.zeros(shape, jax.dtypes.float0)
 
 
-def _accumulate_into_leaf(tensor, grad_array):
+def _accumulate_into_leaf(tensor, grad_array, create_graph=False):
     from .tensor import Tensor
+    if create_graph:
+        # grad_array is a live Tensor; keep its graph so grads of grads work
+        g = grad_array
+        if tensor._hooks:
+            raise NotImplementedError(
+                "tensor hooks are not supported together with "
+                "create_graph=True (the hook would cut the double-grad "
+                "chain)")
+        tensor._grad = g if tensor._grad is None else tensor._grad + g
+        tensor._grad.name = tensor.name + "@GRAD"
+        from . import trace as trace_mod
+        ctx = trace_mod.current_trace()
+        if ctx is not None:
+            ctx.register_created(tensor._grad)
+        return
     grad_array = _apply_hooks(tensor, grad_array)
     if tensor._grad is None:
         tensor._grad = Tensor(grad_array, stop_gradient=True,
@@ -100,7 +115,8 @@ def _accumulate_into_leaf(tensor, grad_array):
         tensor._grad.value = tensor._grad.value + grad_array
 
 
-def run_backward(loss, grad_tensor=None, retain_graph=False):
+def run_backward(loss, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
     from .tensor import Tensor
     if loss.stop_gradient or loss._grad_node is None:
         raise RuntimeError(
@@ -112,6 +128,15 @@ def run_backward(loss, grad_tensor=None, retain_graph=False):
         init_ct = jnp.ones(shape, dt)
     else:
         init_ct = grad_tensor.value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+    if create_graph:
+        # cotangents flow as live Tensors through differentiable vjp ops
+        # (reference: partial_grad_engine.cc create_graph double-grad path);
+        # the vjp ops capture closures by value, so the first-order nodes
+        # need not be retained unless the caller asks
+        if isinstance(grad_tensor, Tensor) and not grad_tensor.stop_gradient:
+            init_ct = grad_tensor
+        else:
+            init_ct = Tensor(init_ct, stop_gradient=True)
 
     # Postorder DFS for reverse-topological order over reachable nodes.
     order = []
@@ -142,9 +167,24 @@ def run_backward(loss, grad_tensor=None, retain_graph=False):
             ct = node.pending[i]
             if ct is None:
                 ct = _zero_ct(shape, dt)
+                if create_graph:
+                    from .tensor import Tensor as _T
+                    if not (jnp.issubdtype(dt, jnp.floating)
+                            or jnp.issubdtype(dt, jnp.complexfloating)):
+                        ct = jnp.zeros(shape, dt)  # placeholder, see vjp_fn
+                    ct = _T(ct, stop_gradient=True)
             else:
                 any_ct = True
-                if node.out_refs is not None and i < len(node.out_refs):
+                if node.out_refs is not None and i < len(node.out_refs) \
+                        and node.out_refs[i] is not None \
+                        and node.out_refs[i]._hooks:
+                    if create_graph:
+                        # an opaque python hook would detach the cotangent
+                        # and silently corrupt higher-order grads
+                        raise NotImplementedError(
+                            "tensor hooks are not supported together with "
+                            "create_graph=True (the hook would cut the "
+                            "double-grad chain)")
                     ct = _apply_hooks(node.out_refs[i], ct)
             cts.append(ct)
         node.pending = None
@@ -154,17 +194,103 @@ def run_backward(loss, grad_tensor=None, retain_graph=False):
             raise RuntimeError(
                 "trying to backward through a released graph; pass "
                 "retain_graph=True to backward()")
-        ct_arg = tuple(cts) if node.multi_out else cts[0]
-        bwd = node.op.vjp_fn(node.key, node.closure)
-        in_grads = bwd(node.arrays, ct_arg)
-        _distribute(node, in_grads)
+        if create_graph:
+            in_grads = _vjp_apply(node, cts)
+        else:
+            ct_arg = tuple(cts) if node.multi_out else cts[0]
+            bwd = node.op.vjp_fn(node.key, node.closure)
+            in_grads = bwd(node.arrays, ct_arg)
+        _distribute(node, in_grads, create_graph)
         if not retain_graph:
             node.released = True
             node.arrays = None
             node.closure = None
 
 
-def _distribute(node, in_grads):
+_vjp_op_cache = {}
+
+
+def _vjp_apply(node, ct_tensors):
+    """Run a node's backward THROUGH the op dispatcher so the produced
+    gradients carry their own grad nodes (double grad; reference:
+    partial_grad_engine.cc). The vjp computation itself becomes a
+    differentiable op over (original inputs..., cotangents...)."""
+    from .tensor import Tensor
+    from .dispatch import Op
+    if node.closure is None:
+        # PyLayer / custom nodes: user backward is opaque python — run it
+        # normally; the chain stops there (grads are constants), matching
+        # the reference, where PyLayer needs explicit double-grad support
+        ct_vals = [c.value if isinstance(c, Tensor) else c
+                   for c in ct_tensors]
+        ct_arg = tuple(ct_vals) if node.multi_out else ct_vals[0]
+        bwd = node.op.vjp_fn(node.key, node.closure)
+        grads = bwd(node.arrays, ct_arg)
+        return [Tensor(g, stop_gradient=True) if g is not None else None
+                for g in grads]
+    need = [i for i, t in enumerate(node.input_tensors)
+            if t is not None and not t.stop_gradient]
+    ckey = ("vjp", node.key, tuple(need))
+    op = _vjp_op_cache.get(ckey)
+    if op is None:
+        closure = node.closure
+        n_in = len(node.arrays)
+        multi = node.multi_out
+        need_c = list(need)
+
+        def vjp_fn(*flat):
+            arrays = flat[:n_in]
+            cts = list(flat[n_in:])
+            primals, vjp = jax.vjp(closure, *arrays)
+            plist = list(primals) if isinstance(primals, (tuple, list)) \
+                else [primals]
+            for i, p in enumerate(plist):
+                if not (jnp.issubdtype(p.dtype, jnp.floating)
+                        or jnp.issubdtype(p.dtype, jnp.complexfloating)):
+                    cts[i] = np.zeros(np.shape(p), jax.dtypes.float0)
+                elif cts[i].dtype != p.dtype:
+                    cts[i] = cts[i].astype(p.dtype)
+            ct_arg = tuple(cts) if multi else cts[0]
+            grads = vjp(ct_arg)
+            outs = [grads[i] for i in need_c]
+            return tuple(outs) if len(outs) != 1 else outs[0]
+
+        # unique name per ckey: the dispatcher's jit cache keys on
+        # (name, slots, attrs, cast), and distinct forward attrs (sum
+        # axis, transpose perm, ...) produce distinct closures that would
+        # otherwise collide under one shared name. A monotonic counter is
+        # collision-free and deterministic within the process (a truncated
+        # randomized hash would neither be).
+        op = Op(f"vjp<{node.op.name}>#{len(_vjp_op_cache)}",
+                vjp_fn, differentiable=True)
+        _vjp_op_cache[ckey] = op
+    # the vjp must see the FORWARD-TIME values (node.arrays), not the
+    # tensors' current values (params may have been mutated by opt.step
+    # since) — but the Tensor objects themselves must flow into the op so
+    # the double-grad graph connects. Temporarily rebind each tensor's
+    # value to its saved array around the dispatch (single-threaded eager).
+    args = []
+    stash = []
+    for t, a in zip(node.input_tensors, node.arrays):
+        if t is not None:
+            stash.append((t, t._value))
+            t._value = a
+            args.append(t)
+        else:
+            args.append(a)
+    try:
+        outs = op(*args, *ct_tensors)
+    finally:
+        for t, v in stash:
+            t._value = v
+    outs = list(outs) if isinstance(outs, tuple) else [outs]
+    in_grads = [None] * len(node.input_tensors)
+    for j, i in enumerate(need):
+        in_grads[i] = outs[j]
+    return in_grads
+
+
+def _distribute(node, in_grads, create_graph=False):
     # in_grads aligns with closure's positional arrays (= input_tensors slots)
     for t, g in zip(node.input_tensors, in_grads):
         if t is None or t.stop_gradient:
@@ -184,4 +310,4 @@ def _distribute(node, in_grads):
             else:
                 pnode.pending[pidx] = pnode.pending[pidx] + g
         else:
-            _accumulate_into_leaf(t, g)
+            _accumulate_into_leaf(t, g, create_graph)

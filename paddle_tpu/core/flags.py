@@ -20,7 +20,39 @@ _DEFAULTS = {
     # DDP/DP gradient fusion bucket size in MB (reference reducer.h:84
     # group_size_limits ~25MB)
     "FLAGS_fuse_parameter_memory_size": 25.0,
+    # persistent XLA compilation cache directory ("" disables). Eager
+    # dispatch compiles one executable per (op, shape); on TPU those
+    # compiles dominate warmup (SURVEY §7 hard-part 1) — the disk cache
+    # amortizes them across processes/runs. Per-user path: cache entries
+    # are executed code, so a world-shared /tmp dir would let another
+    # local user poison them.
+    "FLAGS_compilation_cache_dir": os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "xla"),
+    # only cache compiles slower than this (seconds)
+    "FLAGS_compilation_cache_min_compile_secs": 0.3,
 }
+
+
+def init_compilation_cache():
+    """Apply FLAGS_compilation_cache_dir to jax (called at import and
+    whenever set_flags changes the cache flags)."""
+    path = get_flag("FLAGS_compilation_cache_dir")
+    if not path:
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(get_flag("FLAGS_compilation_cache_min_compile_secs")))
+    except Exception:  # unwritable dir/old jax: run without the cache
+        pass
 
 _flags = {}
 
@@ -47,11 +79,18 @@ def get_flag(name):
     return default
 
 
+_CACHE_FLAGS = ("FLAGS_compilation_cache_dir",
+                "FLAGS_compilation_cache_min_compile_secs")
+
+
 def set_flags(flags):
     """paddle.set_flags({'FLAGS_check_nan_inf': 1})"""
+    reinit_cache = any(k in _CACHE_FLAGS for k in flags)
     for k, v in flags.items():
         default = _DEFAULTS.get(k)
         _flags[k] = _coerce(default, v) if default is not None else v
+    if reinit_cache:
+        init_compilation_cache()
 
 
 def get_flags(names):

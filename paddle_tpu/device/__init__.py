@@ -28,3 +28,41 @@ class cuda:  # namespace shim for paddle.device.cuda users
 def synchronize(device=None):
     import jax
     jax.effects_barrier()
+
+
+def memory_stats(device=None):
+    """Per-device HBM statistics from PjRt (the analogue of the reference
+    allocator stats: memory/allocation/allocator_facade.cc + pybind
+    memory stat getters). Keys follow jax's device.memory_stats().
+    `device`: None (device 0), an int index, a 'tpu:1'-style string, or a
+    jax Device."""
+    import jax
+    if device is not None and hasattr(device, "memory_stats"):
+        return dict(device.memory_stats() or {})
+    devs = jax.local_devices()
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str) and device:
+        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
+    if not 0 <= idx < len(devs):
+        raise ValueError(
+            f"device index {idx} out of range (have {len(devs)} local "
+            "devices)")
+    return dict(devs[idx].memory_stats() or {})
+
+
+def max_memory_allocated(device=None):
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None):
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None):
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    return int(memory_stats(device).get("bytes_in_use", 0))

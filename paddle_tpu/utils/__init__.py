@@ -26,3 +26,4 @@ def deprecated(since=None, update_to=None, reason=None):
     def deco(fn):
         return fn
     return deco
+from . import unique_name  # noqa: F401

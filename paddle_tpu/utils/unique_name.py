@@ -1,0 +1,34 @@
+"""paddle.utils.unique_name equivalent (reference:
+python/paddle/fluid/unique_name.py: generate, guard, switch)."""
+import contextlib
+
+_generators = [{}]
+
+
+def generate(key):
+    """Return key_N with a per-generator increasing N."""
+    counters = _generators[-1]
+    n = counters.get(key, 0)
+    counters[key] = n + 1
+    return f"{key}_{n}"
+
+
+def generate_with_ignorable_key(key):
+    return generate(key)
+
+
+def switch(new_generator=None):
+    old = _generators[-1]
+    _generators[-1] = new_generator if new_generator is not None else {}
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Fresh name scope; restores the previous one on exit."""
+    _generators.append(new_generator if isinstance(new_generator, dict)
+                       else {})
+    try:
+        yield
+    finally:
+        _generators.pop()

@@ -177,3 +177,148 @@ def test_embedding_grad_scatter():
     assert g[1].sum() == pytest.approx(8.0)  # row 1 hit twice
     assert g[3].sum() == pytest.approx(4.0)
     assert g[0].sum() == 0
+
+
+def test_double_grad_scalar():
+    """d2/dx2 of x^3 = 6x (reference: partial_grad_engine.cc create_graph)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.float32(2.0))
+    x.stop_gradient = False
+    y = x * x * x
+    (g,) = paddle.grad(y, x, create_graph=True)
+    assert float(g.numpy()) == 12.0  # 3x^2
+    assert not g.stop_gradient
+    (g2,) = paddle.grad(g, x)
+    assert float(g2.numpy()) == 12.0  # 6x
+
+
+def test_double_grad_vector_and_gradient_penalty():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    paddle.seed(11)
+    net = nn.Linear(4, 1)
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    x.stop_gradient = False
+    out = net(x).sum()
+    (gx,) = paddle.grad(out, x, create_graph=True)
+    # gradient penalty: ||dout/dx||^2 — backward through the grad
+    gp = (gx * gx).sum()
+    gp.backward()
+    w = net.weight
+    assert w.grad is not None
+    # analytic: gx rows = w^T; gp = 8 * ||w||^2; d gp/d w = 16 w
+    np.testing.assert_allclose(w.grad.numpy(),
+                               16.0 * w.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_triple_grad():
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.float32(3.0))
+    x.stop_gradient = False
+    y = x ** 4
+    (g1,) = paddle.grad(y, x, create_graph=True)       # 4x^3
+    (g2,) = paddle.grad(g1, x, create_graph=True)      # 12x^2
+    (g3,) = paddle.grad(g2, x)                         # 24x
+    assert float(g1.numpy()) == 108.0
+    assert float(g2.numpy()) == 108.0
+    assert float(g3.numpy()) == 72.0
+
+
+def test_pylayer_under_create_graph_cuts_cleanly():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor(np.float32(3.0))
+    x.stop_gradient = False
+    y = Double.apply(x) * x  # 2x^2
+    (g,) = paddle.grad(y, x, create_graph=True)
+    assert float(g.numpy()) == 12.0  # 4x
+
+
+def test_double_grad_distinct_attrs_no_vjp_cache_collision():
+    """Two same-named forward ops differing only in attrs (sum axis) must
+    not share a vjp executable (regression: jit-cache collision)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.arange(9, dtype="float32").reshape(3, 3))
+    x.stop_gradient = False
+    v = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+
+    y0 = (x.sum(axis=0) * v).sum()
+    (g0,) = paddle.grad(y0, x, create_graph=True)
+    y1 = (x.sum(axis=1) * v).sum()
+    (g1,) = paddle.grad(y1, x, create_graph=True)
+    # d(sum axis 0)/dx broadcasts v along rows; axis 1 along columns
+    np.testing.assert_allclose(g0.numpy(), np.tile([[1, 2, 3]], (3, 1)))
+    np.testing.assert_allclose(g1.numpy(),
+                               np.tile([[1], [2], [3]], (1, 3)))
+
+
+def test_hooks_with_create_graph_raise():
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.float32(2.0))
+    x.stop_gradient = False
+    y = x * x
+    y.register_hook(lambda g: g)
+    z = y * x
+    with pytest.raises(NotImplementedError, match="create_graph"):
+        paddle.grad(z, x, create_graph=True)
+
+
+def test_set_flags_reapplies_compilation_cache():
+    import jax
+    import paddle_tpu as paddle
+    old = paddle.get_flags(["FLAGS_compilation_cache_dir"])[
+        "FLAGS_compilation_cache_dir"]
+    try:
+        paddle.set_flags({"FLAGS_compilation_cache_dir": ""})
+        assert jax.config.jax_compilation_cache_dir is None
+        paddle.set_flags({"FLAGS_compilation_cache_dir": "/tmp/ptpu_cache_t"})
+        assert jax.config.jax_compilation_cache_dir == "/tmp/ptpu_cache_t"
+    finally:
+        paddle.set_flags({"FLAGS_compilation_cache_dir": old})
+
+
+def test_grad_failure_restores_accumulated_grads():
+    """paddle.grad must not wipe .grad when backward raises mid-run."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+    x = paddle.to_tensor(np.float32(2.0))
+    x.stop_gradient = False
+    x._grad = Tensor(np.float32(5.0))  # pre-accumulated
+    y = x * x
+    y.register_hook(lambda g: g)
+    z = y * x
+    with pytest.raises(NotImplementedError):
+        paddle.grad(z, x, create_graph=True)
+    assert float(x.grad.numpy()) == 5.0
+
+
+def test_double_grad_uses_forward_time_values():
+    """vjp must see the forward-time param values even after in-place
+    mutation (opt.step) before the create_graph backward."""
+    import numpy as np
+    import paddle_tpu as paddle
+    w = paddle.to_tensor(np.float32(3.0))
+    w.stop_gradient = False
+    y = w * w  # dy/dw = 2w = 6 at forward time
+    w.value = np.float32(100.0)  # simulate opt.step mutation
+    (g,) = paddle.grad(y, w, create_graph=True)
+    assert float(g.numpy()) == 6.0

@@ -1,0 +1,85 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/)."""
+import numpy as np
+
+from paddle_tpu.vision import transforms as T
+
+
+def _img():
+    np.random.seed(5)
+    return np.random.rand(3, 16, 16).astype("float32")
+
+
+def test_geometric_transforms():
+    img = _img()
+    assert T.Pad(2)(img).shape == (3, 20, 20)
+    assert T.Pad((1, 2))(img).shape == (3, 20, 18)
+    np.testing.assert_allclose(T.rotate(img, 90),
+                               np.rot90(img, 1, axes=(1, 2)), atol=1e-4)
+    np.testing.assert_allclose(T.hflip(img), img[..., ::-1])
+    np.testing.assert_allclose(T.vflip(img), img[..., ::-1, :])
+    assert T.RandomRotation(30)(img).shape == (3, 16, 16)
+    assert T.RandomResizedCrop(8)(img).shape == (3, 8, 8)
+    assert T.RandomVerticalFlip(1.0)(img).shape == (3, 16, 16)
+    assert T.Transpose()(img.transpose(1, 2, 0)).shape == (3, 16, 16)
+    assert T.crop(img, 2, 3, 5, 6).shape == (3, 5, 6)
+
+
+def test_color_transforms():
+    img = _img()
+    assert T.ColorJitter(0.2, 0.2, 0.2, 0.1)(img).shape == (3, 16, 16)
+    g = T.Grayscale(1)(img)
+    assert g.shape == (1, 16, 16)
+    np.testing.assert_allclose(
+        g[0], 0.299 * img[0] + 0.587 * img[1] + 0.114 * img[2], rtol=1e-5)
+    np.testing.assert_allclose(T.adjust_brightness(img, 2.0), img * 2.0)
+    # hue rotation by 0 is identity; +/-0.5 are (approximately) involutive
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img)
+    h = T.adjust_hue(img, 0.25)
+    assert h.shape == img.shape and not np.allclose(h, img)
+
+
+def test_base_transform_keys():
+    class AddOne(T.BaseTransform):
+        def __init__(self):
+            super().__init__(keys=("image", "label"))
+
+        def _apply_image(self, img):
+            return img + 1
+
+    img = _img()
+    out_img, label = AddOne()((img, 7))
+    np.testing.assert_allclose(out_img, img + 1)
+    assert label == 7
+
+
+def test_unique_name():
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard():
+        assert unique_name.generate("w") == "w_0"
+        assert unique_name.generate("w") == "w_1"
+        with unique_name.guard():
+            assert unique_name.generate("w") == "w_0"
+        assert unique_name.generate("w") == "w_2"
+
+
+def test_device_memory_stats():
+    import paddle_tpu as paddle
+    stats = paddle.device.memory_stats()
+    assert isinstance(stats, dict)
+    assert paddle.device.memory_allocated() >= 0
+    assert paddle.device.max_memory_allocated() >= 0
+
+
+def test_adjust_hue_grayscale_no_crash():
+    img = np.zeros((1, 8, 8), np.float32)
+    np.testing.assert_allclose(T.adjust_hue(img, 0.1), img)
+
+
+def test_memory_stats_device_args():
+    import pytest
+    import paddle_tpu as paddle
+    s0 = paddle.device.memory_stats(0)
+    assert isinstance(s0, dict)
+    assert isinstance(paddle.device.memory_stats("cpu:1"), dict)
+    with pytest.raises(ValueError):
+        paddle.device.memory_stats(999)
