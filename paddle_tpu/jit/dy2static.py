@@ -1,0 +1,377 @@
+"""Dygraph-to-static AST conversion (scoped subset).
+
+Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/
+(ifelse_transformer.py, loop_transformer.py, logical_transformer.py —
+the AST suite behind @to_static that rewrites Python control flow over
+tensors into program ops). TPU-native design: the rewritten constructs
+dispatch at RUN time — a python-bool predicate executes the plain python
+branch (zero overhead, trace-unrolled like the reference's static
+backend), while a Tensor predicate lowers to lax.cond / lax.while_loop
+via static.nn, so data-dependent branching stays inside the compiled XLA
+program instead of being silently baked to the traced branch.
+
+Supported subset (the transformer falls back to the original function on
+anything else): `if/elif/else` statements whose branches assign local
+names (no early returns inside tensor-pred branches), `while` loops
+mutating local names, and `and/or/not` over tensors. `for` over python
+ranges/containers keeps normal python semantics (unrolled at trace time,
+like the reference's static unroll of constant loops).
+"""
+import ast
+import functools
+import inspect
+import textwrap
+import warnings
+
+_UNSUPPORTED = (ast.Return, ast.Break, ast.Continue, ast.Yield,
+                ast.YieldFrom)
+
+
+def _assigned_names(nodes):
+    """Local names assigned anywhere in a list of statements."""
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Store) and n.id not in names:
+                names.append(n.id)
+
+        def visit_AugAssign(self, n):
+            if isinstance(n.target, ast.Name) and n.target.id not in names:
+                names.append(n.target.id)
+            self.generic_visit(n)
+
+    for s in nodes:
+        V().visit(s)
+    return names
+
+
+def _loaded_names(nodes):
+    names = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, n):
+            if isinstance(n.ctx, ast.Load) and n.id not in names:
+                names.append(n.id)
+
+    for s in nodes:
+        V().visit(s)
+    return names
+
+
+def _contains_unsupported(nodes):
+    for s in nodes:
+        for sub in ast.walk(s):
+            if isinstance(sub, _UNSUPPORTED):
+                return True
+    return False
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while statements into runtime-dispatch helper calls."""
+
+    def __init__(self):
+        self.counter = 0
+        self.failed = False
+
+    def _fresh(self, base):
+        self.counter += 1
+        return f"__jst_{base}_{self.counter}"
+
+    # -- if/elif/else ------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if self.failed:
+            return node
+        if _contains_unsupported(node.body) or \
+                _contains_unsupported(node.orelse):
+            # branches with return/break/... keep python semantics; a
+            # tensor predicate there raises at runtime via __bool__
+            return node
+        out_names = sorted(set(_assigned_names(node.body)
+                               + _assigned_names(node.orelse)))
+        if not out_names:
+            return node
+        true_name = self._fresh("true_fn")
+        false_name = self._fresh("false_fn")
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in out_names],
+            ctx=ast.Load()))
+
+        def make_fn(name, body):
+            # PURE branches: current values of out_names come in as
+            # parameters (same names, so `y = y * 10` reads the pre-if
+            # value) and updates go out via the return. No nonlocal —
+            # writes must not leak between lax.cond's two branch traces.
+            fargs = ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in out_names],
+                kwonlyargs=[], kw_defaults=[], defaults=[])
+            return ast.FunctionDef(
+                name=name, args=fargs,
+                body=(list(body) if body else [ast.Pass()]) + [ret],
+                decorator_list=[], type_params=[])
+
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in out_names],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__jst_convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=true_name, ctx=ast.Load()),
+                      ast.Name(id=false_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in out_names],
+                                ctx=ast.Load()),
+                      ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                               args=[], keywords=[])],
+                keywords=[]))
+        return [make_fn(true_name, node.body),
+                make_fn(false_name, node.orelse), call]
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if self.failed or node.orelse or _contains_unsupported(node.body):
+            return node
+        # carry EVERY assigned name: a store-only variable's last value
+        # must survive the loop too
+        carried = sorted(set(_assigned_names(node.body)))
+        if not carried:
+            return node
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=n) for n in carried],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carried],
+            ctx=ast.Load()))
+        cond_name = self._fresh("while_cond")
+        body_name = self._fresh("while_body")
+        cond_fn = ast.FunctionDef(
+            name=cond_name, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            type_params=[])
+        body_fn = ast.FunctionDef(
+            name=body_name, args=args,
+            body=list(node.body) + [ret], decorator_list=[], type_params=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carried],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__jst_convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_name, ctx=ast.Load()),
+                      ast.Name(id=body_name, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in carried], ctx=ast.Load()),
+                      ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                               args=[], keywords=[])],
+                keywords=[]))
+        return [cond_fn, body_fn, call]
+
+    # -- and/or/not over tensors ------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        name = ("__jst_and" if isinstance(node.op, ast.And) else "__jst_or")
+        self.counter += 1
+        empty_args = ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[])
+        out = node.values[0]
+        for v in node.values[1:]:
+            # rhs wrapped in a lambda: python short-circuit semantics are
+            # preserved for non-tensor operands (reference:
+            # logical_transformer.py does the same)
+            out = ast.Call(func=ast.Name(id=name, ctx=ast.Load()),
+                           args=[out, ast.Lambda(args=empty_args, body=v)],
+                           keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.counter += 1
+            return ast.Call(func=ast.Name(id="__jst_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+# -- runtime helpers --------------------------------------------------------
+
+def _is_tensor(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+class _Undefined:
+    """Placeholder for an out_name not yet bound before the if statement.
+    Any USE raises, matching python's UnboundLocalError for a variable the
+    taken branch never assigned; assign-then-use inside a branch is fine
+    (the parameter is simply overwritten)."""
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "local variable referenced before assignment (a to_static "
+            "converted branch left it undefined)")
+
+    __bool__ = __iter__ = __call__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _raise
+    __truediv__ = __rtruediv__ = __getitem__ = __eq__ = __lt__ = _raise
+
+    def __getattr__(self, name):
+        self._raise()
+
+    def __repr__(self):
+        return "<undefined local>"
+
+
+_UNDEF = _Undefined()
+
+
+def convert_ifelse(pred, true_fn, false_fn, out_names, local_ns):
+    """Runtime dispatch (reference: dygraph_to_static convert_ifelse).
+    Python predicate -> plain python branch. Tensor predicate -> lax.cond
+    via static.nn; both branches are pure functions of the current
+    out_name values and must produce every output."""
+    args = [local_ns.get(n, _UNDEF) for n in out_names]
+    if not _is_tensor(pred):
+        return true_fn(*args) if pred else false_fn(*args)
+    from ..static import nn as snn
+    try:
+        outs = snn.cond(pred, lambda: true_fn(*args),
+                        lambda: false_fn(*args))
+    except TypeError as e:
+        # an <undefined local> placeholder reached jnp.asarray: a branch
+        # read or returned an out_name it never assigned
+        if "_Undefined" in str(e) or "undefined local" in str(e):
+            raise RuntimeError(
+                "to_static if/else on a Tensor predicate: every converted "
+                f"output {list(out_names)} must be assigned in BOTH "
+                "branches or defined before the if statement") from e
+        raise
+    # call site always tuple-unpacks the out_names
+    return outs if isinstance(outs, tuple) else (outs,)
+
+
+def convert_while(cond_fn, body_fn, out_names, local_ns):
+    """Runtime dispatch for while loops: python condition -> plain loop;
+    Tensor condition -> lax.while_loop via static.nn."""
+    carried = tuple(local_ns.get(n, _UNDEF) for n in out_names)
+    first = cond_fn(*carried)
+    if not _is_tensor(first):
+        vals = carried
+        while cond_fn(*vals):
+            out = body_fn(*vals)
+            vals = out if isinstance(out, tuple) else (out,)
+        return vals
+    from ..static import nn as snn
+    out = snn.while_loop(cond_fn, lambda *a: body_fn(*a), list(carried))
+    return tuple(out)
+
+
+def convert_logical_and(a, b_fn):
+    """b_fn is lazy: python short-circuit is preserved for non-tensors."""
+    if _is_tensor(a):
+        from ..ops import logic
+        b = b_fn()
+        return logic.logical_and(a, b)
+    if not a:
+        return a
+    b = b_fn()
+    if _is_tensor(b):
+        from ..ops import logic
+        return logic.logical_and(a, b)
+    return b
+
+
+def convert_logical_or(a, b_fn):
+    if _is_tensor(a):
+        from ..ops import logic
+        return logic.logical_or(a, b_fn())
+    if a:
+        return a
+    b = b_fn()
+    if _is_tensor(b):
+        from ..ops import logic
+        return logic.logical_or(a, b)
+    return b
+
+
+def convert_logical_not(a):
+    if _is_tensor(a):
+        from ..ops import logic
+        return logic.logical_not(a)
+    return not a
+
+
+class _GlobalsProxy(dict):
+    """exec globals that fall back to the original module globals — late-
+    bound helpers and recursion resolve at call time like undecorated
+    python."""
+
+    def __init__(self, base, extra):
+        super().__init__(extra)
+        self._base = base
+
+    def __missing__(self, key):
+        return self._base[key]
+
+
+def convert_to_static(fn):
+    """AST-rewrite fn's control flow; returns the original fn when the
+    source is unavailable or the rewrite does not apply."""
+    import types
+    if inspect.ismethod(fn):
+        inner = convert_to_static(fn.__func__)
+        if inner is fn.__func__:
+            return fn
+        return types.MethodType(inner, fn.__self__)
+    if getattr(fn, "__wrapped__", None) is not None \
+            and getattr(fn, "__code__", None) is not \
+            getattr(inspect.unwrap(fn), "__code__", None):
+        # fn is a decorator wrapper around the real function —
+        # inspect.getsource would return the INNER source and re-execing
+        # it would silently drop the wrapper; keep trace semantics instead
+        warnings.warn(
+            f"dy2static: {fn.__name__} is wrapped by another decorator; "
+            "skipping AST conversion (tensor-dependent python control "
+            "flow will be baked at trace time)")
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        # drop decorators so exec doesn't re-wrap
+        fdef.decorator_list = []
+        tr = _ControlFlowTransformer()
+        new_tree = tr.visit(tree)
+        if tr.failed or tr.counter == 0:
+            return fn  # nothing rewritten
+        ast.fix_missing_locations(new_tree)
+        code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+        extra = {"__jst_convert_ifelse": convert_ifelse,
+                 "__jst_convert_while": convert_while,
+                 "__jst_and": convert_logical_and,
+                 "__jst_or": convert_logical_or,
+                 "__jst_not": convert_logical_not}
+        # closures: materialize free variables as globals of the new fn
+        if fn.__closure__:
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    extra[name] = cell.cell_contents
+                except ValueError:
+                    pass
+        globs = _GlobalsProxy(fn.__globals__, extra)
+        ns = {}
+        exec(code, globs, ns)
+        new_fn = ns[fn.__name__]
+        functools.update_wrapper(new_fn, fn)
+        new_fn.__wrapped_dy2static__ = True
+        return new_fn
+    except (OSError, TypeError, SyntaxError) as e:
+        warnings.warn(f"dy2static: could not convert {fn!r} ({e}); "
+                      "tensor-dependent python control flow will be baked "
+                      "at trace time")
+        return fn

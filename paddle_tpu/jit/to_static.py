@@ -66,7 +66,13 @@ def _rebuild(struct, leaf_iter):
 
 
 class TracedFunction:
-    def __init__(self, fn, input_spec=None, warmup=1):
+    def __init__(self, fn, input_spec=None, warmup=1, enable_ast=True):
+        if enable_ast and not getattr(fn, "__wrapped_dy2static__", False):
+            # AST-rewrite tensor-dependent if/while into lax control flow
+            # (reference: dygraph_to_static program_translator.py applies
+            # its AST suite under @to_static)
+            from .dy2static import convert_to_static
+            fn = convert_to_static(fn)
         self._fn = fn
         self._input_spec = input_spec
         # warmup=0: skip the eager pass and record on call 1 — valid when
