@@ -54,13 +54,30 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     x = manipulation.reshape(input, list(input.shape[:num_flatten_dims])
                              + [in_features])
     out = layer(x)
-    if act == "relu":
-        out = nn_ops.relu(out)
-    elif act == "softmax":
-        out = nn_ops.softmax(out)
-    elif act == "tanh":
-        out = math_ops.tanh(out)
+    if act is not None:
+        out = _apply_act(out, act)
     return out
+
+
+# activation names fluid layers may apply via act= (reference validates
+# against the OpMaker activation registry; arbitrary callables like
+# dropout must NOT be reachable through act=)
+_ACT_NAMES = frozenset({
+    "relu", "relu6", "sigmoid", "tanh", "softmax", "log_softmax", "gelu",
+    "leaky_relu", "elu", "selu", "celu", "softplus", "softsign", "silu",
+    "swish", "mish", "hardswish", "hardsigmoid", "hardtanh", "tanhshrink",
+    "softshrink", "hardshrink", "exp", "square", "sqrt", "rsqrt", "abs",
+    "reciprocal", "log", "log1p", "sin", "cos",
+})
+
+
+def _apply_act(out, act):
+    fn = None
+    if act in _ACT_NAMES:
+        fn = getattr(nn_ops, act, None) or getattr(math_ops, act, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"unsupported activation {act!r}")
+    return fn(out)
 
 
 def relu(x, name=None):

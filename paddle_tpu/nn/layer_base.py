@@ -245,6 +245,12 @@ class Layer:
                 raise ValueError(
                     f"shape mismatch for {name}: {arr.shape} vs {tgt.shape}")
             tgt.value = jnp.asarray(arr, tgt.value.dtype)
+        # let layers re-derive transient python state from loaded buffers
+        # (e.g. quant observers marking themselves calibrated)
+        for _, layer in self.named_sublayers(include_self=True):
+            hook = getattr(layer, "_after_load_state_dict", None)
+            if hook is not None:
+                hook()
         return missing
 
     set_dict = set_state_dict

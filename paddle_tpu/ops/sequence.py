@@ -24,6 +24,10 @@ def sequence_pad(x, pad_value=0.0, maxlen=None, dtype="float32"):
             for a in x]
     lens = np.asarray([len(a) for a in arrs], "int64")
     L = int(maxlen) if maxlen is not None else int(lens.max())
+    # truncating pad: returned lengths must match the clipped data, or
+    # masked ops downstream index past the pad (reference checks
+    # padded_length >= max seq len)
+    lens = np.minimum(lens, L)
     tail = arrs[0].shape[1:]
     out = np.full((len(arrs), L) + tail, pad_value,
                   arrs[0].dtype if arrs[0].dtype != np.int64 else "int64")

@@ -128,6 +128,17 @@ class FakeQuantMovingAverageAbsMax(Layer):
             return _fake_qdq_abs_max(x, bits=self._bits)
         return _fake_qdq_moving(x, self.scale, bits=self._bits)
 
+    def _after_load_state_dict(self):
+        # calibration state is derivable from the persisted buffers: any
+        # training step leaves scale>0 (abs_max) or state>0 (ema). Loading
+        # an uncalibrated (all-zero) checkpoint must also CLEAR the flag,
+        # or eval would quantize through scale=0 and collapse activations.
+        try:
+            self._calibrated = bool(float(self.scale.numpy()) > 0
+                                    or float(self.state.numpy()) > 0)
+        except Exception:
+            pass  # traced/abstract buffers: leave the flag unchanged
+
 
 class QuantizedLinear(Layer):
     """Linear with fake-quantized weight (channel-wise abs-max) and

@@ -392,6 +392,8 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     """Greedy NMS (reference: detection/multiclass_nms_op / nms util).
     Host-side: sequential suppression is an inference post-process.
     Returns kept indices sorted by score desc."""
+    if categories is not None and category_idxs is None:
+        raise ValueError("nms: `categories` requires `category_idxs`")
     b = boxes.numpy() if isinstance(boxes, Tensor) else np.asarray(boxes)
     if scores is None:
         order = np.arange(len(b))
@@ -422,8 +424,17 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         suppressed |= (iou > iou_threshold) & (cats == cats[i])
         suppressed[i] = True
     keep = np.asarray(keep, np.int64)
+    if categories is not None:
+        # paddle.vision.ops.nms semantics: suppression ran per category
+        # above (cats==cats[i] mask); `categories` then restricts the
+        # output and top_k applies GLOBALLY to the merged score-sorted set
+        cat_arr = np.asarray(categories.numpy()
+                             if isinstance(categories, Tensor)
+                             else categories).reshape(-1)
+        cat_set = {int(c) for c in cat_arr}
+        keep = keep[np.isin(cats[keep], list(cat_set))]
     if top_k is not None:
-        keep = keep[:top_k]
+        keep = keep[:top_k]  # keep is already score-descending
     return Tensor(keep)
 
 

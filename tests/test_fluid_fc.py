@@ -1,0 +1,12 @@
+import numpy as np
+import pytest
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_fluid_fc_any_registered_act():
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    out = fluid.layers.fc(x, size=3, act="sigmoid")
+    assert ((out.numpy() > 0) & (out.numpy() < 1)).all()
+    with pytest.raises(ValueError):
+        fluid.layers.fc(x, size=3, act="not_an_act")

@@ -121,3 +121,34 @@ def test_ptq_abs_max_takes_max_over_batches():
                  if isinstance(sub, QuantizedLinear)]
     assert len(quantized) == 1
     assert float(quantized[0]._act_quant.scale.numpy()) >= 100.0
+
+
+def test_observer_calibration_survives_reload():
+    # ADVICE r1 (medium): reloaded QAT checkpoints must reuse the saved
+    # scale, not fall back to dynamic per-batch abs-max
+    q = FakeQuantMovingAverageAbsMax(bits=8, moving_rate=0.9)
+    q.train()
+    q(paddle.to_tensor(np.full((4, 4), 2.0, "float32")))
+    q.eval()
+    ref = q(paddle.to_tensor(np.full((2, 2), 100.0, "float32"))).numpy()
+
+    q2 = FakeQuantMovingAverageAbsMax(bits=8, moving_rate=0.9)
+    q2.set_state_dict(q.state_dict())
+    q2.eval()
+    out = q2(paddle.to_tensor(np.full((2, 2), 100.0, "float32"))).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+    # the frozen scale (~2.0) must clip the 100.0 input hard
+    assert out.max() < 50.0
+
+
+def test_observer_uncalibrated_reload_clears_flag():
+    # loading an all-zero checkpoint must clear _calibrated, or eval
+    # quantizes through scale=0 and collapses activations to 0
+    q = FakeQuantMovingAverageAbsMax(bits=8, moving_rate=0.9)
+    q.train()
+    q(paddle.to_tensor(np.full((4, 4), 2.0, "float32")))
+    fresh = FakeQuantMovingAverageAbsMax(bits=8, moving_rate=0.9)
+    q.set_state_dict(fresh.state_dict())
+    q.eval()
+    out = q(paddle.to_tensor(np.full((2, 2), 3.0, "float32"))).numpy()
+    assert out.max() > 1.0  # dynamic fallback, not scale-0 collapse

@@ -59,3 +59,14 @@ def test_sequence_expand_and_reverse():
     rev = F.sequence_reverse(padded, lens).numpy()
     for i, s in enumerate(seqs):
         np.testing.assert_allclose(rev[i, :len(s)], s[::-1])
+
+
+def test_sequence_pad_truncating_maxlen_clips_lengths():
+    # ADVICE r1: maxlen < longest seq must clip returned lengths too
+    seqs = [np.arange(5, dtype="float32"), np.arange(2, dtype="float32")]
+    padded, lens = F.sequence_pad(seqs, pad_value=0.0, maxlen=3)
+    assert padded.shape == [2, 3]
+    np.testing.assert_array_equal(lens.numpy(), [3, 2])
+    # LAST pooling must gather the last *kept* element, index 2 -> 2.0
+    last = F.sequence_pool(padded, lens, pool_type="last").numpy()
+    np.testing.assert_allclose(last, [2.0, 1.0])

@@ -144,3 +144,43 @@ def test_read_file_roundtrip(tmp_path):
     p.write_bytes(bytes(range(16)))
     t = vops.read_file(str(p))
     assert t.numpy().tolist() == list(range(16))
+
+
+def test_nms_categories_filter_and_global_topk():
+    # ADVICE r1: categories restricts output; top_k applies globally to
+    # the merged score-sorted set (paddle.vision.ops.nms semantics)
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [100, 100, 110, 110], [200, 200, 210, 210],
+        [300, 300, 310, 310], [400, 400, 410, 410],
+    ], "float32"))
+    scores = paddle.to_tensor(np.array([.9, .8, .7, .6, .5], "float32"))
+    cats = paddle.to_tensor(np.array([0, 1, 0, 1, 2], "int64"))
+    keep = vops.nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                    categories=[0, 1]).numpy()
+    # cat2 (idx4) excluded; score-desc order preserved
+    np.testing.assert_array_equal(keep, [0, 1, 2, 3])
+    keep1 = vops.nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                     categories=[0, 1], top_k=1).numpy()
+    np.testing.assert_array_equal(keep1, [0])  # global top_k, not per-cat
+    # duplicate category ids must not duplicate indices
+    keep_dup = vops.nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                        categories=[0, 0]).numpy()
+    np.testing.assert_array_equal(keep_dup, [0, 2])
+
+
+def test_nms_categories_accepts_tensor():
+    boxes = paddle.to_tensor(np.array([[0, 0, 10, 10],
+                                       [100, 100, 110, 110]], "float32"))
+    scores = paddle.to_tensor(np.array([.9, .8], "float32"))
+    cats = paddle.to_tensor(np.array([0, 1], "int64"))
+    keep = vops.nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                    categories=paddle.to_tensor(np.array([0], "int64"))).numpy()
+    np.testing.assert_array_equal(keep, [0])
+
+
+def test_nms_categories_without_idxs_raises():
+    import pytest
+    boxes = paddle.to_tensor(np.array([[0, 0, 10, 10]], "float32"))
+    scores = paddle.to_tensor(np.array([.9], "float32"))
+    with pytest.raises(ValueError):
+        vops.nms(boxes, 0.5, scores=scores, categories=[1, 2])
