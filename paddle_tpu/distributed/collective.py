@@ -30,7 +30,8 @@ from ..core.dispatch import register_op
 from . import topology
 
 _GROUPS = {}
-_next_group_id = [0]
+_next_group_id = [1]  # gid 0 is the default group
+_default = [None]
 
 
 class Group:
@@ -66,24 +67,38 @@ def _default_group():
         # implicit flat dp mesh over all devices
         hc = topology.HybridCommunicateGroup(dp=jax.device_count())
         mesh = hc.mesh
-    return Group(axis="dp", mesh=mesh)
+    cached = _default[0]
+    if cached is None or cached.mesh is not mesh:
+        cached = Group(axis="dp", mesh=mesh, gid=0)
+        _default[0] = cached
+        _GROUPS[0] = cached
+    return cached
 
 
 def new_group(ranks=None, backend=None, timeout=None):
     """Reference: collective.py:209. Creates a group over the given global
     ranks; in the mesh model sub-groups map to mesh axes — a custom rank
-    subset gets a dedicated 1-axis mesh over those devices."""
+    subset gets a dedicated 1-axis mesh over those devices. The group is
+    registered so get_group(g.id) finds it again."""
     if ranks is None:
-        return _default_group()
-    devs = jax.devices()
-    sub = [devs[r] for r in ranks]
-    import numpy as np
-    mesh = jax.sharding.Mesh(np.asarray(sub), ("sub",))
-    return Group(axis="sub", mesh=mesh, ranks=list(ranks))
+        g = _default_group()
+    else:
+        devs = jax.devices()
+        sub = [devs[r] for r in ranks]
+        import numpy as np
+        mesh = jax.sharding.Mesh(np.asarray(sub), ("sub",))
+        g = Group(axis="sub", mesh=mesh, ranks=list(ranks))
+    _GROUPS[g.id] = g
+    return g
 
 
 def get_group(gid=0):
-    return _GROUPS.get(gid) or _default_group()
+    if gid == 0:
+        return _default_group()
+    g = _GROUPS.get(gid)
+    if g is None:
+        raise ValueError(f"no group with id {gid}; create it via new_group")
+    return g
 
 
 def _axis_in_scope(axis):
@@ -142,20 +157,23 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     if n == 1:
         return tensor
     red_name = op if isinstance(op, str) else "sum"
-
-    def shard_fn(v, single):
-        red = _REDUCE_FNS.get(red_name, jax.lax.psum)
-        if red_name == "avg":
-            return jax.lax.psum(v, axis) / n
-        if red_name == "prod":
-            # no pprod primitive: log-sum-exp style via all_gather
-            g_all = jax.lax.all_gather(v, axis)
-            return jnp.prod(g_all, axis=0)
-        return red(v, axis)
-
-    out = _eager_collective(tensor.value, g, shard_fn)
+    out = _eager_collective(
+        tensor.value, g,
+        lambda v, single: _reduce_shard(v, axis, red_name, n))
     tensor.value = out
     return tensor
+
+
+def _reduce_shard(v, axis, red_name, n):
+    """Per-shard reduction body (runs inside shard_map)."""
+    if red_name == "avg":
+        return jax.lax.psum(v, axis) / n
+    if red_name == "prod":
+        # no pprod primitive in lax: gather the n shard values and take
+        # the product (log-psum would break on zeros/negatives)
+        g_all = jax.lax.all_gather(v, axis)
+        return jnp.prod(g_all, axis=0)
+    return _REDUCE_FNS.get(red_name, jax.lax.psum)(v, axis)
 
 
 @register_op("c_allreduce", differentiable=True)
@@ -168,6 +186,8 @@ def _spmd_allreduce(x, *, axis, op):
         return jax.lax.pmin(x, axis)
     if op == "avg":
         return jax.lax.pmean(x, axis)
+    if op == "prod":
+        return jnp.prod(jax.lax.all_gather(x, axis), axis=0)
     raise ValueError(op)
 
 
@@ -183,11 +203,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         tensor_list.append(tensor)
         return tensor_list
     # Eager single-controller: the tensor's shards along the group axis are
-    # the per-rank values; gather them to host-visible tensors.
-    v = tensor.value
-    shards = jnp.split(jnp.asarray(v), n, axis=0) if v.shape and \
-        v.shape[0] % n == 0 else [jnp.asarray(v)] * n
-    tensor_list.extend(Tensor(s) for s in shards)
+    # the per-rank values; gather them to host-visible tensors. A leading
+    # dim that does not divide the group size has no per-rank meaning —
+    # silently replicating would be a wrong result.
+    v = jnp.asarray(tensor.value)
+    if v.ndim == 0 or v.shape[0] % n != 0:
+        raise ValueError(
+            f"all_gather: leading dim of shape {tuple(v.shape)} is not "
+            f"divisible by group size {n}; eager collectives treat the "
+            "leading-axis shards as the per-rank values")
+    tensor_list.extend(Tensor(s) for s in jnp.split(v, n, axis=0))
     return tensor_list
 
 
@@ -215,8 +240,40 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):  # noqa: A001
-    # all ranks compute the reduction; dst semantics collapse in SPMD
-    return all_reduce(tensor, op, group, sync_op)
+    """paddle.distributed.reduce: only rank `dst` receives the reduction;
+    other ranks keep their input (reference collective.py:495). Inside an
+    SPMD region dst semantics collapse (every program instance is the same
+    program) and this is an all_reduce; eagerly the dst *shard* gets the
+    reduced value and the other shards are left unchanged."""
+    g = group or _default_group()
+    if _axis_in_scope(g.axis):
+        return all_reduce(tensor, op, group, sync_op)
+    n = g.nranks
+    if n == 1:
+        return tensor
+    # dst is a GLOBAL rank; convert to the group-local index the axis
+    # compares against (reference: group.get_group_rank(dst))
+    if g.ranks is not None:
+        if dst not in g.ranks:
+            raise ValueError(f"reduce: dst rank {dst} not in group "
+                             f"{g.ranks}")
+        dst_local = g.ranks.index(dst)
+    else:
+        if not 0 <= dst < n:
+            raise ValueError(f"reduce: dst rank {dst} out of range for "
+                             f"group of size {n}")
+        dst_local = dst
+    red_name = op if isinstance(op, str) else "sum"
+    axis = g.axis
+
+    def shard_fn(v, single):
+        red = _reduce_shard(v, axis, red_name, n)
+        idx = jax.lax.axis_index(axis)
+        return jnp.where(idx == dst_local, red, v)
+
+    out = _eager_collective(tensor.value, g, shard_fn)
+    tensor.value = out
+    return tensor
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):

@@ -75,16 +75,69 @@ class ShardingParallel(_MetaParallelBase):
         super().__init__(layers, hcg, strategy)
 
 
-class PipelineParallel(_MetaParallelBase):
-    """Reference: meta_parallel/pipeline_parallel.py:32; train_batch(:114)
-    runs the 1F1B micro-batch schedule with p2p send/recv.
+def _layer_signature(layer):
+    """Structural signature used to find the homogeneous block run: two
+    layers pipeline-stack iff class and param (names, shapes, dtypes)
+    match."""
+    if not isinstance(layer, Layer):
+        return None
+    sig = tuple(sorted((k, tuple(v.aval_shape()), str(v.value.dtype))
+                       for k, v in layer.state_dict().items()))
+    return (type(layer).__name__, sig)
 
-    TPU-native round-1 design: micro-batches are executed sequentially over
-    the stage segments on the controller (gradient accumulation semantics
-    identical to 1F1B); stage parameters carry pp-mesh shardings so under
-    jit GSPMD maps stage weights onto their pp slice. A shard_map-based
-    collective-permute pipeline (compute/transfer overlap on ICI) is the
-    planned optimization — see distributed/pipeline.py.
+
+def _functional_call(bindings, fn, *arrays, rng=None):
+    """Call a Layer-graph function purely: bind param Tensors to traced
+    values, wrap jax arrays as fresh Tensors, return the jax output value.
+    When `rng` is given, the global generator state is bound to it and the
+    advanced state is returned alongside (so dropout differs per step —
+    the same threading the to_static machinery does automatically)."""
+    from ....core import trace as trace_mod
+    from ....core import rng as rng_mod
+
+    ctx = trace_mod.TraceContext("jit")
+    rng_t = rng_mod.default_generator.state if rng is not None else None
+    with trace_mod.trace_guard(ctx):
+        for t, v in bindings:
+            ctx.bind(t, v)
+        if rng_t is not None:
+            ctx.bind(rng_t, rng)
+        targs = []
+        for a in arrays:
+            ta = Tensor(a)
+            ctx.register_created(ta)
+            targs.append(ta)
+        out = fn(*targs)
+        out_val = out.value if isinstance(out, Tensor) else out
+        new_rng = ctx.final_value(rng_t) if rng_t is not None else None
+    if rng is not None:
+        return out_val, new_rng
+    return out_val
+
+
+class PipelineParallel(_MetaParallelBase):
+    """TPU-native pipeline engine (reference:
+    meta_parallel/pipeline_parallel.py:32 train_batch:114 over p2p NCCL;
+    framework/section_worker.cc:34 1F1B schedule).
+
+    Instead of per-stage worker processes exchanging activations, the whole
+    train step is ONE compiled program:
+      - the model's edge segments (embedding / final norm / head / loss)
+        run as plain GSPMD ops on the full mesh — so a tied/shared
+        embedding (SharedLayerDesc) is literally the same tensor used in
+        both places, no cross-stage sync;
+      - the repeated blocks are pipelined over the 'pp' mesh axis via
+        scan + ppermute (distributed/pipeline.py), manual only over 'pp'
+        so TP ('mp') and DP shardings inside blocks still compile via
+        GSPMD;
+      - backward is jax autodiff of the schedule — the reversed scan with
+        reversed ppermute, i.e. 1F1B-equivalent gradient accumulation.
+
+    Models opt in by providing pp_segments() -> {'pre': fn(x)->h,
+    'blocks': [Layer...], 'post': fn(h, label)->loss}; PipelineLayer
+    containers are segmented automatically (homogeneous middle run).
+    Uneven block counts are padded to ceil(n/pp) per stage (padded slots
+    masked out).
     """
 
     def __init__(self, layers, hcg, strategy=None):
@@ -93,32 +146,218 @@ class PipelineParallel(_MetaParallelBase):
         if strategy is not None:
             self._acc_steps = strategy.pipeline_configs.get(
                 "accumulate_steps", 1)
+        self._plan = None
+        self._jitted = {}
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
+    # -- segmentation ------------------------------------------------------
+    def _segments(self):
+        model = self._layers
+        if hasattr(model, "pp_segments"):
+            return model.pp_segments()
+        from .pp_layers import PipelineLayer
+        if isinstance(model, PipelineLayer):
+            return self._segments_from_pipeline_layer(model)
+        raise TypeError(
+            "pipeline parallelism needs a model with pp_segments() or a "
+            "PipelineLayer container; got " + type(model).__name__)
+
+    @staticmethod
+    def _segments_from_pipeline_layer(model):
+        items = model.run_function
+        sigs = [_layer_signature(l) for l, tag in items]
+        # longest contiguous run of identical non-trivial signatures
+        best = (0, 0)
+        i = 0
+        while i < len(items):
+            if sigs[i] is None or not sigs[i][1]:
+                i += 1
+                continue
+            j = i
+            while j < len(items) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        lo, hi = best
+        if hi - lo < 2:
+            raise ValueError(
+                "PipelineLayer has no homogeneous block run to pipeline")
+        pre_items, block_items, post_items = \
+            items[:lo], items[lo:hi], items[hi:]
+        run = type(model).apply_items
+
+        def pre(x):
+            return run(pre_items, x)
+
+        def post(h, label):
+            out = run(post_items, h)
+            return model.loss(out, label)
+
+        return {"pre": pre, "blocks": [l for l, _ in block_items],
+                "post": post}
+
+    # -- compiled pipeline step -------------------------------------------
+    def _build_plan(self):
+        import numpy as np
+        segs = self._segments()
+        model = self._layers
+        blocks = list(segs["blocks"])
+        template = blocks[0]
+        block_states = [b.state_dict() for b in blocks]
+        keys = list(block_states[0].keys())
+        block_ids = {id(t) for st in block_states for t in st.values()}
+        full = model.state_dict()
+        other = {n: t for n, t in full.items() if id(t) not in block_ids}
+
+        # only float trainables are differentiated; buffers/int state are
+        # passed through undifferentiated (value_and_grad needs float args)
+        import jax.numpy as jnp
+        diff = {n: t for n, t in other.items()
+                if t.trainable and jnp.issubdtype(t.value.dtype,
+                                                  jnp.floating)}
+        aux = {n: t for n, t in other.items() if n not in diff}
+
+        mesh = self._hcg.mesh
+        pp = int(mesh.shape["pp"])
+        groups = np.array_split(np.arange(len(blocks)), pp)
+        lps = max(len(g) for g in groups)
+        # stage-major [pp, lps] block index map; padded slots repeat the
+        # stage's last block (real weights -> no NaN hazards) and are
+        # masked out of both forward and grads
+        idx_map = np.asarray([[g[min(j, len(g) - 1)] for j in range(lps)]
+                              for g in groups])
+        valid = np.asarray([[j < len(g) for j in range(lps)]
+                            for g in groups])
+        self._plan = dict(
+            segs=segs, blocks=blocks, template=template,
+            block_states=block_states, keys=keys, diff=diff, aux=aux,
+            mesh=mesh, pp=pp, idx_map=idx_map, valid=valid, lps=lps)
+        return self._plan
+
+    def _stacked_values(self, plan):
+        import jax.numpy as jnp
+        stacked = {}
+        for k in plan["keys"]:
+            rows = []
+            for s in range(plan["pp"]):
+                rows.append(jnp.stack(
+                    [plan["block_states"][i][k].value
+                     for i in plan["idx_map"][s]], axis=0))
+            stacked[k] = jnp.stack(rows, axis=0)  # [pp, lps, ...]
+        return stacked
+
+    def _make_loss_fn(self, plan, micro):
+        from ...pipeline import pipeline_blocks_apply
+        import jax.numpy as jnp
+
+        segs, template = plan["segs"], plan["template"]
+        tmpl_state = plan["block_states"][0]
+        keys, mesh = plan["keys"], plan["mesh"]
+        diff, aux = plan["diff"], plan["aux"]
+        tmpl_tensors = [tmpl_state[k] for k in keys]
+        valid = jnp.asarray(plan["valid"])
+        dp = int(mesh.shape.get("dp", 1))
+
+        def block_fn(sliced, h):
+            # sliced: (param values dict for ONE block, rng key)
+            vals, key = sliced
+            binds = list(zip(tmpl_tensors, [vals[k] for k in keys]))
+            out, _ = _functional_call(binds, template, h, rng=key)
+            return out
+
+        def loss_fn(diff_vals, stacked_vals, aux_vals, x, y, rng,
+                    loss_scale):
+            binds = ([(diff[n], diff_vals[n]) for n in diff] +
+                     [(aux[n], aux_vals[n]) for n in aux])
+            if x.ndim >= 1 and x.shape[0] % dp == 0 and dp > 1:
+                x = jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P("dp")))
+            r_pre, r_blocks, r_post = jax.random.split(rng, 3)
+            h, _ = _functional_call(binds, segs["pre"], x, rng=r_pre)
+            block_keys = jax.random.split(
+                r_blocks, plan["pp"] * plan["lps"]).reshape(
+                    plan["pp"], plan["lps"], -1)
+            h = pipeline_blocks_apply(
+                block_fn, (stacked_vals, block_keys), valid, h, micro,
+                mesh)
+            args = (h,) if y is None else (h, y)
+            loss, _ = _functional_call(binds, segs["post"], *args,
+                                       rng=r_post)
+            # grads are taken of the SCALED loss (GradScaler contract:
+            # scaler.step later unscales + runs inf detection); the raw
+            # loss is returned for reporting
+            return loss * loss_scale, loss
+
+        return loss_fn
+
+    def _run_step(self, x, y, micro, training=True, loss_scale=None):
+        import jax.numpy as jnp
+        plan = self._plan or self._build_plan()
+        key = ("train" if training else "eval", micro,
+               tuple(x.shape), str(x.value.dtype),
+               None if y is None else tuple(y.shape))
+        jitted = self._jitted.get(key)
+        if jitted is None:
+            loss_fn = self._make_loss_fn(plan, micro)
+            if training:
+                jitted = jax.jit(jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True))
+            else:
+                jitted = jax.jit(lambda *a: loss_fn(*a)[1])
+            self._jitted[key] = jitted
+
+        from ....core import rng as rng_mod
+        diff_vals = {n: t.value for n, t in plan["diff"].items()}
+        aux_vals = {n: t.value for n, t in plan["aux"].items()}
+        stacked_vals = self._stacked_values(plan)
+        rng = rng_mod.next_key().value
+        yv = None if y is None else y.value
+        scale = jnp.asarray(1.0 if loss_scale is None else loss_scale,
+                            jnp.float32)
+        if not training:
+            return jitted(diff_vals, stacked_vals, aux_vals, x.value, yv,
+                          rng, scale)
+        (_, loss), (g_diff, g_stacked) = jitted(
+            diff_vals, stacked_vals, aux_vals, x.value, yv, rng, scale)
+        self._assign_grads(plan, g_diff, g_stacked)
+        return loss
+
+    @staticmethod
+    def _accum_grad(t, g):
+        if t.grad is None:
+            t.grad = Tensor(g, stop_gradient=True)
+        else:
+            t.grad.value = t.grad.value + g
+
+    def _assign_grads(self, plan, g_diff, g_stacked):
+        for n, t in plan["diff"].items():
+            self._accum_grad(t, g_diff[n])
+        for k in plan["keys"]:
+            g = g_stacked[k]  # [pp, lps, ...]
+            for s in range(plan["pp"]):
+                for j, bi in enumerate(plan["idx_map"][s]):
+                    if not plan["valid"][s][j]:
+                        continue
+                    t = plan["block_states"][bi][k]
+                    if t.trainable:
+                        self._accum_grad(t, g[s, j])
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Reference signature: pipeline_parallel.py:114."""
+        """Reference signature: pipeline_parallel.py:114. Runs the compiled
+        pipelined forward+backward (grads land on param.grad), then the
+        optimizer step."""
         x, label = data
         micro = self._acc_steps
         n = x.shape[0]
-        assert n % micro == 0, "batch must divide accumulate_steps"
-        mb = n // micro
-        total_loss = None
-        optimizer.clear_grad()
-        for i in range(micro):
-            xs = x[i * mb:(i + 1) * mb]
-            ys = label[i * mb:(i + 1) * mb]
-            out = self._layers(xs)
-            loss = self._layers.loss(out, ys) if hasattr(
-                self._layers, "loss") else out
-            scaled = math_ops.scale(loss, 1.0 / micro)
-            if scaler is not None:
-                scaler.scale(scaled).backward()
-            else:
-                scaled.backward()
-            total_loss = scaled if total_loss is None else \
-                math_ops.add(total_loss, scaled)
+        assert n % micro == 0, \
+            "batch size must be a multiple of accumulate_steps"
+        scale = None if scaler is None else float(scaler._scale.numpy())
+        loss_val = self._run_step(x, label, micro, training=True,
+                                  loss_scale=scale)
+        loss = Tensor(loss_val)
         if scaler is not None:
             scaler.step(optimizer)
         else:
@@ -126,11 +365,16 @@ class PipelineParallel(_MetaParallelBase):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return total_loss
+        return loss
 
     def eval_batch(self, data, compute_loss=True):
         x, label = data
-        out = self._layers(x)
-        if compute_loss and hasattr(self._layers, "loss"):
-            return self._layers.loss(out, label)
-        return out
+        if compute_loss:
+            was = self.training
+            self.eval()
+            out = Tensor(self._run_step(x, label, self._acc_steps,
+                                        training=False))
+            if was:
+                self.train()
+            return out
+        return self._layers(x)

@@ -102,9 +102,11 @@ class PipelineLayer(Layer):
     def stage_segments(self):
         return self._segments
 
-    def forward_stage(self, x, stage):
-        lo, hi = self._segments[stage]
-        for layer, tag in self.run_function[lo:hi]:
+    @staticmethod
+    def apply_items(items, x):
+        """Run a sequence of (layer, tag) items — the single dispatch point
+        for stage execution and for PP auto-segmentation."""
+        for layer, tag in items:
             if tag == "fn":
                 x = layer(x)
             elif tag is not None and callable(tag):
@@ -112,6 +114,10 @@ class PipelineLayer(Layer):
             else:
                 x = layer(x)
         return x
+
+    def forward_stage(self, x, stage):
+        lo, hi = self._segments[stage]
+        return self.apply_items(self.run_function[lo:hi], x)
 
     def forward(self, x):
         for stage in range(self._num_stages):

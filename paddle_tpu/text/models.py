@@ -174,6 +174,9 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.gpt(input_ids)
+        return self._head_loss(h, labels)
+
+    def _head_loss(self, h, labels=None):
         if self.cfg.tie_embeddings:
             logits = math_ops.matmul(h, self.gpt.word_embeddings.weight,
                                      transpose_y=True)
@@ -185,6 +188,29 @@ class GPTForCausalLM(nn.Layer):
             manipulation.reshape(logits, (-1, self.cfg.vocab_size)),
             manipulation.reshape(labels, (-1,)))
         return loss
+
+    def pp_segments(self):
+        """Pipeline-parallel segmentation (see PipelineParallel): edge
+        segments run GSPMD on the full mesh — which makes the tied
+        embedding (used in pre AND post) trivially shared — and the
+        transformer blocks are the pipelined homogeneous run."""
+        core = self.gpt
+
+        def pre(input_ids):
+            s = input_ids.shape[1]
+            pos = creation.arange(0, s, dtype="int64")
+            x = core.word_embeddings(input_ids)
+            x = math_ops.add(x, core.position_embeddings(pos))
+            if core.cfg.dropout:
+                x = nn_ops.dropout(x, p=core.cfg.dropout,
+                                   training=core.training)
+            return x
+
+        def post(h, labels=None):
+            h = core.ln_f(h)
+            return self._head_loss(h, labels)
+
+        return {"pre": pre, "blocks": list(core.blocks), "post": post}
 
 
 class BertModel(_TransformerCore):

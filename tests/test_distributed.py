@@ -218,3 +218,48 @@ def test_recompute_preserves_rng():
     # grad nonzero exactly where forward kept values (same mask replayed)
     g = x.grad.numpy()
     np.testing.assert_array_equal(g != 0, out_np != 0)
+
+
+def test_collective_edge_semantics(hybrid_mesh):
+    # VERDICT r1 weak#5: all_gather non-divisible, reduce dst, group
+    # registry, ReduceOp.PROD
+    g = collective._default_group()  # dp axis, 2 ranks
+
+    # group registry: new_group registers, get_group finds it
+    sub = collective.new_group(ranks=[0, 1])
+    assert collective.get_group(sub.id) is sub
+    assert sub.id != 0
+    with pytest.raises(ValueError):
+        collective.get_group(9999)
+
+    # all_gather: non-divisible leading dim must raise, not replicate
+    bad = paddle.to_tensor(np.ones((3, 2), "float32"))
+    with pytest.raises(ValueError):
+        collective.all_gather([], bad, group=g)
+    ok = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    outs = collective.all_gather([], ok, group=g)
+    assert len(outs) == 2 and outs[0].shape == [2, 2]
+    np.testing.assert_allclose(outs[1].numpy(), [[4, 5], [6, 7]])
+
+    # reduce honors dst eagerly: dst shard reduced, others unchanged
+    t = paddle.to_tensor(np.asarray([[1.0, 2.0], [10.0, 20.0]], "float32"))
+    collective.reduce(t, dst=1, group=g)
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [11, 22]])
+
+    # PROD: eager and in-SPMD
+    t2 = paddle.to_tensor(np.asarray([[2.0], [3.0]], "float32"))
+    collective.all_reduce(t2, op=collective.ReduceOp.PROD, group=g)
+    np.testing.assert_allclose(t2.numpy(), [[6.0], [6.0]])
+    mesh = hybrid_mesh.mesh
+    out = jax.jit(jax.shard_map(
+        lambda x: collective._spmd_allreduce.fn(x, axis="dp", op="prod"),
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))(
+            jnp.asarray([2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(out), [6.0, 6.0])
+
+
+def test_reduce_dst_validation(hybrid_mesh):
+    g = collective._default_group()
+    t = paddle.to_tensor(np.ones((2, 2), "float32"))
+    with pytest.raises(ValueError):
+        collective.reduce(t, dst=5, group=g)  # out of range for 2 ranks

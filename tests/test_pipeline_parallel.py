@@ -1,0 +1,173 @@
+"""Integrated pipeline parallelism (VERDICT r1 item 2).
+
+Covers: heterogeneous stages (embedding != block != head) via
+GPTForCausalLM.pp_segments, shared/tied embedding, PP-vs-non-PP loss
+parity, uneven block counts (padded slots), and the PipelineLayer
+container auto-segmentation path.
+Reference: fleet/meta_parallel/pipeline_parallel.py:114,
+framework/section_worker.cc:34, pp_layers.py:23,62.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.text.models import TransformerLMConfig, GPTForCausalLM
+
+
+def _init_fleet(dp, mp, pp, acc=2):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                               "pp_degree": pp}
+    strategy.pipeline_configs = {"accumulate_steps": acc}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def _gpt(num_layers=4, use_mp=False):
+    paddle.seed(0)
+    cfg = TransformerLMConfig(vocab_size=128, hidden_size=32, num_layers=num_layers,
+                              num_heads=4, max_seq_len=16, dropout=0.0,
+                              use_mp=use_mp)
+    return GPTForCausalLM(cfg)
+
+
+def _data(batch=8, seq=16, vocab=128):
+    ids = np.random.RandomState(0).randint(0, vocab, (batch, seq))
+    lab = np.random.RandomState(1).randint(0, vocab, (batch, seq))
+    return (paddle.to_tensor(ids.astype("int64")),
+            paddle.to_tensor(lab.astype("int64")))
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    yield
+    topology._HYBRID = None
+
+
+def _train_losses_pp(dp, mp, pp, steps=4, num_layers=4, acc=2):
+    _init_fleet(dp, mp, pp, acc)
+    model = fleet.distributed_model(_gpt(num_layers, use_mp=(mp > 1)))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    ids, lab = _data()
+    return [float(model.train_batch((ids, lab), opt).numpy())
+            for _ in range(steps)]
+
+
+def test_pp2_gpt_trains_and_matches_pp1():
+    # pp=2 x mp=2 x dp=2: heterogeneous stages + tied embedding
+    losses_pp = _train_losses_pp(2, 2, 2)
+    topology._HYBRID = None
+    # same model/init/data WITHOUT pipelining (pp=1 -> TensorParallel path)
+    _init_fleet(4, 2, 1)
+    model = fleet.distributed_model(_gpt(4, use_mp=True))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    ids, lab = _data()
+
+    @paddle.jit.to_static
+    def step(ids, lab):
+        loss = model(ids, lab)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses_ref = [float(step(ids, lab).numpy()) for _ in range(4)]
+    np.testing.assert_allclose(losses_pp, losses_ref, rtol=2e-3, atol=2e-3)
+    assert losses_pp[-1] < losses_pp[0]
+
+
+def test_pp_uneven_blocks():
+    # 5 blocks over pp=2 -> stages of 3 and 2 (padded slot masked)
+    losses = _train_losses_pp(4, 1, 2, num_layers=5)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    topology._HYBRID = None
+    _init_fleet(8, 1, 1)
+    model = fleet.distributed_model(_gpt(5))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    ids, lab = _data()
+
+    @paddle.jit.to_static
+    def step(ids, lab):
+        loss = model(ids, lab)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses_ref = [float(step(ids, lab).numpy()) for _ in range(4)]
+    np.testing.assert_allclose(losses, losses_ref, rtol=2e-3, atol=2e-3)
+
+
+def test_pp4_deep_gpt():
+    losses = _train_losses_pp(2, 1, 4, num_layers=8, acc=4)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_pipeline_layer_container_segmentation():
+    # heterogeneous PipelineLayer: embedding-ish pre, homogeneous middle,
+    # head post — auto-segmented, trained through the PP engine
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc)
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_wrappers import (
+        PipelineParallel)
+
+    _init_fleet(4, 1, 2)
+    paddle.seed(0)
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16),
+                LayerDesc(nn.Linear, 16, 16),
+                LayerDesc(nn.Linear, 16, 16),
+                LayerDesc(nn.Linear, 16, 16),
+                LayerDesc(nn.Linear, 16, 16),
+                LayerDesc(Head)],
+        num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    model = fleet.distributed_model(pipe)
+    assert isinstance(model, PipelineParallel)
+    segs = model._segments()
+    assert len(segs["blocks"]) == 4  # the 16->16 homogeneous run
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.RandomState(2).randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(3).randint(0, 4, (8,)).astype("int64"))
+    losses = [float(model.train_batch((x, y), opt).numpy())
+              for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_pp_eval_batch():
+    _init_fleet(4, 1, 2)
+    model = fleet.distributed_model(_gpt(4))
+    ids, lab = _data()
+    loss = model.eval_batch((ids, lab))
+    assert np.isfinite(float(loss.numpy()))
+
+
+def test_pp_train_batch_with_grad_scaler():
+    # grads must be computed from the SCALED loss so scaler.step's
+    # unscale+inf-check contract holds
+    _init_fleet(4, 1, 2)
+    model = fleet.distributed_model(_gpt(4))
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    ids, lab = _data()
+    losses = [float(model.train_batch((ids, lab), opt,
+                                      scaler=scaler).numpy())
+              for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # updates at the right magnitude
