@@ -204,17 +204,23 @@ class PipelineParallel(_MetaParallelBase):
         import numpy as np
         segs = self._segments()
         model = self._layers
+        import jax.numpy as jnp
         blocks = list(segs["blocks"])
         template = blocks[0]
         block_states = [b.state_dict() for b in blocks]
-        keys = list(block_states[0].keys())
+        # differentiate only float trainable block entries; int/bool
+        # buffers (masks, counters) ride along undifferentiated in their
+        # own stack (value_and_grad rejects non-float argnums)
+        keys = [k for k, t in block_states[0].items()
+                if t.trainable and jnp.issubdtype(t.value.dtype,
+                                                  jnp.floating)]
+        aux_keys = [k for k in block_states[0] if k not in keys]
         block_ids = {id(t) for st in block_states for t in st.values()}
         full = model.state_dict()
         other = {n: t for n, t in full.items() if id(t) not in block_ids}
 
         # only float trainables are differentiated; buffers/int state are
         # passed through undifferentiated (value_and_grad needs float args)
-        import jax.numpy as jnp
         diff = {n: t for n, t in other.items()
                 if t.trainable and jnp.issubdtype(t.value.dtype,
                                                   jnp.floating)}
@@ -233,14 +239,15 @@ class PipelineParallel(_MetaParallelBase):
                             for g in groups])
         self._plan = dict(
             segs=segs, blocks=blocks, template=template,
-            block_states=block_states, keys=keys, diff=diff, aux=aux,
+            block_states=block_states, keys=keys, aux_keys=aux_keys,
+            diff=diff, aux=aux,
             mesh=mesh, pp=pp, idx_map=idx_map, valid=valid, lps=lps)
         return self._plan
 
-    def _stacked_values(self, plan):
+    def _stacked_values(self, plan, which="keys"):
         import jax.numpy as jnp
         stacked = {}
-        for k in plan["keys"]:
+        for k in plan[which]:
             rows = []
             for s in range(plan["pp"]):
                 rows.append(jnp.stack(
@@ -255,21 +262,25 @@ class PipelineParallel(_MetaParallelBase):
 
         segs, template = plan["segs"], plan["template"]
         tmpl_state = plan["block_states"][0]
-        keys, mesh = plan["keys"], plan["mesh"]
+        keys, aux_keys, mesh = plan["keys"], plan["aux_keys"], plan["mesh"]
         diff, aux = plan["diff"], plan["aux"]
         tmpl_tensors = [tmpl_state[k] for k in keys]
+        tmpl_aux_tensors = [tmpl_state[k] for k in aux_keys]
         valid = jnp.asarray(plan["valid"])
         dp = int(mesh.shape.get("dp", 1))
 
         def block_fn(sliced, h):
-            # sliced: (param values dict for ONE block, rng key)
-            vals, key = sliced
-            binds = list(zip(tmpl_tensors, [vals[k] for k in keys]))
+            # sliced: (diff param values, aux values, rng key) for ONE
+            # block
+            vals, aux_vals_b, key = sliced
+            binds = (list(zip(tmpl_tensors, [vals[k] for k in keys])) +
+                     list(zip(tmpl_aux_tensors,
+                              [aux_vals_b[k] for k in aux_keys])))
             out, _ = _functional_call(binds, template, h, rng=key)
             return out
 
-        def loss_fn(diff_vals, stacked_vals, aux_vals, x, y, rng,
-                    loss_scale):
+        def loss_fn(diff_vals, stacked_vals, aux_vals, stacked_aux, x, y,
+                    rng, loss_scale):
             binds = ([(diff[n], diff_vals[n]) for n in diff] +
                      [(aux[n], aux_vals[n]) for n in aux])
             if x.ndim >= 1 and x.shape[0] % dp == 0 and dp > 1:
@@ -281,8 +292,8 @@ class PipelineParallel(_MetaParallelBase):
                 r_blocks, plan["pp"] * plan["lps"]).reshape(
                     plan["pp"], plan["lps"], -1)
             h = pipeline_blocks_apply(
-                block_fn, (stacked_vals, block_keys), valid, h, micro,
-                mesh)
+                block_fn, (stacked_vals, stacked_aux, block_keys), valid,
+                h, micro, mesh)
             args = (h,) if y is None else (h, y)
             loss, _ = _functional_call(binds, segs["post"], *args,
                                        rng=r_post)
@@ -312,16 +323,18 @@ class PipelineParallel(_MetaParallelBase):
         from ....core import rng as rng_mod
         diff_vals = {n: t.value for n, t in plan["diff"].items()}
         aux_vals = {n: t.value for n, t in plan["aux"].items()}
-        stacked_vals = self._stacked_values(plan)
+        stacked_vals = self._stacked_values(plan, "keys")
+        stacked_aux = self._stacked_values(plan, "aux_keys")
         rng = rng_mod.next_key().value
         yv = None if y is None else y.value
         scale = jnp.asarray(1.0 if loss_scale is None else loss_scale,
                             jnp.float32)
         if not training:
-            return jitted(diff_vals, stacked_vals, aux_vals, x.value, yv,
-                          rng, scale)
+            return jitted(diff_vals, stacked_vals, aux_vals, stacked_aux,
+                          x.value, yv, rng, scale)
         (_, loss), (g_diff, g_stacked) = jitted(
-            diff_vals, stacked_vals, aux_vals, x.value, yv, rng, scale)
+            diff_vals, stacked_vals, aux_vals, stacked_aux, x.value, yv,
+            rng, scale)
         self._assign_grads(plan, g_diff, g_stacked)
         return loss
 

@@ -2,10 +2,12 @@
 
 Reference parity: python/paddle/fluid/reader.py:146 DataLoader +
 dataloader_iter.py (single/multiprocess iters) + operators/reader/
-buffered_reader.cc (async H2D double buffering). TPU-native: worker threads
-(numpy collate releases the GIL for the heavy parts) feed a bounded queue;
-device transfer happens via jax.device_put which is async, giving the same
-overlap the reference gets from its side-stream buffered reader.
+buffered_reader.cc (async H2D double buffering). TPU-native:
+num_workers>0 spawns worker PROCESSES (io/worker.py) that decode and
+collate to numpy; large arrays travel through POSIX shared memory, and a
+background thread double-buffers jax.device_put so the next batch's H2D
+transfer overlaps the current step — the same overlap the reference gets
+from its side-stream buffered reader.
 """
 import queue
 import threading
@@ -40,7 +42,7 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
@@ -48,17 +50,20 @@ class DataLoader:
         self.prefetch_factor = max(2, prefetch_factor)
         self.return_list = return_list
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
+        self.batch_size = batch_size
+        self.drop_last = drop_last
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
-            self.batch_size = batch_size
-            self.drop_last = drop_last
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
             if batch_size is None:
                 self.batch_sampler = None
-                self.batch_size = None
             else:
                 self.batch_sampler = BatchSampler(
                     dataset, shuffle=shuffle, batch_size=batch_size,
@@ -69,22 +74,25 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
-    def _make_batches(self):
+    @staticmethod
+    def _to_tensors(collated):
         from ..core.tensor import Tensor
+        if isinstance(collated, (list, tuple)):
+            return [Tensor(c) if isinstance(c, np.ndarray) else c
+                    for c in collated]
+        if isinstance(collated, np.ndarray):
+            return [Tensor(collated)]
+        return collated
 
-        def to_tensors(collated):
-            if isinstance(collated, (list, tuple)):
-                return [Tensor(c) if isinstance(c, np.ndarray) else c
-                        for c in collated]
-            if isinstance(collated, np.ndarray):
-                return [Tensor(collated)]
-            return collated
+    def _make_batches(self):
+        to_tensors = self._to_tensors
 
         if self._iterable_mode:
+            bs = self.batch_size or 1  # None = per-sample (no batching)
             buf = []
             for sample in self.dataset:
                 buf.append(sample)
-                if len(buf) == self.batch_size:
+                if len(buf) == bs:
                     yield to_tensors(self.collate_fn(buf))
                     buf = []
             if buf and not self.drop_last:
@@ -102,66 +110,141 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._make_batches()
             return
-        from ..core import native
-        if self.use_buffer_reader and native.available():
-            yield from self._iter_native()
+        yield from self._iter_multiprocess()
+
+    def _convert_batch(self, batch, shm_holds):
+        """Turn a decoded worker batch into consumer tensors and release
+        its shm segments safely (exception-safe: segments are always
+        freed)."""
+        from .worker import _release
+        import jax
+        try:
+            cpu_backend = jax.default_backend() == "cpu"
+            if shm_holds and (cpu_backend
+                              or not self._fast_convertible(batch)):
+                # Materialize private copies of arrays that would
+                # otherwise alias the shm buffer after release:
+                # CPU-backend jax arrays can wrap host numpy zero-copy,
+                # and structures _to_tensors leaves as raw numpy (dicts,
+                # nested lists) alias it unconditionally.
+                batch = self._copy_out(batch)
+                _release(shm_holds)
+                shm_holds = []
+            tensors = self._to_tensors(batch)
+            if shm_holds:
+                # accelerator path: the H2D copy must land before the
+                # shm segment goes away
+                for t in tensors:
+                    v = getattr(t, "value", None)
+                    if hasattr(v, "block_until_ready"):
+                        v.block_until_ready()
+                _release(shm_holds)
+                shm_holds = []
+            return tensors
+        finally:
+            if shm_holds:
+                _release(shm_holds)
+
+    @classmethod
+    def _copy_out(cls, obj):
+        if isinstance(obj, np.ndarray):
+            return np.array(obj, copy=True)
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(cls._copy_out(o) for o in obj)
+        if isinstance(obj, dict):
+            return {k: cls._copy_out(v) for k, v in obj.items()}
+        return obj
+
+    @staticmethod
+    def _fast_convertible(b):
+        # shapes _to_tensors fully converts to device arrays: a bare
+        # ndarray, or a flat list/tuple whose array entries are all
+        # top-level (nested containers stay raw numpy inside)
+        if isinstance(b, np.ndarray):
+            return True
+        if isinstance(b, (list, tuple)):
+            return not any(isinstance(o, (list, tuple, dict)) for o in b)
+        return False
+
+    def _get_mp_iter(self):
+        from .worker import _MultiprocessIter
+        it = getattr(self, "_mp_iter", None)
+        if it is not None and not it._shut \
+                and all(w.is_alive() for w in it.workers):
+            it.reset()
+            return it
+        self._mp_iter = None
+        it = _MultiprocessIter(self)
+        if it.persistent:
+            self._mp_iter = it
+        return it
+
+    def _finish_epoch(self, mp_iter, completed):
+        if completed and mp_iter.persistent and not mp_iter._shut:
+            return  # keep the pool for the next epoch
+        mp_iter._shutdown()
+        if getattr(self, "_mp_iter", None) is mp_iter:
+            self._mp_iter = None
+
+    def _iter_multiprocess(self):
+        """Worker processes collate; large arrays arrive via shared
+        memory; with use_buffer_reader a background thread stages the
+        next batches onto the device (double-buffered device_put — the
+        analogue of the reference's buffered_reader side-stream H2D
+        prefetch, operators/reader/buffered_reader.cc) and releases each
+        shm segment once its transfer has landed."""
+        mp_iter = self._get_mp_iter()
+
+        if not self.use_buffer_reader:
+            completed = False
+            try:
+                for batch, shm_holds in mp_iter:
+                    yield self._convert_batch(batch, shm_holds)
+                completed = True
+            finally:
+                self._finish_epoch(mp_iter, completed)
             return
-        # threaded prefetch pipeline: workers collate, main thread yields
-        q = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
+
+        q = queue.Queue(maxsize=2)
         sentinel = object()
         err = []
+        stop = threading.Event()
+
+        def put(item):
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
+            completed = False
             try:
-                for b in self._make_batches():
-                    q.put(b)
+                for batch, shm_holds in mp_iter:
+                    if not put(self._convert_batch(batch, shm_holds)):
+                        return  # consumer abandoned the iterator
+                completed = True
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
-                q.put(sentinel)
+                try:
+                    self._finish_epoch(mp_iter, completed)
+                except BaseException as e:
+                    err.append(e)
+                put(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        if err:
-            raise err[0]
-
-    def _iter_native(self):
-        """Batches flow through the C++ blocking queue (runtime_cpp) — the
-        analogue of the reference's LoDTensorBlockingQueue between workers
-        and the buffered reader."""
-        import pickle
-        from ..core import native
-        from ..core.tensor import Tensor
-        q = native.NativeBlockingQueue(
-            capacity=self.prefetch_factor * self.num_workers)
-        err = []
-
-        def producer():
-            try:
-                for b in self._make_batches():
-                    payload = [t.numpy() if isinstance(t, Tensor) else t
-                               for t in b] if isinstance(b, list) else b
-                    q.put_bytes(pickle.dumps(payload, protocol=4))
-            except BaseException as e:
-                err.append(e)
-            finally:
-                q.close()
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            raw = q.get_bytes()
-            if raw is None:
-                break
-            batch = pickle.loads(raw)
-            if isinstance(batch, list):
-                batch = [Tensor(a) if isinstance(a, np.ndarray) else a
-                         for a in batch]
-            yield batch
-        if err:
-            raise err[0]
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            stop.set()
+            t.join(timeout=10.0)

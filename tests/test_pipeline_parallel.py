@@ -171,3 +171,42 @@ def test_pp_train_batch_with_grad_scaler():
               for _ in range(4)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]  # updates at the right magnitude
+
+
+def test_pp_block_with_int_buffer():
+    # a non-float buffer inside a pipelined block must ride along
+    # undifferentiated instead of crashing value_and_grad
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc)
+
+    _init_fleet(4, 1, 2)
+    paddle.seed(0)
+
+    class MaskedLinear(nn.Layer):
+        def __init__(self, d):
+            super().__init__()
+            self.fc = nn.Linear(d, d)
+            self.register_buffer(
+                "keep", paddle.to_tensor(np.ones((d,), dtype="int32")))
+
+        def forward(self, x):
+            from paddle_tpu.ops import math as m
+            return self.fc(x) * m.cast(self.keep, "float32")
+
+    pipe = PipelineLayer(
+        layers=[LayerDesc(nn.Linear, 8, 16),
+                LayerDesc(MaskedLinear, 16),
+                LayerDesc(MaskedLinear, 16),
+                LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    model = fleet.distributed_model(pipe)
+    opt = fleet.distributed_optimizer(
+        paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(
+        np.random.RandomState(3).randint(0, 4, (8,)).astype("int64"))
+    losses = [float(model.train_batch((x, y), opt).numpy())
+              for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
