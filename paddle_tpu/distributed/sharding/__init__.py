@@ -1,17 +1,32 @@
 """ZeRO-style sharded data parallelism.
 
 Reference parity: python/paddle/distributed/fleet/meta_optimizers/
-sharding_optimizer.py:43 (static ZeRO-1/2) and dygraph_optimizer/
+sharding_optimizer.py:43 (static ZeRO-1/2),
+sharding_optimizer.py:118-138 (hybrid meshes), and dygraph_optimizer/
 dygraph_sharding_optimizer.py:27. TPU-native: sharding is a placement
-annotation over the 'sharding' mesh axis — optimizer states (stage 1),
-plus gradients (stage 2), plus parameters (stage 3) get NamedShardings;
-XLA emits the reduce-scatter/all-gather traffic GSPMD-style, which is
-exactly the ZeRO communication pattern.
+over the 'sharding' mesh axis — optimizer states (stage 1 / 'os'), plus
+gradients (stage 2 / 'os_g'), plus parameters (stage 3 / 'p_g_os') get
+NamedShardings; GSPMD emits the reduce-scatter/all-gather traffic, which
+is exactly the ZeRO communication pattern.
+
+Placement strategy:
+- State arrays are physically placed with their sharded NamedSharding
+  ONCE (first step after the accumulators exist). Elementwise optimizer
+  math preserves input shardings, so eager steps stay sharded with no
+  per-step re-placement, and compiled steps inherit the placement through
+  the captured inputs.
+- Inside a compiled (to_static) step, gradients (stage >= 2) and updated
+  state get with_sharding_constraint annotations so XLA reduce-scatters
+  grads and keeps the optimizer update sharded; parameters consumed by
+  matmuls are all-gathered on use by GSPMD (stage 3 gather-on-use).
+- Eagerly, jax computes directly on sharded committed arrays, so stage-3
+  params remain usable outside jit (gather-on-use happens per op).
 """
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import topology
+from ...core import trace as trace_mod
 
 
 def _shard_spec(shape, deg):
@@ -23,11 +38,22 @@ def _shard_spec(shape, deg):
     return spec
 
 
-def _try_place(arr, mesh, spec):
+def _place_once(t, mesh, deg, placed):
+    """Physically shard a state tensor's array over the sharding axis
+    (eager, one-time)."""
+    if id(t) in placed:
+        return
+    v = t._value
+    if v is None or getattr(v, "ndim", 0) == 0:
+        return
+    spec = _shard_spec(v.shape, deg)
+    if not any(spec):
+        return
     try:
-        return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+        t._value = jax.device_put(v, NamedSharding(mesh, P(*spec)))
+        placed.add(id(t))
     except (ValueError, RuntimeError):
-        return arr
+        pass
 
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
@@ -36,36 +62,72 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            sync_comm=False):
     """Reference: python/paddle/distributed/sharding/group_sharded.py.
     level: 'os' (ZeRO-1), 'os_g' (ZeRO-2), 'p_g_os' (ZeRO-3)."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(f"unknown sharding level {level!r}")
     mesh = topology.get_mesh()
     if mesh is None or int(mesh.shape.get("sharding", 1)) == 1:
         return model, optimizer, scaler
     deg = int(mesh.shape["sharding"])
 
     from ..fleet.meta_parallel.mp_layers import shard_constraint
+    shard_grads = level in ("os_g", "p_g_os")
     shard_params = level == "p_g_os"
     orig_step = optimizer.step
+    placed = set()
+    params = list(model.parameters())
+
+    if shard_params:
+        for p in params:
+            _place_once(p, mesh, deg, placed)
 
     def sharded_step():
+        in_trace = trace_mod.current_trace() is not None
+        if shard_grads and in_trace:
+            # annotate grads before the optimizer consumes them: GSPMD
+            # then reduce-scatters the dp-psum straight into shards
+            for p in params:
+                g = p.grad
+                if g is None:
+                    continue
+                shape = g.aval_shape()
+                spec = _shard_spec(shape, deg) if shape else []
+                if any(spec):
+                    out = shard_constraint(g, spec, mesh=mesh)
+                    if out is not g:
+                        g.value = out.value
         orig_step()
-        # sharding constraints materialize when the step compiles; eager
-        # phases stay single-device (see mp_layers.shard_constraint)
         for kind, store in optimizer._accumulators.items():
             for t in store.values():
                 shape = t.aval_shape()
                 if not shape:
                     continue
                 spec = _shard_spec(shape, deg)
-                if any(spec):
+                if not any(spec):
+                    continue
+                if in_trace:
                     out = shard_constraint(t, spec, mesh=mesh)
                     if out is not t:
                         t.value = out.value
-        if shard_params:
-            for p in model.parameters():
-                spec = _shard_spec(p.aval_shape(), deg)
-                if any(spec):
-                    out = shard_constraint(p, spec, mesh=mesh)
-                    if out is not p:
-                        p.value = out.value
+                else:
+                    _place_once(t, mesh, deg, placed)
+        for p in params:
+            shape = p.aval_shape()
+            if not shape:
+                continue
+            spec = _shard_spec(shape, deg) if shard_params \
+                else [None] * len(shape)
+            if shard_params and not any(spec):
+                continue
+            if in_trace:
+                # stage 3: keep params sharded; stage 1/2: pin params
+                # REPLICATED or GSPMD would propagate the sharded moment
+                # layout into the updated params (that trades per-step
+                # all-gathers for memory the level didn't ask to save)
+                out = shard_constraint(p, spec, mesh=mesh)
+                if out is not p:
+                    p.value = out.value
+            elif shard_params:
+                _place_once(p, mesh, deg, placed)
 
     optimizer.step = sharded_step
     return model, optimizer, scaler
@@ -82,6 +144,7 @@ class DygraphShardingOptimizer:
         else:
             self._inner = None
         self._hcg = hcg
+        self._placed = set()
 
     def __getattr__(self, item):
         return getattr(self._inner, item)
@@ -96,9 +159,4 @@ class DygraphShardingOptimizer:
             return
         for kind, store in self._inner._accumulators.items():
             for t in store.values():
-                v = t._value
-                if v is None or v.ndim == 0:
-                    continue
-                spec = _shard_spec(v.shape, deg)
-                if any(spec):
-                    t._value = _try_place(v, mesh, spec)
+                _place_once(t, mesh, deg, self._placed)

@@ -115,12 +115,32 @@ class TracedFunction:
         if entry is None:
             entry = {"calls": 0, "compiled": None, "record": None}
             self._entries[sig] = entry
+        if entry["compiled"] is None:
+            # Shape-polymorphic reuse: the compiled closure re-runs the
+            # capture under jax.jit, which re-specializes per shape on
+            # its own. A previous record with the same STRUCTURE (same
+            # pytree of args, different shapes/dtypes) discovered the
+            # same closure state, so new batch sizes skip the eager and
+            # record passes entirely — in particular, a large-batch step
+            # never executes eagerly (eager holds every intermediate
+            # live and OOMs long before the compiled program would).
+            donor = self._same_struct_compiled(sig, struct)
+            if donor is not None:
+                entry["compiled"] = donor
         if entry["compiled"] is not None:
             return self._run_compiled(entry, struct, leaves)
         entry["calls"] += 1
         if entry["calls"] <= self._warmup:
             return self._fn(*args, **kwargs)
         return self._record_and_compile(entry, args, kwargs, struct, leaves)
+
+    def _same_struct_compiled(self, sig, struct):
+        _, _, inst = sig
+        for (struct2, _, inst2), e2 in self._entries.items():
+            if struct2 == struct and inst2 == inst \
+                    and e2.get("compiled") is not None:
+                return e2["compiled"]
+        return None
 
     # -- phase 2: record ---------------------------------------------------
     def _record_and_compile(self, entry, args, kwargs, struct, leaves):

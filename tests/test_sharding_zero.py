@@ -1,0 +1,147 @@
+"""ZeRO sharding memory verification (VERDICT r1 item 6).
+
+Asserts per-device live-buffer sizes actually drop ~1/sharding_degree at
+each level ('os', 'os_g', 'p_g_os'), that stage-3 params remain usable
+eagerly (gather-on-use), and loss parity vs unsharded training.
+Reference: fleet/meta_optimizers/sharding_optimizer.py:43,118-138,
+distributed/sharding/group_sharded.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+DEG = 4
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "sharding_degree": DEG, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    yield
+    topology._HYBRID = None
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                         nn.Linear(128, 64), nn.ReLU(),
+                         nn.Linear(64, 8))
+
+
+def _per_device_bytes(t):
+    """Actual bytes held on ONE device for this tensor's array."""
+    v = t._value
+    return v.addressable_shards[0].data.nbytes
+
+
+def _train(model, opt, steps=4):
+    loss_fn = nn.CrossEntropyLoss()
+
+    @paddle.jit.to_static
+    def step(x, y):
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(16, 64).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 8, (16,)).astype("int64"))
+    return [float(step(x, y).numpy()) for _ in range(steps)]
+
+
+def _state_tensors(opt):
+    return [t for store in opt._accumulators.values()
+            for t in store.values() if t.aval_shape()]
+
+
+def _sharded_fraction(tensors):
+    """sum(per-device bytes) / sum(full bytes) over tensors with >=DEG
+    elements on their shardable dim."""
+    full = sum(t._value.nbytes for t in tensors)
+    per_dev = sum(_per_device_bytes(t) for t in tensors)
+    return per_dev / full
+
+
+def test_zero1_os_shards_optimizer_state():
+    model = _model()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="os")
+    losses = _train(model, opt)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    moments = _state_tensors(opt)
+    assert moments, "optimizer accumulated no state"
+    frac = _sharded_fraction(moments)
+    assert frac <= 1.2 / DEG, (
+        f"optimizer state not sharded: per-device fraction {frac:.3f}, "
+        f"expected ~{1 / DEG:.3f}")
+    # params stay replicated at ZeRO-1
+    p_frac = _sharded_fraction(list(model.parameters()))
+    assert p_frac > 0.9
+
+
+def test_zero2_os_g_shards_state_and_keeps_parity():
+    # parity: identical init/data, sharded vs unsharded
+    topology._HYBRID = None
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "sharding_degree": DEG, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+
+    base = _model()
+    base_opt = paddle.optimizer.Adam(1e-3, parameters=base.parameters())
+    base_losses = _train(base, base_opt)
+
+    model = _model()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="os_g")
+    losses = _train(model, opt)
+
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-4, atol=2e-5)
+    frac = _sharded_fraction(_state_tensors(opt))
+    assert frac <= 1.2 / DEG
+
+
+def test_zero3_p_g_os_shards_params_and_gathers_on_use():
+    model = _model()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+
+    # params are physically sharded immediately (before any step)
+    p_frac = _sharded_fraction(list(model.parameters()))
+    assert p_frac <= 1.2 / DEG, (
+        f"stage-3 params not sharded: per-device fraction {p_frac:.3f}")
+
+    losses = _train(model, opt)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    # still sharded after compiled steps (outputs keep the placement)
+    p_frac = _sharded_fraction(list(model.parameters()))
+    assert p_frac <= 1.2 / DEG
+    frac = _sharded_fraction(_state_tensors(opt))
+    assert frac <= 1.2 / DEG
+
+    # gather-on-use: eager forward on sharded params works and matches
+    # itself deterministically
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(4, 64).astype("float32"))
+    out1 = model(x).numpy()
+    out2 = model(x).numpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-6)
+    assert np.isfinite(out1).all()
+
+
+def test_invalid_level_rejected():
+    model = _model()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    with pytest.raises(ValueError):
+        group_sharded_parallel(model, opt, level="zero9")
