@@ -87,6 +87,26 @@ def _zero_ct(shape, dt):
 
 def _accumulate_into_leaf(tensor, grad_array, create_graph=False):
     from .tensor import Tensor
+    from .sparse_grad import IndexedSlices, SparseGradTensor
+    if isinstance(grad_array, IndexedSlices) and not create_graph:
+        if tensor._hooks:
+            # opaque hooks see dense tensors; correctness over sparsity
+            grad_array = grad_array.to_dense()
+        elif isinstance(tensor._grad, SparseGradTensor):
+            tensor._grad.accumulate(grad_array)
+            return
+        elif tensor._grad is None:
+            tensor._grad = SparseGradTensor(grad_array,
+                                            name=tensor.name + "@GRAD")
+            from . import trace as trace_mod
+            ctx = trace_mod.current_trace()
+            if ctx is not None:
+                ctx.register_created(tensor._grad)
+            return
+        else:  # existing dense grad: densify the slices into it
+            grad_array = grad_array.to_dense()
+    elif isinstance(grad_array, IndexedSlices):
+        grad_array = grad_array.to_dense()
     if create_graph:
         # grad_array is a live Tensor; keep its graph so grads of grads work
         g = grad_array
@@ -291,12 +311,17 @@ def _vjp_apply(node, ct_tensors):
 
 
 def _distribute(node, in_grads, create_graph=False):
+    from .sparse_grad import IndexedSlices
     # in_grads aligns with closure's positional arrays (= input_tensors slots)
     for t, g in zip(node.input_tensors, in_grads):
         if t is None or t.stop_gradient:
             continue
         if g is None or (hasattr(g, "dtype") and g.dtype == jax.dtypes.float0):
             continue
+        if isinstance(g, IndexedSlices) and t._grad_node is not None:
+            # non-leaf consumer: cotangent must be a dense array for the
+            # upstream vjp
+            g = g.to_dense()
         if t._grad_node is not None:
             pnode, pidx = t._grad_node
             if pnode.released:

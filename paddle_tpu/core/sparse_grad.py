@@ -1,0 +1,132 @@
+"""Sparse (row-slice) gradients for embedding tables.
+
+TPU-native equivalent of the reference's SelectedRows gradient
+representation (reference: paddle/fluid/framework/selected_rows.h:41,
+paddle/fluid/imperative/gradient_accumulator.cc SelectedRows paths,
+paddle/fluid/operators/optimizers/adam_op.h lazy_mode sparse update).
+
+Design: eager-mode embedding lookups with sparse=True produce an
+IndexedSlices gradient — {indices, values rows, full dense shape} — so a
+large-vocab table never materializes a [vocab, dim] dense gradient on the
+host-visible path. Accumulation merges slices; the optimizers' sparse
+paths update only the touched rows (scatter ops XLA executes in O(rows)).
+Inside a compiled (to_static) step the dense vjp path is used instead:
+XLA fuses the one-hot scatter-add and the update into the program, which
+is already the memory-optimal form under jit.
+
+A SparseGradTensor is a Tensor whose dense value materializes lazily: any
+consumer that reads `.value` (hooks, user numpy access, unaware
+optimizers) transparently gets the dense array; sparse-aware consumers
+check `.is_sparse()` first and read `.slices`.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+class IndexedSlices:
+    """Rows `values[k]` sit at row `indices[k]` of a dense tensor of shape
+    `full_shape`; unlisted rows are zero. Duplicate indices mean
+    sum-accumulation (same as SelectedRows)."""
+
+    __slots__ = ("indices", "values", "full_shape", "coalesced")
+
+    def __init__(self, indices, values, full_shape, coalesced=False):
+        self.indices = indices
+        self.values = values
+        self.full_shape = tuple(full_shape)
+        self.coalesced = coalesced
+
+    @property
+    def nbytes(self):
+        return self.values.nbytes + self.indices.nbytes
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merge(self, other):
+        """Concatenate slice sets (sum semantics via duplicate indices)."""
+        assert self.full_shape == other.full_shape
+        return IndexedSlices(
+            jnp.concatenate([self.indices, other.indices], axis=0),
+            jnp.concatenate([self.values, other.values], axis=0),
+            self.full_shape)
+
+    def coalesce(self):
+        """Sum duplicate rows -> unique, sorted indices (reference:
+        scatter::MergeAdd on SelectedRows). Eager-only (dynamic shape)."""
+        if self.coalesced:
+            return self
+        uniq, inv = jnp.unique(self.indices, return_inverse=True)
+        summed = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                     num_segments=int(uniq.shape[0]))
+        return IndexedSlices(uniq, summed, self.full_shape, coalesced=True)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.full_shape, self.values.dtype)
+        return dense.at[self.indices].add(self.values)
+
+    def scale(self, factor):
+        return IndexedSlices(self.indices, self.values * factor,
+                             self.full_shape, coalesced=self.coalesced)
+
+    def __repr__(self):
+        return (f"IndexedSlices(rows={int(self.indices.shape[0])}, "
+                f"full_shape={self.full_shape})")
+
+
+class SparseGradTensor(Tensor):
+    """Gradient tensor backed by IndexedSlices; densifies lazily on
+    `.value` access (paddle analogue: a Variable holding SelectedRows that
+    unaware ops see through a to-dense cast)."""
+
+    __slots__ = ("slices",)
+
+    def __init__(self, slices, name=None):
+        # _value stays None until someone asks for the dense view
+        super().__init__(jnp.zeros((), slices.values.dtype), name=name,
+                         stop_gradient=True)
+        self._value = None
+        self.slices = slices
+
+    def is_sparse(self):
+        return self._value is None and self.slices is not None
+
+    is_selected_rows = is_sparse
+
+    @property
+    def value(self):
+        if self._value is None and self.slices is not None:
+            self._value = self.slices.to_dense()
+        return Tensor.value.fget(self)
+
+    @value.setter
+    def value(self, v):
+        self.slices = None
+        Tensor.value.fset(self, v)
+
+    def aval_shape(self):
+        if self._value is None and self.slices is not None:
+            return self.slices.full_shape
+        return super().aval_shape()
+
+    @property
+    def dtype(self):
+        if self._value is None and self.slices is not None:
+            from . import dtype as dtype_mod
+            return dtype_mod.to_paddle_dtype(self.slices.values.dtype)
+        return Tensor.dtype.fget(self)
+
+    def accumulate(self, other):
+        """Sum-accumulate another gradient (IndexedSlices or dense array)
+        into this one, staying sparse when possible."""
+        if isinstance(other, IndexedSlices) and self.is_sparse():
+            self.slices = self.slices.merge(other)
+            return self
+        if isinstance(other, IndexedSlices):
+            other = other.to_dense()
+        self.value = self.value + other
+        return self

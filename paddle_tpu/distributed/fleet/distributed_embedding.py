@@ -24,14 +24,35 @@ from ...ops import nn_ops
 from .meta_parallel.mp_layers import shard_constraint
 
 
+def c_embedding(ids, local_weight, axis, start_index):
+    """SPMD vocab-parallel lookup INSIDE a shard_map manual region: each
+    chip holds rows [start_index, start_index + local_rows); out-of-range
+    ids contribute zero locally and the psum over `axis` assembles the
+    full rows — the explicit form of the PS 'pull' / reference
+    c_embedding op (operators/collective/c_embedding_op.cu). The backward
+    of this computation is the masked scatter-add, i.e. each chip
+    receives exactly its own rows' gradient (the PS 'push')."""
+    import jax
+    local_rows = local_weight.shape[0]
+    local_ids = ids - start_index
+    in_range = (local_ids >= 0) & (local_ids < local_rows)
+    safe = jnp.where(in_range, local_ids, 0)
+    rows = jnp.take(local_weight, safe, axis=0)
+    rows = jnp.where(in_range[..., None], rows, jnp.zeros_like(rows))
+    return jax.lax.psum(rows, axis)
+
+
 class DistributedEmbedding(Layer):
     """HBM-sharded embedding: rows sharded over the 'mp' axis (or a given
-    axis); gradient is a dense scatter-add XLA handles sharded."""
+    axis). Under GSPMD (to_static) the sharded gather emits the same
+    collectives automatically; `use_c_embedding` routes through the
+    explicit masked-lookup+psum primitive inside manual regions."""
 
     def __init__(self, num_embeddings, embedding_dim, axis="mp",
-                 weight_attr=None, name=None):
+                 weight_attr=None, sparse=False, name=None):
         super().__init__()
         self._axis = axis
+        self._sparse = bool(sparse)
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim),
             attr=init_mod.ParamAttr._to_attr(weight_attr),
@@ -40,7 +61,7 @@ class DistributedEmbedding(Layer):
 
     def forward(self, ids):
         w = shard_constraint(self.weight, self.weight.tp_spec)
-        return nn_ops.embedding(ids, w)
+        return nn_ops.embedding(ids, w, sparse=self._sparse)
 
 
 class HostEmbeddingTable:
@@ -83,11 +104,37 @@ class HostEmbeddingTable:
         else:
             np.subtract.at(self.table, ids_np, lr * g)
 
+    def push_sparse(self, slices, lr=0.01):
+        """Apply an IndexedSlices gradient (core/sparse_grad.py) directly
+        — the SelectedRows push the reference Communicator sends
+        (distributed/service/communicator.h:348). Duplicates are merged
+        first (reference scatter::MergeAdd) so adagrad scaling sees one
+        summed row per id."""
+        slices = slices.coalesce()
+        ids = np.asarray(slices.indices).reshape(-1)
+        g = np.asarray(slices.values).reshape(-1, self.embedding_dim)
+        self.push(ids, g, lr)
+
     def save(self, path):
-        np.save(path, self.table)
+        """Persist full server state (table + optimizer accumulators) —
+        reference: sparse table save/load
+        (distributed/table/common_sparse_table.h Save/Load)."""
+        state = {"table": self.table, "optimizer": self.optimizer}
+        if self._adagrad_acc is not None:
+            state["adagrad_acc"] = self._adagrad_acc
+        np.savez(path, **state)
 
     def load(self, path):
-        self.table = np.load(path)
+        import os
+        if not os.path.exists(path) and not str(path).endswith(".npz"):
+            path = str(path) + ".npz"
+        data = np.load(path, allow_pickle=False)
+        if hasattr(data, "files"):  # npz: full server state
+            self.table = data["table"]
+            if "adagrad_acc" in data.files:
+                self._adagrad_acc = data["adagrad_acc"]
+        else:  # legacy single-array .npy format
+            self.table = data
 
 
 class HostEmbedding(Layer):

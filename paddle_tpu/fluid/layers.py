@@ -170,10 +170,12 @@ def cast(x, dtype):
 def embedding(input, size, is_sparse=False, param_attr=None,
               dtype="float32", name=None):
     from ..nn.layer.common import Embedding
-    key = _reuse_key(name, ("embedding", int(size[0]), int(size[1])))
+    key = _reuse_key(name, ("embedding", int(size[0]), int(size[1]),
+                            bool(is_sparse)))
     layer = _layer_cache.get(key)
     if layer is None:
-        layer = Embedding(size[0], size[1], weight_attr=param_attr)
+        layer = Embedding(size[0], size[1], weight_attr=param_attr,
+                          sparse=is_sparse)
         _layer_cache[key] = layer
     return layer(input)
 

@@ -82,16 +82,47 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def _global_norm_sq(self, grads):
         return _global_norm_sq(*grads)
 
+    @staticmethod
+    def _is_sparse(g):
+        from ..core.sparse_grad import SparseGradTensor
+        return isinstance(g, SparseGradTensor) and g.is_sparse()
+
     def __call__(self, params_grads):
-        grads = [g for p, g in params_grads
-                 if g is not None and getattr(p, "need_clip", True)]
-        if not grads:
+        from ..core.sparse_grad import SparseGradTensor
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        dense = [g for p, g in clippable if not self._is_sparse(g)]
+        # coalesced copies (originals untouched — like the dense path,
+        # clipping returns NEW grads and leaves param.grad as-is)
+        sparse_co = {id(g): g.slices.coalesce()
+                     for p, g in clippable if self._is_sparse(g)}
+        if not dense and not sparse_co:
             return params_grads
-        norm_sq = self._global_norm_sq(grads)
+        # sparse grads join the global norm through their coalesced row
+        # values (zero rows contribute zero) without densifying
+        norm_sq = self._global_norm_sq(dense) if dense \
+            else Tensor(jnp.zeros((), jnp.float32))
+        if sparse_co:
+            total = norm_sq.value
+            for co in sparse_co.values():
+                total = total + jnp.sum(
+                    jnp.square(co.values.astype(jnp.float32)))
+            norm_sq = Tensor(total)
+        factor = None
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if self._is_sparse(g):
+                if factor is None:
+                    norm = jnp.sqrt(norm_sq.value)
+                    factor = self.clip_norm / jnp.maximum(norm,
+                                                          self.clip_norm)
+                co = sparse_co[id(g)]
+                out.append((p, SparseGradTensor(
+                    co.scale(factor.astype(co.values.dtype)),
+                    name=g.name)))
                 continue
             out.append((p, _apply_global_scale(g, norm_sq,
                                                clip_norm=self.clip_norm)))

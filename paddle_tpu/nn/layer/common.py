@@ -60,6 +60,7 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = bool(sparse)
         attr = init_mod.ParamAttr._to_attr(weight_attr)
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=attr,
@@ -72,7 +73,9 @@ class Embedding(Layer):
             self.weight.value = w.at[pi].set(jnp.zeros_like(w[pi]))
 
     def forward(self, x):
-        return nn_ops.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return nn_ops.embedding(x, self.weight,
+                                padding_idx=self._padding_idx,
+                                sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

@@ -638,13 +638,76 @@ def _embedding(ids, weight, *, padding_idx):
     return out
 
 
+class _SparseLookupOp:
+    """Op stand-in for the engine: backward emits an IndexedSlices grad
+    for the table instead of a dense [vocab, dim] scatter-add (reference:
+    lookup_table_v2_op grad with is_sparse=True -> SelectedRows,
+    selected_rows.h:41)."""
+
+    name = "lookup_table_v2_sparse"
+    differentiable = True
+
+    def __init__(self, padding_idx):
+        self._padding_idx = padding_idx
+
+    def vjp_fn(self, key, closure):
+        pi = self._padding_idx
+
+        def bwd(arrays, ct):
+            ids, weight = arrays
+            idx = ids.reshape(-1)
+            vals = ct.reshape(-1, weight.shape[-1])
+            if pi is not None and pi >= 0:
+                vals = jnp.where((idx != pi)[:, None], vals,
+                                 jnp.zeros_like(vals))
+            from ..core.sparse_grad import IndexedSlices
+            return (np.zeros(ids.shape, jax.dtypes.float0),
+                    IndexedSlices(idx, vals, weight.shape))
+        return bwd
+
+
+def _embedding_sparse_grad(x, weight, pi):
+    """Eager sparse-grad lookup: forward via the normal op with autograd
+    suppressed, then a hand-built grad node whose backward produces
+    IndexedSlices. create_graph falls back to the dense closure."""
+    from ..core.engine import GradNode
+    from ..core.dispatch import no_grad
+    from ..core.tensor import Tensor
+
+    with no_grad():
+        out = _embedding(x, weight, padding_idx=pi)
+    op = _SparseLookupOp(pi)
+    arrays = [x.value if isinstance(x, Tensor) else jnp.asarray(x),
+              weight.value]
+
+    def closure(ids, w):  # dense fallback for double-grad (_vjp_apply)
+        return _embedding.fn(ids, w, padding_idx=pi)
+
+    node = GradNode(op, ("lookup_table_v2_sparse", pi), closure, arrays,
+                    [None, weight], [(out.value.shape, out.value.dtype)])
+    out.stop_gradient = False
+    out._grad_node = (node, 0)
+    node.out_refs = [out]
+    return out
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Reference: operators/lookup_table_v2_op. `sparse` (SelectedRows grads)
-    is a no-op here: XLA handles scatter-add gradients densely and efficiently."""
+    """Reference: operators/lookup_table_v2_op. sparse=True produces
+    IndexedSlices gradients for the table in eager mode (reference
+    SelectedRows, selected_rows.h:41); under a compiled step the dense
+    vjp is used — XLA fuses the scatter-add into the program, which is
+    already the memory-optimal jit form."""
+    from ..core import trace as trace_mod
+    from ..core.dispatch import is_grad_enabled
     pi = -1 if padding_idx is None else int(padding_idx)
     if pi < 0 and padding_idx is not None:
         pi = weight.shape[0] + int(padding_idx)
-    return _embedding(x, weight, padding_idx=pi if padding_idx is not None else None)
+    pi = pi if padding_idx is not None else None
+    if (sparse and trace_mod.current_trace() is None and is_grad_enabled()
+            and hasattr(weight, "stop_gradient")
+            and not weight.stop_gradient):
+        return _embedding_sparse_grad(x, weight, pi)
+    return _embedding(x, weight, padding_idx=pi)
 
 
 @register_op("one_hot_v2", differentiable=False)

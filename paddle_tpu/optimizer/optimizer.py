@@ -93,8 +93,18 @@ class Optimizer:
                 (p, Tensor(g.value + coeff * jnp.sign(
                     p.value.astype(g.value.dtype))))
                 for p, g in params_grads]
+        from ..core.sparse_grad import SparseGradTensor
         for p, g in params_grads:
-            self._apply_one(p, g)
+            if isinstance(g, SparseGradTensor) and g.is_sparse():
+                # SelectedRows-equivalent path: update only touched rows
+                # (reference: optimizers/*_op.h SelectedRows kernels)
+                self._apply_sparse(p, g.slices.coalesce())
+            else:
+                self._apply_one(p, g)
+
+    def _apply_sparse(self, p, slices):
+        """Fallback for optimizers without a sparse kernel: densify."""
+        self._apply_one(p, Tensor(slices.to_dense()))
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

@@ -38,6 +38,76 @@ def _momentum(param, grad, velocity, lr, *, mu, wd, use_nesterov):
     return new_p.astype(param.dtype), v_new
 
 
+# ---- sparse (SelectedRows-equivalent) row updates --------------------------
+# Reference: operators/optimizers/sgd_op.h (SelectedRows branch),
+# momentum_op.h SparseMomentumFunctor, adam_op.h SparseAdamFunctor
+# (lazy_mode). Only the looked-up rows are read and written; XLA lowers
+# the gather/scatter pair to O(rows * dim) work.
+
+@register_op("sgd_sparse_update", differentiable=False)
+def _sgd_sparse(param, idx, vals, lr, *, wd):
+    p_rows = jnp.take(param, idx, axis=0).astype(jnp.float32)
+    g = vals.astype(jnp.float32)
+    if wd:
+        g = g + wd * p_rows
+    new_rows = p_rows - lr * g
+    return param.at[idx].set(new_rows.astype(param.dtype))
+
+
+@register_op("momentum_sparse_update", differentiable=False)
+def _momentum_sparse(param, idx, vals, velocity, lr, *, mu, wd,
+                     use_nesterov):
+    # dense-equivalent semantics (reference SparseMomentumFunctor treats
+    # rows absent from the grad as grad=0): velocity decays everywhere
+    # and untouched params keep moving — only the grad itself is sparse
+    p32 = param.astype(jnp.float32)
+    g = jnp.zeros_like(p32).at[idx].add(vals.astype(jnp.float32))
+    if wd:
+        g = g + wd * p32
+    v_new = mu * velocity + g
+    upd = g + mu * v_new if use_nesterov else v_new
+    return (p32 - lr * upd).astype(param.dtype), v_new
+
+
+@register_op("adam_sparse_update", differentiable=False)
+def _adam_sparse(param, idx, vals, m, v, beta1_pow, beta2_pow, lr, *,
+                 beta1, beta2, epsilon, wd, decoupled, lazy):
+    b1p = beta1_pow * beta1
+    b2p = beta2_pow * beta2
+    if lazy:
+        # lazy_mode (reference adam_op.h SparseAdamFunctor, lazy): ONLY
+        # looked-up rows of param/moments change — O(rows) work
+        g = vals.astype(jnp.float32)
+        p_rows = jnp.take(param, idx, axis=0).astype(jnp.float32)
+        if wd and not decoupled:
+            g = g + wd * p_rows
+        m_rows = beta1 * jnp.take(m, idx, axis=0) + (1.0 - beta1) * g
+        v_rows = beta2 * jnp.take(v, idx, axis=0) + (1.0 - beta2) * g * g
+        m_hat = m_rows / (1.0 - b1p)
+        v_hat = v_rows / (1.0 - b2p)
+        upd = lr * m_hat / (jnp.sqrt(v_hat) + epsilon)
+        if wd and decoupled:
+            upd = upd + lr * wd * p_rows
+        return (param.at[idx].set((p_rows - upd).astype(param.dtype)),
+                m.at[idx].set(m_rows), v.at[idx].set(v_rows), b1p, b2p)
+    # lazy_mode=False (default): dense-equivalent — absent rows see
+    # grad=0, so their moments decay and params keep moving, matching
+    # the dense trajectory exactly; the grad stays sparse (the scatter
+    # fuses into the elementwise chain, no dense grad is stored)
+    p32 = param.astype(jnp.float32)
+    g = jnp.zeros_like(p32).at[idx].add(vals.astype(jnp.float32))
+    if wd and not decoupled:
+        g = g + wd * p32
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    m_hat = m_new / (1.0 - b1p)
+    v_hat = v_new / (1.0 - b2p)
+    upd = m_hat / (jnp.sqrt(v_hat) + epsilon)
+    if wd and decoupled:
+        upd = upd + wd * p32
+    return ((p32 - lr * upd).astype(param.dtype), m_new, v_new, b1p, b2p)
+
+
 @register_op("adam_update", differentiable=False)
 def _adam(param, grad, m, v, beta1_pow, beta2_pow, lr, *,
           beta1, beta2, epsilon, wd, decoupled, lazy):
@@ -131,6 +201,11 @@ class SGD(Optimizer):
         new_p = _sgd(p, g, self._lr_tensor, wd=self._weight_decay)
         p.value = new_p.value
 
+    def _apply_sparse(self, p, slices):
+        new_p = _sgd_sparse(p, slices.indices, slices.values,
+                            self._lr_tensor, wd=self._weight_decay)
+        p.value = new_p.value
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -150,6 +225,16 @@ class Momentum(Optimizer):
         p.value = new_p.value
         vel.value = new_v.value
 
+    def _apply_sparse(self, p, slices):
+        vel = self._acc("velocity", p, shape=tuple(p.aval_shape()),
+                        dtype=jnp.float32)
+        new_p, new_v = _momentum_sparse(
+            p, slices.indices, slices.values, vel, self._lr_tensor,
+            mu=self._momentum, wd=self._weight_decay,
+            use_nesterov=self._use_nesterov)
+        p.value = new_p.value
+        vel.value = new_v.value
+
 
 class Adam(Optimizer):
     _decoupled = False
@@ -163,6 +248,7 @@ class Adam(Optimizer):
         self._beta1 = float(beta1)
         self._beta2 = float(beta2)
         self._epsilon = float(epsilon)
+        self._lazy_mode = bool(lazy_mode)
 
     def _apply_one(self, p, g):
         shape = tuple(p.aval_shape())
@@ -174,6 +260,25 @@ class Adam(Optimizer):
             p, g, m, v, b1p, b2p, self._lr_tensor,
             beta1=self._beta1, beta2=self._beta2, epsilon=self._epsilon,
             wd=self._weight_decay, decoupled=self._decoupled, lazy=False)
+        p.value = new_p.value
+        m.value = m_n.value
+        v.value = v_n.value
+        b1p.value = b1n.value
+        b2p.value = b2n.value
+
+    def _apply_sparse(self, p, slices):
+        """lazy_mode sparse Adam: only looked-up rows of param/moments are
+        updated (reference: adam_op.h SparseAdamFunctor, lazy_mode)."""
+        shape = tuple(p.aval_shape())
+        m = self._acc("moment1", p, shape=shape, dtype=jnp.float32)
+        v = self._acc("moment2", p, shape=shape, dtype=jnp.float32)
+        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        new_p, m_n, v_n, b1n, b2n = _adam_sparse(
+            p, slices.indices, slices.values, m, v, b1p, b2p,
+            self._lr_tensor, beta1=self._beta1, beta2=self._beta2,
+            epsilon=self._epsilon, wd=self._weight_decay,
+            decoupled=self._decoupled, lazy=self._lazy_mode)
         p.value = new_p.value
         m.value = m_n.value
         v.value = v_n.value
@@ -203,6 +308,16 @@ class AdamW(Adam):
             self._weight_decay = 0.0
         try:
             super()._apply_one(p, g)
+        finally:
+            self._weight_decay = wd_save
+
+    def _apply_sparse(self, p, slices):
+        wd_save = self._weight_decay
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            self._weight_decay = 0.0
+        try:
+            super()._apply_sparse(p, slices)
         finally:
             self._weight_decay = wd_save
 

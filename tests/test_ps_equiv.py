@@ -102,3 +102,55 @@ def test_host_table_save_load(tmp_path):
     t2 = HostEmbeddingTable(10, 3, seed=4)
     t2.load(path)
     np.testing.assert_array_equal(t.table, t2.table)
+
+
+def test_host_table_save_load_with_optimizer_state(tmp_path):
+    # full server state roundtrip (reference: common_sparse_table Save/Load)
+    t = HostEmbeddingTable(10, 3, seed=3, optimizer="adagrad")
+    t.push(np.array([2, 7]), np.ones((2, 3), np.float32), lr=0.5)
+    path = str(tmp_path / "server_state")
+    t.save(path)
+    t2 = HostEmbeddingTable(10, 3, seed=4, optimizer="adagrad")
+    t2.load(path)
+    np.testing.assert_array_equal(t.table, t2.table)
+    np.testing.assert_array_equal(t._adagrad_acc, t2._adagrad_acc)
+
+
+def test_host_table_push_sparse_indexed_slices():
+    from paddle_tpu.core.sparse_grad import IndexedSlices
+    t = HostEmbeddingTable(10, 3, seed=1)
+    before = t.table.copy()
+    sl = IndexedSlices(np.array([4, 4, 8]),
+                       np.ones((3, 3), np.float32), (10, 3))
+    t.push_sparse(sl, lr=1.0)
+    np.testing.assert_allclose(t.table[4], before[4] - 2.0)  # dup summed
+    np.testing.assert_allclose(t.table[8], before[8] - 1.0)
+    np.testing.assert_allclose(t.table[0], before[0])
+
+
+def test_c_embedding_manual_spmd_lookup():
+    # explicit masked-lookup + psum primitive under shard_map over 'mp'
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import topology
+    from paddle_tpu.distributed.fleet.distributed_embedding import c_embedding
+
+    hcg = topology.HybridCommunicateGroup(dp=2, mp=4)
+    mesh = hcg.mesh
+    vocab, dim, n = 16, 8, 4
+    rs = np.random.RandomState(0)
+    w = rs.randn(vocab, dim).astype(np.float32)
+    ids = rs.randint(0, vocab, (6,))
+
+    def fn(w_local, ids_rep):
+        rank = jax.lax.axis_index("mp")
+        start = rank * (vocab // n)
+        return c_embedding(ids_rep, w_local, "mp", start)
+
+    out = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P("mp", None), P()),
+        out_specs=P())(jnp.asarray(w), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(out), w[ids], rtol=1e-6)
+    topology._HYBRID = None

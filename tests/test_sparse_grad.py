@@ -1,0 +1,230 @@
+"""Sparse (IndexedSlices / SelectedRows-equivalent) embedding gradients.
+
+Reference: paddle/fluid/framework/selected_rows.h:41,
+imperative/gradient_accumulator.cc (SelectedRows sum),
+operators/optimizers/adam_op.h (SparseAdamFunctor lazy_mode),
+operators/optimizers/sgd_op.h (SelectedRows branch).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core.sparse_grad import IndexedSlices, SparseGradTensor
+
+
+def _ids(vals):
+    return paddle.to_tensor(np.asarray(vals, dtype="int64"))
+
+
+def test_sparse_embedding_grad_is_indexed_slices():
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    x = _ids([1, 3, 3, 7])
+    out = emb(x)
+    out.sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SparseGradTensor) and g.is_sparse()
+    assert g.slices.full_shape == (10, 4)
+    assert int(g.slices.indices.shape[0]) == 4
+    # dense equivalence
+    dense = np.asarray(g.slices.to_dense())
+    expect = np.zeros((10, 4), np.float32)
+    for i in [1, 3, 3, 7]:
+        expect[i] += 1.0
+    np.testing.assert_allclose(dense, expect, rtol=1e-6)
+    # .value access densifies transparently for unaware consumers
+    np.testing.assert_allclose(np.asarray(g.value), expect, rtol=1e-6)
+    assert not g.is_sparse()
+
+
+def test_sparse_grad_accumulates_sparsely():
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    for ids in ([0, 2], [2, 5]):
+        out = emb(_ids(ids))
+        out.sum().backward()  # two backwards accumulate into one grad
+    g = emb.weight.grad
+    assert g.is_sparse()
+    assert int(g.slices.indices.shape[0]) == 4  # merged, not densified
+    expect = np.zeros((10, 4), np.float32)
+    for i in [0, 2, 2, 5]:
+        expect[i] += 1.0
+    np.testing.assert_allclose(np.asarray(g.slices.to_dense()), expect,
+                               rtol=1e-6)
+
+
+def test_coalesce_sums_duplicates():
+    sl = IndexedSlices(np.asarray([3, 1, 3]),
+                       np.asarray([[1.0], [2.0], [10.0]], np.float32),
+                       (5, 1))
+    co = sl.coalesce()
+    np.testing.assert_array_equal(np.asarray(co.indices), [1, 3])
+    np.testing.assert_allclose(np.asarray(co.values), [[2.0], [11.0]])
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (paddle.optimizer.SGD, {}),
+    (paddle.optimizer.Momentum, {"momentum": 0.9}),
+    (paddle.optimizer.Adam, {}),
+    (paddle.optimizer.AdamW, {"weight_decay": 0.01}),
+])
+def test_sparse_step_matches_dense(opt_cls, kw):
+    # when every row is touched, lazy sparse updates == dense updates
+    def run(sparse):
+        paddle.seed(0)
+        emb = nn.Embedding(6, 4, sparse=sparse)
+        opt = opt_cls(0.1, parameters=emb.parameters(), **kw)
+        x = _ids([0, 1, 2, 3, 4, 5])
+        for _ in range(3):
+            loss = (emb(x) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight.value)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_clip_global_norm_matches_dense():
+    def run(sparse):
+        paddle.seed(0)
+        emb = nn.Embedding(6, 4, sparse=sparse)
+        fc = nn.Linear(4, 2)
+        params = emb.parameters() + fc.parameters()
+        opt = paddle.optimizer.SGD(
+            0.1, parameters=params,
+            grad_clip=nn.ClipGradByGlobalNorm(0.05))
+        x = _ids([1, 1, 4])
+        loss = (fc(emb(x)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        return np.asarray(emb.weight.value), np.asarray(fc.weight.value)
+
+    w_s, f_s = run(True)
+    w_d, f_d = run(False)
+    np.testing.assert_allclose(w_s, w_d, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(f_s, f_d, rtol=2e-5, atol=2e-6)
+
+
+def test_million_vocab_trains_without_dense_grad():
+    # VERDICT r1 item 5 "done" criterion: a 1M-vocab embedding trains
+    # without materializing a dense [1M, dim] gradient
+    vocab, dim = 1_000_000, 16
+    paddle.seed(0)
+    emb = nn.Embedding(vocab, dim, sparse=True)
+    opt = paddle.optimizer.Adam(0.01, parameters=emb.parameters())
+    x = _ids([5, 123456, 999999, 123456])
+    w_before = np.asarray(emb.weight.value[np.asarray([5, 0])])
+    loss = emb(x).sum()
+    loss.backward()
+    g = emb.weight.grad
+    assert g.is_sparse()
+    dense_bytes = vocab * dim * 4
+    assert g.slices.nbytes < dense_bytes / 1000, (
+        f"sparse grad holds {g.slices.nbytes}B — not sparse")
+    opt.step()
+    opt.clear_grad()
+    # the grad was consumed without ever densifying
+    assert g._value is None
+    w_after = np.asarray(emb.weight.value[np.asarray([5, 0])])
+    assert not np.allclose(w_after[0], w_before[0])  # touched row moved
+    np.testing.assert_allclose(w_after[1], w_before[1])  # untouched row
+    # moments exist but only touched rows are nonzero
+    m = next(iter(opt._accumulators["moment1"].values()))
+    m_rows = np.asarray(m.value[np.asarray([5, 0])])
+    assert np.abs(m_rows[0]).max() > 0
+    assert np.abs(m_rows[1]).max() == 0
+
+
+def test_sparse_embedding_in_to_static_falls_back_dense():
+    paddle.seed(0)
+    emb = nn.Embedding(8, 4, sparse=True)
+    opt = paddle.optimizer.SGD(0.1, parameters=emb.parameters())
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = (emb(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    x = _ids([1, 2, 3])
+    losses = [float(step(x).numpy()) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_padding_idx_rows_get_no_sparse_grad():
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True, padding_idx=2)
+    out = emb(_ids([1, 2, 2, 3]))
+    out.sum().backward()
+    dense = np.asarray(emb.weight.grad.slices.to_dense())
+    assert np.abs(dense[2]).max() == 0  # padding row untouched
+    assert np.abs(dense[1]).max() > 0
+
+
+def test_adam_nonlazy_matches_dense_on_partial_rows():
+    # default (lazy_mode=False): rows absent from the batch must follow
+    # the dense trajectory (moments decay, params keep moving)
+    def run(sparse):
+        paddle.seed(0)
+        emb = nn.Embedding(6, 4, sparse=sparse)
+        opt = paddle.optimizer.Adam(0.1, parameters=emb.parameters())
+        for ids in ([0, 1, 2], [3, 4], [0, 5]):  # different rows per step
+            loss = (emb(_ids(ids)) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight.value)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5, atol=2e-6)
+
+
+def test_adam_lazy_mode_only_touches_rows():
+    paddle.seed(0)
+    emb = nn.Embedding(6, 4, sparse=True)
+    opt = paddle.optimizer.Adam(0.1, parameters=emb.parameters(),
+                                lazy_mode=True)
+    # step 1 touches rows 0-2 so they accumulate moments
+    loss = (emb(_ids([0, 1, 2])) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    w1 = np.asarray(emb.weight.value)
+    # step 2 touches rows 3-4 only: rows 0-2 must NOT move (lazy)
+    loss = (emb(_ids([3, 4])) ** 2).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    w2 = np.asarray(emb.weight.value)
+    np.testing.assert_array_equal(w2[:3], w1[:3])
+    assert not np.allclose(w2[3:5], w1[3:5])
+
+
+def test_clip_does_not_mutate_sparse_param_grad():
+    paddle.seed(0)
+    emb = nn.Embedding(6, 4, sparse=True)
+    clip = nn.ClipGradByGlobalNorm(1e-3)
+    loss = (emb(_ids([1, 1, 2])) * 100.0).sum()
+    loss.backward()
+    g = emb.weight.grad
+    before = np.asarray(g.slices.to_dense())
+    out = clip([(emb.weight, g)])
+    # param.grad keeps the unclipped values (same contract as dense)
+    np.testing.assert_array_equal(np.asarray(g.slices.to_dense()), before)
+    clipped = out[0][1]
+    assert clipped is not g and clipped.is_sparse()
+    assert np.abs(np.asarray(clipped.slices.values)).sum() \
+        < np.abs(before).sum()
+
+
+def test_sparse_grad_dtype_accessor():
+    paddle.seed(0)
+    emb = nn.Embedding(6, 4, sparse=True)
+    emb(_ids([1])).sum().backward()
+    g = emb.weight.grad
+    assert g.is_sparse()
+    assert "float32" in str(g.dtype)
+    assert g.is_sparse()  # reading dtype must not densify
