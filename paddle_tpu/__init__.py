@@ -115,8 +115,8 @@ __version__ = "0.1.0"
 
 
 def disable_static(place=None):
-    """Dygraph is the default and only eager mode; kept for API parity."""
-    return None
+    from . import static as _static
+    _static._disable()
 
 
 def enable_static():

@@ -74,6 +74,12 @@ class enable_grad:
 _REGISTRY = {}
 _jit_cache = {}
 
+# set by paddle_tpu.static.program: the Variable class, plus an
+# is-anyone-building flag maintained by _set_building so the eager hot
+# path pays one boolean test, not a per-arg isinstance scan
+_static_variable_cls = None
+_static_active = False
+
 
 def get_op(name):
     return _REGISTRY[name]
@@ -110,6 +116,19 @@ class Op:
     def __call__(self, *args, **attrs):
         from .tensor import Tensor
         from .engine import GradNode
+
+        if _static_active \
+                and any(isinstance(a, _static_variable_cls) for a in args):
+            # static-graph building (paddle.enable_static): record the op
+            # into the current Program instead of executing (reference:
+            # framework.py append_op path of every layer/op helper)
+            from ..static.program import building_program
+            prog = building_program()
+            if prog is None:
+                raise RuntimeError(
+                    f"op {self.name!r} called on a static Variable outside "
+                    "a program_guard / enable_static context")
+            return prog.append_op(self, args, attrs)
 
         tensor_args = []   # Tensor (or None) owner per *array slot*
         arrays = []

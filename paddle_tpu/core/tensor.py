@@ -63,6 +63,13 @@ class Tensor:
 
     @value.setter
     def value(self, v):
+        from . import dispatch as _d
+        if _d._static_active and isinstance(v, _d._static_variable_cls):
+            # static building: `param.value = new_param.value` in an
+            # optimizer's _apply_one records an in-place write-back of
+            # the producing op's output onto this persistable tensor
+            v.program.mark_writeback(v, self)
+            return
         ctx = trace_mod.current_trace()
         if ctx is not None:
             ctx.write(self, v)

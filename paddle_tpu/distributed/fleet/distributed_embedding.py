@@ -131,8 +131,15 @@ class HostEmbeddingTable:
         data = np.load(path, allow_pickle=False)
         if hasattr(data, "files"):  # npz: full server state
             self.table = data["table"]
+            if "optimizer" in data.files:
+                self.optimizer = str(data["optimizer"])
             if "adagrad_acc" in data.files:
                 self._adagrad_acc = data["adagrad_acc"]
+            elif self.optimizer == "adagrad":
+                self._adagrad_acc = np.zeros(self.table.shape[0],
+                                             np.float32)
+            else:
+                self._adagrad_acc = None
         else:  # legacy single-array .npy format
             self.table = data
 

@@ -19,7 +19,17 @@ def _wrap_scalar(x, other):
     semantics: scalar adopts the tensor's dtype)."""
     if isinstance(x, Tensor):
         return x
-    dt = other.value.dtype if isinstance(other, Tensor) else None
+    from ..core import dispatch as _d
+    if _d._static_variable_cls is not None \
+            and isinstance(x, _d._static_variable_cls):
+        return x  # static program Variable: the op layer records it
+    if isinstance(other, Tensor):
+        dt = other.value.dtype
+    elif _d._static_variable_cls is not None \
+            and isinstance(other, _d._static_variable_cls):
+        dt = other._dtype
+    else:
+        dt = None
     arr = jnp.asarray(x, dtype=dt)
     return Tensor(arr)
 

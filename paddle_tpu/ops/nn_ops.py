@@ -698,15 +698,22 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     vjp is used — XLA fuses the scatter-add into the program, which is
     already the memory-optimal jit form."""
     from ..core import trace as trace_mod
+    from ..core import dispatch as _d
     from ..core.dispatch import is_grad_enabled
     pi = -1 if padding_idx is None else int(padding_idx)
     if pi < 0 and padding_idx is not None:
         pi = weight.shape[0] + int(padding_idx)
     pi = pi if padding_idx is not None else None
-    if (sparse and trace_mod.current_trace() is None and is_grad_enabled()
-            and hasattr(weight, "stop_gradient")
+    if (sparse and trace_mod.current_trace() is None
+            and is_grad_enabled() and hasattr(weight, "stop_gradient")
             and not weight.stop_gradient):
-        return _embedding_sparse_grad(x, weight, pi)
+        in_static = False
+        if _d._static_variable_cls is not None:
+            from ..static.program import building_program
+            in_static = building_program() is not None
+        if not in_static:
+            return _embedding_sparse_grad(x, weight, pi)
+    # static / traced: dense record path (XLA fuses the scatter-add)
     return _embedding(x, weight, padding_idx=pi)
 
 

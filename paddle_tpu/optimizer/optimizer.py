@@ -22,9 +22,13 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
         if parameters is None:
-            raise ValueError(
-                "parameters must be given in dygraph mode (pass "
-                "model.parameters())")
+            from ..static import _static_mode
+            if not _static_mode[0]:
+                raise ValueError(
+                    "parameters must be given in dygraph mode (pass "
+                    "model.parameters())")
+            # static mode: parameters come from the program at minimize()
+            parameters = []
         self._param_groups = list(parameters)
         self._grad_clip = grad_clip
         self._name = name
@@ -108,9 +112,32 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable
+        if isinstance(loss, Variable):
+            return self._minimize_static(loss, parameters)
         loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, loss, parameters=None):
+        """Static-graph minimize (reference: Optimizer.minimize building
+        grad + update ops into the program, optimizer.py:1037): appends
+        the gradient boundary, then records each update by running the
+        normal _apply_one under the program-building hooks (the op call
+        records, `p.value = new_p.value` records a write-back)."""
+        prog = loss.program
+        params = parameters
+        if params is None:
+            params = self._parameter_list() or [
+                t for t in prog.persist.values()
+                if getattr(t, "trainable", True) and not t.stop_gradient]
+        params_grads = prog.append_backward(loss, params)
+        if self._grad_clip is not None:
+            # clip ops record into the program like any others
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            self._apply_one(p, g)
+        return None, params_grads
 
     # -- state -------------------------------------------------------------
     def _acc(self, kind, param, init=None, shape=None, dtype=None):

@@ -1,34 +1,29 @@
-"""paddle.static equivalent (functional subset).
+"""paddle.static: real program-capture static graph mode.
 
-Reference parity: python/paddle/static/ (InputSpec, Program/Executor,
-program_guard). The reference's static graph is a ProgramDesc interpreted
-by the C++ Executor (executor.cc:166); the TPU-native equivalent of a
-static program is a traced-and-compiled XLA computation (jit.to_static).
-This module provides InputSpec plus a thin Program/Executor facade over
-the trace machinery so `paddle.static`-style code has a migration path;
-new code should use paddle_tpu.jit.to_static directly.
+Reference parity: python/paddle/static/ (Program/Executor/program_guard/
+data/append_backward, fluid/executor.py:916, fluid/backward.py:1377).
+See program.py for the TPU-native design: ops record into an editable
+op-list Program; Executor compiles the whole program as one XLA
+computation per feed signature.
 """
 from .input_spec import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Program, Variable, Executor, program_guard, append_backward,
+    building_program, _set_building)
 
 _static_mode = [False]
+_default_main = Program()
+_default_startup = Program()
 
 
 def _enable():
     _static_mode[0] = True
+    _set_building(_default_main)
 
 
-class Program:
-    """Facade: holds a python callable captured via to_static."""
-
-    def __init__(self, fn=None):
-        self.fn = fn
-
-    def clone(self, for_test=False):
-        return Program(self.fn)
-
-
-_default_main = Program()
-_default_startup = Program()
+def _disable():
+    _static_mode[0] = False
+    _set_building(None)
 
 
 def default_main_program():
@@ -39,39 +34,17 @@ def default_startup_program():
     return _default_startup
 
 
-class Executor:
-    """Facade over direct eager/compiled execution. `run(fn, feed, fetch)`
-    executes a python function (the 'program')."""
-
-    def __init__(self, place=None):
-        self.place = place
-
-    def run(self, program=None, feed=None, fetch_list=None):
-        if callable(program):
-            out = program(**(feed or {}))
-        elif isinstance(program, Program) and callable(program.fn):
-            out = program.fn(**(feed or {}))
-        else:
-            raise TypeError(
-                "paddle_tpu.static.Executor runs python callables; build "
-                "models with nn.Layer + jit.to_static instead of op-desc "
-                "programs")
-        return out if isinstance(out, (list, tuple)) else [out]
-
-
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """Reference: static.data — declares a feed Variable in the current
+    program (falls back to an InputSpec outside static mode, the
+    to_static-era behavior)."""
+    prog = building_program()
+    if prog is None:
+        return InputSpec(shape, dtype, name)
+    return prog.data(name, shape, dtype)
 
 
-class program_guard:
-    def __init__(self, main_program=None, startup_program=None):
-        pass
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False
+CompiledProgram = Program  # single-device alias; DP comes from fleet
 
 
 from ..amp import auto_cast as amp  # noqa: F401,E402

@@ -118,3 +118,31 @@ def case(pred_fn_pairs, default=None, name=None):
     pos = jnp.where(has_true, first, len(fns) - 1).astype(jnp.int32)
     out = jax.lax.switch(pos, fns)
     return _wrap_out(out)
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    """Reference: paddle.static.nn.fc. Every unnamed call creates fresh
+    parameters (reference: unique auto-generated param names per
+    append_op); a `name` reuses that layer's parameters WITHIN the same
+    program only (so separate Programs never share weights)."""
+    from ..nn.layer.common import Linear
+    from ..ops import nn_ops as _F
+    from .program import building_program
+    in_dim = int(x.shape[-1])
+    prog = building_program()
+    cache = prog._layer_cache if prog is not None else {}
+    key = ("fc", name, in_dim, int(size)) if name is not None else None
+    layer = cache.get(key) if key is not None else None
+    if layer is None:
+        layer = Linear(in_dim, int(size), weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+        if key is not None:
+            cache[key] = layer
+    out = layer(x)
+    if activation:
+        act = getattr(_F, activation, None)
+        if act is None:
+            raise ValueError(f"unknown activation {activation!r}")
+        out = act(out)
+    return out

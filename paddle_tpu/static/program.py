@@ -1,0 +1,495 @@
+"""Real static-graph programs: symbolic capture, append_backward, Executor.
+
+TPU-native replacement for the reference's ProgramDesc + C++ Executor
+static mode (reference: python/paddle/fluid/framework.py Program/Block/
+Variable, fluid/executor.py:916 Executor.run, fluid/backward.py:1377
+append_backward, paddle/fluid/framework/executor.cc:166).
+
+Design: under paddle.enable_static(), framework ops called on symbolic
+Variables APPEND an op record to the current Program instead of
+executing — the Program is a real, editable, introspectable op-list IR
+(global_block().ops, op.type/inputs/outputs/attrs). Parameters stay
+eagerly-initialized Tensors registered as persistable program inputs
+(the startup program's job is done at creation, so running the startup
+program is a no-op by construction). append_backward marks a gradient
+boundary; at execution it becomes jax.grad over the interpreted forward
+sub-program. Executor.run interprets the whole op list as ONE jax
+function and jit-compiles it per feed signature — the reference's
+op-by-op C++ interpreter becomes a single fused XLA program, which is
+the TPU-idiomatic execution of a static graph.
+"""
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import dtype as dtype_mod
+
+_state = threading.local()
+
+
+def _register_with_dispatch():
+    from ..core import dispatch
+    dispatch._static_variable_cls = Variable
+
+
+def building_program():
+    """The Program currently capturing ops, or None (eager)."""
+    return getattr(_state, "program", None)
+
+
+def _set_building(prog):
+    _state.program = prog
+    # flip the dispatcher's fast-path gate. NOTE: the gate is
+    # process-wide while the build state is thread-local: concurrent
+    # static building from multiple threads is not supported (same as
+    # the reference's global default-program state)
+    from ..core import dispatch
+    dispatch._static_active = prog is not None
+
+
+class Variable:
+    """Symbolic program variable (reference: framework.py Variable over
+    VarDesc). Holds metadata only; values exist at Executor.run time."""
+
+    __slots__ = ("name", "_shape", "_dtype", "program", "stop_gradient",
+                 "persistable")
+
+    def __init__(self, name, shape, dtype, program, stop_gradient=True):
+        self.name = name
+        self._shape = tuple(shape)
+        self._dtype = jnp.dtype(dtype)
+        self.program = program
+        self.stop_gradient = stop_gradient
+        self.persistable = False
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def aval_shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def dtype(self):
+        return dtype_mod.to_paddle_dtype(self._dtype)
+
+    @property
+    def value(self):
+        # static-apply recording (optimizer _apply_one reuse): reading a
+        # Variable's "value" during program building yields the Variable
+        # itself so `p.value = new_p.value` routes through the setter
+        if building_program() is not None:
+            return self
+        raise RuntimeError(
+            f"Variable {self.name!r} has no value outside Executor.run; "
+            "fetch it via fetch_list")
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} is symbolic; run the program and "
+            "fetch it to get values")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={list(self._shape)}, "
+                f"dtype={self._dtype.name})")
+
+    # arithmetic sugar routes through the regular op layer, which records
+    def _binop(self, other, fn, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return fn(a, b)
+
+    def __add__(self, o):
+        from ..ops import math
+        return self._binop(o, math.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        from ..ops import math
+        return self._binop(o, math.subtract)
+
+    def __rsub__(self, o):
+        from ..ops import math
+        return self._binop(o, math.subtract, reverse=True)
+
+    def __mul__(self, o):
+        from ..ops import math
+        return self._binop(o, math.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        from ..ops import math
+        return self._binop(o, math.divide)
+
+    def __rtruediv__(self, o):
+        from ..ops import math
+        return self._binop(o, math.divide, reverse=True)
+
+    def __pow__(self, o):
+        from ..ops import math
+        return self._binop(o, math.pow)
+
+    def __neg__(self):
+        from ..ops import math
+        return math.scale(self, -1.0)
+
+    def __matmul__(self, o):
+        from ..ops import math
+        return self._binop(o, math.matmul)
+
+
+class OpRecord:
+    """One recorded op (reference: OpDesc). in_refs entries are Variable
+    names (str), ("#const", array) or None; writebacks map output index ->
+    persistable Tensor updated in place by this op (optimizer updates)."""
+
+    __slots__ = ("op", "in_refs", "out_names", "attrs", "writebacks")
+
+    def __init__(self, op, in_refs, out_names, attrs):
+        self.op = op
+        self.in_refs = in_refs
+        self.out_names = out_names
+        self.attrs = attrs
+        self.writebacks = {}
+
+    @property
+    def type(self):
+        return self.op.name
+
+    def input_names(self):
+        return [r for r in self.in_refs if isinstance(r, str)]
+
+    def output_names(self):
+        return list(self.out_names)
+
+    def __repr__(self):
+        ins = [r if isinstance(r, str)
+               else ("<const>" if r is not None else "None")
+               for r in self.in_refs]
+        return f"{{{self.type}: ({', '.join(ins)}) -> {self.out_names}}}"
+
+
+class GradRecord:
+    """Gradient boundary (reference: the grad-op chain append_backward
+    inserts). At run time: jax.grad of the interpreted forward
+    sub-program wrt the listed persistable params."""
+
+    __slots__ = ("loss_name", "params", "grad_names", "upto")
+
+    type = "@grad"
+
+    def __init__(self, loss_name, params, grad_names, upto):
+        self.loss_name = loss_name
+        self.params = params  # list of persistable Tensors
+        self.grad_names = grad_names
+        self.upto = upto  # number of forward records to differentiate
+
+    def __repr__(self):
+        return (f"{{@grad: d{self.loss_name}/d["
+                f"{', '.join(p.name for p in self.params)}]}}")
+
+
+class Program:
+    """An editable op-list program (reference: framework.py Program;
+    single-block subset — control flow uses lax primitives inside ops)."""
+
+    def __init__(self):
+        self.ops = []
+        self.vars = {}
+        self.persist = {}    # name -> Tensor (parameters, optimizer state)
+        self.feed_names = []
+        self._counter = [0]
+        self._layer_cache = {}  # static.nn name -> layer (per program)
+        self.random_seed = None
+
+    # -- building ---------------------------------------------------------
+    def _new_name(self, hint):
+        self._counter[0] += 1
+        return f"{hint}.tmp_{self._counter[0]}"
+
+    def data(self, name, shape, dtype="float32"):
+        shape = [(-1 if s is None else int(s)) for s in shape]
+        v = Variable(name, shape, dtype_mod.to_jax_dtype(dtype), self)
+        self.vars[name] = v
+        if name not in self.feed_names:
+            self.feed_names.append(name)
+        return v
+
+    def register_persist(self, tensor):
+        if tensor.name not in self.persist:
+            self.persist[tensor.name] = tensor
+        return tensor.name
+
+    def append_op(self, op, args, attrs):
+        """Called from Op.__call__ when building: records instead of
+        executing; infers output shapes via jax.eval_shape."""
+        in_refs = []
+        avals = []
+        for a in args:
+            if isinstance(a, Variable):
+                in_refs.append(a.name)
+                shape = tuple(1 if s == -1 else s for s in a._shape)
+                avals.append(jax.ShapeDtypeStruct(shape, a._dtype))
+            elif isinstance(a, Tensor):
+                name = self.register_persist(a)
+                in_refs.append(name)
+                avals.append(jax.ShapeDtypeStruct(
+                    tuple(a.aval_shape()), a._value.dtype))
+            elif a is None:
+                in_refs.append(None)
+                avals.append(None)
+            else:
+                arr = a if isinstance(a, jax.Array) else jnp.asarray(a)
+                in_refs.append(("#const", arr))
+                avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+        def shape_fn(*arrs):
+            return op.fn(*arrs, **attrs)
+
+        zeros = [None if av is None else jnp.zeros(av.shape, av.dtype)
+                 for av in avals]
+        outs = jax.eval_shape(shape_fn, *zeros)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_vars = []
+        out_names = []
+        for o in out_list:
+            name = self._new_name(op.name)
+            v = Variable(name, o.shape, o.dtype, self, stop_gradient=False)
+            self.vars[name] = v
+            out_names.append(name)
+            out_vars.append(v)
+        self.ops.append(OpRecord(op, in_refs, out_names, dict(attrs)))
+        return tuple(out_vars) if multi else out_vars[0]
+
+    def mark_writeback(self, out_var, target_tensor):
+        """The most recent producer of out_var updates target_tensor in
+        place at run time (optimizer update semantics)."""
+        for rec in reversed(self.ops):
+            if isinstance(rec, OpRecord) and out_var.name in rec.out_names:
+                idx = rec.out_names.index(out_var.name)
+                rec.writebacks[idx] = target_tensor
+                self.register_persist(target_tensor)
+                return
+        raise ValueError(f"no producer for {out_var.name}")
+
+    def append_backward(self, loss, parameter_list=None):
+        """Reference: fluid/backward.py:1377. Returns [(param, grad_var)].
+        The gradient is taken of the forward sub-program recorded so far."""
+        if not isinstance(loss, Variable):
+            raise TypeError("append_backward needs a program Variable loss")
+        params = parameter_list
+        if params is None:
+            params = [t for t in self.persist.values()
+                      if getattr(t, "trainable", True)
+                      and not t.stop_gradient]
+        grad_names = []
+        for p in params:
+            gname = p.name + "@GRAD"
+            gv = Variable(gname, tuple(p.aval_shape()),
+                          p._value.dtype, self)
+            self.vars[gname] = gv
+            grad_names.append(gname)
+        self.ops.append(GradRecord(loss.name, list(params), grad_names,
+                                   len(self.ops)))
+        return [(p, self.vars[g]) for p, g in zip(params, grad_names)]
+
+    # -- introspection ----------------------------------------------------
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return list(self.persist.values())
+
+    def clone(self, for_test=False):
+        c = Program()
+        c.ops = list(self.ops)
+        c.vars = dict(self.vars)
+        c.persist = dict(self.persist)
+        c.feed_names = list(self.feed_names)
+        c._counter = self._counter
+        c._layer_cache = self._layer_cache
+        if for_test:
+            # Reference semantics (framework.py Program.clone): prune the
+            # backward + optimize sub-graph — everything from the first
+            # gradient boundary on — and strip state write-backs (e.g.
+            # BatchNorm running stats) while KEEPING those forward ops'
+            # outputs for downstream consumers.
+            fwd = []
+            for r in c.ops:
+                if isinstance(r, GradRecord):
+                    break
+                if r.writebacks:
+                    r2 = OpRecord(r.op, r.in_refs, r.out_names, r.attrs)
+                    fwd.append(r2)
+                else:
+                    fwd.append(r)
+            c.ops = fwd
+        return c
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = [f"Program(ops={len(self.ops)}, "
+                 f"feeds={self.feed_names}, "
+                 f"persist={list(self.persist)})"]
+        lines += [f"  {rec!r}" for rec in self.ops]
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def _version(self):
+        """Content-sensitive fingerprint so Executor caches survive only
+        while the (editable) op list is truly unchanged: op identities
+        catch append/delete/replace, attr reprs catch in-place edits."""
+        return hash((tuple(id(r) for r in self.ops),
+                     tuple(repr(getattr(r, "attrs", None))
+                           for r in self.ops)))
+
+
+class program_guard:
+    """Reference: static.program_guard — redirects building to the given
+    programs."""
+
+    def __init__(self, main_program=None, startup_program=None):
+        self.main = main_program if main_program is not None else Program()
+        self.startup = startup_program
+
+    def __enter__(self):
+        self._saved = building_program()
+        _set_building(self.main)
+        return self
+
+    def __exit__(self, *exc):
+        _set_building(self._saved)
+        return False
+
+
+def _interpret(records, env, persist_written):
+    """Execute op records over an env of name -> array."""
+    for rec in records:
+        if isinstance(rec, GradRecord):
+            pnames = [p.name for p in rec.params]
+
+            def fwd(pvals):
+                env2 = dict(env)
+                env2.update(zip(pnames, pvals))
+                _run_forward(rec_slice(records, rec), env2)
+                return env2[rec.loss_name]
+
+            grads = jax.grad(fwd)([env[n] for n in pnames])
+            env.update(zip(rec.grad_names, grads))
+            continue
+        ins = []
+        for r in rec.in_refs:
+            if r is None:
+                ins.append(None)
+            elif isinstance(r, str):
+                ins.append(env[r])
+            else:
+                ins.append(r[1])
+        outs = rec.op.fn(*ins, **rec.attrs)
+        out_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        for name, o in zip(rec.out_names, out_list):
+            env[name] = o
+        for idx, target in rec.writebacks.items():
+            env[target.name] = out_list[idx]
+            persist_written.add(target.name)
+
+
+def rec_slice(records, grad_rec):
+    return records[:grad_rec.upto]
+
+
+def _run_forward(records, env):
+    sink = set()
+    _interpret([r for r in records if isinstance(r, OpRecord)], env, sink)
+
+
+class Executor:
+    """Reference: fluid/executor.py:916. run() interprets the program as
+    one jax function, jit-compiled per feed signature; persistable state
+    (params, optimizer moments) is threaded through and written back, so
+    consecutive run() calls train."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        from . import _default_startup
+        feed = feed or {}
+        # legacy paths: python callables and the facade startup program
+        if callable(program):
+            out = program(**feed)
+            return out if isinstance(out, (list, tuple)) else [out]
+        if program is None or getattr(program, "ops", None) is None \
+                or (isinstance(program, Program) and not program.ops):
+            return []  # startup: params are initialized eagerly already
+        if not isinstance(program, Program):
+            raise TypeError(f"cannot run {type(program).__name__}")
+
+        fetch_list = fetch_list or []
+        fetch_names = [f.name if isinstance(f, Variable) else str(f)
+                       for f in fetch_list]
+        feed_arrays = {}
+        for name, val in feed.items():
+            if isinstance(val, Tensor):
+                val = val.value
+            feed_arrays[name] = jnp.asarray(val)
+        # the Program object itself keys the cache (identity hash) — and
+        # the strong reference pins it, so a GC'd program's id can never
+        # alias a new one; _version() invalidates on edits
+        sig = (program, program._version(),
+               tuple(sorted((n, tuple(a.shape), str(a.dtype))
+                            for n, a in feed_arrays.items())),
+               tuple(fetch_names))
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            compiled = self._compile(program, fetch_names)
+            self._cache[sig] = compiled
+        persist_names, jitted = compiled
+        persist_vals = [program.persist[n]._value for n in persist_names]
+        fetches, new_persist = jitted(feed_arrays, persist_vals)
+        for n, v in zip(persist_names, new_persist):
+            program.persist[n]._value = v
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def _compile(self, program, fetch_names):
+        records = list(program.ops)
+        persist_names = list(program.persist)
+
+        def run_fn(feed_arrays, persist_vals):
+            env = dict(feed_arrays)
+            env.update(zip(persist_names, persist_vals))
+            sink = set()
+            _interpret(records, env, sink)
+            return ([env[n] for n in fetch_names],
+                    [env[n] for n in persist_names])
+
+        return persist_names, jax.jit(run_fn)
+
+    def close(self):
+        self._cache.clear()
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Module-level API (reference: paddle.static.append_backward)."""
+    prog = loss.program if isinstance(loss, Variable) \
+        else building_program()
+    if prog is None:
+        raise RuntimeError("append_backward requires static mode")
+    return prog.append_backward(loss, parameter_list)
+
+
+_register_with_dispatch()

@@ -154,3 +154,14 @@ def test_c_embedding_manual_spmd_lookup():
         out_specs=P())(jnp.asarray(w), jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(out), w[ids], rtol=1e-6)
     topology._HYBRID = None
+
+
+def test_host_table_load_restores_optimizer_kind(tmp_path):
+    t = HostEmbeddingTable(10, 3, seed=3, optimizer="adagrad")
+    t.push(np.array([2]), np.ones((1, 3), np.float32), lr=0.5)
+    path = str(tmp_path / "state2")
+    t.save(path)
+    t2 = HostEmbeddingTable(10, 3, seed=4, optimizer="sgd")
+    t2.load(path)
+    assert t2.optimizer == "adagrad"
+    np.testing.assert_array_equal(t._adagrad_acc, t2._adagrad_acc)

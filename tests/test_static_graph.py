@@ -1,0 +1,237 @@
+"""Real paddle.static program capture + Executor (VERDICT r1 item 10).
+
+Reference: fluid/executor.py:916 (Executor.run), fluid/backward.py:1377
+(append_backward), framework.py Program/Variable. Book-style flows:
+declare data -> build ops on Variables -> minimize -> exe.run(feed,
+fetch_list) in a loop.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import static
+
+
+@pytest.fixture()
+def static_mode():
+    paddle.enable_static()
+    prog = static.Program()
+    guard = static.program_guard(prog)
+    guard.__enter__()
+    yield prog
+    guard.__exit__()
+    paddle.disable_static()
+
+
+def test_static_linear_regression_trains(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 13], "float32")
+    y = static.data("y", [None, 1], "float32")
+    pred = static.nn.fc(x, 1, name="lr_fc")
+    import paddle_tpu as M
+    loss = M.mean(M.square(pred - y))
+    opt = paddle.optimizer.SGD(learning_rate=0.05)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())  # params already init'd
+
+    rs = np.random.RandomState(0)
+    w_true = rs.randn(13, 1).astype("float32")
+    losses = []
+    for _ in range(30):
+        xb = rs.randn(32, 13).astype("float32")
+        yb = xb @ w_true
+        out, = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(out))
+    assert losses[-1] < losses[0] * 0.5, f"did not train: {losses[::10]}"
+
+
+def test_static_mlp_adam_and_intermediate_fetch(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 8], "float32")
+    y = static.data("y", [None], "int64")
+    h = static.nn.fc(x, 16, activation="relu", name="h")
+    logits = static.nn.fc(h, 4, name="out")
+    from paddle_tpu.ops import nn_ops
+    loss = nn_ops.cross_entropy(logits, y)
+    import paddle_tpu as M
+    loss = M.mean(loss)
+    opt = paddle.optimizer.Adam(learning_rate=0.05)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    rs = np.random.RandomState(1)
+    xb = rs.randn(16, 8).astype("float32")
+    yb = rs.randint(0, 4, (16,)).astype("int64")
+    losses = []
+    for _ in range(10):
+        lv, hv = exe.run(prog, feed={"x": xb, "y": yb},
+                         fetch_list=[loss, h])
+        losses.append(float(lv))
+    assert hv.shape == (16, 16)
+    assert losses[-1] < losses[0]
+
+
+def test_program_is_introspectable_and_editable(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 4], "float32")
+    import paddle_tpu as M
+    a = M.scale(x, 2.0)
+    b = M.add(a, a)
+    ops = prog.global_block().ops
+    assert len(ops) == 2
+    assert ops[0].type == "scale"
+    assert a.name in ops[0].output_names()
+    assert "x" in ops[0].input_names()
+    s = prog.to_string()
+    assert "scale" in s and "elementwise_add" in s or "add" in s
+    # editable: drop the second op and run just the first
+    del prog.ops[1]
+    exe = static.Executor()
+    out, = exe.run(prog, feed={"x": np.ones((2, 4), np.float32)},
+                   fetch_list=[a])
+    np.testing.assert_allclose(out, 2 * np.ones((2, 4)), rtol=1e-6)
+
+
+def test_append_backward_explicit(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 3], "float32")
+    import paddle_tpu as M
+    w = nn.Linear(3, 1)
+    loss = M.mean(w(x))
+    pg = static.append_backward(loss)
+    assert len(pg) == 2  # weight + bias
+    names = [g.name for _, g in pg]
+    assert all(n.endswith("@GRAD") for n in names)
+    exe = static.Executor()
+    outs = exe.run(prog, feed={"x": np.ones((4, 3), np.float32)},
+                   fetch_list=[g for _, g in pg])
+    # d(mean(xW+b))/dW = mean of x rows = ones/1 ... shape checks + values
+    np.testing.assert_allclose(outs[0], np.full((3, 1), 1.0), rtol=1e-5)
+    np.testing.assert_allclose(outs[1], [1.0], rtol=1e-5)
+
+
+def test_clone_for_test_drops_updates(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 2], "float32")
+    pred = static.nn.fc(x, 1, name="c")
+    import paddle_tpu as M
+    loss = M.mean(M.square(pred))
+    test_prog = prog.clone(for_test=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    assert any(isinstance(r, static.program.GradRecord)
+               if hasattr(static, "program") else False
+               for r in prog.ops) or len(prog.ops) > len(test_prog.ops)
+    exe = static.Executor()
+    xb = np.ones((4, 2), np.float32)
+    before, = exe.run(test_prog, feed={"x": xb}, fetch_list=[pred])
+    again, = exe.run(test_prog, feed={"x": xb}, fetch_list=[pred])
+    np.testing.assert_allclose(before, again)  # eval program: no updates
+    # but the train program updates params
+    l1, = exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+    l2, = exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+    assert float(l2) < float(l1)
+
+
+def test_static_grad_clip_records(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 4], "float32")
+    pred = static.nn.fc(x, 1, name="clip_fc")
+    import paddle_tpu as M
+    loss = M.mean(M.square(pred))
+    opt = paddle.optimizer.SGD(
+        learning_rate=0.1, grad_clip=nn.ClipGradByGlobalNorm(0.01))
+    opt.minimize(loss)
+    exe = static.Executor()
+    xb = np.full((4, 4), 10.0, np.float32)
+    l1, = exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+    l2, = exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+    assert float(l2) < float(l1)
+    # clipped update must move slowly: loss drop bounded
+    assert float(l2) > 0.5 * float(l1)
+
+
+def test_eager_unaffected_after_static_session():
+    paddle.enable_static()
+    paddle.disable_static()
+    t = paddle.to_tensor(np.ones((2, 2), np.float32))
+    out = (t * 3).numpy()
+    np.testing.assert_allclose(out, 3 * np.ones((2, 2)))
+
+
+def test_static_sparse_embedding_records_dense(static_mode):
+    prog = static_mode
+    ids = static.data("ids", [None, 4], "int64")
+    emb = nn.Embedding(10, 4, sparse=True)  # sparse path must defer
+    out = emb(ids)
+    import paddle_tpu as M
+    loss = M.mean(M.square(out))
+    opt = paddle.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+    exe = static.Executor()
+    xb = np.random.RandomState(0).randint(0, 10, (8, 4)).astype("int64")
+    l1, = exe.run(prog, feed={"ids": xb}, fetch_list=[loss])
+    l2, = exe.run(prog, feed={"ids": xb}, fetch_list=[loss])
+    assert float(l2) < float(l1)
+
+
+def test_unnamed_fc_creates_fresh_params(static_mode):
+    prog = static_mode
+    x = static.data("x", [None, 8], "float32")
+    h1 = static.nn.fc(x, 8)
+    h2 = static.nn.fc(h1, 8)  # same (in,out) dims, must NOT share weights
+    assert len(prog.persist) == 4  # two weights + two biases
+
+
+def test_named_fc_not_shared_across_programs():
+    paddle.enable_static()
+    try:
+        p1, p2 = static.Program(), static.Program()
+        with static.program_guard(p1):
+            x = static.data("x", [None, 3], "float32")
+            static.nn.fc(x, 1, name="shared")
+        with static.program_guard(p2):
+            x = static.data("x", [None, 3], "float32")
+            static.nn.fc(x, 1, name="shared")
+        assert not (set(id(t) for t in p1.persist.values())
+                    & set(id(t) for t in p2.persist.values()))
+    finally:
+        paddle.disable_static()
+
+
+def test_clone_for_test_keeps_writeback_op_outputs(static_mode):
+    # BatchNorm-style: an op output is both written back to state AND
+    # consumed downstream; clone(for_test) must keep the op
+    prog = static_mode
+    import paddle_tpu as M
+    from paddle_tpu.core.tensor import Tensor
+    x = static.data("x", [None, 4], "float32")
+    stat = Tensor(np.zeros((), np.float32), name="running_stat",
+                  persistable=True)
+    m = M.mean(x)
+    stat.value = m.value  # records the write-back
+    out = x - m
+    test_prog = prog.clone(for_test=True)
+    exe = static.Executor()
+    xb = np.ones((2, 4), np.float32)
+    o, = exe.run(test_prog, feed={"x": xb}, fetch_list=[out])
+    np.testing.assert_allclose(o, np.zeros((2, 4)), atol=1e-6)
+    # and the eval run did NOT advance the stat
+    np.testing.assert_allclose(np.asarray(stat.value), 0.0)
+
+
+def test_executor_cache_invalidated_on_attr_edit(static_mode):
+    prog = static_mode
+    import paddle_tpu as M
+    x = static.data("x", [None, 2], "float32")
+    a = M.scale(x, 2.0)
+    exe = static.Executor()
+    xb = np.ones((1, 2), np.float32)
+    o1, = exe.run(prog, feed={"x": xb}, fetch_list=[a])
+    prog.ops[0].attrs["scale"] = 5.0  # in-place edit of the IR
+    o2, = exe.run(prog, feed={"x": xb}, fetch_list=[a])
+    np.testing.assert_allclose(o1, 2.0 * xb)
+    np.testing.assert_allclose(o2, 5.0 * xb)
