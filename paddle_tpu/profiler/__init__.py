@@ -37,26 +37,108 @@ class RecordEvent:
         self.__exit__()
 
 
+class ProfilerState:
+    """Reference: paddle.profiler.ProfilerState."""
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget:
+    """Reference: paddle.profiler.ProfilerTarget (CPU/GPU); device
+    timelines here come from the XPlane capture, which covers both."""
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+def make_scheduler(closed=0, ready=0, record=1000000, repeat=0,
+                   skip_first=0):
+    """Reference: paddle.profiler.make_scheduler — step-state schedule
+    [skip_first][closed][ready][record]... repeated."""
+    period = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat > 0 and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """Reference: paddle.profiler.export_chrome_tracing. The XPlane
+    capture already contains a Perfetto/chrome-compatible trace; this
+    callback surfaces where it landed."""
+    def on_ready(prof):
+        prof.log_dir = dir_name
+        return dir_name
+    return on_ready
+
+
 class Profiler:
-    """paddle.profiler.Profiler-style API over jax.profiler traces."""
+    """paddle.profiler.Profiler-style API over jax.profiler traces
+    (reference: python/paddle/profiler/profiler.py). start/stop (or the
+    scheduler) capture an XPlane trace under log_dir — the TPU-native
+    analogue of the reference's CUPTI DeviceTracer timeline
+    (platform/device_tracer.h:43) — viewable in TensorBoard/Perfetto."""
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  log_dir="./profiler_log", timer_only=False):
         self.log_dir = log_dir
         self.timer_only = timer_only
+        if isinstance(scheduler, tuple):
+            start, stop = scheduler
+            scheduler = make_scheduler(closed=start, ready=0,
+                                       record=stop - start, repeat=1)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
         self._started = False
+        self._tracing = False
+        self._step_num = 0
         self._step_times = []
         self._t0 = None
 
-    def start(self):
-        if not self.timer_only:
+    def _state(self):
+        if self.scheduler is None:
+            return ProfilerState.RECORD
+        return self.scheduler(self._step_num)
+
+    def _sync_trace(self):
+        want = (not self.timer_only
+                and self._state() in (ProfilerState.RECORD,
+                                      ProfilerState.RECORD_AND_RETURN))
+        if want and not self._tracing:
             jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+        elif not want and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
+
+    def start(self):
         self._started = True
+        self._sync_trace()
         self._t0 = time.perf_counter()
 
     def stop(self):
-        if self._started and not self.timer_only:
+        if self._tracing:
             jax.profiler.stop_trace()
+            self._tracing = False
+            if self.on_trace_ready is not None:
+                self.on_trace_ready(self)
         self._started = False
 
     def step(self):
@@ -64,6 +146,9 @@ class Profiler:
         if self._t0 is not None:
             self._step_times.append(now - self._t0)
         self._t0 = now
+        self._step_num += 1
+        if self._started:
+            self._sync_trace()
 
     def step_info(self, unit=None):
         if not self._step_times:

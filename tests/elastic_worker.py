@@ -1,0 +1,66 @@
+"""Elastic-training worker used by test_elastic_rejoin (run as a
+subprocess). Trains a tiny model, checkpoints every step, resumes from
+the latest checkpoint on (re)start, heartbeats into the elastic store.
+
+Reference flow: fleet/elastic.py worker + incubate auto-checkpoint
+(fluid/incubate/checkpoint/auto_checkpoint.py TrainEpochRange).
+"""
+import json
+import os
+import sys
+import time
+
+
+def main():
+    rank, ckpt_dir, store_root, total, log_path = sys.argv[1:6]
+    total = int(total)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      FileStore)
+
+    paddle.set_flags({"FLAGS_compilation_cache_dir": ""})
+    em = ElasticManager(node_id=f"w{rank}",
+                        store=FileStore(store_root, ttl=1.5),
+                        heartbeat_interval=0.3)
+    em.start()
+
+    def log(payload):
+        with open(log_path, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    start_step = 0
+    ck = os.path.join(ckpt_dir, f"w{rank}.ckpt")
+    if os.path.exists(ck):
+        state = paddle.load(ck)
+        model.set_state_dict(state["model"])
+        start_step = int(state["step"])
+    log({"event": "start", "rank": rank, "resumed_from": start_step})
+
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    for step in range(start_step, total):
+        loss = ((model(x) - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        paddle.save({"model": model.state_dict(), "step": step + 1}, ck)
+        log({"event": "step", "rank": rank, "step": step + 1,
+             "loss": float(loss.numpy())})
+        time.sleep(0.25)
+    log({"event": "done", "rank": rank})
+    em.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -164,3 +164,98 @@ def test_hdfs_client_gated():
         pytest.skip("hadoop present")
     with pytest.raises(ExecuteError):
         HDFSClient()
+
+
+def test_elastic_kill_relaunch_resume(tmp_path):
+    """VERDICT r1 item 8: launch 2 workers, kill one, the manager
+    detects the death (check_procs + heartbeat expiry), relaunches it,
+    and training resumes from the checkpoint instead of restarting.
+    Reference: fleet/elastic.py:101,173-206."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time as _t
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      FileStore)
+
+    ckpt = tmp_path / "ckpt"
+    store_root = str(tmp_path / "store")
+    ckpt.mkdir()
+    logs = {r: str(tmp_path / f"w{r}.log") for r in (0, 1)}
+    total = 8
+    worker = os.path.join(os.path.dirname(__file__), "elastic_worker.py")
+
+    def read_log(rank):
+        try:
+            with open(logs[rank]) as f:
+                return [json.loads(ln) for ln in f if ln.strip()]
+        except FileNotFoundError:
+            return []
+
+    def cmd(rank):
+        return [sys.executable, worker, str(rank), str(ckpt), store_root,
+                str(total), logs[rank]]
+
+    mgr = ElasticManager(node_id="supervisor",
+                         store=FileStore(store_root, ttl=1.5),
+                         heartbeat_interval=0.3)
+    p0 = mgr.launch(cmd(0))
+    p1 = mgr.launch(cmd(1))
+    try:
+        # wait until worker 1 has made real progress
+        deadline = _t.time() + 120
+        while _t.time() < deadline:
+            steps = [e["step"] for e in read_log(1) if e["event"] == "step"]
+            if steps and steps[-1] >= 3:
+                break
+            _t.sleep(0.3)
+        else:
+            raise AssertionError(f"worker1 made no progress: {read_log(1)}")
+
+        p1.send_signal(signal.SIGKILL)  # simulate node failure
+        p1.wait(timeout=30)
+
+        # supervisor notices the dead child...
+        done, failed = mgr.check_procs()
+        assert failed and failed[0][0] == p1.pid
+        # ...and the heartbeat registry drops the node after ttl
+        deadline = _t.time() + 30
+        while _t.time() < deadline:
+            if "w1" not in mgr.store.alive_nodes():
+                break
+            _t.sleep(0.3)
+        assert "w1" not in mgr.store.alive_nodes()
+
+        # relaunch the failed worker: it must RESUME, not restart
+        p1b = mgr.launch(cmd(1))
+        deadline = _t.time() + 180
+        while _t.time() < deadline:
+            if any(e["event"] == "done" for e in read_log(1)):
+                break
+            _t.sleep(0.5)
+        events = read_log(1)
+        assert any(e["event"] == "done" for e in events), events[-3:]
+        starts = [e for e in events if e["event"] == "start"]
+        assert len(starts) == 2
+        assert starts[0]["resumed_from"] == 0
+        assert starts[1]["resumed_from"] >= 3, starts
+        steps = [e["step"] for e in events if e["event"] == "step"]
+        assert steps[-1] == total
+        # no step ran twice after the resume point
+        resumed = starts[1]["resumed_from"]
+        post = steps[steps.index(resumed + 1):]
+        assert post == list(range(resumed + 1, total + 1))
+
+        # worker 0 was never disturbed and finishes too
+        deadline = _t.time() + 180
+        while _t.time() < deadline:
+            if any(e["event"] == "done" for e in read_log(0)):
+                break
+            _t.sleep(0.5)
+        assert any(e["event"] == "done" for e in read_log(0))
+        p0.wait(timeout=30)
+        p1b.wait(timeout=30)
+    finally:
+        mgr.kill_children()
+        mgr.stop()

@@ -1,0 +1,84 @@
+"""Profiler: XPlane trace artifacts + RecordEvent scopes in XLA metadata
+(VERDICT r1 item 9).
+
+Reference: platform/profiler.h:127 (RecordEvent), :213 (EnableProfiler),
+platform/device_tracer.h:43 (CUPTI timeline), tools/timeline.py.
+TPU-native: jax.profiler XPlane capture + named_scope op metadata.
+"""
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler as prof_mod
+
+
+def _xplane_files(log_dir):
+    return glob.glob(os.path.join(log_dir, "plugins", "profile", "*",
+                                  "*.xplane.pb"))
+
+
+def test_profiler_produces_xplane_trace(tmp_path):
+    log_dir = str(tmp_path / "trace")
+    p = prof_mod.Profiler(log_dir=log_dir)
+    p.start()
+    x = paddle.to_tensor(np.random.randn(64, 64).astype("float32"))
+    for _ in range(3):
+        y = paddle.matmul(x, x)
+        p.step()
+    float(y.numpy().sum())
+    p.stop()
+    files = _xplane_files(log_dir)
+    assert files, f"no XPlane trace produced under {log_dir}"
+    assert os.path.getsize(files[0]) > 0
+    assert "avg step" in p.step_info()
+
+
+def test_record_event_scopes_reach_xla_metadata():
+    import jax
+    import jax.numpy as jnp
+
+    def fn(a):
+        with prof_mod.RecordEvent("my_hot_block"):
+            return jnp.sin(a) * 2.0
+
+    txt = jax.jit(fn).lower(jnp.ones((4,))).as_text(debug_info=True)
+    assert "my_hot_block" in txt, (
+        "named_scope annotation missing from lowered module")
+
+
+def test_profiler_scheduler_windows(tmp_path):
+    log_dir = str(tmp_path / "sched")
+    traces = []
+    p = prof_mod.Profiler(
+        log_dir=log_dir,
+        scheduler=prof_mod.make_scheduler(closed=1, ready=0, record=2,
+                                          repeat=1),
+        on_trace_ready=lambda prof: traces.append(prof._step_num))
+    p.start()
+    x = paddle.to_tensor(np.ones((8, 8), np.float32))
+    for _ in range(5):
+        x = x + 1.0
+        p.step()
+    p.stop()
+    assert traces, "scheduler never completed a record window"
+    assert _xplane_files(log_dir)
+
+
+def test_legacy_fluid_profiler_context(tmp_path):
+    log_dir = str(tmp_path / "legacy")
+    with prof_mod.profiler(profile_path=log_dir):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        (x * 2).numpy()
+    assert _xplane_files(log_dir)
+
+
+def test_timer_only_mode_writes_nothing(tmp_path):
+    log_dir = str(tmp_path / "timeronly")
+    p = prof_mod.Profiler(log_dir=log_dir, timer_only=True)
+    p.start()
+    p.step()
+    p.stop()
+    assert not os.path.exists(log_dir)
