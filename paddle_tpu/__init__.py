@@ -42,10 +42,13 @@ from .ops.math import (  # noqa: F401
     isinf, isfinite, einsum, atan2, hypot, logit, nan_to_num, increment,
     stanh, kron, inner, outer, trace, diff, deg2rad, rad2deg, angle, conj,
     real, imag, heaviside, logaddexp, multiply as elementwise_mul,
+    renorm, vander, logcumsumexp, trapezoid, cumulative_trapezoid,
+    polygamma, igamma, i0,
 )
 from .ops.reduction import (  # noqa: F401
     sum, mean, max, min, prod, all, any, std, var, median, logsumexp, norm,  # noqa: A004
     dist, amax, amin, count_nonzero, nansum, nanmean, quantile,
+    nanmedian, nanquantile,
 )
 from .ops.manipulation import (  # noqa: F401
     reshape, transpose, t, flatten, squeeze, unsqueeze, concat, stack,
@@ -54,6 +57,8 @@ from .ops.manipulation import (  # noqa: F401
     expand_as, broadcast_to, broadcast_tensors, flip, roll, rot90,
     repeat_interleave, where, meshgrid, numel, shape, take_along_axis,
     put_along_axis, unstack, shard_index, unfold, strided_slice,
+    moveaxis, index_add, index_add_, index_fill, index_fill_, tensordot,
+    as_real, as_complex, view_as_real, view_as_complex,
 )
 from .ops.logic import (  # noqa: F401
     equal, not_equal, greater_than, greater_equal, less_than, less_equal,
@@ -63,10 +68,15 @@ from .ops.logic import (  # noqa: F401
 )
 from .ops.search import (  # noqa: F401
     argmax, argmin, argsort, sort, topk, nonzero, unique, kthvalue, mode,
-    searchsorted,
+    searchsorted, bincount, bucketize,
 )
 from .ops.nn_ops import one_hot  # noqa: F401
 from .ops import linalg  # noqa: F401
+from .ops.linalg import (  # noqa: F401
+    cholesky, det, slogdet, matrix_power, pinv, lstsq, solve,
+    triangular_solve, cholesky_solve, matrix_rank, multi_dot, svd, qr,
+    eig, eigh, eigvalsh, lu, householder_product, corrcoef, cov,
+)
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401

@@ -159,3 +159,71 @@ def _cond(x, *, p):
 
 def cond(x, p=None, name=None):
     return _cond(x, p=p)
+
+
+@register_op("lu", differentiable=False)
+def _lu(x):
+    lu, pivots, _ = jax.lax.linalg.lu(x)
+    return lu, pivots + 1  # paddle pivots are 1-based (reference lu_op)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """Reference: paddle.linalg.lu (operators/lu_op). Returns (LU,
+    pivots[, infos]); infos are always 0 here (XLA LU does not report
+    singularity)."""
+    res, piv = _lu(x)
+    if get_infos:
+        from .creation import zeros
+        info = zeros(list(x.aval_shape()[:-2]) or [1], dtype="int32")
+        return res, piv, info
+    return res, piv
+
+
+@register_op("cholesky_solve")
+def _cholesky_solve(y, x, *, upper):
+    return jax.scipy.linalg.cho_solve((x, not upper), y)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Reference: operators/cholesky_solve_op — solves A @ out = x given
+    the Cholesky factor y of A."""
+    return _cholesky_solve(x, y, upper=bool(upper))
+
+
+@register_op("householder_product", differentiable=False)
+def _householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+def householder_product(x, tau, name=None):
+    return _householder_product(x, tau)
+
+
+@register_op("eig", differentiable=False)
+def _eig(x):
+    return jnp.linalg.eig(x)
+
+
+def eig(x, name=None):
+    """Reference: operators/eig_op (CPU-only there too; XLA lowers eig on
+    the host)."""
+    return _eig(x)
+
+
+@register_op("corrcoef", differentiable=False)
+def _corrcoef(x, *, rowvar):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _corrcoef(x, rowvar=bool(rowvar))
+
+
+@register_op("cov", differentiable=False)
+def _cov(x, fweights, aweights, *, rowvar, ddof):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _cov(x, fweights, aweights, rowvar=bool(rowvar), ddof=bool(ddof))

@@ -573,3 +573,95 @@ def tolist(x):
 def broadcast_shape(x_shape, y_shape):
     import numpy as np
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---- round-2 op additions (reference: python/paddle/tensor/manipulation.py)
+
+@register_op("moveaxis_op")
+def _moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    src = tuple(source) if isinstance(source, (list, tuple)) else int(source)
+    dst = tuple(destination) if isinstance(destination, (list, tuple)) \
+        else int(destination)
+    return _moveaxis(x, source=src, destination=dst)
+
+
+@register_op("index_add_op")
+def _index_add(x, index, value, *, axis):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(x, index, value, axis=int(axis))
+
+
+def index_add_(x, index, axis, value, name=None):
+    out = index_add(x, index, axis, value)
+    x.value = out.value
+    return x
+
+
+@register_op("index_fill_op")
+def _index_fill(x, index, *, axis, fill_value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(fill_value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis, value, name=None):
+    from ..core.tensor import Tensor
+    if isinstance(value, Tensor):
+        value = float(value.numpy())
+    return _index_fill(x, index, axis=int(axis), fill_value=value)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x.value = out.value
+    return x
+
+
+@register_op("tensordot_op")
+def _tensordot(x, y, *, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        a, b = axes
+        axes = (tuple(a) if isinstance(a, (list, tuple)) else (a,),
+                tuple(b) if isinstance(b, (list, tuple)) else (b,))
+    else:
+        axes = int(axes)
+    return _tensordot(x, y, axes=axes)
+
+
+@register_op("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    """Reference: paddle.as_real — complex [..] -> float [.., 2]."""
+    return _as_real(x)
+
+
+view_as_real = as_real
+
+
+@register_op("as_complex")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return _as_complex(x)
+
+
+view_as_complex = as_complex

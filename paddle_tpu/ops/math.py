@@ -389,3 +389,94 @@ def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
 def tanh_(x, name=None):
     x.value = jnp.tanh(x.value)
     return x
+
+
+# ---- round-2 op additions (reference: python/paddle/tensor/math.py) -------
+
+@register_op("renorm")
+def _renorm(x, *, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm,
+                       max_norm / jnp.maximum(norms, 1e-12),
+                       jnp.ones_like(norms))
+    shaped = factor.reshape((-1,) + (1,) * (moved.ndim - 1))
+    return jnp.moveaxis(moved * shaped, 0, axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Reference: operators/renorm_op — clamp each slice along `axis` to
+    p-norm <= max_norm."""
+    return _renorm(x, p=float(p), axis=int(axis), max_norm=float(max_norm))
+
+
+@register_op("vander", differentiable=False)
+def _vander(x, *, n, increasing):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _vander(x, n=None if n is None else int(n),
+                   increasing=bool(increasing))
+
+
+@register_op("logcumsumexp")
+def _logcumsumexp(x, *, axis):
+    if axis is None:
+        return jax.lax.associative_scan(jnp.logaddexp, x.reshape(-1))
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    out = _logcumsumexp(x, axis=None if axis is None else int(axis))
+    if dtype is not None:
+        return cast(out, dtype)
+    return out
+
+
+@register_op("trapezoid_op")
+def _trapezoid(y, x, *, dx, axis):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return _trapezoid(y, x, dx=dx, axis=int(axis))
+
+
+@register_op("cumulative_trapezoid_op")
+def _cumulative_trapezoid(y, x, *, dx, axis):
+    y1 = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        xm = jnp.moveaxis(x, axis, -1) if x.ndim == y.ndim else x
+        d = jnp.diff(xm, axis=-1)
+    else:
+        d = jnp.full((y1.shape[-1] - 1,), 1.0 if dx is None else dx,
+                     y1.dtype)
+    seg = (y1[..., 1:] + y1[..., :-1]) * 0.5 * d
+    return jnp.moveaxis(jnp.cumsum(seg, axis=-1), -1, axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    return _cumulative_trapezoid(y, x, dx=dx, axis=int(axis))
+
+
+@register_op("polygamma_op", differentiable=False)
+def _polygamma(x, *, n):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def polygamma(x, n, name=None):
+    return _polygamma(x, n=int(n))
+
+
+@register_op("igamma_op", differentiable=False)
+def _igamma(x, a):
+    return jax.scipy.special.gammainc(a, x)
+
+
+def igamma(x, a, name=None):
+    """Reference: paddle.igamma (regularized lower incomplete gamma)."""
+    return _igamma(x, a)

@@ -130,3 +130,25 @@ def norm(x, p="fro", axis=None, keepdim=False, name=None):
 def dist(x, y, p=2.0):
     from . import math as math_ops
     return norm(math_ops.subtract(x, y), p=float(p))
+
+
+@register_op("nanmedian_op", differentiable=False)
+def _nanmedian(x, *, axis, keepdim):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) \
+        else (None if axis is None else int(axis))
+    return _nanmedian(x, axis=ax, keepdim=bool(keepdim))
+
+
+@register_op("nanquantile_op", differentiable=False)
+def _nanquantile(x, *, q, axis, keepdim):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = None if axis is None else int(axis)
+    return _nanquantile(x, q=tuple(q) if isinstance(q, (list, tuple))
+                        else float(q), axis=ax, keepdim=bool(keepdim))

@@ -157,3 +157,26 @@ def index_sample(x, index):
 def where(condition, x=None, y=None, name=None):
     from . import manipulation
     return manipulation.where(condition, x, y, name)
+
+
+@register_op("bincount_op", differentiable=False)
+def _bincount(x, weights, *, length):
+    return jnp.bincount(x, weights=weights, length=length)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    """Reference: operators/bincount_op. The output length is
+    data-dependent, so it is resolved eagerly (max(x)+1) and baked as a
+    static shape for the XLA kernel."""
+    from ..core.tensor import Tensor
+    xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    n = int(jnp.max(xv)) + 1 if xv.size else 0
+    length = max(n, int(minlength))
+    return _bincount(x, weights, length=length)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Reference: paddle.bucketize — index of the bucket each element
+    falls into (thin wrapper over searchsorted)."""
+    return searchsorted(sorted_sequence, x, out_int32=out_int32,
+                        right=right)

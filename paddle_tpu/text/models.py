@@ -21,7 +21,7 @@ class TransformerLMConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_seq_len=1024,
                  dropout=0.1, use_mp=False, tie_embeddings=True,
-                 use_flash_attention=True):
+                 use_flash_attention=True, initializer_range=0.02):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -32,6 +32,7 @@ class TransformerLMConfig:
         self.use_mp = use_mp
         self.tie_embeddings = tie_embeddings
         self.use_flash_attention = use_flash_attention
+        self.initializer_range = initializer_range
 
 
 def _mp_active():
@@ -125,15 +126,24 @@ class _TransformerCore(nn.Layer):
         super().__init__()
         self.cfg = cfg
         use_mp = cfg.use_mp and _mp_active()
+        # reference init (BERT/GPT initializer_range=0.02): with tied
+        # embeddings, N(0,1) rows would give logits of scale
+        # sqrt(hidden) and an untrainable initial loss
+        from ..nn import initializer as init_mod
+        emb_attr = init_mod.ParamAttr(
+            initializer=init_mod.Normal(0.0, cfg.initializer_range))
         if use_mp:
             self.word_embeddings = VocabParallelEmbedding(
-                cfg.vocab_size, cfg.hidden_size)
+                cfg.vocab_size, cfg.hidden_size, weight_attr=emb_attr)
         else:
             self.word_embeddings = nn.Embedding(cfg.vocab_size,
-                                                cfg.hidden_size)
+                                                cfg.hidden_size,
+                                                weight_attr=emb_attr)
         self.position_embeddings = nn.Embedding(cfg.max_seq_len,
-                                                cfg.hidden_size)
-        self.token_type_embeddings = nn.Embedding(2, cfg.hidden_size) \
+                                                cfg.hidden_size,
+                                                weight_attr=emb_attr)
+        self.token_type_embeddings = nn.Embedding(
+            2, cfg.hidden_size, weight_attr=emb_attr) \
             if with_token_type else None
         self.blocks = nn.LayerList(
             [Block(cfg, causal, pre_norm) for _ in range(cfg.num_layers)])

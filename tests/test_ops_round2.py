@@ -1,0 +1,157 @@
+"""Round-2 op additions (closing the 211-vs-707 registered-op gap):
+linalg (lu, cholesky_solve, householder_product, eig, corrcoef, cov),
+math (renorm, vander, logcumsumexp, trapezoid, cumulative_trapezoid,
+polygamma, igamma), manipulation (moveaxis, index_add, index_fill,
+tensordot, as_real/as_complex), search/stat (bincount, bucketize,
+nanmedian, nanquantile). Reference: python/paddle/tensor/*.py +
+operators/{lu,cholesky_solve,renorm,bincount,...}_op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def T(a, dtype=None):
+    return paddle.to_tensor(np.asarray(a, dtype=dtype))
+
+
+def test_lu_reconstructs():
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 4).astype("float32")
+    lu, piv = paddle.lu(T(a))
+    lu_np, piv_np = np.asarray(lu.numpy()), np.asarray(piv.numpy())
+    L = np.tril(lu_np, -1) + np.eye(4, dtype="float32")
+    U = np.triu(lu_np)
+    # apply recorded row swaps (1-based pivots)
+    P = np.eye(4, dtype="float32")
+    for i, p in enumerate(piv_np):
+        P[[i, p - 1]] = P[[p - 1, i]]
+    np.testing.assert_allclose(P @ a, L @ U, rtol=1e-4, atol=1e-5)
+
+
+def test_lu_get_infos():
+    a = np.eye(3, dtype="float32")
+    lu, piv, info = paddle.lu(T(a), get_infos=True)
+    assert np.asarray(info.numpy()).sum() == 0
+
+
+def test_cholesky_solve():
+    rs = np.random.RandomState(1)
+    m = rs.randn(3, 3).astype("float32")
+    a = m @ m.T + 3 * np.eye(3, dtype="float32")
+    b = rs.randn(3, 2).astype("float32")
+    L = np.linalg.cholesky(a).astype("float32")
+    out = paddle.cholesky_solve(T(b), T(L), upper=False)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+
+
+def test_eig_eigenvalues():
+    a = np.diag([1.0, 2.0, 3.0]).astype("float32")
+    w, v = paddle.eig(T(a))
+    np.testing.assert_allclose(sorted(np.asarray(w.numpy()).real),
+                               [1, 2, 3], rtol=1e-5)
+
+
+def test_corrcoef_cov():
+    rs = np.random.RandomState(2)
+    x = rs.randn(3, 50).astype("float32")
+    np.testing.assert_allclose(np.asarray(paddle.corrcoef(T(x)).numpy()),
+                               np.corrcoef(x), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(paddle.cov(T(x)).numpy()),
+                               np.cov(x), rtol=1e-4, atol=1e-5)
+
+
+def test_renorm_clamps_slices():
+    x = np.asarray([[[3.0, 4.0]], [[0.3, 0.4]]], "float32")  # norms 5, .5
+    out = np.asarray(paddle.renorm(T(x), p=2.0, axis=0,
+                                   max_norm=1.0).numpy())
+    np.testing.assert_allclose(np.sqrt((out[0] ** 2).sum()), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(out[1], x[1], rtol=1e-6)  # under the cap
+
+
+def test_vander_logcumsumexp():
+    x = np.asarray([1.0, 2.0, 3.0], "float32")
+    np.testing.assert_allclose(np.asarray(paddle.vander(T(x)).numpy()),
+                               np.vander(x), rtol=1e-6)
+    v = np.asarray([0.1, 0.5, 2.0], "float32")
+    out = np.asarray(paddle.logcumsumexp(T(v), axis=0).numpy())
+    ref = np.log(np.cumsum(np.exp(v)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_trapezoid_family():
+    y = np.asarray([1.0, 2.0, 3.0, 4.0], "float32")
+    np.testing.assert_allclose(
+        float(paddle.trapezoid(T(y)).numpy()), np.trapezoid(y), rtol=1e-6)
+    out = np.asarray(paddle.cumulative_trapezoid(T(y)).numpy())
+    np.testing.assert_allclose(out, [1.5, 4.0, 7.5], rtol=1e-6)
+
+
+def test_special_functions():
+    x = np.asarray([0.5, 1.5], "float32")
+    out = np.asarray(paddle.polygamma(T(x), 1).numpy())
+    assert np.all(out > 0)  # trigamma positive
+    ig = np.asarray(paddle.igamma(T(x), T([1.0, 1.0], "float32")).numpy())
+    np.testing.assert_allclose(ig, 1 - np.exp(-x), rtol=1e-4)
+
+
+def test_moveaxis_tensordot():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    np.testing.assert_array_equal(
+        np.asarray(paddle.moveaxis(T(x), 0, 2).numpy()),
+        np.moveaxis(x, 0, 2))
+    a = np.random.RandomState(3).randn(2, 3).astype("float32")
+    b = np.random.RandomState(4).randn(3, 4).astype("float32")
+    np.testing.assert_allclose(
+        np.asarray(paddle.tensordot(T(a), T(b), axes=1).numpy()),
+        np.tensordot(a, b, axes=1), rtol=1e-5)
+
+
+def test_index_add_fill():
+    x = np.zeros((4, 2), "float32")
+    idx = np.asarray([1, 3, 1])
+    val = np.ones((3, 2), "float32")
+    out = np.asarray(paddle.index_add(T(x), T(idx), 0, T(val)).numpy())
+    np.testing.assert_allclose(out[1], [2, 2])  # duplicate accumulates
+    np.testing.assert_allclose(out[3], [1, 1])
+    np.testing.assert_allclose(out[0], [0, 0])
+    out2 = np.asarray(paddle.index_fill(T(x), T(np.asarray([0, 2])), 0,
+                                        5.0).numpy())
+    np.testing.assert_allclose(out2[0], [5, 5])
+    np.testing.assert_allclose(out2[1], [0, 0])
+
+
+def test_as_real_complex_roundtrip():
+    c = np.asarray([1 + 2j, 3 - 1j], "complex64")
+    r = paddle.as_real(T(c))
+    assert list(r.shape) == [2, 2]
+    back = paddle.as_complex(r)
+    np.testing.assert_allclose(np.asarray(back.numpy()), c)
+
+
+def test_bincount_bucketize():
+    x = np.asarray([1, 2, 2, 5])
+    out = np.asarray(paddle.bincount(T(x)).numpy())
+    np.testing.assert_array_equal(out, [0, 1, 2, 0, 0, 1])
+    out2 = np.asarray(paddle.bincount(T(x), minlength=8).numpy())
+    assert out2.shape[0] == 8
+    edges = np.asarray([1.0, 2.0, 3.0], "float32")
+    vals = np.asarray([0.5, 1.5, 2.5, 3.5], "float32")
+    bk = np.asarray(paddle.bucketize(T(vals), T(edges)).numpy())
+    np.testing.assert_array_equal(bk, [0, 1, 2, 3])
+
+
+def test_nan_reductions():
+    x = np.asarray([[1.0, np.nan, 3.0], [4.0, 5.0, np.nan]], "float32")
+    assert float(paddle.nanmedian(T(x)).numpy()) == 3.5
+    np.testing.assert_allclose(
+        float(paddle.nanquantile(T(x), 0.5).numpy()), 3.5)
+
+
+def test_renorm_grad_flows():
+    x = paddle.to_tensor(np.ones((2, 3), "float32") * 2, stop_gradient=False)
+    out = paddle.renorm(x, p=2.0, axis=0, max_norm=1.0)
+    out.sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
