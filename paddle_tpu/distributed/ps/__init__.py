@@ -1,0 +1,59 @@
+"""Parameter-server subsystem with a real remote path.
+
+Reference parity: paddle/fluid/distributed/service/brpc_ps_server.h /
+brpc_ps_client.h (pull/push dense & sparse over RPC),
+distributed/service/communicator.h:197 (Communicator, AsyncCommunicator
+:348, GeoCommunicator :497), distributed/table/ (CommonDenseTable,
+CommonSparseTable). SURVEY §7.7 allows a reduced-scope equivalent; this
+one is reduced in TRANSPORT (length-prefixed pickle over TCP sockets
+instead of baidu-rpc + protobuf) but keeps the architecture: standalone
+server processes own sharded tables, trainer clients pull/push over the
+network, and sync/async/geo communication modes change when and how
+gradients reach the server.
+
+TPU-native division of labor: the dense compute path stays on
+XLA devices; the PS serves what does NOT fit or belongs host-side —
+huge sparse embeddings — exactly the reference's CPU-parameter-server
+role next to GPU trainers.
+"""
+from .server import PSServer, DenseTable, SparseTable  # noqa: F401
+from .client import PSClient  # noqa: F401
+from .communicator import (  # noqa: F401
+    Communicator, AsyncCommunicator, GeoCommunicator)
+
+
+class PSEmbedding:
+    """Trainer-side embedding over a REMOTE sparse table: forward pulls
+    rows (autograd-cut at the pull, like the reference DownpourWorker's
+    pull), backward grads on the pulled rows are pushed back via the
+    communicator (reference: distributed_lookup_table_op +
+    fleet_wrapper.h:69 PullSparse/PushSparseGrad)."""
+
+    def __init__(self, client, table_id, dim, communicator=None):
+        self.client = client
+        self.table_id = table_id
+        self.dim = int(dim)
+        self.comm = communicator or Communicator(client)
+        self._last = None
+
+    def __call__(self, ids):
+        import jax.numpy as jnp
+        from ...core.tensor import Tensor
+        ids_np = ids.numpy() if isinstance(ids, Tensor) else ids
+        rows = self.client.pull_sparse(self.table_id, ids_np)
+        rows = rows.reshape(tuple(ids_np.shape) + (self.dim,))
+        pulled = Tensor(jnp.asarray(rows))
+        pulled.stop_gradient = False
+        self._last = (ids_np, pulled)
+        return pulled
+
+    def apply_push(self):
+        if self._last is None:
+            return
+        ids_np, pulled = self._last
+        if pulled._grad is not None:
+            g = pulled._grad.value
+            self.comm.send_sparse(
+                self.table_id, ids_np.reshape(-1),
+                __import__("numpy").asarray(g).reshape(-1, self.dim))
+        self._last = None

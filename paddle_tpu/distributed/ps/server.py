@@ -1,0 +1,275 @@
+"""PS server: owns tables, serves pull/push over TCP.
+
+Reference: paddle/fluid/distributed/service/brpc_ps_server.h BrpcPsServer
++ distributed/table/common_dense_table.h / common_sparse_table.h (tables
+with per-table optimizer rules applied server-side on push).
+"""
+import os
+import socketserver
+import threading
+
+import numpy as np
+
+from .rpc import send_msg, recv_msg
+
+
+class DenseTable:
+    """Reference: CommonDenseTable — a flat dense param block updated by
+    pushed gradients with a server-side rule (sgd / adam / sum)."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01, init=None,
+                 seed=0):
+        self.lock = threading.Lock()
+        if init is not None:
+            self.value = np.asarray(init, np.float32).copy()
+        else:
+            rs = np.random.RandomState(seed)
+            self.value = (rs.randn(*shape) * 0.01).astype(np.float32)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        if optimizer == "adam":
+            self._m = np.zeros_like(self.value)
+            self._v = np.zeros_like(self.value)
+            self._t = 0
+
+    def pull(self):
+        with self.lock:
+            return self.value.copy()
+
+    def push(self, grad):
+        # the TCP server is threaded: concurrent trainer pushes must not
+        # interleave the read-modify-write (numpy releases the GIL)
+        g = np.asarray(grad, np.float32)
+        with self.lock:
+            if self.optimizer == "sum":
+                self.value += g
+            elif self.optimizer == "adam":
+                self._t += 1
+                self._m = 0.9 * self._m + 0.1 * g
+                self._v = 0.999 * self._v + 0.001 * g * g
+                mh = self._m / (1 - 0.9 ** self._t)
+                vh = self._v / (1 - 0.999 ** self._t)
+                self.value -= self.lr * mh / (np.sqrt(vh) + 1e-8)
+            else:  # sgd
+                self.value -= self.lr * g
+
+    def set(self, value):
+        with self.lock:
+            self.value = np.asarray(value, np.float32).copy()
+
+    def state(self):
+        s = {"value": self.value, "optimizer": self.optimizer,
+             "lr": self.lr}
+        if self.optimizer == "adam":
+            s.update(m=self._m, v=self._v, t=self._t)
+        return s
+
+    def load_state(self, s):
+        self.value = s["value"]
+        self.optimizer = s["optimizer"]
+        self.lr = s["lr"]
+        if self.optimizer == "adam":
+            self._m, self._v, self._t = s["m"], s["v"], s["t"]
+
+
+class SparseTable:
+    """Reference: CommonSparseTable — hash-sparse embedding rows created
+    on first access, sparse SGD/adagrad applied on push."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01,
+                 seed=0):
+        self.lock = threading.Lock()
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.init_std = float(init_std)
+        self._rs = np.random.RandomState(seed)
+        self.rows = {}
+        self._acc = {}
+
+    def _row(self, rid):
+        r = self.rows.get(rid)
+        if r is None:
+            r = (self._rs.randn(self.dim) * self.init_std).astype(
+                np.float32)
+            self.rows[rid] = r
+        return r
+
+    def pull(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        with self.lock:
+            return np.stack([self._row(int(i)).copy() for i in ids],
+                            axis=0)
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        with self.lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    acc = self._acc.get(i, 0.0) + float((g * g).mean())
+                    self._acc[i] = acc
+                    row -= self.lr / (np.sqrt(acc) + 1e-6) * g
+                else:
+                    row -= self.lr * g
+
+    def state(self):
+        with self.lock:
+            return {"dim": self.dim, "optimizer": self.optimizer,
+                    "lr": self.lr, "rows": dict(self.rows),
+                    "acc": dict(self._acc), "init_std": self.init_std,
+                    "rs": self._rs.get_state()}
+
+    def load_state(self, s):
+        self.dim = s["dim"]
+        self.optimizer = s["optimizer"]
+        self.lr = s["lr"]
+        self.rows = s["rows"]
+        self._acc = s["acc"]
+        self.init_std = s.get("init_std", 0.01)
+        if "rs" in s:
+            # restore the row-init RNG stream position: rows created
+            # after a restore must not replay pre-save values
+            self._rs.set_state(s["rs"])
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server = self.server.ps  # type: PSServer
+        while True:
+            req = recv_msg(self.request)
+            if req is None:
+                return
+            try:
+                resp = server._dispatch(req)
+            except Exception as e:  # noqa: BLE001 — serve errors to client
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            send_msg(self.request, resp)
+            if req.get("cmd") == "stop":
+                return
+
+
+class _TCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PSServer:
+    """Reference: BrpcPsServer — start() binds and serves until stop().
+    Tables are created by client request or locally."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._srv = _TCP((host, port), _Handler)
+        self._srv.ps = self
+        self.host, self.port = self._srv.server_address
+        self.tables = {}
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._barrier_cv = threading.Condition()
+        self._thread = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def run(self):
+        """Blocking serve (server-process entry, reference
+        run_server)."""
+        self._srv.serve_forever()
+
+    # -- dispatch ---------------------------------------------------------
+    def _dispatch(self, req):
+        cmd = req.get("cmd")
+        if cmd == "create_dense":
+            self.tables[req["table_id"]] = DenseTable(
+                req.get("shape"), optimizer=req.get("optimizer", "sgd"),
+                lr=req.get("lr", 0.01), init=req.get("init"),
+                seed=req.get("seed", 0))
+            return {"ok": True}
+        if cmd == "create_sparse":
+            self.tables[req["table_id"]] = SparseTable(
+                req["dim"], optimizer=req.get("optimizer", "sgd"),
+                lr=req.get("lr", 0.01), seed=req.get("seed", 0))
+            return {"ok": True}
+        if cmd == "pull_dense":
+            return {"ok": True, "value": self.tables[req["table_id"]].pull()}
+        if cmd == "push_dense":
+            self.tables[req["table_id"]].push(req["grad"])
+            return {"ok": True}
+        if cmd == "set_dense":
+            self.tables[req["table_id"]].set(req["value"])
+            return {"ok": True}
+        if cmd == "pull_sparse":
+            return {"ok": True,
+                    "rows": self.tables[req["table_id"]].pull(req["ids"])}
+        if cmd == "push_sparse":
+            self.tables[req["table_id"]].push(req["ids"], req["grads"])
+            return {"ok": True}
+        if cmd == "save":
+            state = {tid: t.state() for tid, t in self.tables.items()}
+            kinds = {tid: type(t).__name__ for tid, t in self.tables.items()}
+            import pickle
+            with open(req["path"], "wb") as f:
+                pickle.dump({"state": state, "kinds": kinds}, f)
+            return {"ok": True}
+        if cmd == "load":
+            import pickle
+            with open(req["path"], "rb") as f:
+                data = pickle.load(f)
+            for tid, s in data["state"].items():
+                cls = DenseTable if data["kinds"][tid] == "DenseTable" \
+                    else SparseTable
+                t = cls.__new__(cls)
+                t.lock = threading.Lock()
+                if cls is SparseTable:
+                    t._rs = np.random.RandomState(0)
+                t.load_state(s)
+                self.tables[tid] = t
+            return {"ok": True}
+        if cmd == "barrier":
+            n = req["trainers"]
+            timeout = float(req.get("timeout", 60.0))
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= n:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                    return {"ok": True}
+                released = self._barrier_cv.wait_for(
+                    lambda: self._barrier_gen != gen, timeout=timeout)
+                if not released:
+                    # roll back so a retry doesn't count this waiter twice
+                    self._barrier_count = max(0, self._barrier_count - 1)
+                    return {"ok": False,
+                            "error": f"barrier timeout after {timeout}s "
+                                     f"waiting for {n} trainers"}
+            return {"ok": True}
+        if cmd == "stop":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return {"ok": True}
+        if cmd == "ping":
+            return {"ok": True, "tables": sorted(self.tables)}
+        raise ValueError(f"unknown command {cmd!r}")
+
+
+def run_server_forever(host="127.0.0.1", port=0, ready_file=None):
+    """Server-process entry: binds, optionally writes 'host:port' to
+    ready_file, serves until stop (reference: the listen_and_serv op)."""
+    srv = PSServer(host, port)
+    if ready_file:
+        tmp = ready_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{srv.host}:{srv.port}")
+        os.rename(tmp, ready_file)
+    srv.run()
