@@ -21,6 +21,7 @@ from .core.device import (  # noqa: F401
 )
 from .core.flags import set_flags, get_flags  # noqa: F401
 from .core.tensor import Tensor, Parameter  # noqa: F401
+from .core import errors  # noqa: F401
 from .core.dispatch import no_grad, enable_grad, is_grad_enabled  # noqa: F401
 from .core.rng import seed, default_generator  # noqa: F401
 from .core import trace as _trace  # noqa: F401

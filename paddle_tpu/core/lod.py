@@ -1,0 +1,174 @@
+"""LoDTensor: level-of-detail (ragged) tensors.
+
+Reference parity: paddle/fluid/framework/lod_tensor.h:109 (LoDTensor =
+dense Tensor + LoD offset levels), python/paddle/fluid/lod_tensor.py
+(create_lod_tensor, create_random_int_lodtensor), lod_tensor.cc
+(ConvertToLengthBasedLoD etc.).
+
+TPU-native design (SURVEY §7 hard-part 3): the DATA stays one dense
+concatenated array on device — XLA-friendly, no ragged device type. The
+raggedness (LoD offsets) is host metadata carried by the tensor. The
+boundary conversions to the compute-friendly forms are explicit:
+  - to_padded(): (padded [N, L, ...], lengths) for masked dense ops
+  - segment_ids(): row->sequence map for jax segment reductions
+  - sequence_list(): python list of per-sequence arrays (host)
+Multi-level LoD composes offsets the same way the reference does (outer
+levels index into the next level).
+"""
+import numpy as np
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+def _lengths_to_offsets(lengths):
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return off
+
+
+class LoDTensor(Tensor):
+    """Dense data + LoD offsets. lod is a list of offset lists; the last
+    level indexes rows of `data` (reference lod_tensor.h:109: 'LoD' =
+    vector<vector<size_t>> of offsets)."""
+
+    __slots__ = ("_lod",)
+
+    def __init__(self, data, lod=None, **kw):
+        super().__init__(data, **kw)
+        self._lod = [list(map(int, lv)) for lv in (lod or [])]
+        self._check()
+
+    def _check(self):
+        n = self.aval_shape()[0] if self.aval_shape() else 0
+        for i, lv in enumerate(self._lod):
+            if lv and lv[0] != 0:
+                raise ValueError(f"LoD level {i} must start at 0: {lv}")
+            if any(a > b for a, b in zip(lv, lv[1:])):
+                raise ValueError(f"LoD level {i} not non-decreasing: {lv}")
+        if self._lod and self._lod[-1] and self._lod[-1][-1] != n:
+            raise ValueError(
+                f"last LoD offset {self._lod[-1][-1]} != rows {n}")
+        for outer, inner in zip(self._lod, self._lod[1:]):
+            if outer and outer[-1] != len(inner) - 1:
+                raise ValueError(
+                    "outer LoD level must index into the inner level")
+
+    # -- reference API -----------------------------------------------------
+    def lod(self):
+        return [list(lv) for lv in self._lod]
+
+    def set_lod(self, lod):
+        self._lod = [list(map(int, lv)) for lv in lod]
+        self._check()
+
+    def recursive_sequence_lengths(self):
+        """Offsets -> nested lengths (reference:
+        LoDTensor.recursive_sequence_lengths)."""
+        return [[b - a for a, b in zip(lv, lv[1:])] for lv in self._lod]
+
+    def has_valid_recursive_sequence_lengths(self):
+        try:
+            self._check()
+            return True
+        except ValueError:
+            return False
+
+    # -- TPU-native conversions -------------------------------------------
+    def nseq(self, level=-1):
+        return len(self._lod[level]) - 1
+
+    def lengths(self, level=-1):
+        lv = self._lod[level]
+        return np.asarray([b - a for a, b in zip(lv, lv[1:])], "int64")
+
+    def segment_ids(self, level=-1):
+        """Row -> sequence index map for jax segment reductions."""
+        return np.repeat(np.arange(self.nseq(level)), self.lengths(level))
+
+    def to_padded(self, pad_value=0.0, level=-1):
+        """(padded [N, L, ...], lengths Tensor) — the masked-dense form
+        every TPU sequence op consumes (ops/sequence.py)."""
+        data = np.asarray(self.numpy())
+        lv = self._lod[level]
+        lens = self.lengths(level)
+        L = int(lens.max()) if len(lens) else 0
+        out = np.full((len(lens), L) + data.shape[1:], pad_value,
+                      data.dtype)
+        for i, (a, b) in enumerate(zip(lv, lv[1:])):
+            out[i, :b - a] = data[a:b]
+        return Tensor(out), Tensor(np.asarray(lens))
+
+    def sequence_list(self, level=-1):
+        data = np.asarray(self.numpy())
+        lv = self._lod[level]
+        return [data[a:b] for a, b in zip(lv, lv[1:])]
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape}, "
+                f"lod={self._lod})")
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Reference: python/paddle/fluid/lod_tensor.py create_lod_tensor —
+    data is a numpy array / list whose rows concatenate all sequences;
+    recursive_seq_lens is nested LENGTHS (converted to offsets)."""
+    if isinstance(data, list) and data and isinstance(
+            data[0], (list, np.ndarray)) and np.asarray(data[0]).ndim >= 1:
+        flat = np.concatenate([np.asarray(d) for d in data], axis=0)
+    else:
+        flat = np.asarray(data)
+    lod = [_lengths_to_offsets(lv) for lv in recursive_seq_lens]
+    return LoDTensor(flat, lod=lod)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    total = sum(recursive_seq_lens[-1])
+    data = np.random.randint(low, high + 1,
+                             (total,) + tuple(base_shape)).astype("int64")
+    lod = [_lengths_to_offsets(lv) for lv in recursive_seq_lens]
+    return LoDTensor(data, lod=lod)
+
+
+# -- LoD-aware sequence reductions (segment form, XLA-friendly) -----------
+
+def lod_sequence_pool(t, pool_type="SUM"):
+    """sequence_pool over a LoDTensor via segment reduction (reference:
+    sequence_pool_op over LoD offsets). Returns a dense [nseq, ...]
+    Tensor."""
+    import jax
+    seg = jnp.asarray(t.segment_ids())
+    data = t.value
+    n = t.nseq()
+    pt = pool_type.upper()
+    if pt == "SUM":
+        out = jax.ops.segment_sum(data, seg, num_segments=n)
+    elif pt == "AVERAGE":
+        s = jax.ops.segment_sum(data, seg, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  seg, num_segments=n)
+        out = s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (s.ndim - 1))
+    elif pt == "MAX":
+        out = jax.ops.segment_max(data, seg, num_segments=n)
+    elif pt == "MIN":
+        out = jax.ops.segment_min(data, seg, num_segments=n)
+    elif pt == "FIRST":
+        lv = t._lod[-1]
+        out = jnp.take(data, jnp.asarray(lv[:-1]), axis=0)
+    elif pt == "LAST":
+        lv = t._lod[-1]
+        out = jnp.take(data, jnp.asarray([b - 1 for b in lv[1:]]), axis=0)
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return Tensor(out)
+
+
+def lod_sequence_expand(x, ref):
+    """Repeat each row of x by the ref LoDTensor's sequence lengths
+    (reference: sequence_expand_op)."""
+    lens = ref.lengths()
+    data = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    rep = jnp.asarray(np.repeat(np.arange(len(lens)), lens))
+    return LoDTensor(jnp.take(data, rep, axis=0), lod=[ref._lod[-1]])

@@ -82,7 +82,8 @@ class Tensor:
             value = value.value
         arr = jnp.asarray(value, dtype=self.value.dtype)
         if tuple(arr.shape) != tuple(self.shape):
-            raise ValueError(
+            from .errors import InvalidArgumentError
+            raise InvalidArgumentError(
                 f"set_value shape mismatch {arr.shape} vs {tuple(self.shape)}")
         self.value = arr
         return self

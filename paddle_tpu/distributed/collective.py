@@ -97,7 +97,9 @@ def get_group(gid=0):
         return _default_group()
     g = _GROUPS.get(gid)
     if g is None:
-        raise ValueError(f"no group with id {gid}; create it via new_group")
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"no group with id {gid}; create it via new_group")
     return g
 
 

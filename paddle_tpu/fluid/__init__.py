@@ -16,6 +16,9 @@ from ..static import (  # noqa: F401
     program_guard, data,
 )
 from ..core.dispatch import no_grad  # noqa: F401
+from ..core.lod import (  # noqa: F401
+    LoDTensor, create_lod_tensor, create_random_int_lodtensor,
+)
 from .. import optimizer  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401

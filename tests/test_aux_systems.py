@@ -259,3 +259,39 @@ def test_elastic_kill_relaunch_resume(tmp_path):
     finally:
         mgr.kill_children()
         mgr.stop()
+
+
+def test_error_taxonomy():
+    """Reference: platform/enforce.h:427 + error_codes.proto — typed
+    error classes that also subclass the natural builtin so existing
+    except-clauses keep working."""
+    import pytest
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import errors
+
+    # enforce helpers
+    errors.enforce(True, "fine")
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce(False, "bad")
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_eq(1, 2)
+    errors.enforce_ge(2, 2)
+    with pytest.raises(errors.NotFoundError):
+        errors.enforce_not_none(None)
+    assert errors.error_for_code("OUT_OF_RANGE") is errors.OutOfRangeError
+
+    # builtin-compatibility contract
+    assert issubclass(errors.InvalidArgumentError, ValueError)
+    assert issubclass(errors.ResourceExhaustedError, MemoryError)
+    assert issubclass(errors.UnimplementedError, NotImplementedError)
+
+    # used at real API edges
+    t = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    with pytest.raises(errors.InvalidArgumentError):
+        t.set_value(np.zeros((3, 3), np.float32))
+    with pytest.raises(ValueError):  # old-style handler still catches
+        t.set_value(np.zeros((3, 3), np.float32))
+    from paddle_tpu.distributed import collective
+    with pytest.raises(errors.InvalidArgumentError):
+        collective.get_group(99999)

@@ -1,0 +1,104 @@
+"""LoDTensor ragged representation (closes the r1 'no LoD/ragged
+representation' gap). Reference: framework/lod_tensor.h:109,
+python/paddle/fluid/lod_tensor.py, operators/sequence_ops/.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+from paddle_tpu.core.lod import (LoDTensor, create_lod_tensor,
+                                 lod_sequence_pool, lod_sequence_expand)
+
+
+def _t():
+    # 3 sequences of lengths 2, 3, 1 over rows of dim 2
+    data = np.arange(12, dtype="float32").reshape(6, 2)
+    return create_lod_tensor(data, [[2, 3, 1]])
+
+
+def test_create_and_metadata():
+    t = _t()
+    assert isinstance(t, LoDTensor)
+    assert t.lod() == [[0, 2, 5, 6]]
+    assert t.recursive_sequence_lengths() == [[2, 3, 1]]
+    assert t.has_valid_recursive_sequence_lengths()
+    assert t.nseq() == 3
+    np.testing.assert_array_equal(t.lengths(), [2, 3, 1])
+    np.testing.assert_array_equal(t.segment_ids(), [0, 0, 1, 1, 1, 2])
+
+
+def test_fluid_namespace_exports():
+    data = np.ones((4, 1), "float32")
+    t = fluid.create_lod_tensor(data, [[1, 3]])
+    assert isinstance(t, fluid.LoDTensor)
+    r = fluid.create_random_int_lodtensor([[2, 2]], [3], low=0, high=9)
+    assert r.lod() == [[0, 2, 4]]
+    assert tuple(r.shape) == (4, 3)
+
+
+def test_invalid_lod_rejected():
+    data = np.ones((4, 1), "float32")
+    with pytest.raises(ValueError, match="start at 0"):
+        LoDTensor(data, lod=[[1, 4]])
+    with pytest.raises(ValueError, match="non-decreasing"):
+        LoDTensor(data, lod=[[0, 3, 2, 4]])
+    with pytest.raises(ValueError, match="rows"):
+        LoDTensor(data, lod=[[0, 2, 3]])
+
+
+def test_multilevel_lod():
+    # 2 outer groups: first has 2 inner seqs, second has 1
+    data = np.arange(5, dtype="float32").reshape(5, 1)
+    t = create_lod_tensor(data, [[2, 1], [2, 1, 2]])
+    assert t.lod() == [[0, 2, 3], [0, 2, 3, 5]]
+    assert t.recursive_sequence_lengths() == [[2, 1], [2, 1, 2]]
+
+
+def test_to_padded_roundtrip():
+    t = _t()
+    padded, lens = t.to_padded(pad_value=-1.0)
+    assert padded.shape == [3, 3, 2]
+    np.testing.assert_array_equal(lens.numpy(), [2, 3, 1])
+    p = padded.numpy()
+    np.testing.assert_allclose(p[0, :2], [[0, 1], [2, 3]])
+    np.testing.assert_allclose(p[2, 1:], -np.ones((2, 2)))
+    seqs = t.sequence_list()
+    assert [len(s) for s in seqs] == [2, 3, 1]
+    np.testing.assert_allclose(seqs[1], [[4, 5], [6, 7], [8, 9]])
+
+
+def test_lod_sequence_pool_all_modes():
+    t = _t()
+    d = np.asarray(t.numpy())
+    np.testing.assert_allclose(
+        lod_sequence_pool(t, "SUM").numpy(),
+        [d[0:2].sum(0), d[2:5].sum(0), d[5:6].sum(0)], rtol=1e-6)
+    np.testing.assert_allclose(
+        lod_sequence_pool(t, "AVERAGE").numpy(),
+        [d[0:2].mean(0), d[2:5].mean(0), d[5:6].mean(0)], rtol=1e-6)
+    np.testing.assert_allclose(
+        lod_sequence_pool(t, "MAX").numpy(),
+        [d[0:2].max(0), d[2:5].max(0), d[5:6].max(0)], rtol=1e-6)
+    np.testing.assert_allclose(
+        lod_sequence_pool(t, "FIRST").numpy(), d[[0, 2, 5]], rtol=1e-6)
+    np.testing.assert_allclose(
+        lod_sequence_pool(t, "LAST").numpy(), d[[1, 4, 5]], rtol=1e-6)
+
+
+def test_lod_sequence_expand():
+    t = _t()
+    x = paddle.to_tensor(np.asarray([[10.0], [20.0], [30.0]], "float32"))
+    out = lod_sequence_expand(x, t)
+    assert isinstance(out, LoDTensor)
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()).reshape(-1),
+        [10, 10, 20, 20, 20, 30])
+    assert out.lod() == [t.lod()[-1]]
+
+
+def test_lod_tensor_is_a_tensor():
+    # LoDTensor flows through normal ops as its dense self
+    t = _t()
+    out = (t * 2.0).numpy()
+    np.testing.assert_allclose(out, 2 * np.asarray(t.numpy()))
