@@ -101,9 +101,72 @@ class InMemoryDataset(DatasetBase):
             bool(np.all(np.diff(offs) == (offs[1] - offs[0])))
             for _, offs in self._slots]
 
-    def global_shuffle(self, fleet=None, thread_num=None):
-        """Single-controller equivalent of the reference's brpc global
-        shuffle: permute samples in memory."""
+    # -- sample (de)serialization for the shuffle exchange ----------------
+    def _export_samples(self):
+        """Per-sample rows: sample i -> tuple of per-slot value arrays."""
+        out = []
+        for i in range(self._num_samples):
+            row = []
+            for vals, offs in self._slots:
+                row.append(vals[offs[i]:offs[i + 1]].copy())
+            out.append(row)
+        return out
+
+    def _import_samples(self, samples):
+        nslots = self._num_slots
+        new_slots = []
+        for s in range(nslots):
+            seqs = [row[s] for row in samples]
+            offs = np.zeros(len(seqs) + 1, np.int64)
+            np.cumsum([len(q) for q in seqs], out=offs[1:])
+            vals = (np.concatenate(seqs) if seqs
+                    else np.zeros((0,), np.float32))
+            new_slots.append((vals, offs))
+        self._slots = new_slots
+        self._num_samples = len(samples)
+        self._slot_is_dense = [
+            bool(len(offs) > 1 and np.all(np.diff(offs)
+                                          == (offs[1] - offs[0])))
+            for _, offs in self._slots]
+
+    def global_shuffle(self, fleet=None, thread_num=None,
+                       ps_endpoints=None, rank=None, world=None,
+                       seed=None):
+        """Reference: InMemoryDataset.global_shuffle — samples are
+        re-dealt ACROSS workers through a shuffle service
+        (data_feed.h:395 InMemoryDataFeed global shuffle over brpc).
+
+        Distributed path (ps_endpoints given): every worker assigns each
+        of its samples a uniform destination rank, deposits the blobs in
+        the PS shuffle buckets, barriers, then collects its own bucket —
+        samples land on random workers. Without endpoints, the
+        single-controller reduction: permute in memory."""
+        if ps_endpoints:
+            from ..ps import PSClient
+            import pickle
+            client = PSClient(ps_endpoints)
+            try:
+                rs = np.random.RandomState(seed)
+                samples = self._export_samples()
+                dests = rs.randint(0, world, size=len(samples))
+                for d in range(world):
+                    idx = np.nonzero(dests == d)[0]
+                    if len(idx):
+                        client.shuffle_put(
+                            int(d),
+                            [pickle.dumps(samples[i], protocol=4)
+                             for i in idx])
+                client.barrier(world)  # all deposits visible
+                mine = [pickle.loads(b)
+                        for b in client.shuffle_take(rank)]
+                rs2 = np.random.RandomState(
+                    None if seed is None else seed + rank)
+                order = rs2.permutation(len(mine))
+                self._import_samples([mine[i] for i in order])
+                client.barrier(world)  # everyone done taking
+            finally:
+                client.close()
+            return
         perm = np.random.permutation(self._num_samples)
         new_slots = []
         for vals, offs in self._slots:

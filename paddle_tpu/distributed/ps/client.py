@@ -128,6 +128,17 @@ class PSClient:
                            "ids": ids[mask], "grads": grads[mask]}
         self._call_parallel(reqs)
 
+    # -- global shuffle exchange ------------------------------------------
+    def shuffle_put(self, dest, blobs):
+        """Deposit sample blobs for `dest` rank (bucket homed on server
+        dest % n_servers)."""
+        self._call(dest % self.n_servers,
+                   {"cmd": "shuffle_put", "dest": dest, "blobs": blobs})
+
+    def shuffle_take(self, rank):
+        return self._call(rank % self.n_servers,
+                          {"cmd": "shuffle_take", "rank": rank})["blobs"]
+
     # -- control -----------------------------------------------------------
     def barrier(self, n_trainers):
         """Global barrier across trainers via server 0 (reference:

@@ -165,6 +165,8 @@ class PSServer:
         self._srv.ps = self
         self.host, self.port = self._srv.server_address
         self.tables = {}
+        self._shuffle = {}        # dest rank -> list of sample blobs
+        self._shuffle_lock = threading.Lock()
         self._barrier_count = 0
         self._barrier_gen = 0
         self._barrier_cv = threading.Condition()
@@ -255,6 +257,18 @@ class PSServer:
                             "error": f"barrier timeout after {timeout}s "
                                      f"waiting for {n} trainers"}
             return {"ok": True}
+        if cmd == "shuffle_put":
+            # global-shuffle exchange (reference: InMemoryDataFeed
+            # GlobalShuffle over brpc channels, data_feed.h:395): workers
+            # deposit sample blobs addressed to a destination rank
+            with self._shuffle_lock:
+                self._shuffle.setdefault(req["dest"], []).extend(
+                    req["blobs"])
+            return {"ok": True}
+        if cmd == "shuffle_take":
+            with self._shuffle_lock:
+                blobs = self._shuffle.pop(req["rank"], [])
+            return {"ok": True, "blobs": blobs}
         if cmd == "stop":
             threading.Thread(target=self.stop, daemon=True).start()
             return {"ok": True}

@@ -276,3 +276,43 @@ def test_async_communicator_surfaces_push_failure():
     comm.send_dense("dead", np.ones(2, np.float32))
     with pytest.raises(RuntimeError, match="send thread"):
         comm.flush()
+
+
+def test_global_shuffle_across_workers(cluster, tmp_path):
+    """Samples re-deal across two dataset workers through the PS shuffle
+    service (reference: InMemoryDataset.global_shuffle over brpc)."""
+    from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+    client, eps = cluster
+
+    def make_ds(lines):
+        p = tmp_path / f"part_{lines[0].split()[1]}.txt"
+        p.write_text("\n".join(lines) + "\n")
+        ds = InMemoryDataset()
+        ds.init(batch_size=1, thread_num=1)
+        ds.set_filelist([str(p)])
+        ds.load_into_memory()
+        return ds
+
+    # each sample: one dense slot with a single distinguishing value
+    ds0 = make_ds([f"1 {v}" for v in range(0, 8)])
+    ds1 = make_ds([f"1 {v}" for v in range(100, 108)])
+    assert ds0.get_memory_data_size() == 8
+
+    import threading
+    def shuf(ds, rank):
+        ds.global_shuffle(ps_endpoints=eps, rank=rank, world=2, seed=123)
+
+    t = threading.Thread(target=shuf, args=(ds1, 1))
+    t.start()
+    shuf(ds0, 0)
+    t.join(timeout=60)
+
+    def values(ds):
+        return sorted(int(v) for v, _ in [ds._slots[0]] for v in v)
+
+    v0, v1 = values(ds0), values(ds1)
+    total = sorted(v0 + v1)
+    assert total == list(range(0, 8)) + list(range(100, 108))
+    # the deal actually crossed workers (seed 123 mixes both ranges)
+    assert any(v >= 100 for v in v0) or any(v < 100 for v in v1)
+    assert ds0.get_memory_data_size() + ds1.get_memory_data_size() == 16
