@@ -60,8 +60,13 @@ class LoDTensor(Tensor):
         return [list(lv) for lv in self._lod]
 
     def set_lod(self, lod):
-        self._lod = [list(map(int, lv)) for lv in lod]
-        self._check()
+        new = [list(map(int, lv)) for lv in lod]
+        old, self._lod = self._lod, new
+        try:
+            self._check()
+        except ValueError:
+            self._lod = old  # reject without corrupting the tensor
+            raise
 
     def recursive_sequence_lengths(self):
         """Offsets -> nested lengths (reference:
@@ -154,12 +159,18 @@ def lod_sequence_pool(t, pool_type="SUM"):
         out = jax.ops.segment_max(data, seg, num_segments=n)
     elif pt == "MIN":
         out = jax.ops.segment_min(data, seg, num_segments=n)
-    elif pt == "FIRST":
+    elif pt in ("FIRST", "LAST"):
         lv = t._lod[-1]
-        out = jnp.take(data, jnp.asarray(lv[:-1]), axis=0)
-    elif pt == "LAST":
-        lv = t._lod[-1]
-        out = jnp.take(data, jnp.asarray([b - 1 for b in lv[1:]]), axis=0)
+        if pt == "FIRST":
+            idx = jnp.asarray([min(a, data.shape[0] - 1) for a in lv[:-1]])
+        else:
+            idx = jnp.asarray([max(b - 1, 0) for b in lv[1:]])
+        out = jnp.take(data, idx, axis=0)
+        # an empty sequence has no first/last row: yield zeros, not a
+        # neighboring sequence's row
+        lens = jnp.asarray(t.lengths())
+        mask = (lens > 0).reshape((-1,) + (1,) * (out.ndim - 1))
+        out = jnp.where(mask, out, jnp.zeros_like(out))
     else:
         raise ValueError(f"unknown pool_type {pool_type!r}")
     return Tensor(out)

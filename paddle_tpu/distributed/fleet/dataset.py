@@ -124,10 +124,10 @@ class InMemoryDataset(DatasetBase):
             new_slots.append((vals, offs))
         self._slots = new_slots
         self._num_samples = len(samples)
-        self._slot_is_dense = [
-            bool(len(offs) > 1 and np.all(np.diff(offs)
-                                          == (offs[1] - offs[0])))
-            for _, offs in self._slots]
+        # NOTE: _slot_is_dense is the dataset SCHEMA — invariant under
+        # shuffling. Re-deriving it from whichever samples landed here
+        # could classify a sparse slot as dense on one rank and not
+        # another, desyncing batch structure across data-parallel ranks.
 
     def global_shuffle(self, fleet=None, thread_num=None,
                        ps_endpoints=None, rank=None, world=None,
@@ -143,10 +143,21 @@ class InMemoryDataset(DatasetBase):
         single-controller reduction: permute in memory."""
         if ps_endpoints:
             from ..ps import PSClient
+            from ...core.errors import enforce, enforce_not_none
             import pickle
+            enforce_not_none(rank, "global_shuffle(ps_endpoints=...) "
+                             "requires rank=")
+            enforce_not_none(world, "global_shuffle(ps_endpoints=...) "
+                             "requires world=")
+            enforce(0 <= rank < world,
+                    f"rank {rank} out of range for world {world}")
             client = PSClient(ps_endpoints)
             try:
-                rs = np.random.RandomState(seed)
+                # decorrelate destination draws per worker: a shared
+                # seed would give every worker the SAME dests sequence
+                # (sample i of every worker co-located, not a shuffle)
+                rs = np.random.RandomState(
+                    None if seed is None else seed + 7919 * (rank + 1))
                 samples = self._export_samples()
                 dests = rs.randint(0, world, size=len(samples))
                 for d in range(world):

@@ -79,11 +79,13 @@ def make_scheduler(closed=0, ready=0, record=1000000, repeat=0,
 
 def export_chrome_tracing(dir_name, worker_name=None):
     """Reference: paddle.profiler.export_chrome_tracing. The XPlane
-    capture already contains a Perfetto/chrome-compatible trace; this
-    callback surfaces where it landed."""
+    capture already contains a Perfetto/chrome-compatible trace; the
+    callback carries the target dir so the Profiler redirects its
+    capture there BEFORE the first trace starts (assigning at
+    trace-ready time would be too late — the file is already written)."""
     def on_ready(prof):
-        prof.log_dir = dir_name
         return dir_name
+    on_ready._export_dir = dir_name
     return on_ready
 
 
@@ -104,6 +106,9 @@ class Profiler:
                                        record=stop - start, repeat=1)
         self.scheduler = scheduler
         self.on_trace_ready = on_trace_ready
+        export_dir = getattr(on_trace_ready, "_export_dir", None)
+        if export_dir is not None:
+            self.log_dir = export_dir
         self._started = False
         self._tracing = False
         self._step_num = 0

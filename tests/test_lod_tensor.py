@@ -102,3 +102,23 @@ def test_lod_tensor_is_a_tensor():
     t = _t()
     out = (t * 2.0).numpy()
     np.testing.assert_allclose(out, 2 * np.asarray(t.numpy()))
+
+
+def test_empty_sequence_first_last_zero():
+    data = np.arange(8, dtype="float32").reshape(4, 2)
+    t = LoDTensor(data, lod=[[0, 2, 2, 4]])  # middle sequence empty
+    f = np.asarray(lod_sequence_pool(t, "FIRST").numpy())
+    l = np.asarray(lod_sequence_pool(t, "LAST").numpy())
+    np.testing.assert_allclose(f[1], [0, 0])  # not seq 2's first row
+    np.testing.assert_allclose(l[1], [0, 0])  # not seq 0's last row
+    np.testing.assert_allclose(f[0], data[0])
+    np.testing.assert_allclose(l[2], data[3])
+
+
+def test_set_lod_rejection_preserves_state():
+    data = np.ones((4, 1), "float32")
+    t = LoDTensor(data, lod=[[0, 2, 4]])
+    with pytest.raises(ValueError):
+        t.set_lod([[0, 3, 2, 4]])
+    assert t.lod() == [[0, 2, 4]]  # unchanged after the rejection
+    assert t.has_valid_recursive_sequence_lengths()

@@ -82,3 +82,16 @@ def test_timer_only_mode_writes_nothing(tmp_path):
     p.step()
     p.stop()
     assert not os.path.exists(log_dir)
+
+
+def test_export_chrome_tracing_redirects_capture(tmp_path):
+    target = str(tmp_path / "chrome_out")
+    p = prof_mod.Profiler(
+        log_dir=str(tmp_path / "ignored"),
+        on_trace_ready=prof_mod.export_chrome_tracing(target))
+    p.start()
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    (x + 1).numpy()
+    p.stop()
+    assert _xplane_files(target), "trace did not land in the export dir"
+    assert not os.path.exists(str(tmp_path / "ignored"))

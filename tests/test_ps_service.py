@@ -316,3 +316,19 @@ def test_global_shuffle_across_workers(cluster, tmp_path):
     # the deal actually crossed workers (seed 123 mixes both ranges)
     assert any(v >= 100 for v in v0) or any(v < 100 for v in v1)
     assert ds0.get_memory_data_size() + ds1.get_memory_data_size() == 16
+
+
+def test_global_shuffle_validates_args(cluster, tmp_path):
+    from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+    from paddle_tpu import errors
+    client, eps = cluster
+    p = tmp_path / "v.txt"
+    p.write_text("1 1\n1 2\n")
+    ds = InMemoryDataset()
+    ds.init(batch_size=1, thread_num=1)
+    ds.set_filelist([str(p)])
+    ds.load_into_memory()
+    with pytest.raises(errors.NotFoundError):
+        ds.global_shuffle(ps_endpoints=eps)  # missing rank/world
+    with pytest.raises(errors.InvalidArgumentError):
+        ds.global_shuffle(ps_endpoints=eps, rank=5, world=2)
