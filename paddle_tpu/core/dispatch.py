@@ -121,14 +121,19 @@ class Op:
                 and any(isinstance(a, _static_variable_cls) for a in args):
             # static-graph building (paddle.enable_static): record the op
             # into the current Program instead of executing (reference:
-            # framework.py append_op path of every layer/op helper)
+            # framework.py append_op path of every layer/op helper). The
+            # active AMP autocast list is captured per op record — the
+            # reference's static-AMP program rewrite
+            # (fluid/contrib/mixed_precision/decorator.py)
             from ..static.program import building_program
+            from ..amp.auto_cast import _cast_dtype_for
             prog = building_program()
             if prog is None:
                 raise RuntimeError(
                     f"op {self.name!r} called on a static Variable outside "
                     "a program_guard / enable_static context")
-            return prog.append_op(self, args, attrs)
+            return prog.append_op(self, args, attrs,
+                                  cast_dtype=_cast_dtype_for(self.name))
 
         tensor_args = []   # Tensor (or None) owner per *array slot*
         arrays = []

@@ -9,7 +9,8 @@ computation per feed signature.
 from .input_spec import InputSpec  # noqa: F401
 from .program import (  # noqa: F401
     Program, Variable, Executor, program_guard, append_backward,
-    building_program, _set_building)
+    building_program, _set_building, save_inference_model,
+    load_inference_model)
 
 _static_mode = [False]
 _default_main = Program()

@@ -151,14 +151,18 @@ class OpRecord:
     names (str), ("#const", array) or None; writebacks map output index ->
     persistable Tensor updated in place by this op (optimizer updates)."""
 
-    __slots__ = ("op", "in_refs", "out_names", "attrs", "writebacks")
+    __slots__ = ("op", "in_refs", "out_names", "attrs", "writebacks",
+                 "cast")
 
-    def __init__(self, op, in_refs, out_names, attrs):
+    def __init__(self, op, in_refs, out_names, attrs, cast=None):
         self.op = op
         self.in_refs = in_refs
         self.out_names = out_names
         self.attrs = attrs
         self.writebacks = {}
+        # AMP: cast float inputs to this dtype before the kernel (the
+        # autocast list active when the op was recorded)
+        self.cast = cast
 
     @property
     def type(self):
@@ -228,7 +232,7 @@ class Program:
             self.persist[tensor.name] = tensor
         return tensor.name
 
-    def append_op(self, op, args, attrs):
+    def append_op(self, op, args, attrs, cast_dtype=None):
         """Called from Op.__call__ when building: records instead of
         executing; infers output shapes via jax.eval_shape."""
         in_refs = []
@@ -252,7 +256,8 @@ class Program:
                 avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
 
         def shape_fn(*arrs):
-            return op.fn(*arrs, **attrs)
+            return op.fn(*[_maybe_cast(a, cast_dtype) for a in arrs],
+                         **attrs)
 
         zeros = [None if av is None else jnp.zeros(av.shape, av.dtype)
                  for av in avals]
@@ -267,7 +272,8 @@ class Program:
             self.vars[name] = v
             out_names.append(name)
             out_vars.append(v)
-        self.ops.append(OpRecord(op, in_refs, out_names, dict(attrs)))
+        self.ops.append(OpRecord(op, in_refs, out_names, dict(attrs),
+                                 cast=cast_dtype))
         return tuple(out_vars) if multi else out_vars[0]
 
     def mark_writeback(self, out_var, target_tensor):
@@ -328,7 +334,8 @@ class Program:
                 if isinstance(r, GradRecord):
                     break
                 if r.writebacks:
-                    r2 = OpRecord(r.op, r.in_refs, r.out_names, r.attrs)
+                    r2 = OpRecord(r.op, r.in_refs, r.out_names, r.attrs,
+                                  cast=r.cast)
                     fwd.append(r2)
                 else:
                     fwd.append(r)
@@ -371,6 +378,13 @@ class program_guard:
         return False
 
 
+def _maybe_cast(a, cast_dtype):
+    if cast_dtype is not None and a is not None \
+            and jnp.issubdtype(a.dtype, jnp.floating):
+        return a.astype(cast_dtype)
+    return a
+
+
 def _interpret(records, env, persist_written):
     """Execute op records over an env of name -> array."""
     for rec in records:
@@ -391,9 +405,9 @@ def _interpret(records, env, persist_written):
             if r is None:
                 ins.append(None)
             elif isinstance(r, str):
-                ins.append(env[r])
+                ins.append(_maybe_cast(env[r], rec.cast))
             else:
-                ins.append(r[1])
+                ins.append(_maybe_cast(r[1], rec.cast))
         outs = rec.op.fn(*ins, **rec.attrs)
         out_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
         for name, o in zip(rec.out_names, out_list):
@@ -490,6 +504,114 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     if prog is None:
         raise RuntimeError("append_backward requires static mode")
     return prog.append_backward(loss, parameter_list)
+
+
+# -- program serialization (reference: save_inference_model writing
+# ProgramDesc protobuf + persistables, fluid/io.py:668; here the op-list
+# IR serializes by op NAME — ops rebind from the registry at load) --------
+
+def _serialize_program(program):
+    recs = []
+    for rec in program.ops:
+        if isinstance(rec, GradRecord):
+            recs.append({"kind": "grad", "loss": rec.loss_name,
+                         "params": [p.name for p in rec.params],
+                         "grad_names": list(rec.grad_names),
+                         "upto": rec.upto})
+        else:
+            recs.append({
+                "kind": "op", "type": rec.op.name,
+                "in_refs": [r if (r is None or isinstance(r, str))
+                            else ("#const", np.asarray(r[1]))
+                            for r in rec.in_refs],
+                "out_names": list(rec.out_names),
+                "attrs": rec.attrs,
+                "cast": None if rec.cast is None
+                else np.dtype(rec.cast).name,
+                "writebacks": {i: t.name
+                               for i, t in rec.writebacks.items()},
+            })
+    var_meta = {n: (list(v._shape), v._dtype.name, v.stop_gradient)
+                for n, v in program.vars.items()}
+    persist = {n: (np.asarray(t._value),
+                   bool(getattr(t, "trainable", True)),
+                   bool(t.stop_gradient))
+               for n, t in program.persist.items()}
+    return {"records": recs, "vars": var_meta, "persist": persist,
+            "feed_names": list(program.feed_names),
+            "counter": program._counter[0]}
+
+
+def _deserialize_program(blob):
+    from ..core.dispatch import _REGISTRY
+    prog = Program()
+    prog.feed_names = list(blob["feed_names"])
+    prog._counter = [int(blob.get("counter", 0))]
+    for n, (shape, dtype, stop_grad) in blob["vars"].items():
+        prog.vars[n] = Variable(n, shape, np.dtype(dtype), prog,
+                                stop_gradient=stop_grad)
+    for n, (arr, trainable, stop_grad) in blob["persist"].items():
+        t = Tensor(arr, name=n, persistable=True,
+                   stop_gradient=stop_grad)
+        t.trainable = trainable
+        prog.persist[n] = t
+    for r in blob["records"]:
+        if r["kind"] == "grad":
+            prog.ops.append(GradRecord(
+                r["loss"], [prog.persist[p] for p in r["params"]],
+                list(r["grad_names"]), int(r["upto"])))
+            continue
+        op = _REGISTRY.get(r["type"])
+        if op is None:
+            raise ValueError(
+                f"program references unknown op {r['type']!r}; is the "
+                "op registered in this build?")
+        rec = OpRecord(op,
+                       [x if (x is None or isinstance(x, str))
+                        else ("#const", jnp.asarray(x[1]))
+                        for x in r["in_refs"]],
+                       list(r["out_names"]), dict(r["attrs"]),
+                       cast=None if r.get("cast") is None
+                       else jnp.dtype(r["cast"]))
+        rec.writebacks = {int(i): prog.persist[name]
+                          for i, name in r["writebacks"].items()}
+        prog.ops.append(rec)
+    return prog
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference: paddle.static.save_inference_model — persists the
+    PRUNED (forward-only) program plus its persistables; feed/fetch var
+    names are recorded so load restores the serving contract."""
+    import pickle
+    if program is None:
+        program = building_program()
+    if program is None:
+        raise RuntimeError("no program to save")
+    pruned = program.clone(for_test=True)
+    blob = _serialize_program(pruned)
+    blob["feed_targets"] = [v.name if isinstance(v, Variable) else str(v)
+                            for v in (feed_vars or [])]
+    blob["fetch_targets"] = [v.name if isinstance(v, Variable) else str(v)
+                             for v in (fetch_vars or [])]
+    with open(str(path_prefix) + ".pdmodel", "wb") as f:
+        pickle.dump(blob, f, protocol=4)
+    return str(path_prefix) + ".pdmodel"
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Reference: paddle.static.load_inference_model — returns
+    (program, feed_target_names, fetch_targets)."""
+    import pickle
+    path = str(path_prefix)
+    if not path.endswith(".pdmodel"):
+        path += ".pdmodel"
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    prog = _deserialize_program(blob)
+    fetch = [prog.vars[n] for n in blob.get("fetch_targets", [])]
+    return prog, list(blob.get("feed_targets", [])), fetch
 
 
 _register_with_dispatch()

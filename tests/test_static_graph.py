@@ -235,3 +235,67 @@ def test_executor_cache_invalidated_on_attr_edit(static_mode):
     o2, = exe.run(prog, feed={"x": xb}, fetch_list=[a])
     np.testing.assert_allclose(o1, 2.0 * xb)
     np.testing.assert_allclose(o2, 5.0 * xb)
+
+
+def test_save_load_inference_model(static_mode, tmp_path):
+    """Reference: fluid/io.py:668 save_inference_model — the serialized
+    op-list program + persistables round-trips and serves."""
+    prog = static_mode
+    x = static.data("x", [None, 6], "float32")
+    h = static.nn.fc(x, 12, activation="relu", name="s1")
+    pred = static.nn.fc(h, 3, name="s2")
+    import paddle_tpu as M
+    loss = M.mean(M.square(pred))
+    opt = paddle.optimizer.SGD(learning_rate=0.05)
+    opt.minimize(loss)
+    exe = static.Executor()
+    xb = np.random.RandomState(0).randn(4, 6).astype("float32")
+    for _ in range(3):  # train so persistables are non-initial
+        exe.run(prog, feed={"x": xb}, fetch_list=[loss])
+    expect, = exe.run(prog.clone(for_test=True), feed={"x": xb},
+                      fetch_list=[pred])
+
+    path = str(tmp_path / "served")
+    static.save_inference_model(path, [x], [pred], exe, program=prog)
+
+    prog2, feeds, fetches = static.load_inference_model(path, exe)
+    assert feeds == ["x"]
+    assert [f.name for f in fetches] == [pred.name]
+    # the loaded program has its own parameter copies
+    assert not (set(id(t) for t in prog2.persist.values())
+                & set(id(t) for t in prog.persist.values()))
+    got, = static.Executor().run(prog2, feed={"x": xb},
+                                 fetch_list=fetches)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    # pruned: no grad records / writebacks in the served program
+    out1, = static.Executor().run(prog2, feed={"x": xb},
+                                  fetch_list=fetches)
+    out2, = static.Executor().run(prog2, feed={"x": xb},
+                                  fetch_list=fetches)
+    np.testing.assert_allclose(out1, out2)
+
+
+def test_static_amp_autocast_records(static_mode):
+    """Static-AMP: ops recorded under auto_cast carry the cast and run
+    in bf16 (reference: fluid/contrib/mixed_precision/decorator.py
+    program rewrite)."""
+    prog = static_mode
+    x = static.data("x", [None, 8], "float32")
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        h = static.nn.fc(x, 16, name="amp_fc")
+    import paddle_tpu as M
+    loss = M.mean(M.square(h))
+    opt = paddle.optimizer.SGD(learning_rate=0.05)
+    opt.minimize(loss)
+    # the matmul record carries the cast; the out-of-scope ops do not
+    casts = {r.type: getattr(r, "cast", None) for r in prog.ops
+             if not isinstance(r, static.program.GradRecord)}
+    assert any(c is not None for c in casts.values()), casts
+    assert casts.get("reduce_mean") is None  # recorded outside auto_cast
+    exe = static.Executor()
+    xb = np.random.RandomState(0).randn(8, 8).astype("float32")
+    # h was produced by a bf16 matmul chain
+    hv, l1 = exe.run(prog, feed={"x": xb}, fetch_list=[h, loss])
+    assert str(hv.dtype) == "bfloat16"
+    l2 = exe.run(prog, feed={"x": xb}, fetch_list=[loss])[0]
+    assert float(l2) < float(l1)  # still trains under bf16
