@@ -14,3 +14,4 @@ from .dataset import Dataset, IterableDataset, TensorDataset, Subset, \
 from .sampler import Sampler, SequenceSampler, RandomSampler, BatchSampler, \
     DistributedBatchSampler, WeightedRandomSampler  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .worker import get_worker_info, WorkerInfo  # noqa: F401,E402

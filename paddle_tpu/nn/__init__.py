@@ -7,9 +7,13 @@ from . import functional  # noqa: F401
 from .layer.common import (  # noqa: F401
     Linear, Dropout, Dropout2D, Embedding, Flatten, Identity, Pad2D,
     Upsample, UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
-    CosineSimilarity, Bilinear,
+    CosineSimilarity, Bilinear, Pad1D, Pad3D, Dropout3D, AlphaDropout,
+    PairwiseDistance, Unfold,
 )
-from .layer.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv2DTranspose, Conv1DTranspose,
+    Conv3DTranspose,
+)
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
     LayerNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
@@ -17,7 +21,8 @@ from .layer.norm import (  # noqa: F401
 )
 from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool1D,
-    AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, MaxPool3D, AvgPool3D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool3D, AdaptiveMaxPool1D,
 )
 from .layer.activation import (  # noqa: F401
     ReLU, ReLU6, Sigmoid, Tanh, Silu, Swish, Mish, Hardswish, Hardsigmoid,
@@ -27,7 +32,7 @@ from .layer.activation import (  # noqa: F401
 )
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
-    SmoothL1Loss, KLDivLoss, MarginRankingLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CTCLoss, HSigmoidLoss,
 )
 from .layer.container import (  # noqa: F401
     Sequential, LayerList, ParameterList, LayerDict,
@@ -37,7 +42,8 @@ from .layer.transformer import (  # noqa: F401
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
 from .layer.rnn import (  # noqa: F401
-    LSTM, GRU, SimpleRNN, LSTMCell, GRUCell, RNNBase,
+    LSTM, GRU, SimpleRNN, LSTMCell, GRUCell, RNNBase, RNNCellBase,
+    SimpleRNNCell, RNN, BiRNN, BeamSearchDecoder, dynamic_decode,
 )
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,

@@ -16,7 +16,14 @@ from ...ops.nn_ops import (  # noqa: F401
     nll_loss, kl_div, square_error_cost, margin_ranking_loss,
     cosine_similarity, interpolate, upsample, pixel_shuffle, label_smooth,
     temporal_shift,
+    max_pool3d, avg_pool3d, adaptive_avg_pool3d, adaptive_max_pool3d,
+    adaptive_avg_pool1d, adaptive_max_pool1d, conv1d_transpose,
+    conv3d_transpose, dropout3d, alpha_dropout, maxout, bilinear,
+    log_loss, dice_loss, npair_loss, sigmoid_focal_loss, ctc_loss,
+    hsigmoid_loss, affine_grid, grid_sample, gather_tree,
+    relu_, elu_, softmax_,
 )
+from ...ops.math import tanh_  # noqa: F401
 from ...ops.manipulation import pad, unfold  # noqa: F401
 from ...ops.attention import (  # noqa: F401
     scaled_dot_product_attention, flash_attention,

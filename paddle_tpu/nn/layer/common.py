@@ -178,3 +178,78 @@ class Bilinear(Layer):
         if self.bias is not None:
             out = math_ops.add(out, self.bias)
         return out
+
+
+class Pad1D(Layer):
+    """Reference: nn/layer/common.py Pad1D over NCL input."""
+
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return manipulation.pad(x, self.padding, self.mode, self.value,
+                                "NCL")
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return manipulation.pad(x, self.padding, self.mode, self.value,
+                                "NCDHW")
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return nn_ops.dropout3d(x, self.p, training=self.training)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return nn_ops.alpha_dropout(x, self.p, training=self.training)
+
+
+class PairwiseDistance(Layer):
+    """Reference: nn/layer/distance.py PairwiseDistance."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ...ops import math as m, reduction as r
+        diff = m.subtract(x, y)
+        return r.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class Unfold(Layer):
+    """Reference: nn/layer/common.py Unfold (im2col)."""
+
+    def __init__(self, kernel_sizes, dilations=1, paddings=0, strides=1,
+                 name=None):
+        super().__init__()
+        self.args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        k, s, p, d = self.args
+        return manipulation.unfold(x, k, strides=s, paddings=p,
+                                   dilations=d)

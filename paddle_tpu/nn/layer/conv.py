@@ -94,3 +94,44 @@ class Conv2DTranspose(_ConvNd):
         return nn_ops.conv2d_transpose(
             x, self.weight, self.bias, self._stride, self._padding,
             self._output_padding, self._dilation, self._groups)
+
+
+class Conv1DTranspose(Layer):
+    """Reference: nn/layer/conv.py Conv1DTranspose (weight [in, out, k])."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, k), weight_attr)
+        self.bias = self.create_parameter(
+            (out_channels,), bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+
+    def forward(self, x):
+        return nn_ops.conv1d_transpose(x, self.weight, self.bias,
+                                       self._stride, self._padding,
+                                       dilation=self._dilation)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        ks = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + ks, weight_attr)
+        self.bias = self.create_parameter(
+            (out_channels,), bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+        self._stride, self._padding, self._dilation = stride, padding, dilation
+
+    def forward(self, x):
+        return nn_ops.conv3d_transpose(x, self.weight, self.bias,
+                                       self._stride, self._padding,
+                                       dilation=self._dilation)

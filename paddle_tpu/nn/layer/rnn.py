@@ -272,3 +272,191 @@ class GRUCell(Layer):
         y, h_new = _gru_layer(x1, states, self.weight_ih, self.weight_hh,
                               self.bias_ih, self.bias_hh, reverse=False)
         return h_new, h_new
+
+
+class RNNCellBase(Layer):
+    """Reference: paddle.nn.RNNCellBase — base protocol for cells usable
+    with paddle.nn.RNN / BiRNN / dynamic_decode: forward(inputs, states)
+    -> (outputs, new_states), plus get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ... import ops
+        b = batch_ref.shape[batch_dim_idx]
+        hs = getattr(self, "hidden_size")
+        dt = dtype or "float32"
+        if isinstance(self, LSTMCell):
+            return (ops.creation.full((b, hs), init_value, dt),
+                    ops.creation.full((b, hs), init_value, dt))
+        return ops.creation.full((b, hs), init_value, dt)
+
+    @property
+    def state_shape(self):
+        hs = getattr(self, "hidden_size")
+        if isinstance(self, LSTMCell):
+            return ((hs,), (hs,))
+        return (hs,)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """Reference: paddle.nn.SimpleRNNCell (tanh/relu single-gate)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / (hidden_size ** 0.5)
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), weight_ih_attr,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), weight_hh_attr,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), bias_ih_attr, is_bias=True,
+            default_initializer=init_mod.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), bias_hh_attr, is_bias=True,
+            default_initializer=init_mod.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        from ...ops import math as m, nn_ops
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre = m.add(
+            m.add(m.matmul(inputs, manipulation.t(self.weight_ih)),
+                  self.bias_ih),
+            m.add(m.matmul(states, manipulation.t(self.weight_hh)),
+                  self.bias_hh))
+        out = nn_ops.relu(pre) if self.activation == "relu" \
+            else m.tanh(pre)
+        return out, out
+
+
+class RNN(Layer):
+    """Reference: paddle.nn.RNN — wraps ANY RNNCellBase cell, scanning it
+    over the time axis (python loop: the cell is an arbitrary Layer; under
+    to_static the unrolled steps compile into one XLA program)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import ops
+        x = inputs if self.time_major else \
+            manipulation.transpose(inputs, (1, 0, 2))
+        T = x.shape[0]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in steps:
+            y, states = self.cell(x[t], states)
+            outs[t] = y
+        out = manipulation.stack(outs, axis=0)
+        if not self.time_major:
+            out = manipulation.transpose(out, (1, 0, 2))
+        return out, states
+
+
+class BiRNN(Layer):
+    """Reference: paddle.nn.BiRNN — forward + backward cells, outputs
+    concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        ifw = ibw = None
+        if initial_states is not None:
+            ifw, ibw = initial_states
+        out_f, st_f = self.rnn_fw(inputs, ifw)
+        out_b, st_b = self.rnn_bw(inputs, ibw)
+        out = manipulation.concat([out_f, out_b], axis=-1)
+        return out, (st_f, st_b)
+
+
+class BeamSearchDecoder(Layer):
+    """Reference: paddle.nn.BeamSearchDecoder — beam expansion over a
+    cell + output layer; used through dynamic_decode."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Reference: paddle.nn.dynamic_decode (rnn.py dynamic_decode +
+    gather_tree finalize). Greedy-within-beam decode driven on the host;
+    returns (ids [B, T, beam], final_states)."""
+    import numpy as np
+    from ... import ops
+    from ...ops import nn_ops, math as m
+    cell = decoder.cell
+    beam = decoder.beam_size
+    # fake a batch from inits or default batch 1
+    if inits is None:
+        raise ValueError("dynamic_decode requires initial states (inits)")
+    states = inits
+    h0 = states[0] if isinstance(states, (tuple, list)) else states
+    b = h0.shape[0]
+    # tile beams into the batch: [B*beam, ...]
+    def tile(t):
+        return manipulation.reshape(
+            manipulation.tile(manipulation.unsqueeze(t, 1),
+                              (1, beam, 1)), (b * beam, -1))
+    if isinstance(states, (tuple, list)):
+        states = type(states)(tile(s) for s in states)
+    else:
+        states = tile(states)
+    tok = ops.creation.full((b * beam,), decoder.start_token, "int64")
+    log_probs = ops.creation.zeros((b, beam), "float32")
+    ids_steps = []
+    parents_steps = []
+    finished = ops.creation.zeros((b, beam), "bool")
+    for _ in range(max_step_num):
+        emb = decoder.embedding_fn(tok) if decoder.embedding_fn \
+            else manipulation.unsqueeze(m.cast(tok, "float32"), -1)
+        out, states = cell(emb, states)
+        logits = decoder.output_fn(out) if decoder.output_fn else out
+        logp = nn_ops.log_softmax(logits, axis=-1)  # [B*beam, V]
+        V = logp.shape[-1]
+        logp = manipulation.reshape(logp, (b, beam, V))
+        total = m.add(manipulation.unsqueeze(log_probs, -1), logp)
+        flat = manipulation.reshape(total, (b, beam * V))
+        top_v, top_i = ops.search.topk(flat, beam, axis=-1)
+        parent = m.cast(ops.math.floor_divide(
+            top_i, ops.creation.full((1,), V, "int64")), "int64")
+        word = ops.math.remainder(
+            top_i, ops.creation.full((1,), V, "int64"))
+        log_probs = top_v
+        ids_steps.append(word)
+        parents_steps.append(parent)
+        # regather states by parent beam
+        import jax.numpy as jnp
+        flat_parent = (parent.value + (jnp.arange(b) * beam)[:, None]
+                       ).reshape(-1)
+        def regather(s):
+            from ...core.tensor import Tensor
+            return Tensor(jnp.take(s.value, flat_parent, axis=0))
+        if isinstance(states, (tuple, list)):
+            states = type(states)(regather(s) for s in states)
+        else:
+            states = regather(states)
+        tok = manipulation.reshape(word, (b * beam,))
+    ids = manipulation.stack(ids_steps, axis=0)        # [T, B, beam]
+    parents = manipulation.stack(parents_steps, axis=0)
+    seqs = nn_ops.gather_tree(ids, parents)
+    return manipulation.transpose(seqs, (1, 0, 2)), states

@@ -117,9 +117,51 @@ def _patch():
         # creation-ish
         "zeros_like": creation.zeros_like, "ones_like": creation.ones_like,
         "full_like": creation.full_like,
+        # round-2 additions (reference tensor/__init__.py method list)
+        "concat": mp.concat, "stack": mp.stack,
+        "strided_slice": mp.strided_slice, "shard_index": mp.shard_index,
+        "multiplex": mp.multiplex, "reverse": mp.reverse,
+        "broadcast_tensors": mp.broadcast_tensors,
+        "moveaxis": mp.moveaxis, "index_add": mp.index_add,
+        "index_fill": mp.index_fill, "tensordot": mp.tensordot,
+        "as_real": mp.as_real, "as_complex": mp.as_complex,
+        "add_n": m.add_n, "cross": m.cross, "histogram": m.histogram,
+        "digamma": m.digamma, "lgamma": m.lgamma, "real": m.real,
+        "imag": m.imag, "floor_mod": m.floor_mod,
+        "broadcast_shape": mp.broadcast_shape,
+        "is_empty": lg.is_empty, "is_tensor": lg.is_tensor,
+        "t": mp.t, "bincount": s.bincount, "bucketize": s.bucketize,
+        "nanmedian": r.nanmedian, "nanquantile": r.nanquantile,
+        "renorm": m.renorm, "logcumsumexp": m.logcumsumexp,
+        "trapezoid": m.trapezoid, "vander": m.vander,
     }
     for name, fn in methods.items():
         setattr(T, name, meth(fn))
+
+    def rank_m(self):
+        return creation.to_tensor(self.ndim)
+    T.rank = rank_m
+    T.scatter_nd = staticmethod(mp.scatter_nd)
+
+    # in-place variants (reference: tensor method list *_ entries) — the
+    # functional result is swapped into the tensor's buffer slot
+    def inplace(fn):
+        def _m(self, *a, **k):
+            out = fn(self, *a, **k)
+            self.value = out.value
+            return self
+        return _m
+
+    for base_name, fn in {
+        "add_": m.add, "subtract_": m.subtract, "ceil_": m.ceil,
+        "floor_": m.floor, "clip_": m.clip, "exp_": m.exp,
+        "reciprocal_": m.reciprocal, "round_": m.round,
+        "rsqrt_": m.rsqrt, "sqrt_": m.sqrt, "scale_": m.scale,
+        "squeeze_": mp.squeeze, "unsqueeze_": mp.unsqueeze,
+        "flatten_": mp.flatten, "scatter_": mp.scatter,
+        "tanh_": m.tanh,
+    }.items():
+        setattr(T, base_name, inplace(fn))
 
 
 _patch()

@@ -371,6 +371,8 @@ def _pad(x, *, paddings, mode, value):
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
     """paddle.nn.functional.pad. `pad` is [left,right,top,bottom,...] pairs on
     trailing dims (paddle convention) or full per-dim list."""
+    if isinstance(pad, int):  # scalar: pad every spatial dim (Pad1/2/3D)
+        pad = [pad] * (2 * max(x.ndim - 2, 1))
     pad = [int(p) for p in (pad.tolist() if isinstance(pad, Tensor) else pad)]
     nd = x.ndim
     if len(pad) == 2 * nd:

@@ -1052,3 +1052,462 @@ def _temporal_shift(x, *, seg_num, shift_ratio):
 
 def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
     return _temporal_shift(x, seg_num=int(seg_num), shift_ratio=float(shift_ratio))
+
+
+# ---- round-2 functional additions (reference: python/paddle/nn/
+# functional/{pooling,conv,loss,vision,extension}.py) -----------------------
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    """Reference: pool3d_op. 3D pooling folds depth into the batch dim
+    and reuses the 2D window machinery per depth slice of the kernel."""
+    ks = _triple(kernel_size)
+    st = _triple(stride) if stride is not None else ks
+    pd = _triple(padding)
+    return _pool3d(x, ksize=ks, strides=st, paddings=pd, mode="max")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    ks = _triple(kernel_size)
+    st = _triple(stride) if stride is not None else ks
+    return _pool3d(x, ksize=ks, strides=st, paddings=_triple(padding),
+                   mode="avg")
+
+
+@register_op("pool3d")
+def _pool3d(x, *, ksize, strides, paddings, mode):
+    kd, kh, kw = ksize
+    sd, sh, sw = strides
+    pd, ph, pw = paddings
+    if pd or ph or pw:
+        pad_v = (-jnp.inf if mode == "max" else 0.0)
+        x = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                    constant_values=pad_v)
+    d, h, w = x.shape[2:]
+    od = (d - kd) // sd + 1
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    out = None
+    for i in range(kd):
+        for j in range(kh):
+            for k in range(kw):
+                win = x[:, :, i:i + (od - 1) * sd + 1:sd,
+                        j:j + (oh - 1) * sh + 1:sh,
+                        k:k + (ow - 1) * sw + 1:sw]
+                if out is None:
+                    out = win
+                elif mode == "max":
+                    out = jnp.maximum(out, win)
+                else:
+                    out = out + win
+    if mode == "avg":
+        out = out / (kd * kh * kw)
+    return out
+
+
+@register_op("adaptive_pool3d")
+def _adaptive_pool3d(x, *, output_size, mode):
+    n, c, d, h, w = x.shape
+    od, oh, ow = output_size
+    assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+        "adaptive 3d pooling needs divisible sizes"
+    x6 = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+    if mode == "max":
+        return x6.max(axis=(3, 5, 7))
+    return x6.mean(axis=(3, 5, 7))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool3d(x, output_size=_triple(output_size),
+                            mode="avg")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool3d(x, output_size=_triple(output_size),
+                            mode="max")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    from . import manipulation
+    x4 = manipulation.unsqueeze(x, axis=2)
+    out = adaptive_avg_pool2d(x4, (1, int(output_size)))
+    return manipulation.squeeze(out, axis=2)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    from . import manipulation
+    x4 = manipulation.unsqueeze(x, axis=2)
+    out = adaptive_max_pool2d(x4, (1, int(output_size)))
+    return manipulation.squeeze(out, axis=2)
+
+
+@register_op("conv_transpose_nd")
+def _conv_transpose_nd(x, weight, bias, *, strides, paddings, dilations,
+                       nd):
+    # weight layout [in, out, *k] (paddle transpose-conv convention);
+    # expressed as a fractionally-strided conv exactly like
+    # _conv2d_transpose: flip spatial axes, swap I/O, lhs_dilation=stride
+    spatial = tuple(range(2, 2 + nd))
+    wf = jnp.flip(weight, axis=spatial)
+    wf = jnp.swapaxes(wf, 0, 1)  # [out, in, *k]
+    letters = "DHW"[3 - nd:]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, wf.shape, ("NC" + letters, "OI" + letters,
+                            "NC" + letters))
+    pad = tuple(
+        ((k - 1) * d + 1 - 1 - p, (k - 1) * d + 1 - 1 - p)
+        for k, d, p in zip(wf.shape[2:], dilations, paddings))
+    out = jax.lax.conv_general_dilated(
+        x, wf, window_strides=(1,) * nd, padding=pad,
+        lhs_dilation=strides, rhs_dilation=dilations,
+        dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    """Reference: conv2d_transpose_op (1D variant)."""
+    st = stride if isinstance(stride, int) else stride[0]
+    pd = padding if isinstance(padding, int) else padding[0]
+    dl = dilation if isinstance(dilation, int) else dilation[0]
+    return _conv_transpose_nd(x, weight, bias, strides=(st,),
+                              paddings=(pd,), dilations=(dl,), nd=1)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, strides=_triple(stride),
+                              paddings=_triple(padding),
+                              dilations=_triple(dilation), nd=3)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Channel-wise dropout for 5-D input (reference: dropout_nd)."""
+    if not training or p == 0.0:
+        return x
+    from ..core import rng as rng_mod
+    key = rng_mod.next_key()
+    return _dropout_nd(x, key, p=float(p), nd=3)
+
+
+@register_op("dropout_nd")
+def _dropout_nd(x, key, *, p, nd):
+    keep = 1.0 - p
+    mask_shape = x.shape[:2] + (1,) * nd
+    mask = jax.random.bernoulli(key, keep, mask_shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (reference: alpha_dropout in
+    nn/functional/common.py): keeps mean/variance of SELU activations."""
+    if not training or p == 0.0:
+        return x
+    from ..core import rng as rng_mod
+    key = rng_mod.next_key()
+    return _alpha_dropout(x, key, p=float(p))
+
+
+@register_op("alpha_dropout_op")
+def _alpha_dropout(x, key, *, p):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return a * jnp.where(mask, x, jnp.full_like(x, alpha_p)) + b
+
+
+def maxout(x, groups, axis=1, name=None):
+    """Reference: maxout_op — max over `groups` consecutive channels."""
+    return _maxout(x, groups=int(groups), axis=int(axis))
+
+
+@register_op("maxout_op")
+def _maxout(x, *, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    assert c % groups == 0, "channels must divide groups"
+    new = shape[:axis] + [c // groups, groups] + shape[axis + 1:]
+    return jnp.max(x.reshape(new), axis=axis + 1)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Reference: bilinear_tensor_product_op: out[b,k] =
+    x1[b,:] @ W[k] @ x2[b,:] + bias[k]."""
+    return _bilinear(x1, x2, weight, bias)
+
+
+@register_op("bilinear_op")
+def _bilinear(x1, x2, weight, bias):
+    out = jnp.einsum("bi,kij,bj->bk", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+# -- losses -----------------------------------------------------------------
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    """Reference: log_loss_op."""
+    return _log_loss(input, label, epsilon=float(epsilon))
+
+
+@register_op("log_loss_op")
+def _log_loss(x, label, *, epsilon):
+    return (-label * jnp.log(x + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - x + epsilon))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Reference: nn/functional/loss.py dice_loss (segmentation)."""
+    return _dice_loss(input, label, epsilon=float(epsilon))
+
+
+@register_op("dice_loss_op")
+def _dice_loss(x, label, *, epsilon):
+    lab = label
+    if lab.ndim == x.ndim:
+        lab = jnp.squeeze(lab, axis=-1)
+    oh = jax.nn.one_hot(lab, x.shape[-1], dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * oh, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(oh, axis=reduce_dims)
+    dice = (2.0 * inter + epsilon) / (union + epsilon)
+    return jnp.mean(1.0 - dice)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference: nn/functional/loss.py npair_loss."""
+    return _npair_loss(anchor, positive, labels, l2_reg=float(l2_reg))
+
+
+@register_op("npair_loss_op")
+def _npair_loss(anchor, positive, labels, *, l2_reg):
+    lab = labels.reshape(-1, 1)
+    same = (lab == lab.T).astype(anchor.dtype)
+    same = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True), 1e-12)
+    logits = anchor @ positive.T
+    logp = jax.nn.log_softmax(logits, axis=1)
+    xent = -jnp.sum(same * logp, axis=1).mean()
+    reg = l2_reg * (jnp.sum(anchor * anchor)
+                    + jnp.sum(positive * positive)) / anchor.shape[0]
+    return xent + reg
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum", name=None):
+    """Reference: sigmoid_focal_loss_op (RetinaNet loss)."""
+    out = _sigmoid_focal_loss(logit, label, alpha=float(alpha),
+                              gamma=float(gamma))
+    from . import reduction as r, math as m
+    if normalizer is not None:
+        out = m.divide(out, normalizer)
+    if reduction == "sum":
+        return r.sum(out)
+    if reduction == "mean":
+        return r.mean(out)
+    return out
+
+
+@register_op("sigmoid_focal_loss_op")
+def _sigmoid_focal_loss(logit, label, *, alpha, gamma):
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    return a_t * ((1 - p_t) ** gamma) * ce
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Reference: warpctc_op / paddle.nn.functional.ctc_loss.
+    log_probs: [T, B, C] (paddle layout); labels: [B, L] int32."""
+    return _ctc(log_probs, labels, input_lengths, label_lengths,
+                blank=int(blank), reduction=reduction)
+
+
+def _ctc(log_probs, labels, input_lengths, label_lengths, *, blank,
+         reduction):
+    import optax
+    from ..core.tensor import Tensor
+    lp = log_probs.value if isinstance(log_probs, Tensor) else log_probs
+    lab = labels.value if isinstance(labels, Tensor) else labels
+    il = (input_lengths.value if isinstance(input_lengths, Tensor)
+          else input_lengths)
+    ll = (label_lengths.value if isinstance(label_lengths, Tensor)
+          else label_lengths)
+    out = _ctc_op(Tensor(lp), Tensor(lab), Tensor(il), Tensor(ll),
+                  blank=blank)
+    from . import reduction as r
+    if reduction == "mean":
+        from . import math as m
+        # paddle normalizes each loss by its label length, then means
+        norm = m.divide(out, m.cast(Tensor(jnp.asarray(ll)), out.dtype))
+        return r.mean(norm)
+    if reduction == "sum":
+        return r.sum(out)
+    return out
+
+
+@register_op("warpctc")
+def _ctc_op(log_probs, labels, input_lengths, label_lengths, *, blank):
+    import optax
+    lp = jnp.transpose(log_probs, (1, 0, 2))  # [B, T, C]
+    T = lp.shape[1]
+    L = labels.shape[1]
+    t_idx = jnp.arange(T)[None, :]
+    logit_pad = (t_idx >= input_lengths[:, None]).astype(lp.dtype)
+    l_idx = jnp.arange(L)[None, :]
+    label_pad = (l_idx >= label_lengths[:, None]).astype(lp.dtype)
+    return optax.ctc_loss(lp, logit_pad, labels.astype(jnp.int32),
+                          label_pad, blank_id=blank)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Reference: hierarchical_sigmoid_op (default complete binary tree;
+    custom path_table/path_code not supported — raise clearly)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported")
+    return _hsigmoid(input, label, weight, bias,
+                     num_classes=int(num_classes))
+
+
+@register_op("hsigmoid_op")
+def _hsigmoid(x, label, weight, bias, *, num_classes):
+    # complete-binary-tree codes: internal nodes = num_classes - 1.
+    # class c's path visits nodes derived from (c + num_classes) >> k.
+    code_len = int(np.ceil(np.log2(num_classes)))
+    lab = label.reshape(-1)
+    c = lab + num_classes
+    losses = jnp.zeros(lab.shape, x.dtype)
+    for k in range(code_len, 0, -1):
+        node = c >> k
+        bit = ((c >> (k - 1)) & 1).astype(x.dtype)
+        active = (node >= 1) & (node - 1 < num_classes - 1)
+        nidx = jnp.clip(node - 1, 0, num_classes - 2)
+        w_row = jnp.take(weight, nidx, axis=0)
+        logit = jnp.sum(x * w_row, axis=-1)
+        if bias is not None:
+            logit = logit + jnp.take(bias.reshape(-1), nidx)
+        # bit==1 -> right child: sigmoid target 1
+        ce = -(bit * jax.nn.log_sigmoid(logit)
+               + (1 - bit) * jax.nn.log_sigmoid(-logit))
+        losses = losses + jnp.where(active, ce, 0.0)
+    return losses.reshape(label.shape[:1] + (1,))
+
+
+# -- vision sampling --------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Reference: affine_grid_op — sampling grid [N, H, W, 2] from 2x3
+    affine matrices."""
+    sh = [int(s) for s in (out_shape.numpy().tolist()
+                           if hasattr(out_shape, "numpy") else out_shape)]
+    return _affine_grid(theta, out_shape=tuple(sh),
+                        align_corners=bool(align_corners))
+
+
+@register_op("affine_grid_op")
+def _affine_grid(theta, *, out_shape, align_corners):
+    n, c, h, w = out_shape
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1).reshape(1, h * w, 3)
+    base = jnp.broadcast_to(base, (n, h * w, 3)).astype(theta.dtype)
+    out = jnp.einsum("nhk,nck->nhc", base, theta)  # [N, H*W, 2]
+    return out.reshape(n, h, w, 2)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Reference: grid_sampler_op — bilinear/nearest sampling of x
+    [N,C,H,W] at grid [N,Ho,Wo,2] normalized coords."""
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                        align_corners=bool(align_corners))
+
+
+@register_op("grid_sampler")
+def _grid_sample(x, grid, *, mode, padding_mode, align_corners):
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+    def sample_one(img, cx, cy):
+        # img [C,H,W]; cx/cy [Ho,Wo]
+        coords = jnp.stack([cy.reshape(-1), cx.reshape(-1)], axis=0)
+        order = 1 if mode == "bilinear" else 0
+        out = jax.vmap(lambda ch: jax.scipy.ndimage.map_coordinates(
+            ch, list(coords), order=order, mode="constant", cval=0.0))(img)
+        return out.reshape(img.shape[0], *cx.shape)
+
+    return jax.vmap(sample_one)(x, fx, fy)
+
+
+def gather_tree(ids, parents):
+    """Reference: gather_tree_op — back-trace beam-search parent pointers
+    into full sequences. ids/parents: [T, B, beam]."""
+    return _gather_tree(ids, parents)
+
+
+@register_op("gather_tree_op", differentiable=False)
+def _gather_tree(ids, parents):
+    T = ids.shape[0]
+
+    def step(beams, t):
+        # beams: current beam index per [B, beam]
+        idx = T - 1 - t
+        out = jnp.take_along_axis(ids[idx], beams, axis=-1)
+        beams = jnp.take_along_axis(parents[idx], beams, axis=-1)
+        return beams, out
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, rev = jax.lax.scan(step, init, jnp.arange(T))
+    return rev[::-1]
+
+
+# -- inplace activation variants -------------------------------------------
+
+def relu_(x, name=None):
+    x.value = jax.nn.relu(x.value)
+    return x
+
+
+def elu_(x, alpha=1.0, name=None):
+    x.value = jax.nn.elu(x.value, alpha)
+    return x
+
+
+def softmax_(x, axis=-1, name=None):
+    x.value = jax.nn.softmax(x.value, axis=axis)
+    return x
