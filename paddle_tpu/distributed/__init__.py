@@ -17,3 +17,26 @@ from . import topology  # noqa: F401
 from . import fleet  # noqa: F401
 from .launch_mod import spawn, launch  # noqa: F401
 from . import sharding  # noqa: F401
+from .collective import send, recv, split  # noqa: F401,E402
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+
+
+class ProbabilityEntry:
+    """Reference: distributed/entry_attr.py — sparse-table entry admission
+    by show probability."""
+
+    def __init__(self, probability):
+        self.probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry:
+    """Reference: distributed/entry_attr.py — admission after N shows."""
+
+    def __init__(self, count_filter):
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"

@@ -423,3 +423,70 @@ def _mp_allreduce(tensor, op=ReduceOp.SUM, group=None,
     if not _axis_in_scope(g.axis):
         return tensor
     return _mp_allreduce_impl(tensor, axis=g.axis)
+
+
+@register_op("send_recv_shift", differentiable=True)
+def _ppermute_shift(x, *, axis, perm):
+    return jax.lax.ppermute(x, axis, perm=list(perm))
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Reference: collective.py send (send_v2 NCCL p2p). SPMD form:
+    inside shard_map a send is one side of a ppermute; the companion
+    recv on the peer completes it. Eager single-controller: the value is
+    staged on the group so the matching recv returns it (loopback
+    semantics, same process)."""
+    g = group or _default_group()
+    if _axis_in_scope(g.axis):
+        n = g.nranks
+        perm = [(i, dst if n == 1 else (dst % n)) for i in range(n)]
+        return _ppermute_shift(tensor, axis=g.axis, perm=perm)
+    _P2P_STAGE.setdefault(id(g) if g.id == 0 else g.id, []).append(
+        tensor)
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """Reference: collective.py recv (recv_v2)."""
+    g = group or _default_group()
+    if _axis_in_scope(g.axis):
+        n = g.nranks
+        perm = [(src % max(n, 1), i) for i in range(n)]
+        out = _ppermute_shift(tensor, axis=g.axis, perm=perm)
+        tensor.value = out.value
+        return tensor
+    staged = _P2P_STAGE.get(id(g) if g.id == 0 else g.id, [])
+    if staged:
+        tensor.value = staged.pop(0).value
+    return tensor
+
+
+_P2P_STAGE = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference: collective.py split — auto-sharded layer factory
+    (parallel linear / embedding over the mp axis). TPU-native: build
+    the matching Megatron TP layer and apply it."""
+    from .fleet.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f,
+                                         gather_output=gather_out,
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False)
+        else:
+            layer = RowParallelLinear(in_f, out_f,
+                                      input_is_parallel=False,
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False)
+        return layer(x)
+    if operation == "embedding":
+        vocab, dim = size
+        layer = VocabParallelEmbedding(vocab, dim,
+                                       weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"unknown split operation {operation!r}")

@@ -149,3 +149,66 @@ def _categorical_sample(logits, key, *, shape):
 
 def kl_divergence(p, q):
     return p.kl_divergence(q)
+
+
+class MultivariateNormalDiag(Distribution):
+    """Reference: fluid/layers/distributions.py MultivariateNormalDiag —
+    diagonal-covariance multivariate normal."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)  # diagonal covariance matrix
+
+    def _diag(self):
+        import jax.numpy as jnp
+        return jnp.diagonal(self.scale, axis1=-2, axis2=-1)
+
+    def sample(self, shape=()):
+        import jax.numpy as jnp
+        from ..core import rng as rng_mod
+        import jax
+        key = rng_mod.next_key().value
+        d = self._diag()
+        eps = jax.random.normal(key, tuple(shape) + self.loc.shape,
+                                self.loc.dtype)
+        return Tensor(self.loc + eps * jnp.sqrt(d))
+
+    def entropy(self):
+        import jax.numpy as jnp
+        d = self._diag()
+        k = self.loc.shape[-1]
+        ent = 0.5 * (k * (1.0 + jnp.log(2 * jnp.pi))
+                     + jnp.sum(jnp.log(d), axis=-1))
+        return Tensor(ent)
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+        v = _arr(value)
+        d = self._diag()
+        k = self.loc.shape[-1]
+        return Tensor(-0.5 * (jnp.sum((v - self.loc) ** 2 / d, axis=-1)
+                              + k * jnp.log(2 * jnp.pi)
+                              + jnp.sum(jnp.log(d), axis=-1)))
+
+    def kl_divergence(self, other):
+        import jax.numpy as jnp
+        d0, d1 = self._diag(), other._diag()
+        k = self.loc.shape[-1]
+        t = (jnp.sum(d0 / d1, axis=-1)
+             + jnp.sum((other.loc - self.loc) ** 2 / d1, axis=-1) - k
+             + jnp.sum(jnp.log(d1), axis=-1)
+             - jnp.sum(jnp.log(d0), axis=-1))
+        return Tensor(0.5 * t)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
+    """Reference: layers.sampling_id — sample a category index per row
+    of a probability matrix."""
+    import jax
+    from ..core import rng as rng_mod
+    key = rng_mod.next_key().value
+    import jax.numpy as jnp
+    from ..core import dtype as dtype_mod
+    idx = jax.random.categorical(key, jnp.log(jnp.maximum(
+        _arr(x), 1e-12)), axis=-1)
+    return Tensor(idx.astype(dtype_mod.to_jax_dtype(dtype)))

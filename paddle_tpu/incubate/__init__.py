@@ -2,3 +2,109 @@
 from . import asp  # noqa: F401
 from . import moe  # noqa: F401
 from . import checkpoint  # noqa: F401
+
+
+class LookAhead:
+    """Reference: incubate/optimizer/lookahead.py — k fast steps, then
+    interpolate slow weights toward fast weights by alpha."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step = 0
+        self._slow = None
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list()
+
+    def step(self):
+        import jax.numpy as jnp
+        self.inner_optimizer.step()
+        if self._slow is None:
+            self._slow = [jnp.array(p.value) for p in self._params()]
+        self._step += 1
+        if self._step % self.k == 0:
+            for p, s in zip(self._params(), self._slow):
+                new_slow = s + self.alpha * (p.value - s)
+                p.value = new_slow
+            self._slow = [jnp.array(p.value) for p in self._params()]
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def __getattr__(self, item):
+        return getattr(self.inner_optimizer, item)
+
+
+class ModelAverage:
+    """Reference: incubate/optimizer/modelaverage.py — maintains a
+    running average of parameters; apply()/restore() swap it in and out
+    for evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage needs parameters")
+        self._params = list(parameters)
+        self._sum = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        import jax.numpy as jnp
+        if self._sum is None:
+            self._sum = [jnp.zeros_like(p.value) for p in self._params]
+        self._sum = [s + p.value for s, p in zip(self._sum, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        if not self._count:
+            return
+        self._backup = [jnp.array(p.value) for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p.value = s / self._count
+        if not need_restore:
+            self._backup = None
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p.value = b
+        self._backup = None
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Reference: incubate softmax_mask_fuse op — softmax(x + mask) in
+    one fused kernel (XLA fuses the chain)."""
+    from ..ops import nn_ops, math as m
+    return nn_ops.softmax(m.add(x, m.cast(mask, x.dtype)), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Reference: softmax over causal (upper-triangle-masked) scores."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core.dispatch import register_op
+    return _softmax_causal(x)
+
+
+from ..core.dispatch import register_op as _rop
+
+
+@_rop("softmax_mask_fuse_upper_triangle")
+def _softmax_causal(x):
+    import jax
+    import jax.numpy as jnp
+    s = x.shape[-1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, x, jnp.full_like(x, -1e9))
+    return jax.nn.softmax(scores, axis=-1)

@@ -1,2 +1,55 @@
 from .to_static import to_static, not_to_static, TracedFunction  # noqa: F401
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+
+class ProgramTranslator:
+    """Reference: dygraph_to_static/program_translator.py:232 — global
+    enable/disable switch for to_static conversion."""
+
+    _instance = None
+    _enabled = [True]
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static):
+        self._enabled[0] = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return self._enabled[0]
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference: jit.set_code_level — dy2static transformed-code dump
+    verbosity (advisory here: trace capture has no AST dump stages)."""
+    return None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    return None
+
+
+class TracedLayer:
+    """Reference: fluid/dygraph/jit.py TracedLayer — trace a layer once,
+    replay/save the captured program (here: a TracedFunction over the
+    layer plus jit.save)."""
+
+    def __init__(self, layer, traced):
+        self._layer = layer
+        self._traced = traced
+
+    @staticmethod
+    def trace(layer, inputs):
+        traced = to_static(layer.forward)
+        outs = traced(*inputs)
+        return outs, TracedLayer(layer, traced)
+
+    def __call__(self, *inputs):
+        return self._traced(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._traced, path)

@@ -50,3 +50,298 @@ CompiledProgram = Program  # single-device alias; DP comes from fleet
 
 from ..amp import auto_cast as amp  # noqa: F401,E402
 from . import nn  # noqa: F401,E402
+
+
+# -- reference API completion (python/paddle/static/__init__.py) ----------
+
+class BuildStrategy:
+    """Reference: BuildStrategy (details/build_strategy.h) — graph-pass
+    knobs. XLA owns fusion/memory passes here; accepted fields are
+    recorded for introspection and otherwise advisory."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+
+
+class ExecutionStrategy:
+    """Reference: ExecutionStrategy — executor threading knobs (XLA/PjRt
+    schedules internally; advisory)."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+        self.allow_op_delay = False
+
+
+class ParallelExecutor:
+    """Reference: ParallelExecutor (parallel_executor.cc:619). The GSPMD
+    mesh replaces the SSA multi-device engine; this wrapper keeps the
+    legacy construction API and executes through Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, **kwargs):
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+
+    def run(self, fetch_list=None, feed=None, return_numpy=True):
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=fetch_list,
+                             return_numpy=return_numpy)
+
+
+def cpu_places(device_count=None):
+    from ..core.device import CPUPlace
+    import os as _os
+    n = device_count or int(_os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    # API parity: on TPU builds there are no CUDA places; mirror the
+    # devices we do have so place-count logic keeps working
+    from ..core.device import get_place
+    import jax as _jax
+    ids = device_ids if device_ids is not None \
+        else range(len(_jax.devices()))
+    return [get_place() for _ in ids]
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Reference: layers.create_global_var — a persistable tensor
+    registered in the current program."""
+    import numpy as _np
+    from ..core.tensor import Tensor
+    t = Tensor(_np.full(shape, value, dtype), name=name,
+               persistable=True, stop_gradient=True)
+    prog = building_program()
+    if prog is not None:
+        prog.register_persist(t)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference: static.create_parameter."""
+    import numpy as _np
+    from ..core.tensor import Parameter
+    from ..nn import initializer as init_mod
+    init = default_initializer or (init_mod.Constant(0.0) if is_bias
+                                   else init_mod.XavierNormal())
+    import jax.numpy as _jnp
+    val = init((tuple(shape)), dtype) if callable(init) else None
+    if val is None:
+        val = _np.zeros(shape, dtype)
+    p = Parameter(val, name=name)
+    p.stop_gradient = False
+    prog = building_program()
+    if prog is not None:
+        prog.register_persist(p)
+    return p
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        prog = building_program()
+        if prog is not None and name in prog.persist:
+            return prog.persist[name]
+        return self.vars.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+
+def global_scope():
+    return _GLOBAL_SCOPE
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+@_contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference: fluid/backward.py:1972 gradients — grad vars of
+    targets wrt persistable inputs in the current static program."""
+    t = targets[0] if isinstance(targets, (list, tuple)) else targets
+    pg = append_backward(t, parameter_list=list(inputs)
+                         if isinstance(inputs, (list, tuple)) else [inputs])
+    return [g for _, g in pg]
+
+
+def save(program, model_path, protocol=4, **kwargs):
+    """Reference: static.save — persistables of a program."""
+    import pickle
+    import numpy as _np
+    state = {n: _np.asarray(t._value)
+             for n, t in program.persist.items()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Reference: static.load — restore persistables into a program."""
+    import pickle
+    import jax.numpy as _jnp
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for n, arr in state.items():
+        if n in program.persist:
+            program.persist[n]._value = _jnp.asarray(arr)
+
+
+def save_program_state(program):
+    import numpy as _np
+    return {n: _np.asarray(t._value) for n, t in program.persist.items()}
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state):
+    import jax.numpy as _jnp
+    for n, arr in state.items():
+        if n in program.persist:
+            program.persist[n]._value = _jnp.asarray(arr)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    from .program import _serialize_program
+    import pickle
+    prog = program or building_program()
+    return pickle.dumps(_serialize_program(prog.clone(for_test=True)),
+                        protocol=4)
+
+
+def deserialize_program(data):
+    from .program import _deserialize_program
+    import pickle
+    return _deserialize_program(pickle.loads(data))
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    import pickle
+    import numpy as _np
+    prog = program or building_program()
+    return pickle.dumps({n: _np.asarray(t._value)
+                         for n, t in prog.persist.items()}, protocol=4)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+    import jax.numpy as _jnp
+    for n, arr in pickle.loads(data).items():
+        if n in program.persist:
+            program.persist[n]._value = _jnp.asarray(arr)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program.clone(for_test=True)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference: py_func_op — run arbitrary python inside the graph via
+    jax.pure_callback (host callback on TPU)."""
+    import jax
+    import numpy as _np
+    from ..core.dispatch import register_op
+    from ..core.tensor import Tensor
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out_spec = jax.ShapeDtypeStruct(tuple(out.aval_shape()
+                                          if hasattr(out, "aval_shape")
+                                          else out.shape),
+                                    _np.dtype("float32"))
+
+    def _op(*arrs):
+        return jax.pure_callback(
+            lambda *a: _np.asarray(func(*a), out_spec.dtype), out_spec,
+            *arrs)
+    op = register_op(f"py_func_{id(func)}", differentiable=False)(_op)
+    return op(*xs)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):  # noqa: A002
+    """Reference: static accuracy layer."""
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, **kwargs):  # noqa: A002
+    """Reference: static auc layer (batch AUC)."""
+    from ..ops import math as m, reduction as r, search as s
+    import jax.numpy as _jnp
+    from ..core.tensor import Tensor
+    probs = input.value[:, 1] if input.aval_shape()[-1] == 2 \
+        else input.value.reshape(-1)
+    lab = label.value.reshape(-1)
+    order = _jnp.argsort(-probs)
+    lab_sorted = _jnp.take(lab, order).astype(_jnp.float32)
+    tps = _jnp.cumsum(lab_sorted)
+    fps = _jnp.cumsum(1.0 - lab_sorted)
+    P = _jnp.maximum(tps[-1], 1e-6)
+    N = _jnp.maximum(fps[-1], 1e-6)
+    tpr = _jnp.concatenate([_jnp.zeros(1), tps / P])
+    fpr = _jnp.concatenate([_jnp.zeros(1), fps / N])
+    a = _jnp.trapezoid(tpr, fpr)
+    return Tensor(a)
+
+
+class Print:
+    """Reference: Print op — debugging passthrough."""
+
+    def __new__(cls, input, message=None, **kwargs):  # noqa: A002
+        print(message or "", input)
+        return input
+
+
+class WeightNormParamAttr:
+    """Reference: WeightNormParamAttr — accepted for API parity; weight
+    norm itself is applied via paddle.nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None, **kwargs):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+
+
+def xpu_places(device_ids=None):
+    """Reference: static.xpu_places (Baidu Kunlun). No XPU in a TPU
+    build; mirrors cuda_places for place-count logic."""
+    return cuda_places(device_ids)

@@ -27,3 +27,20 @@ def deprecated(since=None, update_to=None, reason=None):
         return fn
     return deco
 from . import unique_name  # noqa: F401
+
+
+def require_version(min_version, max_version=None):
+    """Reference: paddle.utils.require_version — version gate against
+    this build's __version__."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > allowed {max_version}")

@@ -3,3 +3,26 @@ from . import models  # noqa: F401
 from . import datasets  # noqa: F401
 from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
+
+
+_image_backend = ["pil"]
+
+
+def set_image_backend(backend):
+    """Reference: paddle.vision.set_image_backend ('pil'|'cv2')."""
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend[0] = backend
+
+
+def get_image_backend():
+    return _image_backend[0]
+
+
+def image_load(path, backend=None):
+    """Reference: paddle.vision.image_load."""
+    backend = backend or _image_backend[0]
+    if backend == "cv2":
+        raise RuntimeError("cv2 is not available in this build; use 'pil'")
+    from PIL import Image
+    return Image.open(path)
