@@ -430,29 +430,39 @@ def _ppermute_shift(x, *, axis, perm):
     return jax.lax.ppermute(x, axis, perm=list(perm))
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    """Reference: collective.py send (send_v2 NCCL p2p). SPMD form:
-    inside shard_map a send is one side of a ppermute; the companion
-    recv on the peer completes it. Eager single-controller: the value is
-    staged on the group so the matching recv returns it (loopback
-    semantics, same process)."""
+def send(tensor, dst=0, group=None, sync_op=True, src=0):
+    """Reference: collective.py send (send_v2 NCCL p2p). SPMD form: one
+    ppermute edge src->dst (both ends named — every rank executes the
+    same program); the destination rank receives the value, other ranks
+    zeros. Eager single-controller: the value is staged on the group so
+    the matching recv returns it (loopback, same process)."""
     g = group or _default_group()
     if _axis_in_scope(g.axis):
         n = g.nranks
-        perm = [(i, dst if n == 1 else (dst % n)) for i in range(n)]
-        return _ppermute_shift(tensor, axis=g.axis, perm=perm)
+        return _ppermute_shift(tensor, axis=g.axis,
+                               perm=((src % n, dst % n),))
     _P2P_STAGE.setdefault(id(g) if g.id == 0 else g.id, []).append(
         tensor)
     return tensor
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    """Reference: collective.py recv (recv_v2)."""
+def recv(tensor, src=0, group=None, sync_op=True, dst=None):
+    """Reference: collective.py recv (recv_v2). Inside an SPMD region a
+    p2p edge must name BOTH ends (every rank runs the same program, so
+    'the current rank' is not a static quantity): pass dst=. The
+    destination rank's buffer gets src's value; other ranks get zeros
+    (recv_v2 overwrites only the destination buffer). For uniform
+    neighbor exchange use the pipeline/ppermute APIs instead."""
     g = group or _default_group()
     if _axis_in_scope(g.axis):
+        if dst is None:
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                "recv inside an SPMD region needs dst= (the receiving "
+                "rank); a single-program p2p edge must name both ends")
         n = g.nranks
-        perm = [(src % max(n, 1), i) for i in range(n)]
-        out = _ppermute_shift(tensor, axis=g.axis, perm=perm)
+        out = _ppermute_shift(tensor, axis=g.axis,
+                              perm=((src % n, dst % n),))
         tensor.value = out.value
         return tensor
     staged = _P2P_STAGE.get(id(g) if g.id == 0 else g.id, [])

@@ -110,11 +110,13 @@ class Conv1DTranspose(Layer):
             (out_channels,), bias_attr, is_bias=True) \
             if bias_attr is not False else None
         self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups = int(groups)
 
     def forward(self, x):
         return nn_ops.conv1d_transpose(x, self.weight, self.bias,
                                        self._stride, self._padding,
-                                       dilation=self._dilation)
+                                       dilation=self._dilation,
+                                       groups=self._groups)
 
 
 class Conv3DTranspose(Layer):
@@ -130,8 +132,10 @@ class Conv3DTranspose(Layer):
             (out_channels,), bias_attr, is_bias=True) \
             if bias_attr is not False else None
         self._stride, self._padding, self._dilation = stride, padding, dilation
+        self._groups = int(groups)
 
     def forward(self, x):
         return nn_ops.conv3d_transpose(x, self.weight, self.bias,
                                        self._stride, self._padding,
-                                       dilation=self._dilation)
+                                       dilation=self._dilation,
+                                       groups=self._groups)

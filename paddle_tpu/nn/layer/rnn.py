@@ -421,11 +421,18 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
         states = type(states)(tile(s) for s in states)
     else:
         states = tile(states)
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
     tok = ops.creation.full((b * beam,), decoder.start_token, "int64")
-    log_probs = ops.creation.zeros((b, beam), "float32")
+    # beam 0 starts live, beams 1..k-1 at -inf: identical scores would
+    # make every beam pick the same token forever (greedy x beam_size)
+    init_lp = np.full((b, beam), -1e9, np.float32)
+    init_lp[:, 0] = 0.0
+    log_probs = Tensor(jnp.asarray(init_lp))
     ids_steps = []
     parents_steps = []
-    finished = ops.creation.zeros((b, beam), "bool")
+    finished = jnp.zeros((b, beam), bool)
+    end = decoder.end_token
     for _ in range(max_step_num):
         emb = decoder.embedding_fn(tok) if decoder.embedding_fn \
             else manipulation.unsqueeze(m.cast(tok, "float32"), -1)
@@ -433,7 +440,12 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
         logits = decoder.output_fn(out) if decoder.output_fn else out
         logp = nn_ops.log_softmax(logits, axis=-1)  # [B*beam, V]
         V = logp.shape[-1]
-        logp = manipulation.reshape(logp, (b, beam, V))
+        logp_v = manipulation.reshape(logp, (b, beam, V)).value
+        # freeze finished beams (reference dynamic_decode): they may
+        # only re-emit end_token, at zero additional cost
+        frozen = jnp.full((V,), -1e9, logp_v.dtype).at[end].set(0.0)
+        logp_v = jnp.where(finished[..., None], frozen, logp_v)
+        logp = Tensor(logp_v)
         total = m.add(manipulation.unsqueeze(log_probs, -1), logp)
         flat = manipulation.reshape(total, (b, beam * V))
         top_v, top_i = ops.search.topk(flat, beam, axis=-1)
@@ -444,8 +456,11 @@ def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
         log_probs = top_v
         ids_steps.append(word)
         parents_steps.append(parent)
+        # carry finished-ness through the beam regather, then mark new
+        # end_token emissions
+        finished = jnp.take_along_axis(finished, parent.value, axis=-1)
+        finished = finished | (word.value == end)
         # regather states by parent beam
-        import jax.numpy as jnp
         flat_parent = (parent.value + (jnp.arange(b) * beam)[:, None]
                        ).reshape(-1)
         def regather(s):

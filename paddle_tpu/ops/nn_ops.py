@@ -1065,36 +1065,57 @@ def _triple(v):
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    """Reference: pool3d_op. 3D pooling folds depth into the batch dim
-    and reuses the 2D window machinery per depth slice of the kernel."""
+    """Reference: pool3d_op (NCDHW)."""
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            "max_pool3d supports NCDHW only (transpose NDHWC inputs)")
     ks = _triple(kernel_size)
     st = _triple(stride) if stride is not None else ks
     pd = _triple(padding)
-    return _pool3d(x, ksize=ks, strides=st, paddings=pd, mode="max")
+    return _pool3d(x, ksize=ks, strides=st, paddings=pd, mode="max",
+                   ceil_mode=bool(ceil_mode), exclusive=True,
+                   divisor=None)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError(
+            "avg_pool3d supports NCDHW only (transpose NDHWC inputs)")
     ks = _triple(kernel_size)
     st = _triple(stride) if stride is not None else ks
     return _pool3d(x, ksize=ks, strides=st, paddings=_triple(padding),
-                   mode="avg")
+                   mode="avg", ceil_mode=bool(ceil_mode),
+                   exclusive=bool(exclusive),
+                   divisor=None if divisor_override is None
+                   else float(divisor_override))
 
 
 @register_op("pool3d")
-def _pool3d(x, *, ksize, strides, paddings, mode):
+def _pool3d(x, *, ksize, strides, paddings, mode, ceil_mode, exclusive,
+            divisor):
     kd, kh, kw = ksize
     sd, sh, sw = strides
     pd, ph, pw = paddings
-    if pd or ph or pw:
-        pad_v = (-jnp.inf if mode == "max" else 0.0)
-        x = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+
+    def out_len(size, k, s, p):
+        if ceil_mode:
+            return -(-(size + 2 * p - k) // s) + 1
+        return (size + 2 * p - k) // s + 1
+
+    d0, h0, w0 = x.shape[2:]
+    od, oh, ow = (out_len(d0, kd, sd, pd), out_len(h0, kh, sh, ph),
+                  out_len(w0, kw, sw, pw))
+    # right-pad enough that every (possibly ceil-extended) window exists
+    need = [max(0, (o - 1) * s + k - (sz + 2 * p))
+            for o, s, k, sz, p in zip((od, oh, ow), strides, ksize,
+                                      (d0, h0, w0), paddings)]
+    pad_v = (-jnp.inf if mode == "max" else 0.0)
+    if pd or ph or pw or any(need):
+        x = jnp.pad(x, ((0, 0), (0, 0), (pd, pd + need[0]),
+                        (ph, ph + need[1]), (pw, pw + need[2])),
                     constant_values=pad_v)
-    d, h, w = x.shape[2:]
-    od = (d - kd) // sd + 1
-    oh = (h - kh) // sh + 1
-    ow = (w - kw) // sw + 1
     out = None
     for i in range(kd):
         for j in range(kh):
@@ -1108,9 +1129,28 @@ def _pool3d(x, *, ksize, strides, paddings, mode):
                     out = jnp.maximum(out, win)
                 else:
                     out = out + win
-    if mode == "avg":
-        out = out / (kd * kh * kw)
-    return out
+    if mode != "avg":
+        return out
+    if divisor is not None:
+        return out / divisor
+    if exclusive and (pd or ph or pw or any(need)):
+        # count only in-bounds cells per window (paddle exclusive=True);
+        # counts are static -> numpy
+        ones = np.zeros((1, 1, d0 + 2 * pd + need[0],
+                         h0 + 2 * ph + need[1], w0 + 2 * pw + need[2]),
+                        np.float32)
+        ones[:, :, pd:pd + d0, ph:ph + h0, pw:pw + w0] = 1.0
+        counts = np.zeros((1, 1, od, oh, ow), np.float32)
+        for i in range(kd):
+            for j in range(kh):
+                for k in range(kw):
+                    counts += ones[:, :, i:i + (od - 1) * sd + 1:sd,
+                                   j:j + (oh - 1) * sh + 1:sh,
+                                   k:k + (ow - 1) * sw + 1:sw]
+        # padded cells contributed -inf/0; zero them out of the sum for
+        # avg by re-summing with 0 pad value happened above (pad_v=0)
+        return out / jnp.asarray(np.maximum(counts, 1.0), x.dtype)
+    return out / (kd * kh * kw)
 
 
 @register_op("adaptive_pool3d")
@@ -1150,25 +1190,30 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
 
 
 @register_op("conv_transpose_nd")
-def _conv_transpose_nd(x, weight, bias, *, strides, paddings, dilations,
-                       nd):
-    # weight layout [in, out, *k] (paddle transpose-conv convention);
-    # expressed as a fractionally-strided conv exactly like
-    # _conv2d_transpose: flip spatial axes, swap I/O, lhs_dilation=stride
+def _conv_transpose_nd(x, weight, bias, *, strides, paddings,
+                       output_padding, dilations, groups, nd):
+    # weight layout [in, out/groups, *k] (paddle transpose-conv
+    # convention); expressed as a fractionally-strided conv exactly like
+    # _conv2d_transpose: flip spatial axes, swap I/O per group,
+    # lhs_dilation=stride
     spatial = tuple(range(2, 2 + nd))
+    in_c, out_pg = weight.shape[0], weight.shape[1]
+    ks = weight.shape[2:]
     wf = jnp.flip(weight, axis=spatial)
-    wf = jnp.swapaxes(wf, 0, 1)  # [out, in, *k]
+    wf = wf.reshape((groups, in_c // groups, out_pg) + ks)
+    wf = jnp.swapaxes(wf, 1, 2).reshape(
+        (groups * out_pg, in_c // groups) + ks)
     letters = "DHW"[3 - nd:]
     dn = jax.lax.conv_dimension_numbers(
         x.shape, wf.shape, ("NC" + letters, "OI" + letters,
                             "NC" + letters))
     pad = tuple(
-        ((k - 1) * d + 1 - 1 - p, (k - 1) * d + 1 - 1 - p)
-        for k, d, p in zip(wf.shape[2:], dilations, paddings))
+        ((k - 1) * d - p, (k - 1) * d - p + op)
+        for k, d, p, op in zip(ks, dilations, paddings, output_padding))
     out = jax.lax.conv_general_dilated(
         x, wf, window_strides=(1,) * nd, padding=pad,
         lhs_dilation=strides, rhs_dilation=dilations,
-        dimension_numbers=dn)
+        dimension_numbers=dn, feature_group_count=groups)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
     return out
@@ -1178,11 +1223,12 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      output_size=None, data_format="NCL", name=None):
     """Reference: conv2d_transpose_op (1D variant)."""
-    st = stride if isinstance(stride, int) else stride[0]
-    pd = padding if isinstance(padding, int) else padding[0]
-    dl = dilation if isinstance(dilation, int) else dilation[0]
-    return _conv_transpose_nd(x, weight, bias, strides=(st,),
-                              paddings=(pd,), dilations=(dl,), nd=1)
+    one = lambda v: (v if isinstance(v, int) else v[0],)  # noqa: E731
+    return _conv_transpose_nd(x, weight, bias, strides=one(stride),
+                              paddings=one(padding),
+                              output_padding=one(output_padding),
+                              dilations=one(dilation),
+                              groups=int(groups), nd=1)
 
 
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -1190,7 +1236,9 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_size=None, data_format="NCDHW", name=None):
     return _conv_transpose_nd(x, weight, bias, strides=_triple(stride),
                               paddings=_triple(padding),
-                              dilations=_triple(dilation), nd=3)
+                              output_padding=_triple(output_padding),
+                              dilations=_triple(dilation),
+                              groups=int(groups), nd=3)
 
 
 def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
@@ -1463,12 +1511,17 @@ def _grid_sample(x, grid, *, mode, padding_mode, align_corners):
         fx = ((gx + 1.0) * w - 1.0) / 2.0
         fy = ((gy + 1.0) * h - 1.0) / 2.0
 
+    nd_mode = {"zeros": "constant", "border": "nearest",
+               "reflection": "mirror"}.get(padding_mode)
+    if nd_mode is None:
+        raise ValueError(f"unknown padding_mode {padding_mode!r}")
+
     def sample_one(img, cx, cy):
         # img [C,H,W]; cx/cy [Ho,Wo]
         coords = jnp.stack([cy.reshape(-1), cx.reshape(-1)], axis=0)
         order = 1 if mode == "bilinear" else 0
         out = jax.vmap(lambda ch: jax.scipy.ndimage.map_coordinates(
-            ch, list(coords), order=order, mode="constant", cval=0.0))(img)
+            ch, list(coords), order=order, mode=nd_mode, cval=0.0))(img)
         return out.reshape(img.shape[0], *cx.shape)
 
     return jax.vmap(sample_one)(x, fx, fy)

@@ -284,10 +284,15 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
     from ..core.dispatch import register_op
     from ..core.tensor import Tensor
     xs = x if isinstance(x, (list, tuple)) else [x]
+    if hasattr(out, "_dtype"):          # static Variable
+        out_dt = _np.dtype(out._dtype)
+    elif getattr(out, "_value", None) is not None:  # Tensor
+        out_dt = _np.dtype(str(out._value.dtype))
+    else:
+        out_dt = _np.dtype("float32")
     out_spec = jax.ShapeDtypeStruct(tuple(out.aval_shape()
                                           if hasattr(out, "aval_shape")
-                                          else out.shape),
-                                    _np.dtype("float32"))
+                                          else out.shape), out_dt)
 
     def _op(*arrs):
         return jax.pure_callback(

@@ -315,3 +315,105 @@ def test_module_surface_completion_smoke():
     pt.enable(True)
     paddle.utils.require_version("0.0.1")
     assert paddle.vision.get_image_backend() in ("pil", "cv2")
+
+
+def test_conv_transpose_groups_and_output_padding():
+    paddle.seed(0)
+    layer = nn.Conv1DTranspose(4, 6, kernel_size=3, stride=2, groups=2)
+    x = T(np.random.RandomState(0).randn(1, 4, 10))
+    out = layer(x)
+    assert out.shape == [1, 6, 21]
+    out.sum().backward()
+    # output_padding extends the right edge
+    out2 = F.conv1d_transpose(x, layer.weight, None, stride=2,
+                              output_padding=1, groups=2)
+    assert out2.shape == [1, 6, 22]
+
+
+def test_avg_pool3d_exclusive_padding():
+    x = T(np.ones((1, 1, 2, 2, 2)))
+    out = np.asarray(F.avg_pool3d(x, 2, stride=2, padding=1).numpy())
+    # paddle default exclusive=True: padded cells excluded -> corners 1.0
+    np.testing.assert_allclose(out, np.ones_like(out), rtol=1e-6)
+    out_inc = np.asarray(F.avg_pool3d(x, 2, stride=2, padding=1,
+                                      exclusive=False).numpy())
+    np.testing.assert_allclose(out_inc, 0.125 * np.ones_like(out_inc),
+                               rtol=1e-6)
+
+
+def test_pool3d_ceil_mode():
+    x = T(np.random.RandomState(0).randn(1, 1, 6, 6, 6))
+    # (6-3)/2 is fractional: ceil adds the partial window
+    assert F.max_pool3d(x, 3, stride=2, ceil_mode=True).shape \
+        == [1, 1, 3, 3, 3]
+    assert F.max_pool3d(x, 3, stride=2, ceil_mode=False).shape \
+        == [1, 1, 2, 2, 2]
+    with pytest.raises(NotImplementedError):
+        F.max_pool3d(x, 2, data_format="NDHWC")
+
+
+def test_grid_sample_border_padding():
+    x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+    # grid far out of range: border clamps to edge values, zeros gives 0
+    grid = T(np.full((1, 1, 1, 2), 5.0, "float32"))
+    z = float(F.grid_sample(T(x), grid, padding_mode="zeros").numpy())
+    b = float(F.grid_sample(T(x), grid, padding_mode="border").numpy())
+    assert z == 0.0
+    assert b == 3.0  # bottom-right value
+
+
+def test_beam_search_beams_diverge_and_freeze():
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(3, 8)
+    proj = nn.Linear(8, 5)
+    emb = nn.Embedding(5, 3)
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=4,
+                               beam_size=3, embedding_fn=emb,
+                               output_fn=proj)
+    inits = cell.get_initial_states(paddle.to_tensor(
+        np.zeros((2, 3), "float32")))
+    ids, _ = nn.dynamic_decode(dec, inits=inits, max_step_num=8)
+    v = np.asarray(ids.numpy())  # [B, T, beam]
+    # beams must NOT be identical copies (the old all-zeros init bug)
+    assert not (np.array_equal(v[:, :, 0], v[:, :, 1])
+                and np.array_equal(v[:, :, 1], v[:, :, 2])), v
+    # once a beam hits end_token, it only re-emits end_token
+    for bi in range(v.shape[0]):
+        for k in range(v.shape[2]):
+            seq = v[bi, :, k]
+            hits = np.nonzero(seq == 4)[0]
+            if len(hits):
+                assert np.all(seq[hits[0]:] == 4), seq
+
+
+def test_send_recv_spmd_edge():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import topology, fleet, collective
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = fleet.get_hybrid_communicate_group().mesh
+    g = collective.get_group(0)
+
+    def body(v):
+        from paddle_tpu.core.tensor import Tensor
+        t = Tensor(v)
+        out = collective.send(t, dst=3, group=g, src=1)
+        return out.value
+
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = jax.shard_map(body, mesh=mesh, in_specs=(P("dp"),),
+                        out_specs=P("dp"))(x)
+    res = np.asarray(out).reshape(-1)
+    assert res[3] == 1.0          # rank 3 received rank 1's value
+    assert res[1] == 0.0          # non-destination ranks zeroed
+    with pytest.raises(Exception):
+        jax.shard_map(
+            lambda v: collective.recv(
+                __import__("paddle_tpu").core.tensor.Tensor(v),
+                src=1, group=g).value,
+            mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"))(x)
+    topology._HYBRID = None
