@@ -38,6 +38,9 @@ def _reference_attention(q, k, v, mask, scale, causal):
 
 def _use_pallas(q):
     b, h, s, d = q.shape
+    # f64 cannot lower on Mosaic (and the kernels trace in 32-bit mode)
+    if q.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return False
     shape_ok = s >= 256 and d in (64, 128, 256) and s % 128 == 0
     if _FORCE_INTERPRET[0]:
         return s % 128 == 0 and s >= 128
@@ -81,9 +84,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), -1e30, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    # NOTE: full-range loop even for causal — the mask zeroes future
-    # blocks; a program_id-dependent trip count does not lower on Mosaic
+    # NOTE: full-range loop even for causal — a program-id-dependent
+    # trip count does not lower on Mosaic; instead each body invocation
+    # branches on the block index, so future blocks cost a predicate,
+    # not three matmuls
     nkb = seq_len // block_k
+    if causal:
+        inner = body
+
+        def body(start, carry):  # noqa: F811
+            return jax.lax.cond(
+                start * jnp.int32(block_k) <= qi * jnp.int32(block_q)
+                + jnp.int32(block_q - 1),
+                lambda c: inner(start, c), lambda c: c, carry)
     acc, m, l = jax.lax.fori_loop(0, nkb, body, (acc0, m0, l0))
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
     lse_ref[...] = (m + jnp.log(l))[None, :]
@@ -149,23 +162,29 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_ref[...] = jnp.zeros_like(dq_ref)
 
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][0]
-    delta = delta_ref[...][0]
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
-    s = (q @ k.T) * jnp.float32(scale)
+    def _compute():
+        q = q_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][0]
+        delta = delta_ref[...][0]
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        s = (q @ k.T) * jnp.float32(scale)
+        if causal:
+            q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(-1e30))
+        p = jnp.exp(s - lse[:, None])
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dq_ref[...] += (ds @ k) * jnp.float32(scale)
+
     if causal:
-        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, jnp.float32(-1e30))
-    p = jnp.exp(s - lse[:, None])
-    dp = do @ v.T
-    ds = p * (dp - delta[:, None])
-    dq_ref[...] += (ds @ k) * jnp.float32(scale)
+        pl.when(qi >= ki)(_compute)  # fully-future blocks contribute 0
+    else:
+        _compute()
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -180,24 +199,30 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_ref[...] = jnp.zeros_like(dk_ref)
         dv_ref[...] = jnp.zeros_like(dv_ref)
 
-    k = k_ref[...].astype(jnp.float32)
-    v = v_ref[...].astype(jnp.float32)
-    q = q_ref[...].astype(jnp.float32)
-    do = do_ref[...].astype(jnp.float32)
-    lse = lse_ref[...][0]
-    delta = delta_ref[...][0]
-    s = (q @ k.T) * jnp.float32(scale)
+    def _compute():
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        q = q_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...][0]
+        delta = delta_ref[...][0]
+        s = (q @ k.T) * jnp.float32(scale)
+        if causal:
+            q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, jnp.float32(-1e30))
+        p = jnp.exp(s - lse[:, None])
+        dv_ref[...] += p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta[:, None])
+        dk_ref[...] += (ds.T @ q) * jnp.float32(scale)
+
     if causal:
-        q_pos = qi * jnp.int32(block_q) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * jnp.int32(block_k) + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, jnp.float32(-1e30))
-    p = jnp.exp(s - lse[:, None])
-    dv_ref[...] += p.T @ do
-    dp = do @ v.T
-    ds = p * (dp - delta[:, None])
-    dk_ref[...] += (ds.T @ q) * jnp.float32(scale)
+        pl.when(qi >= ki)(_compute)
+    else:
+        _compute()
 
 
 def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal):
