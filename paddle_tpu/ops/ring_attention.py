@@ -76,8 +76,8 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
     sp = int(mesh.shape[axis_name])
     sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if sp == 1:
-        from .attention import _reference_attention
-        return _reference_attention(q, k, v, None, sc, causal)
+        from .attention import _flash_attention_core
+        return _flash_attention_core(q, k, v, sc, causal)
     body = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                              sp=sp, scale=sc, causal=causal)
     spec = P(None, None, axis_name, None)
@@ -102,13 +102,12 @@ def _ulysses_sharded(q, k, v, *, axis_name, sp, scale, causal):
     qh = seq_to_heads(q)
     kh = seq_to_heads(k)
     vh = seq_to_heads(v)
-    s = qh.shape[2]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qh * scale, kh).astype(jnp.float32)
-    if causal:
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask, scores, -1e30)
-    p = jax.nn.softmax(scores, axis=-1).astype(qh.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    # full-sequence attention per head group through the flash core: at
+    # long S the dense [S, S] score matrix this used to build is exactly
+    # what Ulysses + flash avoids (the core self-falls-back to the dense
+    # composition for small shapes / CPU)
+    from .attention import _flash_attention_core
+    out = _flash_attention_core(qh, kh, vh, scale, causal)
     return heads_to_seq(out)
 
 
@@ -116,8 +115,8 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
     sp = int(mesh.shape[axis_name])
     sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if sp == 1:
-        from .attention import _reference_attention
-        return _reference_attention(q, k, v, None, sc, causal)
+        from .attention import _flash_attention_core
+        return _flash_attention_core(q, k, v, sc, causal)
     assert q.shape[1] % sp == 0, "num_heads must divide sp for Ulysses"
     body = functools.partial(_ulysses_sharded, axis_name=axis_name, sp=sp,
                              scale=sc, causal=causal)
