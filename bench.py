@@ -73,22 +73,42 @@ def _init_backend():
     import jax
 
     last = RuntimeError("backend init failed")
-    for attempt in range(3):
+    attempts = int(os.environ.get("BENCH_INIT_ATTEMPTS", "8"))
+    for attempt in range(attempts):
         try:
-            devs = jax.devices()
-            if devs and devs[0].platform != "cpu":
-                print(f"# backend: {devs[0].platform} x{len(devs)}",
-                      file=sys.stderr)
-                return devs
-            last = RuntimeError(
-                "only CPU devices available — accelerator init failed")
+            # jax.devices() can HANG (not fail) when the tunnel is
+            # wedged: probe it in a worker thread with its own timeout
+            # so the retry loop keeps control
+            box = {}
+
+            def probe():
+                try:
+                    box["devs"] = jax.devices()
+                except Exception as e:  # noqa: BLE001
+                    box["err"] = e
+
+            t = threading.Thread(target=probe, daemon=True)
+            t.start()
+            t.join(timeout=90.0)
+            if "devs" in box:
+                devs = box["devs"]
+                if devs and devs[0].platform != "cpu":
+                    print(f"# backend: {devs[0].platform} x{len(devs)}",
+                          file=sys.stderr)
+                    return devs
+                last = RuntimeError(
+                    "only CPU devices available — accelerator init failed")
+            elif "err" in box:
+                last = box["err"]
+            else:
+                last = TimeoutError("backend init hung >90s (tunnel wedge)")
         except Exception as e:
             last = e
         print(f"# backend init failed (attempt {attempt + 1}): {last!r}",
               file=sys.stderr)
-        if attempt < 2:  # no backoff after the final attempt
+        if attempt < attempts - 1:
             _clear_backend_cache()
-            time.sleep(5.0 * (attempt + 1))
+            time.sleep(min(60.0, 10.0 * (attempt + 1)))
     raise last
 
 
