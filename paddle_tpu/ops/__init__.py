@@ -141,6 +141,22 @@ def _patch():
     def rank_m(self):
         return creation.to_tensor(self.ndim)
     T.rank = rank_m
+
+    def _iter(self):
+        # without __iter__, python's getitem-protocol fallback loops
+        # forever (our indexing clamps instead of raising IndexError).
+        # NOT a generator: the 0-d check must fire at iter() time.
+        if self.ndim == 0:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(self.aval_shape()[0]))
+    T.__iter__ = _iter
+    T.__len__ = lambda self: (self.aval_shape()[0] if self.ndim
+                              else (_ for _ in ()).throw(
+                                  TypeError("len() of a 0-d tensor")))
+    T.element_size = lambda self: int(
+        __import__("numpy").dtype(str(self._value.dtype)).itemsize)
+    T.ndimension = lambda self: self.ndim
+    T.pin_memory = lambda self: self  # host staging is PjRt's job here
     T.scatter_nd = staticmethod(mp.scatter_nd)
 
     # in-place variants (reference: tensor method list *_ entries) — the

@@ -119,3 +119,21 @@ def test_methods():
 def test_repr_does_not_crash():
     assert "Tensor" in repr(paddle.ones([2]))
     assert "Parameter" in repr(paddle.Parameter(np.ones(2, np.float32)))
+
+
+def test_tensor_iteration_protocol():
+    # iterating without __iter__ used to loop forever (getitem clamps
+    # instead of raising IndexError); 0-d iteration must raise at
+    # iter() time
+    t = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], "float32"))
+    rows = [r.numpy().tolist() for r in t]
+    assert rows == [[1.0, 2.0], [3.0, 4.0]]
+    assert len(t) == 2
+    assert t.element_size() == 4
+    assert t.ndimension() == 2
+    s = paddle.to_tensor(np.asarray(1.0, "float32"))
+    import pytest
+    with pytest.raises(TypeError):
+        iter(s)
+    with pytest.raises(TypeError):
+        len(s)
