@@ -20,3 +20,83 @@ class utils:
     from ..utils_recompute import recompute  # noqa: F401
     from . import utils_fs as fs  # noqa: F401
     from .utils_fs import LocalFS, HDFSClient  # noqa: F401
+from .fleet_base import (  # noqa: F401,E402
+    is_worker, is_server, server_num, server_index, server_endpoints,
+    worker_endpoints, init_server, run_server, init_worker, stop_worker,
+    minimize, state_dict, save_persistables, save_inference_model,
+    ps_client, communicator,
+)
+from .role_maker import (  # noqa: F401,E402
+    Role, PaddleCloudRoleMaker, UserDefinedRoleMaker,
+)
+from .data_generator import (  # noqa: F401,E402
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+
+
+class UtilBase:
+    """Reference: fleet/utils/__init__.py UtilBase (fleet.util) —
+    worker-side helpers over the collective/PS backends."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        """Reduce across WORKER processes (reference: gloo all_reduce).
+        With a PS cluster attached, trainers combine through a server-side
+        'sum' scratch table; a lone worker is the identity."""
+        import numpy as np
+        from .fleet_base import ps_client, worker_num
+        arr = np.asarray(getattr(input, "numpy", lambda: input)())
+        client = ps_client()
+        n = worker_num()
+        if client is None or n <= 1:
+            return arr  # single worker: reduction of one contribution
+        tid = "__fleet_util_allreduce__"
+        try:
+            client.create_dense_table(tid, shape=arr.shape,
+                                      optimizer="sum",
+                                      init=np.zeros_like(arr))
+        except RuntimeError:
+            pass  # another worker created it
+        client.push_dense(tid, arr)
+        client.barrier(n)
+        out = np.asarray(client.pull_dense(tid))
+        client.barrier(n)
+        if mode == "min":
+            raise NotImplementedError("util.all_reduce mode 'min'")
+        if mode == "max":
+            raise NotImplementedError("util.all_reduce mode 'max'")
+        return out
+
+    def barrier(self, comm_world="worker"):
+        from .fleet_base import barrier_worker
+        barrier_worker()
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference:
+        UtilBase.get_file_shard)."""
+        from .fleet_base import worker_index, worker_num
+        n, i = worker_num(), worker_index()
+        per = len(files) // n
+        rem = len(files) % n
+        start = per * i + min(i, rem)
+        end = start + per + (1 if i < rem else 0)
+        return files[start:end]
+
+    def print_on_rank(self, message, rank_id=0):
+        from .fleet_base import worker_index
+        if worker_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+
+class Fleet:
+    """Reference: fleet_base.py:72 Fleet — the module-level functions ARE
+    the singleton's methods; this class exposes the same surface for
+    code that instantiates/attributes `fleet.Fleet`."""
+
+    def __getattr__(self, item):
+        import sys
+        mod = sys.modules[__name__]
+        return getattr(mod, item)

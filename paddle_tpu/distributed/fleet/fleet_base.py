@@ -22,6 +22,14 @@ _fleet_state = {"strategy": None, "hcg": None, "initialized": False}
 def init(role_maker=None, is_collective=True, strategy=None):
     if strategy is None:
         strategy = DistributedStrategy()
+    _fleet_state["role_maker"] = role_maker
+    if role_maker is not None and not getattr(role_maker,
+                                              "_is_collective", False):
+        # parameter-server mode (reference: fleet.init(role_maker) +
+        # a_sync strategy): no device mesh — workers/servers talk over
+        # the PS subsystem; a server process must not touch the chips
+        _fleet_state.update(strategy=strategy, initialized=True)
+        return None
     strategy.check_conflicts(device_count=jax.device_count())
     hc = strategy.hybrid_configs
     degrees = {k: hc.get(k, 1) for k in
@@ -52,17 +60,23 @@ def distributed_model(model):
     """Reference: fleet_base.py:836-913 — chooses the parallel wrapper."""
     if not _fleet_state["initialized"]:
         init()
+    if _fleet_state.get("hcg") is None:  # PS mode: model runs as-is
+        _fleet_state["dist_model"] = model
+        return model
     hcg = _fleet_state["hcg"]
     from .meta_parallel.parallel_wrappers import (
         TensorParallel, PipelineParallel, ShardingParallel)
     from ..parallel import DataParallel
     if hcg.get_pipe_parallel_world_size() > 1:
-        return PipelineParallel(model, hcg, strategy=_strategy())
-    if hcg.get_model_parallel_world_size() > 1:
-        return TensorParallel(model, hcg, strategy=_strategy())
-    if hcg.get_sharding_parallel_world_size() > 1:
-        return ShardingParallel(model, hcg, strategy=_strategy())
-    return DataParallel(model)
+        wrapped = PipelineParallel(model, hcg, strategy=_strategy())
+    elif hcg.get_model_parallel_world_size() > 1:
+        wrapped = TensorParallel(model, hcg, strategy=_strategy())
+    elif hcg.get_sharding_parallel_world_size() > 1:
+        wrapped = ShardingParallel(model, hcg, strategy=_strategy())
+    else:
+        wrapped = DataParallel(model)
+    _fleet_state["dist_model"] = wrapped
+    return wrapped
 
 
 def distributed_optimizer(optimizer, strategy=None):
@@ -73,8 +87,14 @@ def distributed_optimizer(optimizer, strategy=None):
     from .hybrid_optimizer import HybridParallelOptimizer
     from .meta_optimizers import apply_meta_optimizers
     optimizer = apply_meta_optimizers(optimizer, _strategy())
-    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"],
-                                   _strategy())
+    if _fleet_state.get("hcg") is None:
+        # PS mode: no mesh wrapping — keep the (meta-wrapped) optimizer
+        _fleet_state["dist_optimizer"] = optimizer
+        return optimizer
+    wrapped = HybridParallelOptimizer(optimizer, _fleet_state["hcg"],
+                                      _strategy())
+    _fleet_state["dist_optimizer"] = wrapped
+    return wrapped
 
 
 def worker_num():
@@ -91,3 +111,158 @@ def is_first_worker():
 
 def barrier_worker():
     jax.effects_barrier()
+
+
+# -- parameter-server mode lifecycle (reference: fleet_base.py
+# init_worker:1051 / init_server:1110 / run_server:1129 / stop_worker
+# over the_one_ps.py TheOnePSRuntime; here over distributed/ps) ----------
+
+def _role_maker():
+    return _fleet_state.get("role_maker")
+
+
+def is_worker():
+    rm = _role_maker()
+    return True if rm is None else rm._is_worker()
+
+
+def is_server():
+    rm = _role_maker()
+    return False if rm is None else rm._is_server()
+
+
+def server_num():
+    rm = _role_maker()
+    return 0 if rm is None else rm._server_num()
+
+
+def server_index():
+    rm = _role_maker()
+    return -1 if rm is None else rm._server_index()
+
+
+def server_endpoints(to_string=False):
+    rm = _role_maker()
+    eps = [] if rm is None else rm._get_pserver_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def worker_endpoints(to_string=False):
+    rm = _role_maker()
+    eps = [] if rm is None else rm._get_trainer_endpoints()
+    return ",".join(eps) if to_string else eps
+
+
+def init_server(*args, **kwargs):
+    """Build this rank's PS server bound to its endpoint (reference:
+    init_server loads a saved model into tables; pass model_dir to do
+    the same via table load)."""
+    rm = _role_maker()
+    if rm is None or not rm._is_server():
+        raise RuntimeError("init_server called on a non-server role")
+    from ..ps import PSServer
+    ep = rm._get_pserver_endpoints()[rm._server_index()]
+    host, port = ep.rsplit(":", 1)
+    srv = PSServer(host, int(port))
+    _fleet_state["ps_server"] = srv
+    if args and isinstance(args[0], str):
+        import os as _os
+        path = _os.path.join(args[0], f"ps_state.server"
+                             f"{rm._server_index()}")
+        if _os.path.exists(path):
+            srv._dispatch({"cmd": "load", "path": path})
+    return srv
+
+
+def run_server():
+    """Serve until stopped (blocking — reference run_server)."""
+    srv = _fleet_state.get("ps_server")
+    if srv is None:
+        srv = init_server()
+    srv.run()
+
+
+def init_worker():
+    """Connect this trainer to the PS cluster and start the communicator
+    the strategy asks for (sync / a_sync / geo; reference:
+    communicator.h:197,348,497)."""
+    rm = _role_maker()
+    if rm is None:
+        raise RuntimeError("init_worker needs fleet.init(role_maker)")
+    from ..ps import PSClient, Communicator, AsyncCommunicator, \
+        GeoCommunicator
+    client = PSClient(rm._get_pserver_endpoints())
+    strategy = _strategy()
+    a_sync = bool(getattr(strategy, "a_sync", False))
+    k_steps = int(getattr(strategy, "a_sync_configs", {})
+                  .get("k_steps", 0) or 0)
+    if a_sync and k_steps > 0:
+        comm = GeoCommunicator(client, k_steps=k_steps)
+    elif a_sync:
+        comm = AsyncCommunicator(client).start()
+    else:
+        comm = Communicator(client)
+    _fleet_state.update(ps_client=client, communicator=comm)
+    return client
+
+
+def stop_worker():
+    comm = _fleet_state.pop("communicator", None)
+    if comm is not None:
+        comm.stop()
+    client = _fleet_state.pop("ps_client", None)
+    if client is not None:
+        client.close()
+
+
+def ps_client():
+    return _fleet_state.get("ps_client")
+
+
+def communicator():
+    return _fleet_state.get("communicator")
+
+
+def minimize(loss, startup_program=None, parameter_list=None,
+             no_grad_set=None):
+    """Reference: fleet_base.py:1288 — requires distributed_optimizer
+    first."""
+    opt = _fleet_state.get("dist_optimizer")
+    if opt is None:
+        raise RuntimeError("call fleet.distributed_optimizer(opt) before "
+                           "fleet.minimize")
+    return opt.minimize(loss)
+
+
+def state_dict():
+    m = _fleet_state.get("dist_model")
+    return {} if m is None else m.state_dict()
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      **kwargs):
+    """PS mode: persist server tables (reference: fleet
+    save_persistables via the PS runtime); collective mode: save the
+    wrapped model's state_dict."""
+    import os as _os
+    client = _fleet_state.get("ps_client")
+    if client is not None and dirname:
+        _os.makedirs(dirname, exist_ok=True)
+        client.save(_os.path.join(dirname, "ps_state"))
+        return
+    m = _fleet_state.get("dist_model")
+    if m is not None and dirname:
+        from ... import save as _save
+        _os.makedirs(dirname, exist_ok=True)
+        _save(m.state_dict(), _os.path.join(dirname, "model.pdparams"))
+
+
+def save_inference_model(executor=None, dirname=None, feeded_var_names=None,
+                         target_vars=None, main_program=None, **kwargs):
+    from ...static import save_inference_model as _sim
+    if main_program is not None and dirname:
+        import os as _os
+        _os.makedirs(dirname, exist_ok=True)
+        return _sim(_os.path.join(dirname, "model"),
+                    feeded_var_names or [], target_vars or [],
+                    program=main_program)

@@ -332,3 +332,138 @@ def test_global_shuffle_validates_args(cluster, tmp_path):
         ds.global_shuffle(ps_endpoints=eps)  # missing rank/world
     with pytest.raises(errors.InvalidArgumentError):
         ds.global_shuffle(ps_endpoints=eps, rank=5, world=2)
+
+
+_PS_TRAINER = r"""
+import sys, os
+import numpy as np
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (UserDefinedRoleMaker, Role,
+                                          DistributedStrategy)
+
+rank, eps = int(sys.argv[1]), sys.argv[2].split(",")
+strategy = DistributedStrategy()
+strategy.a_sync = True
+rm = UserDefinedRoleMaker(current_id=rank, role=Role.WORKER,
+                          worker_num=2, server_endpoints=eps)
+fleet.init(rm, strategy=strategy)
+assert fleet.is_worker() and not fleet.is_server()
+assert fleet.server_num() == len(eps)
+client = fleet.init_worker()
+comm = fleet.communicator()
+client.barrier(2)
+for step in range(4):
+    w = client.pull_dense("w")
+    comm.send_dense("w", np.ones(4, np.float32))
+comm.flush()
+client.barrier(2)
+fleet.stop_worker()
+print("ps trainer", rank, "done")
+"""
+
+
+def test_fleet_ps_mode_lifecycle(tmp_path):
+    """fleet.init(role_maker) PS mode: server processes via
+    fleet.init_server/run_server, trainers via fleet.init_worker with
+    the a_sync communicator (reference: fleet_base.py init_worker:1051,
+    the_one_ps.py runtime)."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ready = str(tmp_path / "srv.ep")
+    server = subprocess.Popen([
+        _sys.executable, "-c", f"""
+import sys, os
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import UserDefinedRoleMaker, Role
+from paddle_tpu.distributed.ps.server import PSServer
+# bind an ephemeral port first, then publish it as the endpoint
+srv = PSServer("127.0.0.1", 0)
+ep = f"{{srv.host}}:{{srv.port}}"
+rm = UserDefinedRoleMaker(current_id=0, role=Role.SERVER,
+                          server_endpoints=[ep])
+fleet.init(rm)
+assert fleet.is_server() and not fleet.is_worker()
+assert fleet.server_index() == 0
+with open({ready!r} + ".tmp", "w") as f:
+    f.write(ep)
+os.rename({ready!r} + ".tmp", {ready!r})
+srv.run()
+"""])
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not os.path.exists(ready):
+            time.sleep(0.1)
+        ep = open(ready).read().strip()
+        boot = PSClient([ep])
+        boot.create_dense_table("w", shape=(4,), optimizer="sum",
+                                init=np.zeros(4))
+        script = str(tmp_path / "ps_trainer.py")
+        with open(script, "w") as f:
+            f.write(_PS_TRAINER.format(repo=repo))
+        import subprocess as sp
+        trainers = [sp.Popen([_sys.executable, script, str(r), ep])
+                    for r in range(2)]
+        for t in trainers:
+            assert t.wait(timeout=180) == 0
+        np.testing.assert_allclose(boot.pull_dense("w"), 8 * np.ones(4))
+        boot.stop_servers()
+        boot.close()
+    finally:
+        if server.poll() is None:
+            server.kill()
+
+
+def test_data_generator_formats(tmp_path):
+    from paddle_tpu.distributed.fleet import (MultiSlotDataGenerator,
+                                              MultiSlotStringDataGenerator)
+    from paddle_tpu.distributed.fleet.dataset import InMemoryDataset
+
+    class Gen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                v = int(line.strip())
+                yield [("feat", [v, v + 1]), ("label", [v % 2])]
+            return it
+
+    src = tmp_path / "raw.txt"
+    src.write_text("1\n2\n3\n")
+    out = tmp_path / "slots.txt"
+    g = Gen()
+    g.run_from_files([str(src)], str(out))
+    text = out.read_text().splitlines()
+    assert text[0] == "2 1 2 1 1"
+    # the emitted format round-trips through InMemoryDataset
+    ds = InMemoryDataset()
+    ds.init(batch_size=1, thread_num=1)
+    ds.set_filelist([str(out)])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+
+    class SGen(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", line.strip().split())]
+            return it
+
+    out2 = tmp_path / "sslots.txt"
+    SGen().run_from_files([str(src)], str(out2))
+    assert out2.read_text().splitlines()[0] == "1 1"
+
+
+def test_fleet_util_helpers():
+    from paddle_tpu.distributed.fleet import util
+    files = [f"f{i}" for i in range(5)]
+    shard = util.get_file_shard(files)
+    assert shard == files  # single worker gets everything
+    out = util.all_reduce(np.ones(3, np.float32))
+    np.testing.assert_allclose(out, np.ones(3))
+    util.barrier()
