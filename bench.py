@@ -70,44 +70,47 @@ def _init_backend():
     regression. On repeated failure raises the last error (caught by
     main's diagnostic path).
     """
-    import jax
+    import subprocess
 
     last = RuntimeError("backend init failed")
     attempts = int(os.environ.get("BENCH_INIT_ATTEMPTS", "8"))
     for attempt in range(attempts):
+        # jax.devices() can HANG (not fail) when the tunnel is wedged,
+        # and a hung in-process probe holds jax's backend-init lock
+        # forever — probe in a SUBPROCESS so a wedge is fully isolated
+        # and each retry starts clean
         try:
-            # jax.devices() can HANG (not fail) when the tunnel is
-            # wedged: probe it in a worker thread with its own timeout
-            # so the retry loop keeps control
-            box = {}
-
-            def probe():
-                try:
-                    box["devs"] = jax.devices()
-                except Exception as e:  # noqa: BLE001
-                    box["err"] = e
-
-            t = threading.Thread(target=probe, daemon=True)
-            t.start()
-            t.join(timeout=90.0)
-            if "devs" in box:
-                devs = box["devs"]
-                if devs and devs[0].platform != "cpu":
-                    print(f"# backend: {devs[0].platform} x{len(devs)}",
+            res = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); "
+                 "print(d[0].platform, len(d))"],
+                capture_output=True, text=True, timeout=90.0)
+            if res.returncode == 0 and res.stdout.strip():
+                platform, n = res.stdout.split()
+                if platform != "cpu":
+                    print(f"# backend probe ok: {platform} x{n}",
                           file=sys.stderr)
-                    return devs
-                last = RuntimeError(
-                    "only CPU devices available — accelerator init failed")
-            elif "err" in box:
-                last = box["err"]
+                    # the tunnel is healthy: init THIS process's backend
+                    # (a fresh wedge here is caught by the watchdog)
+                    import jax
+                    devs = jax.devices()
+                    if devs and devs[0].platform != "cpu":
+                        return devs
+                    last = RuntimeError("in-process init fell back to CPU")
+                else:
+                    last = RuntimeError(
+                        "only CPU devices available — accelerator init "
+                        "failed")
             else:
-                last = TimeoutError("backend init hung >90s (tunnel wedge)")
-        except Exception as e:
+                last = RuntimeError(
+                    f"probe rc={res.returncode}: {res.stderr[-200:]}")
+        except subprocess.TimeoutExpired:
+            last = TimeoutError("backend init hung >90s (tunnel wedge)")
+        except Exception as e:  # noqa: BLE001
             last = e
         print(f"# backend init failed (attempt {attempt + 1}): {last!r}",
               file=sys.stderr)
         if attempt < attempts - 1:
-            _clear_backend_cache()
             time.sleep(min(60.0, 10.0 * (attempt + 1)))
     raise last
 

@@ -39,10 +39,18 @@ class UtilBase:
     """Reference: fleet/utils/__init__.py UtilBase (fleet.util) —
     worker-side helpers over the collective/PS backends."""
 
+    _allreduce_round = [0]
+
     def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
         """Reduce across WORKER processes (reference: gloo all_reduce).
-        With a PS cluster attached, trainers combine through a server-side
-        'sum' scratch table; a lone worker is the identity."""
+        With a PS cluster attached, trainers combine through a fresh
+        round-scoped server-side 'sum' scratch table (create is
+        first-wins on the server, so the racing trainers share one
+        table); a lone worker is the identity. Calls must be collective:
+        every worker invokes the same sequence of all_reduce calls."""
+        if mode != "sum":
+            raise NotImplementedError(
+                f"util.all_reduce mode {mode!r}; only 'sum' is supported")
         import numpy as np
         from .fleet_base import ps_client, worker_num
         arr = np.asarray(getattr(input, "numpy", lambda: input)())
@@ -50,21 +58,15 @@ class UtilBase:
         n = worker_num()
         if client is None or n <= 1:
             return arr  # single worker: reduction of one contribution
-        tid = "__fleet_util_allreduce__"
-        try:
-            client.create_dense_table(tid, shape=arr.shape,
-                                      optimizer="sum",
-                                      init=np.zeros_like(arr))
-        except RuntimeError:
-            pass  # another worker created it
+        rnd = self._allreduce_round[0]
+        self._allreduce_round[0] += 1
+        tid = f"__fleet_util_allreduce__{rnd}"
+        client.create_dense_table(tid, shape=arr.shape, optimizer="sum",
+                                  init=np.zeros_like(arr))
         client.push_dense(tid, arr)
         client.barrier(n)
         out = np.asarray(client.pull_dense(tid))
         client.barrier(n)
-        if mode == "min":
-            raise NotImplementedError("util.all_reduce mode 'min'")
-        if mode == "max":
-            raise NotImplementedError("util.all_reduce mode 'max'")
         return out
 
     def barrier(self, comm_world="worker"):

@@ -10,10 +10,10 @@ import sys
 
 class DataGenerator:
     def __init__(self):
-        self._line_limit = None
+        self.batch_size_ = 1
 
     def set_batch(self, batch_size):
-        self.batch_size_ = batch_size
+        self.batch_size_ = int(batch_size)
 
     def generate_sample(self, line):
         """User hook: returns an iterator of [(slot_name, [values]), ...]
@@ -33,18 +33,32 @@ class DataGenerator:
     def _gen_str(self, record):
         raise NotImplementedError
 
-    def run_from_stdin(self):
-        for line in sys.stdin:
+    def _emit(self, lines, write):
+        # every sample flows through generate_batch (reference contract:
+        # subclasses may batch/reorder/augment there), collected in
+        # batch_size_ groups
+        buf = []
+        for line in lines:
             for record in self.generate_sample(line)():
-                sys.stdout.write(self._format(record))
+                buf.append(record)
+                if len(buf) >= self.batch_size_:
+                    for rec in self.generate_batch(buf)():
+                        write(self._format(rec))
+                    buf = []
+        if buf:
+            for rec in self.generate_batch(buf)():
+                write(self._format(rec))
+
+    def run_from_stdin(self):
+        self._emit(sys.stdin, sys.stdout.write)
 
     def run_from_files(self, filelist, output):
-        with open(output, "w") as out:
+        def lines():
             for path in filelist:
                 with open(path) as f:
-                    for line in f:
-                        for record in self.generate_sample(line)():
-                            out.write(self._format(record))
+                    yield from f
+        with open(output, "w") as out:
+            self._emit(lines(), out.write)
 
 
 class MultiSlotDataGenerator(DataGenerator):

@@ -98,18 +98,30 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 def worker_num():
+    rm = _fleet_state.get("role_maker")
+    if rm is not None and rm._worker_num():
+        return rm._worker_num()
     return get_world_size()
 
 
 def worker_index():
+    rm = _fleet_state.get("role_maker")
+    if rm is not None and rm._is_worker():
+        return rm._worker_index()
     return get_rank()
 
 
 def is_first_worker():
-    return get_rank() == 0
+    return worker_index() == 0
 
 
 def barrier_worker():
+    # PS mode: a REAL rendezvous across trainer processes via the server
+    # barrier; collective single-controller mode: device-queue sync
+    client = _fleet_state.get("ps_client")
+    if client is not None and worker_num() > 1:
+        client.barrier(worker_num())
+        return
     jax.effects_barrier()
 
 

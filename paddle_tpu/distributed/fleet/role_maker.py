@@ -62,8 +62,13 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             ip = os.environ.get("POD_IP", "127.0.0.1")
             port = os.environ.get("PADDLE_PORT", "0")
             ep = f"{ip}:{port}"
+            if self._server_endpoints and ep not in self._server_endpoints:
+                raise ValueError(
+                    f"current endpoint {ep} (POD_IP:PADDLE_PORT) is not "
+                    f"in PADDLE_PSERVERS_IP_PORT_LIST "
+                    f"{self._server_endpoints}")
             self._current_id = (self._server_endpoints.index(ep)
-                                if ep in self._server_endpoints else 0)
+                                if self._server_endpoints else 0)
 
 
 class UserDefinedRoleMaker(RoleMakerBase):

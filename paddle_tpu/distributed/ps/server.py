@@ -192,16 +192,23 @@ class PSServer:
     def _dispatch(self, req):
         cmd = req.get("cmd")
         if cmd == "create_dense":
-            self.tables[req["table_id"]] = DenseTable(
-                req.get("shape"), optimizer=req.get("optimizer", "sgd"),
-                lr=req.get("lr", 0.01), init=req.get("init"),
-                seed=req.get("seed", 0))
-            return {"ok": True}
+            # first creation wins: concurrent trainers racing to create
+            # the same table must NOT wipe each other's pushes
+            if req["table_id"] not in self.tables:
+                self.tables[req["table_id"]] = DenseTable(
+                    req.get("shape"),
+                    optimizer=req.get("optimizer", "sgd"),
+                    lr=req.get("lr", 0.01), init=req.get("init"),
+                    seed=req.get("seed", 0))
+                return {"ok": True, "created": True}
+            return {"ok": True, "created": False}
         if cmd == "create_sparse":
-            self.tables[req["table_id"]] = SparseTable(
-                req["dim"], optimizer=req.get("optimizer", "sgd"),
-                lr=req.get("lr", 0.01), seed=req.get("seed", 0))
-            return {"ok": True}
+            if req["table_id"] not in self.tables:
+                self.tables[req["table_id"]] = SparseTable(
+                    req["dim"], optimizer=req.get("optimizer", "sgd"),
+                    lr=req.get("lr", 0.01), seed=req.get("seed", 0))
+                return {"ok": True, "created": True}
+            return {"ok": True, "created": False}
         if cmd == "pull_dense":
             return {"ok": True, "value": self.tables[req["table_id"]].pull()}
         if cmd == "push_dense":

@@ -153,8 +153,13 @@ def _patch():
     T.__len__ = lambda self: (self.aval_shape()[0] if self.ndim
                               else (_ for _ in ()).throw(
                                   TypeError("len() of a 0-d tensor")))
-    T.element_size = lambda self: int(
-        __import__("numpy").dtype(str(self._value.dtype)).itemsize)
+    from ..core import dtype as _dtype_mod
+    import numpy as _np
+
+    def _element_size(self):
+        # via the dtype property (trace-aware) rather than raw _value
+        return int(_np.dtype(_dtype_mod.to_jax_dtype(self.dtype)).itemsize)
+    T.element_size = _element_size
     T.ndimension = lambda self: self.ndim
     T.pin_memory = lambda self: self  # host staging is PjRt's job here
     T.scatter_nd = staticmethod(mp.scatter_nd)
