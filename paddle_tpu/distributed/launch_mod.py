@@ -25,13 +25,71 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
     return func(*args)
 
 
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch_ps_cluster(server_num, worker_num, script, script_args):
+    """Reference: fleet/launch.py PS mode — spawn server processes
+    (TRAINING_ROLE=PSERVER, POD_IP/PADDLE_PORT) and worker processes
+    (TRAINING_ROLE=TRAINER, PADDLE_TRAINER_ID), all sharing
+    PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_TRAINER_ENDPOINTS."""
+    import signal
+    import subprocess
+    server_eps = [f"127.0.0.1:{_free_port()}" for _ in range(server_num)]
+    worker_eps = [f"127.0.0.1:{_free_port()}" for _ in range(worker_num)]
+    base = dict(os.environ)
+    base["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(server_eps)
+    base["PADDLE_TRAINER_ENDPOINTS"] = ",".join(worker_eps)
+    base["PADDLE_TRAINERS_NUM"] = str(worker_num)
+    procs = []
+    for i, ep in enumerate(server_eps):
+        env = dict(base)
+        ip, port = ep.rsplit(":", 1)
+        env.update(TRAINING_ROLE="PSERVER", POD_IP=ip, PADDLE_PORT=port)
+        procs.append(("server", subprocess.Popen(
+            [sys.executable, script] + script_args, env=env)))
+    for i in range(worker_num):
+        env = dict(base)
+        env.update(TRAINING_ROLE="TRAINER", PADDLE_TRAINER_ID=str(i))
+        procs.append(("worker", subprocess.Popen(
+            [sys.executable, script] + script_args, env=env)))
+    # reference launcher semantics: wait for workers; servers are
+    # terminated when training finishes
+    rc = 0
+    for kind, p in procs:
+        if kind == "worker":
+            rc = p.wait() or rc
+    for kind, p in procs:
+        if kind == "server" and p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for kind, p in procs:
+        if kind == "server":
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return rc
+
+
 def launch():
-    """python -m paddle_tpu.distributed.launch_mod [--coordinator host:port]
-    [--nnodes N] [--node_rank R] script.py args..."""
+    """python -m paddle_tpu.distributed.launch_mod
+    [--coordinator host:port] [--nnodes N] [--node_rank R]
+    [--server_num N --worker_num M]  script.py args...
+
+    With --server_num/--worker_num, spawns a local parameter-server
+    cluster (reference: fleet/launch.py PS mode)."""
     argv = sys.argv[1:]
     coordinator = None
     nnodes = 1
     node_rank = 0
+    server_num = 0
+    worker_num = 0
     script_idx = 0
     i = 0
     while i < len(argv):
@@ -45,14 +103,24 @@ def launch():
         elif a == "--node_rank":
             node_rank = int(argv[i + 1])
             i += 2
+        elif a == "--server_num":
+            server_num = int(argv[i + 1])
+            i += 2
+        elif a == "--worker_num":
+            worker_num = int(argv[i + 1])
+            i += 2
         else:
             script_idx = i
             break
+    script = argv[script_idx]
+    script_args = argv[script_idx + 1:]
+    if server_num > 0:
+        sys.exit(_launch_ps_cluster(server_num, max(worker_num, 1),
+                                    script, script_args))
     if coordinator and nnodes > 1:
         os.environ["PADDLE_COORDINATOR"] = coordinator
         os.environ["PADDLE_TRAINERS_NUM"] = str(nnodes)
         os.environ["PADDLE_TRAINER_ID"] = str(node_rank)
-    script = argv[script_idx]
     sys.argv = argv[script_idx:]
     runpy.run_path(script, run_name="__main__")
 

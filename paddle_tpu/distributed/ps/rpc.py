@@ -37,8 +37,20 @@ def _recv_exact(sock, n):
     return buf
 
 
-def connect(host, port, timeout=30.0):
-    sock = socket.create_connection((host, port), timeout=timeout)
+def connect(host, port, timeout=30.0, retry_secs=60.0):
+    """Connect with readiness retries: trainers routinely start before
+    their servers have bound (reference: test_collective_base.py:37
+    waits for endpoint readiness)."""
+    import time
+    deadline = time.time() + retry_secs
+    while True:
+        try:
+            sock = socket.create_connection((host, port), timeout=timeout)
+            break
+        except (ConnectionRefusedError, OSError):
+            if time.time() >= deadline:
+                raise
+            time.sleep(0.3)
     # blocking after connect: a receive timeout mid-request (e.g. a long
     # barrier wait) would desync the length-prefixed stream — the late
     # response would be read as the reply to the NEXT request

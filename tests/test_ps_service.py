@@ -467,3 +467,53 @@ def test_fleet_util_helpers():
     out = util.all_reduce(np.ones(3, np.float32))
     np.testing.assert_allclose(out, np.ones(3))
     util.barrier()
+
+
+def test_launcher_ps_mode(tmp_path):
+    """python -m launch --server_num 1 --worker_num 2 script.py spawns a
+    PS cluster: the SAME script runs as server or trainer based on the
+    launcher-set env (reference: fleet/launch.py PS mode +
+    PaddleCloudRoleMaker)."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    marker = str(tmp_path / "result.txt")
+    script = tmp_path / "ps_script.py"
+    script.write_text(f"""
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker,
+                                          DistributedStrategy)
+
+strategy = DistributedStrategy()
+strategy.a_sync = True
+fleet.init(PaddleCloudRoleMaker(), strategy=strategy)
+if fleet.is_server():
+    fleet.init_server()
+    fleet.run_server()       # serves until the launcher terminates us
+else:
+    client = fleet.init_worker()
+    client.create_dense_table("w", shape=(2,), optimizer="sum",
+                              init=np.zeros(2))
+    fleet.barrier_worker()
+    fleet.communicator().send_dense("w", np.ones(2, np.float32))
+    fleet.communicator().flush()
+    fleet.barrier_worker()
+    if fleet.worker_index() == 0:
+        total = client.pull_dense("w")
+        with open({marker!r}, "w") as f:
+            f.write(str(float(total.sum())))
+    fleet.stop_worker()
+""")
+    rc = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch_mod",
+         "--server_num", "1", "--worker_num", "2", str(script)],
+        cwd=repo, timeout=180).returncode
+    assert rc == 0
+    # 2 workers each pushed ones(2) into a sum table: total = 4
+    assert float(open(marker).read()) == 4.0
