@@ -55,7 +55,7 @@ def _emit(payload):
         if _printed:
             return
         _printed = True
-    print(json.dumps(payload), flush=True)
+        print(json.dumps(payload), flush=True)
 
 
 def _latest_artifact():
@@ -249,7 +249,16 @@ def main():
     last_err = "no attempt completed"
 
     def _on_term(signum, frame):  # driver killed us: still emit the line
-        _emit_fallback(f"terminated by signal {signum}; last: {last_err}")
+        # handler runs on the main thread; if the signal interrupted an
+        # in-flight _emit (lock held), exiting here would truncate that
+        # print — return instead and let it finish
+        if not _print_lock.acquire(timeout=2.0):
+            return
+        already = _printed
+        _print_lock.release()
+        if not already:
+            _emit_fallback(f"terminated by signal {signum}; "
+                           f"last: {last_err}")
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _on_term)

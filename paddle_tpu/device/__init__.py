@@ -24,6 +24,30 @@ class cuda:  # namespace shim for paddle.device.cuda users
         import jax
         (jax.device_put(0) + 0).block_until_ready()
 
+    # memory-query API (reference: python/paddle/device/cuda/__init__.py
+    # max_memory_allocated etc., backed by allocator_facade.cc stats) —
+    # forwarded to the accelerator (HBM) equivalents so reference code
+    # keeps running unchanged on TPU.
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
+
+    @staticmethod
+    def empty_cache():
+        empty_cache()
+
 
 def synchronize(device=None):
     import jax
@@ -37,19 +61,10 @@ def memory_stats(device=None):
     `device`: None (device 0), an int index, a 'tpu:1'-style string, or a
     jax Device."""
     import jax
-    if device is not None and hasattr(device, "memory_stats"):
-        return dict(device.memory_stats() or {})
-    devs = jax.local_devices()
-    idx = 0
-    if isinstance(device, int):
-        idx = device
-    elif isinstance(device, str) and device:
-        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
-    if not 0 <= idx < len(devs):
-        raise ValueError(
-            f"device index {idx} out of range (have {len(devs)} local "
-            "devices)")
-    return dict(devs[idx].memory_stats() or {})
+    dev = _resolve_device(device)
+    if dev is None:
+        dev = jax.local_devices()[0]
+    return dict(dev.memory_stats() or {})
 
 
 def max_memory_allocated(device=None):
@@ -66,3 +81,110 @@ def max_memory_reserved(device=None):
 
 def memory_reserved(device=None):
     return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def empty_cache():
+    """Drop python-side references to dead buffers + jit caches (analogue
+    of the reference's allocator Release(): allocator_facade.cc). PjRt
+    frees HBM when the last reference dies, so gc is the lever here."""
+    import gc
+    gc.collect()
+
+
+def _resolve_device(device):
+    """None | int index | 'tpu:1'-style string | jax Device -> Device or
+    None (same argument forms as memory_stats)."""
+    import jax
+    if device is None or hasattr(device, "memory_stats"):
+        return device
+    devs = jax.local_devices()
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str) and device:
+        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
+    else:
+        raise ValueError(f"unsupported device spec {device!r}")
+    if not 0 <= idx < len(devs):
+        raise ValueError(
+            f"device index {idx} out of range (have {len(devs)} local "
+            "devices)")
+    return devs[idx]
+
+
+def live_array_bytes(device=None):
+    """Total bytes of live jax arrays (optionally on one device) — the
+    live_buffers surface of the reference's memory stat getters
+    (memory/stats.h DeviceMemoryStatCurrentValue), usable on every
+    backend including the CPU test mesh where PjRt memory_stats() is
+    unavailable. `device` takes the same forms as memory_stats."""
+    import jax
+    device = _resolve_device(device)
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            for sh in arr.addressable_shards:
+                if device is None or sh.device == device:
+                    total += sh.data.nbytes
+        except Exception:  # deleted/donated arrays
+            continue
+    return total
+
+
+class memory_tracker:
+    """Context manager measuring live-array memory across a region:
+
+        with paddle.device.memory_tracker() as mt:
+            ...training step...
+            mt.sample()          # optional mid-region samples
+        mt.peak_bytes, mt.delta_bytes
+
+    Peak is the max over enter/samples/exit (host-visible live arrays;
+    XLA-internal temps are captured by program_memory_analysis instead).
+    Used by the ZeRO and pipeline memory tests; the analogue of the
+    reference's peak memory stats (memory/stats.h DeviceMemoryStatPeak).
+    """
+
+    def __init__(self, device=None):
+        self._device = device
+        self.start_bytes = 0
+        self.peak_bytes = 0
+        self.end_bytes = 0
+
+    def sample(self):
+        b = live_array_bytes(self._device)
+        self.peak_bytes = max(self.peak_bytes, b)
+        return b
+
+    def __enter__(self):
+        self.start_bytes = self.sample()
+        return self
+
+    def __exit__(self, *exc):
+        self.end_bytes = self.sample()
+        return False
+
+    @property
+    def delta_bytes(self):
+        return self.end_bytes - self.start_bytes
+
+
+def program_memory_analysis(fn, *args, **kwargs):
+    """XLA memory analysis of `fn` compiled on these args: dict with
+    temp/argument/output/generated-code bytes and their total. This is
+    the compile-time equivalent of the reference's allocator peak stats
+    — deterministic, available on every backend (the pipeline memory
+    test asserts 1F1B flatness with it). `fn` may be a python callable
+    (jitted here) or an existing jax.jit object."""
+    import jax
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    ma = jfn.lower(*args, **kwargs).compile().memory_analysis()
+    out = {
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+    }
+    out["total_bytes"] = (out["temp_bytes"] + out["argument_bytes"]
+                          + out["output_bytes"] - out["alias_bytes"])
+    return out

@@ -128,15 +128,18 @@ class ReduceOp:
     AVG = "avg"
 
 
-def _eager_collective(x, group, per_shard_fn, out_spec_fn=None):
+def _eager_collective(x, group, per_shard_fn, out_spec_fn=None,
+                      in_spec=None):
     """Run an XLA collective eagerly over the group's mesh axis via a
-    one-op shard_map. x is sharded (or replicated) on the leading dim."""
+    one-op shard_map. x is sharded (or replicated) on the leading dim
+    unless a custom in_spec is given."""
     mesh = group.mesh
     axis = group.axis
     n = int(mesh.shape[axis])
     if n == 1:
         return per_shard_fn(x, single=True)
-    in_spec = P(axis)
+    if in_spec is None:
+        in_spec = P(axis)
     out_spec = out_spec_fn(axis) if out_spec_fn is not None else P(axis)
     fn = jax.shard_map(lambda v: per_shard_fn(v, single=False),
                        mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)
@@ -312,8 +315,33 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
             out_tensor_list.extend(in_tensor_list)
             return out_tensor_list
         return list(in_tensor_list)
-    raise NotImplementedError(
-        "eager alltoall across mesh shards: use inside shard_map")
+    # Eager single-controller (reference: imperative alltoall is an eager
+    # op — paddle/fluid/imperative eager collectives): each tensor's
+    # leading-axis blocks are the per-rank values; out[j] block r =
+    # in[r] block j. One shard_map'd lax.all_to_all over the slot axis
+    # does the exchange on ICI.
+    if len(in_tensor_list) != n:
+        raise ValueError(
+            f"alltoall: need exactly {n} input tensors (one per rank), "
+            f"got {len(in_tensor_list)}")
+    vals = [jnp.asarray(t.value if isinstance(t, Tensor) else t)
+            for t in in_tensor_list]
+    if vals[0].ndim == 0 or vals[0].shape[0] % n != 0:
+        raise ValueError(
+            f"alltoall: leading dim of shape {tuple(vals[0].shape)} is "
+            f"not divisible by group size {n}; eager collectives treat "
+            "the leading-axis blocks as the per-rank values")
+    stacked = jnp.stack(vals, axis=1)  # [B, n_slots, ...]
+    axis = g.axis
+    out = _eager_collective(
+        stacked, g,
+        lambda v, single: jax.lax.all_to_all(
+            v, axis, split_axis=1, concat_axis=1, tiled=False))
+    outs = [Tensor(out[:, j]) for j in range(n)]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(outs)
+        return out_tensor_list
+    return outs
 
 
 @register_op("c_alltoall", differentiable=True)
@@ -336,8 +364,63 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         if tensor_list:
             tensor.value = tensor_list[0].value
         return tensor
-    raise NotImplementedError(
-        "eager reduce_scatter across mesh shards: use inside shard_map")
+    # Eager single-controller: rank r's output = reduce over ranks j of
+    # (rank j's tensor_list[r]); with leading-axis blocks as per-rank
+    # values this is one shard_map'd psum_scatter (SUM fast path) or an
+    # all_gather + local reduction (other ops) over the slot axis.
+    n = g.nranks
+    axis = g.axis
+    red_name = op if isinstance(op, str) else "sum"
+
+    def _scatter_reduce(v, scatter_dim):
+        # v per-device: slot dim `scatter_dim` has size n; keep column
+        # axis_index after reducing over ranks
+        if red_name == "sum":
+            return jax.lax.psum_scatter(v, axis,
+                                        scatter_dimension=scatter_dim,
+                                        tiled=False)
+        g_all = jax.lax.all_gather(v, axis)      # [n_ranks, ...local...]
+        idx = jax.lax.axis_index(axis)
+        mine = jnp.take(g_all, idx, axis=1 + scatter_dim)  # my column
+        if red_name == "max":
+            return jnp.max(mine, axis=0)
+        if red_name == "min":
+            return jnp.min(mine, axis=0)
+        if red_name == "prod":
+            return jnp.prod(mine, axis=0)
+        if red_name == "avg":
+            return jnp.mean(mine, axis=0)
+        raise ValueError(f"unknown reduce op {red_name!r}")
+
+    if tensor_list is not None:
+        if len(tensor_list) != n:
+            raise ValueError(
+                f"reduce_scatter: need exactly {n} input tensors (one "
+                f"per rank), got {len(tensor_list)}")
+        vals = [jnp.asarray(t.value if isinstance(t, Tensor) else t)
+                for t in tensor_list]
+        if vals[0].ndim == 0 or vals[0].shape[0] % n != 0:
+            raise ValueError(
+                f"reduce_scatter: leading dim of shape "
+                f"{tuple(vals[0].shape)} is not divisible by group size "
+                f"{n}; eager collectives treat the leading-axis blocks "
+                "as the per-rank values")
+        stacked = jnp.stack(vals, axis=1)  # [B, n_slots, ...]
+        tensor.value = _eager_collective(
+            stacked, g, lambda v, single: _scatter_reduce(v, 1))
+        return tensor
+    # single-input form: each rank's block is split n ways and scattered
+    v = jnp.asarray(tensor.value)
+    if v.ndim == 0 or v.shape[0] % (n * n) != 0:
+        raise ValueError(
+            f"reduce_scatter: leading dim of shape {tuple(v.shape)} must "
+            f"divide by group_size^2 ({n * n}) in single-tensor eager "
+            "form (each per-rank block is split n ways)")
+    tensor.value = _eager_collective(
+        v, g,
+        lambda s, single: _scatter_reduce(
+            s.reshape((n, s.shape[0] // n) + s.shape[1:]), 0))
+    return tensor
 
 
 @register_op("c_reducescatter", differentiable=True)

@@ -1,0 +1,99 @@
+"""Pipeline-parallel memory bounds (reference: SectionWorker 1F1B,
+paddle/fluid/framework/section_worker.cc:34-103 — the schedule exists to
+BOUND in-flight activation memory, not just order the microbatches).
+
+The scan+ppermute pipeline must show the same property: at a fixed global
+batch, raising the microbatch count M must NOT raise peak temp memory —
+the per-tick jax.checkpoint keeps only the stage input as residual, so
+in-flight storage stays ~(ticks * microbatch) ~ batch, independent of M.
+XLA's own memory analysis of the compiled program is the measurement
+(deterministic, works on the CPU mesh)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.pipeline import (
+    pipeline_loss_and_grad, stack_stage_params)
+
+PP = 4
+B = 64
+H = 128
+L = 4
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:PP]), ("pp",))
+
+
+def _stacked_params():
+    rng = np.random.RandomState(0)
+    per_stage = [{
+        "w": jnp.asarray(rng.randn(L, H, H), jnp.float32) * 0.05,
+        "b": jnp.zeros((L, H), jnp.float32),
+    } for _ in range(PP)]
+    return stack_stage_params(per_stage)
+
+
+def _stage_fn(params, x):
+    def one(carry, wl):
+        w, b = wl
+        return jnp.tanh(carry @ w + b), None
+
+    y, _ = jax.lax.scan(one, x, (params["w"], params["b"]))
+    return y
+
+
+def _loss_fn(out, y):
+    return jnp.mean((out - y) ** 2)
+
+
+def _temp_bytes(m, remat):
+    mesh = _mesh()
+    stacked = _stacked_params()
+    mb = B // m
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(m, mb, H), jnp.float32)
+    y = jnp.asarray(rng.randn(m, mb, H), jnp.float32)
+
+    def f(params, x, y):
+        return pipeline_loss_and_grad(_stage_fn, _loss_fn, params, x, y,
+                                      mesh, "pp", remat=remat)
+
+    from paddle_tpu.device import program_memory_analysis
+    return program_memory_analysis(f, stacked, x, y)["temp_bytes"]
+
+
+class TestPipelineMemory:
+    def test_peak_memory_flat_in_microbatch_count(self):
+        """1F1B parity: M 4 -> 32 at fixed batch must not grow peak temp
+        memory (measured ~0.58MB -> ~0.45MB; assert <= 1.2x headroom)."""
+        t4 = _temp_bytes(4, remat=True)
+        t32 = _temp_bytes(32, remat=True)
+        assert t32 <= 1.2 * t4, (t4, t32)
+
+    def test_remat_reduces_peak_memory(self):
+        """The per-tick jax.checkpoint is load-bearing: disabling it must
+        cost real memory (measured ~2x on this config)."""
+        with_remat = _temp_bytes(4, remat=True)
+        without = _temp_bytes(4, remat=False)
+        assert with_remat < 0.8 * without, (with_remat, without)
+
+    def test_loss_matches_across_microbatch_counts(self):
+        """Memory knobs must not change numerics: same fixed batch, the
+        mean loss is M-invariant."""
+        losses = []
+        for m in (4, 16):
+            mesh = _mesh()
+            stacked = _stacked_params()
+            mb = B // m
+            rng = np.random.RandomState(1)
+            x = jnp.asarray(rng.randn(B, H), jnp.float32)
+            y = jnp.asarray(rng.randn(B, H), jnp.float32)
+            loss, _ = jax.jit(
+                lambda p, xm, ym: pipeline_loss_and_grad(
+                    _stage_fn, _loss_fn, p, xm, ym, mesh, "pp"))(
+                stacked, x.reshape(m, mb, H), y.reshape(m, mb, H))
+            losses.append(float(loss))
+        assert losses[0] == pytest.approx(losses[1], rel=1e-5)
