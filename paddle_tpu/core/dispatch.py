@@ -32,6 +32,7 @@ import numpy as np
 
 from . import trace as trace_mod
 from . import flags as flags_mod
+from . import lazy as lazy_mod
 
 _grad_state = threading.local()
 
@@ -104,10 +105,14 @@ class Op:
     values as attrs.
     """
 
-    def __init__(self, name, fn, differentiable=True):
+    def __init__(self, name, fn, differentiable=True, defer=True):
         self.name = name
         self.fn = fn
         self.differentiable = differentiable
+        # defer=False opts out of lazy micro-tracing (e.g. RNG key
+        # splitting, whose outputs feed raw jax.random calls that cannot
+        # abstractify a LazyArray)
+        self.defer = defer
         _REGISTRY[name] = self
 
     def __repr__(self):
@@ -163,11 +168,24 @@ class Op:
         if ctx is not None and ctx.mode == "jit":
             outs = closure(*arrays)
         else:
-            jitted = _jit_cache.get(key)
-            if jitted is None:
-                jitted = jax.jit(closure)
-                _jit_cache[key] = jitted
-            outs = jitted(*arrays)
+            outs = None
+            if ctx is None and self.defer and lazy_mod.enabled():
+                # lazy micro-tracing (SURVEY §7 hard-part 1): defer the
+                # op into the thread's micro-graph; a whole eager step
+                # flushes as ONE cached executable at the next
+                # materialization / step boundary
+                try:
+                    outs = lazy_mod.dispatch(closure, key, arrays)
+                except lazy_mod.Fallback:
+                    outs = None
+            if outs is None:
+                if lazy_mod.ever_enabled():
+                    arrays = [lazy_mod.concrete(a) for a in arrays]
+                jitted = _jit_cache.get(key)
+                if jitted is None:
+                    jitted = jax.jit(closure)
+                    _jit_cache[key] = jitted
+                outs = jitted(*arrays)
 
         multi = isinstance(outs, (tuple, list))
         out_list = list(outs) if multi else [outs]
@@ -254,8 +272,8 @@ def _check_finite(op_name, out_list):
                 f"(FLAGS_check_nan_inf is set)")
 
 
-def register_op(name, differentiable=True):
+def register_op(name, differentiable=True, defer=True):
     """Decorator: register a pure jax function as a framework op."""
     def deco(fn):
-        return Op(name, fn, differentiable=differentiable)
+        return Op(name, fn, differentiable=differentiable, defer=defer)
     return deco

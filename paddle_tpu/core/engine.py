@@ -18,6 +18,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import lazy as lazy_mod
+
 
 class GradNode:
     __slots__ = ("op", "key", "closure", "arrays", "input_tensors",
@@ -132,7 +134,7 @@ def _accumulate_into_leaf(tensor, grad_array, create_graph=False):
             ctx.register_created(tensor._grad)
     else:
         # keep the same Tensor object so traced steps functionalize correctly
-        tensor._grad.value = tensor._grad.value + grad_array
+        tensor._grad.value = lazy_mod.add(tensor._grad.value, grad_array)
 
 
 def run_backward(loss, grad_tensor=None, retain_graph=False,
@@ -180,12 +182,19 @@ def run_backward(loss, grad_tensor=None, retain_graph=False,
         node.pending = [None] * len(node.out_avals)
     root_node.pending[root_idx] = init_ct
 
+    lazy_bwd = not create_graph and lazy_mod.enabled()
     for node in reversed(order):
         cts = []
         any_ct = False
         for i, (shape, dt) in enumerate(node.out_avals):
             ct = node.pending[i]
             if ct is None:
+                if lazy_bwd:
+                    # deferred vjp treats None as an absent cotangent
+                    # (builds the zeros inside the fused graph); avoids
+                    # one eager bind per missing output
+                    cts.append(None)
+                    continue
                 ct = _zero_ct(shape, dt)
                 if create_graph:
                     from .tensor import Tensor as _T
@@ -217,9 +226,32 @@ def run_backward(loss, grad_tensor=None, retain_graph=False,
         if create_graph:
             in_grads = _vjp_apply(node, cts)
         else:
-            ct_arg = tuple(cts) if node.multi_out else cts[0]
-            bwd = node.op.vjp_fn(node.key, node.closure)
-            in_grads = bwd(node.arrays, ct_arg)
+            in_grads = None
+            # only standard deferrable ops: custom op stand-ins (e.g.
+            # _SparseLookupOp) override vjp_fn with semantics autodiff
+            # of the closure would not reproduce (IndexedSlices grads)
+            if node.closure is not None and getattr(node.op, "defer", False) \
+                    and lazy_mod.enabled():
+                # lazy micro-tracing: the vjp becomes a deferred node so
+                # the whole backward fuses into the step's micro-graph
+                try:
+                    in_grads = lazy_mod.dispatch_vjp(node, cts)
+                except lazy_mod.Fallback:
+                    in_grads = None
+            if in_grads is None:
+                if lazy_mod.ever_enabled():
+                    cts_c = [
+                        _zero_ct(*node.out_avals[i]) if c is None
+                        else lazy_mod.concrete(c)
+                        for i, c in enumerate(cts)]
+                else:
+                    cts_c = cts
+                ct_arg = tuple(cts_c) if node.multi_out else cts_c[0]
+                bwd = node.op.vjp_fn(node.key, node.closure)
+                arrays = node.arrays
+                if arrays is not None and lazy_mod.ever_enabled():
+                    arrays = [lazy_mod.concrete(a) for a in arrays]
+                in_grads = bwd(arrays, ct_arg)
         _distribute(node, in_grads, create_graph)
         if not retain_graph:
             node.released = True
@@ -332,7 +364,9 @@ def _distribute(node, in_grads, create_graph=False):
                 pnode.pending = [None] * len(pnode.out_avals)
             if pnode.pending[pidx] is None:
                 pnode.pending[pidx] = g
-            else:
+            elif create_graph:
                 pnode.pending[pidx] = pnode.pending[pidx] + g
+            else:
+                pnode.pending[pidx] = lazy_mod.add(pnode.pending[pidx], g)
         else:
             _accumulate_into_leaf(t, g, create_graph)

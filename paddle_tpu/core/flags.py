@@ -30,6 +30,11 @@ _DEFAULTS = {
         os.path.expanduser("~"), ".cache", "paddle_tpu", "xla"),
     # only cache compiles slower than this (seconds)
     "FLAGS_compilation_cache_min_compile_secs": 0.3,
+    # lazy micro-tracing eager executor (core/lazy.py): defer eager ops
+    # into a micro-graph flushed as one cached XLA executable at
+    # materialization/step boundaries. The TPU answer to the reference's
+    # generated fast eager entry points (op_function_generator.cc:519).
+    "FLAGS_lazy_eager": True,
 }
 
 

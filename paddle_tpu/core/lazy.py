@@ -1,0 +1,535 @@
+"""Lazy micro-tracing eager executor (SURVEY §7 hard-part 1, second half).
+
+TPU-native answer to the reference's generated fast eager entry points
+(reference: paddle/fluid/pybind/op_function_generator.cc:519 — per-op C
+functions that bypass python op assembly to make eager dispatch cheap).
+On TPU the per-op cost is not python assembly but the PjRt launch round
+trip: one executable launch per op. So instead of making each launch
+cheaper, consecutive eager ops are DEFERRED into a micro-graph and
+flushed as ONE fused XLA executable at materialization points
+(`.numpy()`, `float()`, printing, control flow on values) or at a step
+boundary (`optimizer.clear_grad`). Steady state, a whole eager train
+step becomes a single cached executable launch — the same dispatch
+economics as `to_static`, with no user annotation.
+
+Mechanics:
+  * `Op.__call__` (core/dispatch.py) calls `dispatch()` instead of
+    executing: the op's pure closure becomes a node in the thread-local
+    `LazyGraph`; outputs are `LazyArray` placeholders carrying
+    shape/dtype from a cached `jax.eval_shape`.
+  * backward is lazy too: the autograd engine (core/engine.py) routes
+    each node's vjp through `dispatch_vjp`, and gradient accumulation
+    through `add`, so fwd+bwd+optimizer of a step accumulate into one
+    graph.
+  * `flush()` compiles a replay function of the whole graph under
+    `jax.jit`, keyed by the graph shape (node keys + wiring + const
+    avals + live outputs); repeated steps hit the cache and pay one
+    executable launch.
+  * Materialization is automatic: `LazyArray.__jax_array__` /
+    `__array__` flush on any direct jnp/numpy use, so code that touches
+    raw values stays correct (it just fuses less).
+
+Enabled via FLAGS_lazy_eager (core/flags.py).
+"""
+import threading
+import weakref
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import flags as flags_mod
+from . import trace as trace_mod
+
+_MAX_NODES = 4096
+_MAX_CACHED_REPLAYS = 64
+_state = threading.local()
+_ever_enabled = [False]
+
+_replay_cache = {}
+_aval_cache = {}
+_vjp_fn_cache = {}
+
+
+class Fallback(Exception):
+    """Raised when an op cannot be deferred (exotic outputs); the caller
+    executes it eagerly instead."""
+
+
+def enabled():
+    if not flags_mod.get_flag("FLAGS_lazy_eager"):
+        return False
+    if trace_mod.current_trace() is not None:
+        return False
+    _ever_enabled[0] = True
+    return True
+
+
+def ever_enabled():
+    return _ever_enabled[0]
+
+
+class LazyArray:
+    """Placeholder for a deferred op output. Quacks enough like a
+    jax.Array for metadata (shape/dtype/ndim) and converts itself on any
+    real use via __jax_array__ / __array__ / attribute fallback."""
+    __slots__ = ("_graph", "_aval", "_concrete", "_node_ref",
+                 "__weakref__")
+
+    def __init__(self, graph, aval):
+        self._graph = graph
+        self._aval = aval
+        self._concrete = None
+
+    # -- metadata (no materialization) --------------------------------
+    @property
+    def shape(self):
+        return self._aval.shape
+
+    @property
+    def dtype(self):
+        return self._aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self._aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self._aval.shape)) if self._aval.shape else 1
+
+    @property
+    def nbytes(self):
+        return self.size * jnp.dtype(self._aval.dtype).itemsize
+
+    @property
+    def weak_type(self):
+        return getattr(self._aval, "weak_type", False)
+
+    # -- materialization ----------------------------------------------
+    def materialize(self):
+        if self._concrete is None:
+            g = self._graph
+            if g is None:
+                raise RuntimeError("deferred value has no graph and no "
+                                   "concrete result (internal error)")
+            g.flush()
+            if self._concrete is None:
+                raise RuntimeError(
+                    "deferred value lost: its lazy graph failed to "
+                    f"execute ({g.error!r})") from g.error
+        return self._concrete
+
+    def __jax_array__(self):
+        return self.materialize()
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self.materialize())
+        return a.astype(dtype) if dtype is not None else a
+
+    def block_until_ready(self):
+        self.materialize().block_until_ready()
+        return self
+
+    def __getattr__(self, item):
+        # any attribute beyond the fast-path ones: materialize + delegate
+        # (never for private names — those are real missing attributes)
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return getattr(self.materialize(), item)
+
+    def __repr__(self):
+        if self._concrete is not None:
+            return repr(self._concrete)
+        return (f"LazyArray(shape={self._aval.shape}, "
+                f"dtype={self._aval.dtype}, deferred)")
+
+    # arithmetic stays lazy (grad accumulation, running-stat updates)
+    def __add__(self, other):
+        return _binary(jnp.add, "add", self, other)
+
+    def __radd__(self, other):
+        return _binary(jnp.add, "add", other, self)
+
+    def __sub__(self, other):
+        return _binary(jnp.subtract, "sub", self, other)
+
+    def __rsub__(self, other):
+        return _binary(jnp.subtract, "sub", other, self)
+
+    def __mul__(self, other):
+        return _binary(jnp.multiply, "mul", self, other)
+
+    def __rmul__(self, other):
+        return _binary(jnp.multiply, "mul", other, self)
+
+    def __truediv__(self, other):
+        return _binary(jnp.divide, "div", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary(jnp.divide, "div", other, self)
+
+    def __neg__(self):
+        if enabled():
+            try:
+                return dispatch(jnp.negative, ("lazy_neg",), [self])
+            except Fallback:
+                pass
+        return jnp.negative(self.materialize())
+
+    def __matmul__(self, other):
+        return _binary(jnp.matmul, "matmul", self, other)
+
+    def __pow__(self, other):
+        return _binary(jnp.power, "pow", self, other)
+
+    def __mod__(self, other):
+        return _binary(jnp.mod, "mod", self, other)
+
+    def __floordiv__(self, other):
+        return _binary(jnp.floor_divide, "floordiv", self, other)
+
+    # comparisons are elementwise (like jax arrays); a missing __eq__
+    # would silently fall back to identity and return a python bool
+    def __eq__(self, other):
+        return _binary(jnp.equal, "eq", self, other)
+
+    def __ne__(self, other):
+        return _binary(jnp.not_equal, "ne", self, other)
+
+    def __lt__(self, other):
+        return _binary(jnp.less, "lt", self, other)
+
+    def __le__(self, other):
+        return _binary(jnp.less_equal, "le", self, other)
+
+    def __gt__(self, other):
+        return _binary(jnp.greater, "gt", self, other)
+
+    def __ge__(self, other):
+        return _binary(jnp.greater_equal, "ge", self, other)
+
+    __hash__ = None  # unhashable, matching jax.Array
+
+    def __or__(self, other):
+        return _binary(jnp.logical_or, "or", self, other)
+
+    def __ror__(self, other):
+        return _binary(jnp.logical_or, "or", other, self)
+
+    def __and__(self, other):
+        return _binary(jnp.logical_and, "and", self, other)
+
+    def __rand__(self, other):
+        return _binary(jnp.logical_and, "and", other, self)
+
+    def __invert__(self):
+        if enabled():
+            try:
+                return dispatch(jnp.logical_not, ("lazy_not",), [self])
+            except Fallback:
+                pass
+        return jnp.logical_not(self.materialize())
+
+    def astype(self, dt):
+        return _unary_astype(self, dt)
+
+    def __getitem__(self, idx):
+        return self.materialize()[idx]
+
+    def __iter__(self):
+        return iter(self.materialize())
+
+    def __float__(self):
+        return float(np.asarray(self.materialize()))
+
+    def __int__(self):
+        return int(np.asarray(self.materialize()))
+
+    def __bool__(self):
+        return bool(np.asarray(self.materialize()))
+
+
+# Register LazyArray as a pytree whose flatten materializes: jax API
+# boundaries (jit args, device_put, shard_map) then accept LazyArrays
+# transparently. Direct lax binds on a LazyArray still raise (jax
+# removed __jax_array__ abstractification) — framework-internal raw-jax
+# sites materialize explicitly via concrete().
+jax.tree_util.register_pytree_node(
+    LazyArray,
+    lambda la: ((la.materialize(),), None),
+    lambda _, ch: ch[0])
+
+
+class _Node:
+    __slots__ = ("fn", "fn_key", "args", "treedef", "avals", "out_wrefs",
+                 "cache_key")
+
+    def __init__(self, fn, fn_key, args, treedef, avals):
+        self.fn = fn
+        self.fn_key = fn_key
+        self.args = args                  # ("c", i) | ("n", node, out)
+        self.treedef = treedef
+        self.avals = avals                # flat ShapeDtypeStructs
+        self.out_wrefs = []
+
+
+class LazyGraph:
+    def __init__(self):
+        self.nodes = []
+        self.consts = []
+        self._const_ids = {}
+        self.flushed = False
+        self.error = None
+
+    # -- building ------------------------------------------------------
+    def _const_ref(self, arr):
+        idx = self._const_ids.get(id(arr))
+        if idx is None:
+            idx = len(self.consts)
+            self.consts.append(arr)
+            self._const_ids[id(arr)] = idx
+        return ("c", idx)
+
+    def _arg_ref(self, a):
+        if isinstance(a, LazyArray):
+            if a._concrete is not None:
+                return self._const_ref(a._concrete), a._concrete
+            if a._graph is not self:
+                # a lazy value from an unflushed foreign graph cannot be
+                # wired in; materialize it (flushes that graph)
+                c = a.materialize()
+                return self._const_ref(c), c
+            return None, a  # same-graph lazy: resolved by caller
+        return self._const_ref(a), a
+
+    def append(self, fn, fn_key, arrays):
+        refs = []
+        in_avals = []
+        for a in arrays:
+            ref, val = self._arg_ref(a)
+            if ref is None:  # same-graph lazy
+                # find its producing slot via the weakref lists
+                ref = val._node_ref
+                in_avals.append(val._aval)
+            else:
+                in_avals.append(_aval_of(val))
+            refs.append(ref)
+        akey = (fn_key,
+                tuple((a.shape, a.dtype,
+                       bool(getattr(a, "weak_type", False)))
+                      for a in in_avals))
+        cached = _aval_cache.get(akey)
+        if cached is None:
+            out_struct = jax.eval_shape(fn, *in_avals)
+            flat, treedef = jax.tree.flatten(out_struct)
+            for leaf in flat:
+                if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+                    raise Fallback(f"non-array output from {fn_key!r}")
+                if leaf.dtype == jax.dtypes.float0:
+                    raise Fallback(f"float0 output from {fn_key!r}")
+            cached = (flat, treedef)
+            _aval_cache[akey] = cached
+        flat_avals, treedef = cached
+        node_idx = len(self.nodes)
+        node = _Node(fn, fn_key, tuple(refs), treedef, flat_avals)
+        node.cache_key = (fn_key, node.args)
+        self.nodes.append(node)
+        outs = []
+        for j, aval in enumerate(flat_avals):
+            la = LazyArray(self, aval)
+            la._node_ref = ("n", node_idx, j)
+            node.out_wrefs.append(weakref.ref(la))
+            outs.append(la)
+        return jax.tree.unflatten(treedef, outs)
+
+    # -- execution -----------------------------------------------------
+    def flush(self):
+        if self.flushed:
+            return
+        self.flushed = True
+        if getattr(_state, "graph", None) is self:
+            _state.graph = None
+        if not self.nodes:
+            return
+        live = []       # (node_idx, out_idx)
+        live_arrays = []  # strong refs so gc can't race the assignment
+        for i, n in enumerate(self.nodes):
+            for j, w in enumerate(n.out_wrefs):
+                la = w()
+                if la is not None and la._concrete is None:
+                    live.append((i, j))
+                    live_arrays.append(la)
+        key = (tuple(n.cache_key for n in self.nodes),
+               tuple((np.shape(c), _dtype_of(c),
+                      bool(getattr(c, "weak_type", False)))
+                     for c in self.consts),
+               tuple(live))
+        exe = _replay_cache.get(key)
+        if exe is None:
+            exe = jax.jit(_make_replay(self.nodes, live))
+            if len(_replay_cache) >= _MAX_CACHED_REPLAYS:
+                # bound compile-cache growth (live-set churn can mint
+                # new keys); FIFO eviction of the oldest entry
+                _replay_cache.pop(next(iter(_replay_cache)))
+            _replay_cache[key] = exe
+        try:
+            outs = exe(*self.consts)
+        except Exception as e:
+            # keep the graph object (with .error) so pending LazyArrays
+            # raise a diagnostic instead of silently yielding None
+            self.error = e
+            raise
+        for la, val in zip(live_arrays, outs):
+            la._concrete = val
+            la._graph = None
+        self.nodes = None
+        self.consts = None
+        self._const_ids = None
+
+
+def _make_replay(nodes, live):
+    def replay(*consts):
+        vals = []
+        for n in nodes:
+            args = [consts[r[1]] if r[0] == "c" else vals[r[1]][r[2]]
+                    for r in n.args]
+            out = n.fn(*args)
+            flat, _ = jax.tree.flatten(out)
+            vals.append(flat)
+        return tuple(vals[i][j] for i, j in live)
+    return replay
+
+
+def _aval_of(x):
+    aval = getattr(x, "aval", None)
+    if aval is not None:  # jax.Array: reuse its ShapedArray directly
+        return aval
+    try:
+        return jax.ShapeDtypeStruct(
+            np.shape(x), _dtype_of(x),
+            weak_type=bool(getattr(x, "weak_type", False)))
+    except TypeError:  # older jax without weak_type kwarg
+        return jax.ShapeDtypeStruct(np.shape(x), _dtype_of(x))
+
+
+def _dtype_of(x):
+    dt = getattr(x, "dtype", None)
+    return dt if dt is not None else np.asarray(x).dtype
+
+
+def _cur():
+    g = getattr(_state, "graph", None)
+    if g is None:
+        g = LazyGraph()
+        _state.graph = g
+    return g
+
+
+def flush():
+    """Flush the current thread's pending graph (step-boundary hint —
+    called by optimizer.clear_grad — or explicit sync)."""
+    g = getattr(_state, "graph", None)
+    if g is not None:
+        g.flush()
+
+
+def concrete(x):
+    return x.materialize() if isinstance(x, LazyArray) else x
+
+
+def dispatch(fn, fn_key, arrays):
+    """Defer `fn(*arrays)` into the current graph; returns the output
+    pytree with LazyArray leaves. Raises Fallback for undeferable ops."""
+    g = _cur()
+    if len(g.nodes) >= _MAX_NODES:
+        g.flush()
+        g = _cur()
+    return g.append(fn, fn_key, arrays)
+
+
+def _binary(jnp_fn, name, a, b):
+    """Lazy-aware elementwise binary (python scalars become consts)."""
+    if enabled() and (isinstance(a, LazyArray) or isinstance(b, LazyArray)):
+        try:
+            aa = jnp.asarray(a) if isinstance(a, (int, float, bool)) else a
+            bb = jnp.asarray(b) if isinstance(b, (int, float, bool)) else b
+            return dispatch(jnp_fn, ("lazy_" + name,), [aa, bb])
+        except Fallback:
+            pass
+    return jnp_fn(concrete(a), concrete(b))
+
+
+def add(a, b):
+    """Lazy-aware addition used by gradient accumulation."""
+    return _binary(jnp.add, "add", a, b)
+
+
+def _unary_astype(a, dt):
+    if enabled() and isinstance(a, LazyArray):
+        try:
+            return dispatch(lambda x: x.astype(dt),
+                            ("lazy_astype", str(jnp.dtype(dt))), [a])
+        except Fallback:
+            pass
+    return concrete(a).astype(dt)
+
+
+def dispatch_vjp(node, cts):
+    """Defer a GradNode's backward into the lazy graph. `cts` is the
+    list of output cotangents (arrays/LazyArrays, or None/float0 zeros
+    for outputs with no incoming gradient). Returns per-input grads
+    aligned with node.input_tensors (None for inputs not needing grad).
+    Raises Fallback when the vjp can't be deferred."""
+    need = tuple(i for i, t in enumerate(node.input_tensors)
+                 if t is not None and not t.stop_gradient)
+    if not need:
+        return [None] * len(node.input_tensors)
+    absent = tuple(i for i, c in enumerate(cts)
+                   if c is None or getattr(c, "dtype", None)
+                   == jax.dtypes.float0)
+    fkey = ("lazy_vjp", node.key, need, absent, node.multi_out)
+    fn = _vjp_fn_cache.get(fkey)
+    if fn is None:
+        closure = node.closure
+        n_in = len(node.arrays)
+        multi = node.multi_out
+        absent_set = set(absent)
+
+        def vjp_flat(*flat):
+            arrays = flat[:n_in]
+            live_cts = list(flat[n_in:])
+            primals, vjp = jax.vjp(closure, *arrays)
+            plist = list(primals) if isinstance(primals, (tuple, list)) \
+                else [primals]
+            full_cts = []
+            li = 0
+            for i, p in enumerate(plist):
+                is_float = (jnp.issubdtype(p.dtype, jnp.floating)
+                            or jnp.issubdtype(p.dtype,
+                                              jnp.complexfloating))
+                if i in absent_set:
+                    c = (jnp.zeros(np.shape(p), p.dtype) if is_float
+                         else np.zeros(np.shape(p), jax.dtypes.float0))
+                else:
+                    c = live_cts[li]
+                    li += 1
+                    if not is_float:
+                        c = np.zeros(np.shape(p), jax.dtypes.float0)
+                    elif c.dtype != p.dtype:
+                        c = c.astype(p.dtype)
+                full_cts.append(c)
+            ct_arg = tuple(full_cts) if multi else full_cts[0]
+            grads = vjp(ct_arg)
+            return tuple(grads[i] for i in need)
+
+        fn = vjp_flat
+        _vjp_fn_cache[fkey] = fn
+    args = list(node.arrays) + [c for i, c in enumerate(cts)
+                                if i not in set(absent)]
+    outs = dispatch(fn, fkey, args)
+    outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+    in_grads = [None] * len(node.input_tensors)
+    for j, i in enumerate(need):
+        in_grads[i] = outs[j]
+    return in_grads

@@ -16,7 +16,7 @@ from .dispatch import register_op
 from .tensor import Tensor
 
 
-@register_op("rng_split", differentiable=False)
+@register_op("rng_split", differentiable=False, defer=False)
 def _rng_split(state):
     k1, k2 = jax.random.split(state)
     return k1, k2

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from . import dtype as dtype_mod
 from . import device as device_mod
 from . import trace as trace_mod
+from .lazy import LazyArray as _LazyArray
 
 _name_counter = [0]
 
@@ -34,7 +35,9 @@ class Tensor:
                  name=None, persistable=False):
         if isinstance(value, Tensor):
             value = value.value
-        if not isinstance(value, jax.Array) or dtype is not None:
+        if isinstance(value, _LazyArray) and dtype is None:
+            pass  # keep the deferred value — no materialization
+        elif not isinstance(value, jax.Array) or dtype is not None:
             jdt = dtype_mod.to_jax_dtype(dtype) if dtype is not None else None
             value = jnp.asarray(value, dtype=jdt)
         if place is not None and not isinstance(value, jax.core.Tracer):

@@ -128,18 +128,15 @@ class ReduceOp:
     AVG = "avg"
 
 
-def _eager_collective(x, group, per_shard_fn, out_spec_fn=None,
-                      in_spec=None):
+def _eager_collective(x, group, per_shard_fn, out_spec_fn=None):
     """Run an XLA collective eagerly over the group's mesh axis via a
-    one-op shard_map. x is sharded (or replicated) on the leading dim
-    unless a custom in_spec is given."""
+    one-op shard_map. x is sharded (or replicated) on the leading dim."""
     mesh = group.mesh
     axis = group.axis
     n = int(mesh.shape[axis])
     if n == 1:
         return per_shard_fn(x, single=True)
-    if in_spec is None:
-        in_spec = P(axis)
+    in_spec = P(axis)
     out_spec = out_spec_fn(axis) if out_spec_fn is not None else P(axis)
     fn = jax.shard_map(lambda v: per_shard_fn(v, single=False),
                        mesh=mesh, in_specs=(in_spec,), out_specs=out_spec)

@@ -15,7 +15,8 @@ from ..core import rng as rng_mod
 
 
 def _key():
-    return rng_mod.next_key().value
+    from ..core import lazy as lazy_mod
+    return lazy_mod.concrete(rng_mod.next_key().value)
 
 
 class Initializer:

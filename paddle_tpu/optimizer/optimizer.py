@@ -79,6 +79,11 @@ class Optimizer:
     def clear_grad(self, set_to_zero=False):
         for p in self._parameter_list():
             p.clear_grad()
+        # step-boundary hint for the lazy micro-tracer: flushing here
+        # makes each eager train step one stable (cache-hitting) fused
+        # executable instead of drifting budget-boundary graphs
+        from ..core import lazy as _lazy
+        _lazy.flush()
 
     clear_gradients = clear_grad
 

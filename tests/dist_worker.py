@@ -1,0 +1,76 @@
+"""Multi-controller DP worker for test_dist_multiproc.py (reference
+strategy parity: test_dist_base.py:745 runs real multi-process loopback
+trainers and compares losses).
+
+Each process: jax.distributed.initialize via init_parallel_env (env vars
+set by the parent), a dp mesh over the GLOBAL device set, a seeded MLP
+(replicated), its process-local slice of the global batch, and 3 eager
+train steps. Prints one JSON line with the per-step losses (replicated —
+must match across ranks) and a param checksum."""
+import json
+import os
+import sys
+
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+from paddle_tpu.distributed import parallel, topology  # noqa: E402
+
+
+def main():
+    parallel.init_parallel_env()  # jax.distributed.initialize from env
+    nproc = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    ndev = jax.device_count()           # global
+    nlocal = len(jax.local_devices())
+    assert ndev == nlocal * nproc, (ndev, nlocal, nproc)
+
+    mesh = topology.get_mesh()
+    assert int(mesh.shape["dp"]) == ndev
+
+    paddle.seed(123)                    # identical replicated params
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    # global batch 8, dp-sharded on dim 0; every process builds the SAME
+    # global data then keeps its local slice (test_dist_base seeds data
+    # identically too)
+    rs = np.random.RandomState(0)
+    gx = rs.randn(8, 16).astype(np.float32)
+    gy = rs.randint(0, 4, (8, 1)).astype(np.int64)
+    shard = NamedSharding(mesh, P("dp"))
+    per = 8 // nproc
+    lx, ly = gx[rank * per:(rank + 1) * per], gy[rank * per:(rank + 1) * per]
+    x = paddle.Tensor(jax.make_array_from_process_local_data(shard, lx))
+    y = paddle.Tensor(jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), ly))
+
+    losses = []
+    for _ in range(3):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+
+    wsum = float(np.asarray(
+        net[0].weight.value.sum() + net[2].weight.value.sum()))
+    print(json.dumps({"rank": rank, "losses": losses, "wsum": wsum}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""Lazy micro-tracing eager executor (SURVEY §7 hard-part 1; VERDICT r2
+item 4; reference purpose parity: op_function_generator.cc:519 fast eager
+dispatch). Deferred ops must be numerically identical to immediate
+execution, flush at every materialization point, and hit the replay
+cache on repeated steps."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.core import lazy as lazy_mod
+
+
+@pytest.fixture(autouse=True)
+def _lazy_on():
+    prev = paddle.get_flags(["FLAGS_lazy_eager"])["FLAGS_lazy_eager"]
+    paddle.set_flags({"FLAGS_lazy_eager": True})
+    yield
+    lazy_mod.flush()
+    paddle.set_flags({"FLAGS_lazy_eager": prev})
+
+
+def _train_losses(lazy, steps=4):
+    paddle.set_flags({"FLAGS_lazy_eager": lazy})
+    paddle.seed(7)
+    np.random.seed(7)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 4, (8,)).astype("int64"))
+    losses = []
+    for _ in range(steps):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+class TestLazyNumerics:
+    def test_training_parity_with_immediate_mode(self):
+        lazy = _train_losses(True)
+        paddle.set_flags({"FLAGS_lazy_eager": True})  # restore for fixture
+        immediate = _train_losses(False)
+        np.testing.assert_allclose(lazy, immediate, rtol=1e-5)
+        assert lazy[0] > lazy[-1]  # actually trained
+
+    def test_deferred_until_materialization(self):
+        a = paddle.to_tensor(np.ones((4, 4), np.float32))
+        b = a * 3.0 + 1.0
+        # the op result is a deferred placeholder, not a concrete array
+        assert isinstance(b._value, lazy_mod.LazyArray)
+        assert b.shape == [4, 4]          # metadata without flush
+        assert b._value._concrete is None
+        np.testing.assert_allclose(b.numpy(), 4.0 * np.ones((4, 4)))
+        assert b._value._concrete is not None  # flushed by .numpy()
+
+    def test_replay_cache_hits_across_steps(self):
+        before = len(lazy_mod._replay_cache)
+        _train_losses(True, steps=6)
+        added = len(lazy_mod._replay_cache) - before
+        # step 1 (accumulator init) + steady-state step: ~2 graphs, not 6
+        assert added <= 3, added
+
+    def test_control_flow_flushes(self):
+        t = paddle.to_tensor(np.asarray([2.0], np.float32))
+        out = t * 2
+        if float(out) > 3.0:  # __float__ materializes
+            ok = True
+        assert ok
+
+    def test_grad_accumulation_without_clear(self):
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(2):
+            lin(x).sum().backward()
+        g2 = lin.weight.grad.numpy()
+        paddle.set_flags({"FLAGS_lazy_eager": False})
+        paddle.seed(0)
+        lin2 = nn.Linear(4, 4)
+        for _ in range(2):
+            lin2(x).sum().backward()
+        np.testing.assert_allclose(g2, lin2.weight.grad.numpy(), rtol=1e-6)
+
+    def test_mixed_lazy_concrete_inputs(self):
+        a = paddle.to_tensor(np.ones((3,), np.float32))
+        b = a + 1.0                      # lazy
+        lazy_mod.flush()                 # b now concrete
+        c = b * 2.0 + a                  # mixes flushed + fresh const
+        np.testing.assert_allclose(c.numpy(), [5.0, 5.0, 5.0])

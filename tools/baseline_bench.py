@@ -45,15 +45,25 @@ def bench_lenet():
         opt.clear_grad()
         return loss
 
-    # eager
-    for _ in range(3):
-        _sync(step())  # warm per-op executable caches
-    t0 = time.perf_counter()
     n = 20
-    for _ in range(n):
-        loss = step()
-    _sync(loss)
-    eager_ms = (time.perf_counter() - t0) / n * 1000
+
+    def time_eager():
+        for _ in range(3):
+            _sync(step())  # warm executable caches
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step()
+        _sync(loss)
+        return (time.perf_counter() - t0) / n * 1000
+
+    # eager with lazy micro-tracing (the default: core/lazy.py defers
+    # ops and flushes each step as one cached executable)
+    paddle.set_flags({"FLAGS_lazy_eager": True})
+    eager_lazy_ms = time_eager()
+    # eager immediate (per-op dispatch — the r2 baseline mode)
+    paddle.set_flags({"FLAGS_lazy_eager": False})
+    eager_imm_ms = time_eager()
+    paddle.set_flags({"FLAGS_lazy_eager": True})
 
     compiled = paddle.jit.to_static(step)
     for _ in range(3):
@@ -66,9 +76,10 @@ def bench_lenet():
 
     print(json.dumps({
         "config": 1, "model": "LeNet/MNIST", "batch": batch,
-        "eager_step_ms": round(eager_ms, 3),
+        "eager_step_ms": round(eager_lazy_ms, 3),
+        "eager_immediate_step_ms": round(eager_imm_ms, 3),
         "to_static_step_ms": round(comp_ms, 3),
-        "eager_over_compiled": round(eager_ms / comp_ms, 1),
+        "eager_over_compiled": round(eager_lazy_ms / comp_ms, 1),
         "samples_per_sec_compiled": round(batch / comp_ms * 1000, 1),
     }), flush=True)
 
