@@ -91,11 +91,17 @@ class PSClient:
 
     # -- sparse (rows sharded id % n_servers) ------------------------------
     def create_sparse_table(self, table_id, dim, optimizer="sgd", lr=0.01,
-                            seed=0):
+                            seed=0, ssd=False, cache_rows=4096,
+                            path=None):
+        """ssd=True creates a disk-backed table on each server (reference
+        ssd_sparse_table.h): at most cache_rows rows stay in RAM, the
+        rest spill to a record file under `path` (server tempdir when
+        None)."""
         self._sparse_dims[table_id] = int(dim)
         self._all({"cmd": "create_sparse", "table_id": table_id,
                    "dim": dim, "optimizer": optimizer, "lr": lr,
-                   "seed": seed})
+                   "seed": seed, "ssd": bool(ssd),
+                   "cache_rows": int(cache_rows), "path": path})
 
     def pull_sparse(self, table_id, ids):
         ids = np.asarray(ids).reshape(-1)
@@ -127,6 +133,85 @@ class PSClient:
                 reqs[s] = {"cmd": "push_sparse", "table_id": table_id,
                            "ids": ids[mask], "grads": grads[mask]}
         self._call_parallel(reqs)
+
+    # -- graph service (GNN; reference graph_brpc_client.h) ----------------
+    def create_graph_table(self, table_id, feat_dim=0, seed=0):
+        self._graph_feat_dims = getattr(self, "_graph_feat_dims", {})
+        self._graph_feat_dims[table_id] = int(feat_dim)
+        self._all({"cmd": "create_graph", "table_id": table_id,
+                   "feat_dim": feat_dim, "seed": seed})
+
+    def graph_add_edges(self, table_id, src, dst, weights=None):
+        src = np.asarray(src).reshape(-1)
+        dst = np.asarray(dst).reshape(-1)
+        w = np.asarray(weights, np.float32).reshape(-1) \
+            if weights is not None else None
+        reqs = {}
+        for s in range(self.n_servers):
+            mask = (src % self.n_servers) == s
+            if mask.any():
+                reqs[s] = {"cmd": "graph_add_edges",
+                           "table_id": table_id, "src": src[mask],
+                           "dst": dst[mask],
+                           "weights": None if w is None else w[mask]}
+        self._call_parallel(reqs)
+
+    def graph_set_node_feat(self, table_id, ids, feats):
+        ids = np.asarray(ids).reshape(-1)
+        feats = np.asarray(feats, np.float32).reshape(len(ids), -1)
+        reqs = {}
+        for s in range(self.n_servers):
+            mask = (ids % self.n_servers) == s
+            if mask.any():
+                reqs[s] = {"cmd": "graph_set_feat", "table_id": table_id,
+                           "ids": ids[mask], "feats": feats[mask]}
+        self._call_parallel(reqs)
+
+    def graph_get_node_feat(self, table_id, ids):
+        ids = np.asarray(ids).reshape(-1)
+        dim = getattr(self, "_graph_feat_dims", {}).get(table_id, 0)
+        if len(ids) == 0:
+            return np.zeros((0, dim), np.float32)
+        reqs, masks = {}, {}
+        for s in range(self.n_servers):
+            mask = (ids % self.n_servers) == s
+            if mask.any():
+                reqs[s] = {"cmd": "graph_get_feat", "table_id": table_id,
+                           "ids": ids[mask]}
+                masks[s] = mask
+        resps = self._call_parallel(reqs)
+        out = np.zeros((len(ids),), dtype=object)
+        for s, resp in resps.items():
+            out[np.nonzero(masks[s])[0]] = list(resp["feats"])
+        return np.stack(list(out), axis=0).astype(np.float32)
+
+    def graph_sample_neighbors(self, table_id, ids, count):
+        """[len(ids), count] sampled neighbor ids; -1 pads isolated
+        nodes. Rows are sharded to each src node's home server."""
+        ids = np.asarray(ids).reshape(-1)
+        if len(ids) == 0:
+            return np.zeros((0, count), np.int64)
+        reqs, masks = {}, {}
+        for s in range(self.n_servers):
+            mask = (ids % self.n_servers) == s
+            if mask.any():
+                reqs[s] = {"cmd": "graph_sample", "table_id": table_id,
+                           "ids": ids[mask], "count": count}
+                masks[s] = mask
+        resps = self._call_parallel(reqs)
+        out = np.full((len(ids), count), -1, np.int64)
+        for s, resp in resps.items():
+            out[np.nonzero(masks[s])[0]] = resp["neighbors"]
+        return out
+
+    def graph_random_nodes(self, table_id, count):
+        resps = self._call_parallel(
+            {s: {"cmd": "graph_random_nodes", "table_id": table_id,
+                 "count": count} for s in range(self.n_servers)})
+        pool = np.concatenate([r["nodes"] for r in resps.values()])
+        # shuffle before truncating: a plain [:count] would sample only
+        # from the first server's shard (even ids), biasing random walks
+        return np.random.default_rng().permutation(pool)[:count]
 
     # -- global shuffle exchange ------------------------------------------
     def shuffle_put(self, dest, blobs):

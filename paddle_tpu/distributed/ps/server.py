@@ -135,6 +135,208 @@ class SparseTable:
             self._rs.set_state(s["rs"])
 
 
+class SSDSparseTable(SparseTable):
+    """Disk-backed sparse table (reference:
+    distributed/table/ssd_sparse_table.h — embedding tables larger than
+    RAM: a bounded in-memory hot set with LRU eviction, cold rows in a
+    fixed-record random-access file; rocksdb there, a flat record file
+    keyed by an in-memory slot index here).
+
+    Record layout: dim float32 row values + 1 float32 adagrad
+    accumulator. Rows enter the hot set on first touch (disk read or
+    fresh init) and spill oldest-first when the hot set exceeds
+    cache_rows."""
+
+    def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01,
+                 seed=0, cache_rows=4096, path=None):
+        super().__init__(dim, optimizer, lr, init_std, seed)
+        import collections
+        import tempfile
+        self.rows = collections.OrderedDict()  # hot set, LRU order
+        self.cache_rows = int(cache_rows)
+        self._dir = path or tempfile.mkdtemp(prefix="ps_ssd_table_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._data_path = os.path.join(self._dir, "rows.bin")
+        # r+b/w+b, NOT a+b: append mode would send every _spill write to
+        # the file end regardless of seek(), silently dropping updates
+        self._file = open(self._data_path,
+                          "r+b" if os.path.exists(self._data_path)
+                          else "w+b")
+        self._slots = {}              # rid -> record slot in the file
+        self._rec = (self.dim + 1) * 4
+
+    def _row(self, rid):
+        r = self.rows.get(rid)
+        if r is not None:
+            self.rows.move_to_end(rid)
+            return r
+        slot = self._slots.get(rid)
+        if slot is not None:
+            self._file.seek(slot * self._rec)
+            buf = np.frombuffer(self._file.read(self._rec), np.float32)
+            r = buf[:self.dim].copy()
+            acc = float(buf[self.dim])
+            if acc:
+                self._acc[rid] = acc
+        else:
+            r = (self._rs.randn(self.dim) * self.init_std).astype(
+                np.float32)
+        self.rows[rid] = r
+        self._evict()
+        return r
+
+    def _spill(self, rid, row):
+        slot = self._slots.setdefault(rid, len(self._slots))
+        rec = np.empty(self.dim + 1, np.float32)
+        rec[:self.dim] = row
+        rec[self.dim] = self._acc.pop(rid, 0.0)
+        self._file.seek(slot * self._rec)
+        self._file.write(rec.tobytes())
+
+    def _evict(self):
+        while len(self.rows) > self.cache_rows:
+            rid, row = self.rows.popitem(last=False)  # oldest-touched
+            self._spill(rid, row)
+
+    def flush(self):
+        """Spill every hot row to disk (rows stay hot); called before
+        state snapshots so the file is complete."""
+        with self.lock:
+            for rid in list(self.rows):
+                acc = self._acc.get(rid)  # _spill pops; keep hot copy
+                self._spill(rid, self.rows[rid])
+                if acc is not None:
+                    self._acc[rid] = acc
+            self._file.flush()
+
+    @property
+    def hot_rows(self):
+        return len(self.rows)
+
+    @property
+    def total_rows(self):
+        return len(set(self._slots) | set(self.rows))
+
+    def state(self):
+        # point-in-time snapshot: the spill file's CONTENT is copied
+        # into the state (referencing the live file would let later
+        # evictions mutate the checkpoint, and the path may not exist
+        # on a restore host)
+        self.flush()
+        with self.lock:
+            with open(self._data_path, "rb") as f:
+                blob = f.read()
+            return {"dim": self.dim, "optimizer": self.optimizer,
+                    "lr": self.lr, "init_std": self.init_std,
+                    "rs": self._rs.get_state(),
+                    "cache_rows": self.cache_rows,
+                    "slots": dict(self._slots),
+                    "data_blob": blob,
+                    "acc": dict(self._acc),
+                    "hot_ids": list(self.rows)}
+
+    def load_state(self, s):
+        import collections
+        import tempfile
+        self.dim = s["dim"]
+        self.optimizer = s["optimizer"]
+        self.lr = s["lr"]
+        self.init_std = s["init_std"]
+        self._rs.set_state(s["rs"])
+        self.cache_rows = s["cache_rows"]
+        self._dir = tempfile.mkdtemp(prefix="ps_ssd_table_")
+        self._data_path = os.path.join(self._dir, "rows.bin")
+        with open(self._data_path, "wb") as f:
+            f.write(s["data_blob"])
+        self._file = open(self._data_path, "r+b")
+        self._slots = dict(s["slots"])
+        self._acc = dict(s["acc"])
+        self._rec = (self.dim + 1) * 4
+        self.rows = collections.OrderedDict()
+        for rid in s["hot_ids"]:      # rewarm the previously-hot set
+            self._row(rid)
+
+
+class GraphTable:
+    """Graph service table for GNN training (reference:
+    distributed/table/common_graph_table.h + the graph PS service
+    graph_brpc_server.h — node/edge storage with weighted random
+    neighbor sampling and node features; reduced: in-memory adjacency,
+    same id%n_servers sharding as sparse tables)."""
+
+    def __init__(self, feat_dim=0, seed=0):
+        self.lock = threading.Lock()
+        self.feat_dim = int(feat_dim)
+        self._rs = np.random.RandomState(seed)
+        self.adj = {}     # src -> (list of dst, list of weight)
+        self.feats = {}   # node -> np.float32[feat_dim]
+
+    def add_edges(self, src, dst, weights=None):
+        src = np.asarray(src).reshape(-1)
+        dst = np.asarray(dst).reshape(-1)
+        w = (np.asarray(weights, np.float32).reshape(-1)
+             if weights is not None else np.ones(len(src), np.float32))
+        with self.lock:
+            for s, d, wt in zip(src, dst, w):
+                nbrs = self.adj.setdefault(int(s), ([], []))
+                nbrs[0].append(int(d))
+                nbrs[1].append(float(wt))
+
+    def set_node_feat(self, ids, feats):
+        ids = np.asarray(ids).reshape(-1)
+        feats = np.asarray(feats, np.float32).reshape(len(ids),
+                                                      self.feat_dim)
+        with self.lock:
+            for i, f in zip(ids, feats):
+                self.feats[int(i)] = f.copy()
+
+    def get_node_feat(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        with self.lock:
+            return np.stack(
+                [self.feats.get(int(i),
+                                np.zeros(self.feat_dim, np.float32))
+                 for i in ids], axis=0) if len(ids) else \
+                np.zeros((0, self.feat_dim), np.float32)
+
+    def sample_neighbors(self, ids, count):
+        """Weighted-with-replacement neighbor sampling; nodes without
+        edges get -1 padding (reference graph sampling semantics)."""
+        ids = np.asarray(ids).reshape(-1)
+        out = np.full((len(ids), count), -1, np.int64)
+        with self.lock:
+            for row, i in enumerate(ids):
+                nbrs = self.adj.get(int(i))
+                if not nbrs or not nbrs[0]:
+                    continue
+                d = np.asarray(nbrs[0], np.int64)
+                w = np.asarray(nbrs[1], np.float64)
+                p = w / w.sum()
+                out[row] = self._rs.choice(d, size=count, replace=True,
+                                           p=p)
+        return out
+
+    def random_nodes(self, count):
+        with self.lock:
+            pool = np.asarray(sorted(self.adj), np.int64)
+        if len(pool) == 0:
+            return np.zeros((0,), np.int64)
+        return self._rs.choice(pool, size=min(count, len(pool)),
+                               replace=False)
+
+    def state(self):
+        with self.lock:
+            return {"feat_dim": self.feat_dim, "adj": dict(self.adj),
+                    "feats": dict(self.feats),
+                    "rs": self._rs.get_state()}
+
+    def load_state(self, s):
+        self.feat_dim = s["feat_dim"]
+        self.adj = s["adj"]
+        self.feats = s["feats"]
+        self._rs.set_state(s["rs"])
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server = self.server.ps  # type: PSServer
@@ -204,11 +406,52 @@ class PSServer:
             return {"ok": True, "created": False}
         if cmd == "create_sparse":
             if req["table_id"] not in self.tables:
-                self.tables[req["table_id"]] = SparseTable(
-                    req["dim"], optimizer=req.get("optimizer", "sgd"),
-                    lr=req.get("lr", 0.01), seed=req.get("seed", 0))
+                if req.get("ssd"):
+                    # an explicit path is broadcast to every server:
+                    # give each shard its own subdir or they would
+                    # overwrite each other's record slots
+                    path = req.get("path")
+                    if path is not None:
+                        path = os.path.join(path, f"shard_{self.port}")
+                    self.tables[req["table_id"]] = SSDSparseTable(
+                        req["dim"], optimizer=req.get("optimizer", "sgd"),
+                        lr=req.get("lr", 0.01), seed=req.get("seed", 0),
+                        cache_rows=req.get("cache_rows", 4096),
+                        path=path)
+                else:
+                    self.tables[req["table_id"]] = SparseTable(
+                        req["dim"], optimizer=req.get("optimizer", "sgd"),
+                        lr=req.get("lr", 0.01), seed=req.get("seed", 0))
                 return {"ok": True, "created": True}
             return {"ok": True, "created": False}
+        if cmd == "create_graph":
+            if req["table_id"] not in self.tables:
+                self.tables[req["table_id"]] = GraphTable(
+                    feat_dim=req.get("feat_dim", 0),
+                    seed=req.get("seed", 0))
+                return {"ok": True, "created": True}
+            return {"ok": True, "created": False}
+        if cmd == "graph_add_edges":
+            self.tables[req["table_id"]].add_edges(
+                req["src"], req["dst"], req.get("weights"))
+            return {"ok": True}
+        if cmd == "graph_set_feat":
+            self.tables[req["table_id"]].set_node_feat(req["ids"],
+                                                       req["feats"])
+            return {"ok": True}
+        if cmd == "graph_get_feat":
+            return {"ok": True,
+                    "feats": self.tables[req["table_id"]].get_node_feat(
+                        req["ids"])}
+        if cmd == "graph_sample":
+            return {"ok": True,
+                    "neighbors": self.tables[
+                        req["table_id"]].sample_neighbors(
+                        req["ids"], req["count"])}
+        if cmd == "graph_random_nodes":
+            return {"ok": True,
+                    "nodes": self.tables[req["table_id"]].random_nodes(
+                        req["count"])}
         if cmd == "pull_dense":
             return {"ok": True, "value": self.tables[req["table_id"]].pull()}
         if cmd == "push_dense":
@@ -235,11 +478,14 @@ class PSServer:
             with open(req["path"], "rb") as f:
                 data = pickle.load(f)
             for tid, s in data["state"].items():
-                cls = DenseTable if data["kinds"][tid] == "DenseTable" \
-                    else SparseTable
+                cls = {"DenseTable": DenseTable,
+                       "SparseTable": SparseTable,
+                       "SSDSparseTable": SSDSparseTable,
+                       "GraphTable": GraphTable}[
+                    data["kinds"][tid]]
                 t = cls.__new__(cls)
                 t.lock = threading.Lock()
-                if cls is SparseTable:
+                if cls is not DenseTable:
                     t._rs = np.random.RandomState(0)
                 t.load_state(s)
                 self.tables[tid] = t

@@ -11,12 +11,27 @@ from ..reader import (  # noqa: F401
 
 def save_persistables(executor=None, dirname=None, main_program=None,
                       filename=None):
-    """The reference walks the program's persistable vars; here model/
-    optimizer state_dicts are the persistables — use paddle.save on
-    state_dict() (this shim exists for source compat)."""
-    raise NotImplementedError(
-        "save_persistables requires a ProgramDesc; in the TPU build save "
-        "state_dicts: paddle.save(model.state_dict(), path)")
+    """Reference: fluid/io.py save_persistables — walk the program's
+    persistable vars and save them. The static Program tracks its
+    persistables (static/program.py register_persist), so this forwards
+    to static.save on that program."""
+    import os
+    from .. import static
+    prog = main_program if main_program is not None \
+        else static.default_main_program()
+    path = os.path.join(dirname or ".", filename or "persistables")
+    return static.save(prog, path)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """Reference: fluid/io.py load_persistables counterpart."""
+    import os
+    from .. import static
+    prog = main_program if main_program is not None \
+        else static.default_main_program()
+    path = os.path.join(dirname or ".", filename or "persistables")
+    return static.load(prog, path, executor)
 
 
 def save_inference_model(dirname, feeded_var_names=None, target_vars=None,

@@ -132,6 +132,61 @@ class ModelCheckpoint(Callback):
             self.model.save(f"{self.save_dir}/final")
 
 
+class VisualDL(Callback):
+    """Training-visualization writer (reference: hapi/callbacks.py
+    VisualDL — scalars via visualdl.LogWriter). Here the scalars go to a
+    TensorBoard events file (utils/tbwriter.py SummaryWriter) so any
+    stock TensorBoard can render loss/metric curves; tags mirror the
+    reference's `train/{loss,metric}` and `eval/...` naming."""
+
+    def __init__(self, log_dir, log_freq=1):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = int(log_freq)
+        self.writer = None
+        self._global_step = 0
+
+    def _w(self):
+        if self.writer is None:
+            from ..utils.tbwriter import SummaryWriter
+            self.writer = SummaryWriter(self.log_dir)
+        return self.writer
+
+    def _write_logs(self, prefix, logs, step):
+        for k, v in (logs or {}).items():
+            if k == "batch_size":
+                continue
+            if isinstance(v, numbers.Number):
+                self._w().add_scalar(f"{prefix}/{k}", v, step)
+            elif isinstance(v, (list, tuple, np.ndarray)):
+                arr = np.asarray(v, dtype=np.float64).reshape(-1)
+                if arr.size:
+                    self._w().add_scalar(f"{prefix}/{k}",
+                                         float(arr.mean()), step)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._global_step % self.log_freq == 0:
+            self._write_logs("train", logs, self._global_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write_logs("train_epoch", logs, epoch)
+        self._w().flush()
+
+    def on_eval_end(self, logs=None):
+        self._write_logs("eval", logs, self._global_step)
+        self._w().flush()
+
+    def on_end(self, mode, logs=None):
+        if mode == "eval":
+            self.on_eval_end(logs)
+        if self.writer is not None:
+            self.writer.flush()
+            if mode == "train":
+                self.writer.close()
+                self.writer = None  # a later fit() reopens cleanly
+
+
 class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         super().__init__()

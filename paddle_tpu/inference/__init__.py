@@ -76,6 +76,11 @@ class Predictor:
         self._inputs = {}
         self._outputs = {}
         self._output_names = []
+        # memory_optim (reference: AnalysisConfig::EnableMemoryOptim —
+        # reuse/free buffers between runs): drop staged host inputs and
+        # stale outputs after each run instead of keeping them resident
+        self._memory_optim = bool(getattr(config,
+                                          "_enable_memory_optim", True))
 
     def get_input_names(self):
         return list(self._input_names)
@@ -88,11 +93,15 @@ class Predictor:
             arrs = [np.asarray(a) for a in inputs]
         else:
             arrs = [self._inputs[n] for n in self._input_names]
+        if self._memory_optim:
+            self._outputs = {}          # free previous run's outputs
         out = self._layer(*[Tensor(a) for a in arrs])
         outs = out if isinstance(out, tuple) else (out,)
         self._output_names = [f"out{i}" for i in range(len(outs))]
         self._outputs = {n: o.numpy() for n, o in
                          zip(self._output_names, outs)}
+        # staged inputs stay resident (reference AnalysisPredictor
+        # semantics: run() is repeatable without re-copying inputs)
         if inputs is not None:
             return [self._outputs[n] for n in self._output_names]
         return True

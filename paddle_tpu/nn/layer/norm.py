@@ -155,6 +155,39 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *args, **kwargs):
+    """Reference: paddle.nn.SpectralNorm (spectral_norm_op.cc; python
+    surface fluid/layers/nn.py:3650): power-iteration estimate of the
+    weight's largest singular value sigma; forward(weight) returns
+    weight / sigma. weight_u/weight_v are persistent buffers refreshed
+    each forward, as in the reference op (u/v treated as constants for
+    the gradient)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._eps = float(eps)
+        self._shape = tuple(int(s) for s in weight_shape)
+        h = self._shape[self._dim]
+        w = 1
+        for i, s in enumerate(self._shape):
+            if i != self._dim:
+                w *= s
+        rs_u = init_mod.Normal(0.0, 1.0)
+        self.register_buffer(
+            "weight_u", Tensor(jnp.asarray(rs_u((h,), jnp.float32)),
+                               persistable=True))
+        self.register_buffer(
+            "weight_v", Tensor(jnp.asarray(rs_u((w,), jnp.float32)),
+                               persistable=True))
+
+    def forward(self, weight):
+        out, u_n, v_n = nn_ops.spectral_norm(
+            weight, self.weight_u, self.weight_v, dim=self._dim,
+            power_iters=self._power_iters, eps=self._eps)
+        # refresh the power-iteration state (reference: the op writes U/V
+        # back in place); buffers are stop_gradient so no graph grows
+        self.weight_u.value = u_n.value
+        self.weight_v.value = v_n.value
+        return out

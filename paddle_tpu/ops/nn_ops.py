@@ -349,15 +349,36 @@ def _max_pool2d(x, *, ksize, strides, paddings, ceil_mode):
     return out
 
 
+@register_op("pool2d_max_with_index")
+def _max_pool2d_with_index(x, *, ksize, strides, paddings):
+    """Reference: max_pool2d_with_index op (pool_with_index_op.cc) — the
+    mask is each max's flat position in the INPUT feature map (h*w),
+    first-max-wins on ties."""
+    wins = jnp.stack(
+        list(_pool_windows(x, ksize, strides, paddings,
+                           _neg_min(x.dtype))), axis=0)
+    out = jnp.max(wins, axis=0)
+    amax = jnp.argmax(wins, axis=0)        # row-major window slot
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = paddings
+    di, dj = amax // kw, amax % kw
+    oh, ow = out.shape[2], out.shape[3]
+    r = jnp.arange(oh)[:, None] * sh - ph + di
+    c = jnp.arange(ow)[None, :] * sw - pw + dj
+    mask = (r * x.shape[3] + c).astype(jnp.int32)
+    return out, mask
+
+
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     ks = _pair(kernel_size)
     st = _pair(stride) if stride is not None else ks
-    out = _max_pool2d(x, ksize=ks, strides=st, paddings=_pair(padding),
-                      ceil_mode=bool(ceil_mode))
     if return_mask:
-        raise NotImplementedError("return_mask not supported yet")
-    return out
+        return _max_pool2d_with_index(x, ksize=ks, strides=st,
+                                      paddings=_pair(padding))
+    return _max_pool2d(x, ksize=ks, strides=st, paddings=_pair(padding),
+                       ceil_mode=bool(ceil_mode))
 
 
 @register_op("pool2d_avg")
@@ -418,7 +439,26 @@ def _adaptive_max_pool2d(x, *, output_size):
     return x4.max(axis=(3, 5))
 
 
+@register_op("adaptive_max_pool2d_with_index")
+def _adaptive_max_pool2d_with_index(x, *, output_size):
+    n, c, h, w = x.shape
+    oh, ow = output_size
+    assert h % oh == 0 and w % ow == 0, "adaptive_max_pool needs divisible sizes"
+    bh, bw = h // oh, w // ow
+    x4 = x.reshape(n, c, oh, bh, ow, bw)
+    blocks = x4.transpose(0, 1, 2, 4, 3, 5).reshape(n, c, oh, ow, bh * bw)
+    amax = jnp.argmax(blocks, axis=-1)
+    di, dj = amax // bw, amax % bw
+    r = jnp.arange(oh)[:, None] * bh + di
+    col = jnp.arange(ow)[None, :] * bw + dj
+    mask = (r * w + col).astype(jnp.int32)
+    return blocks.max(axis=-1), mask
+
+
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool2d_with_index(
+            x, output_size=_pair(output_size))
     return _adaptive_max_pool2d(x, output_size=_pair(output_size))
 
 
@@ -427,7 +467,13 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     from . import manipulation
     x4 = manipulation.unsqueeze(x, axis=2)
     out = max_pool2d(x4, (1, kernel_size), (1, stride or kernel_size),
-                     (0, padding if isinstance(padding, int) else padding[0]))
+                     (0, padding if isinstance(padding, int)
+                      else padding[0]),
+                     return_mask=return_mask)
+    if return_mask:
+        # the [1, L] feature map's flat index IS the index in L
+        return (manipulation.squeeze(out[0], axis=2),
+                manipulation.squeeze(out[1], axis=2))
     return manipulation.squeeze(out, axis=2)
 
 
@@ -442,6 +488,33 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 
 
 # ---- normalization ---------------------------------------------------------
+
+
+@register_op("spectral_norm_op")
+def _spectral_norm(weight, u, v, *, dim, power_iters, eps):
+    """Reference: spectral_norm_op.cc — power iteration for the largest
+    singular value; u/v are carried state, constant for the gradient
+    (lax.stop_gradient), exactly the reference kernel's treatment."""
+    perm = (dim,) + tuple(i for i in range(weight.ndim) if i != dim)
+    mat = jnp.transpose(weight, perm).reshape(weight.shape[dim], -1)
+
+    def _l2(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    uu, vv = u, v
+    for _ in range(max(1, power_iters)):
+        vv = _l2(mat.T @ uu)
+        uu = _l2(mat @ vv)
+    uu = jax.lax.stop_gradient(uu)
+    vv = jax.lax.stop_gradient(vv)
+    sigma = uu @ (mat @ vv)
+    return weight / sigma, uu, vv
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12,
+                  name=None):
+    return _spectral_norm(weight, u, v, dim=int(dim),
+                          power_iters=int(power_iters), eps=float(eps))
 
 @register_op("layer_norm")
 def _layer_norm(x, scale, bias, *, epsilon, begin_norm_axis):
@@ -1063,33 +1136,106 @@ def _triple(v):
     return (int(v),) * 3
 
 
+def _to_ncdhw(x, data_format):
+    from . import manipulation
+    if data_format == "NDHWC":
+        return manipulation.transpose(x, (0, 4, 1, 2, 3))
+    if data_format != "NCDHW":
+        raise ValueError(f"pool3d: unknown data_format {data_format!r}")
+    return x
+
+
+def _from_ncdhw(x, data_format):
+    from . import manipulation
+    if data_format == "NDHWC":
+        return manipulation.transpose(x, (0, 2, 3, 4, 1))
+    return x
+
+
+def _neg_min(dtype):
+    """Most-negative value for max-pool padding, dtype-aware (shared by
+    the 2d and 3d with-index kernels so they cannot drift)."""
+    return (-jnp.inf if jnp.issubdtype(dtype, jnp.floating)
+            else jnp.iinfo(dtype).min)
+
+
+def _pool_windows3d(x, ksize, strides, paddings, pad_value):
+    """3d counterpart of _pool_windows: yield the kd*kh*kw strided
+    window slices (same slice-only building block)."""
+    kd, kh, kw = ksize
+    sd, sh, sw = strides
+    pd, ph, pw = paddings
+    if pd or ph or pw:
+        x = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+                    constant_values=pad_value)
+    od = (x.shape[2] - kd) // sd + 1
+    oh = (x.shape[3] - kh) // sh + 1
+    ow = (x.shape[4] - kw) // sw + 1
+    for i in range(kd):
+        for j in range(kh):
+            for k in range(kw):
+                yield x[:, :, i:i + (od - 1) * sd + 1:sd,
+                        j:j + (oh - 1) * sh + 1:sh,
+                        k:k + (ow - 1) * sw + 1:sw]
+
+
+@register_op("pool3d_max_with_index")
+def _max_pool3d_with_index(x, *, ksize, strides, paddings):
+    """Reference: max_pool3d_with_index (pool_with_index_op) — mask is
+    the max's flat position in the input d*h*w volume."""
+    kd, kh, kw = ksize
+    sd, sh, sw = strides
+    pd, ph, pw = paddings
+    d0, h0, w0 = x.shape[2:]
+    od = (d0 + 2 * pd - kd) // sd + 1
+    oh = (h0 + 2 * ph - kh) // sh + 1
+    ow = (w0 + 2 * pw - kw) // sw + 1
+    wins = jnp.stack(
+        list(_pool_windows3d(x, ksize, strides, paddings,
+                             _neg_min(x.dtype))), axis=0)
+    out = jnp.max(wins, axis=0)
+    amax = jnp.argmax(wins, axis=0)
+    di = amax // (kh * kw)
+    dj = (amax // kw) % kh
+    dk = amax % kw
+    zd = jnp.arange(od)[:, None, None] * sd - pd + di
+    zh = jnp.arange(oh)[None, :, None] * sh - ph + dj
+    zw = jnp.arange(ow)[None, None, :] * sw - pw + dk
+    mask = ((zd * h0 + zh) * w0 + zw).astype(jnp.int32)
+    return out, mask
+
+
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    """Reference: pool3d_op (NCDHW)."""
-    if data_format != "NCDHW":
-        raise NotImplementedError(
-            "max_pool3d supports NCDHW only (transpose NDHWC inputs)")
+    """Reference: pool3d_op; NDHWC handled by transposing around the
+    NCDHW kernel (TPU-native layout choice: XLA re-lays-out anyway)."""
+    x = _to_ncdhw(x, data_format)
     ks = _triple(kernel_size)
     st = _triple(stride) if stride is not None else ks
-    pd = _triple(padding)
-    return _pool3d(x, ksize=ks, strides=st, paddings=pd, mode="max",
-                   ceil_mode=bool(ceil_mode), exclusive=True,
-                   divisor=None)
+    pad3 = _triple(padding)
+    if return_mask:
+        out, mask = _max_pool3d_with_index(x, ksize=ks, strides=st,
+                                           paddings=pad3)
+        return _from_ncdhw(out, data_format), _from_ncdhw(mask,
+                                                          data_format)
+    out = _pool3d(x, ksize=ks, strides=st, paddings=pad3, mode="max",
+                  ceil_mode=bool(ceil_mode), exclusive=True,
+                  divisor=None)
+    return _from_ncdhw(out, data_format)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
-    if data_format != "NCDHW":
-        raise NotImplementedError(
-            "avg_pool3d supports NCDHW only (transpose NDHWC inputs)")
+    x = _to_ncdhw(x, data_format)
     ks = _triple(kernel_size)
     st = _triple(stride) if stride is not None else ks
-    return _pool3d(x, ksize=ks, strides=st, paddings=_triple(padding),
-                   mode="avg", ceil_mode=bool(ceil_mode),
-                   exclusive=bool(exclusive),
-                   divisor=None if divisor_override is None
-                   else float(divisor_override))
+    out = _pool3d(x, ksize=ks, strides=st, paddings=_triple(padding),
+                  mode="avg", ceil_mode=bool(ceil_mode),
+                  exclusive=bool(exclusive),
+                  divisor=None if divisor_override is None
+                  else float(divisor_override))
+    return _from_ncdhw(out, data_format)
 
 
 @register_op("pool3d")
@@ -1170,7 +1316,31 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
                             mode="avg")
 
 
+@register_op("adaptive_max_pool3d_with_index")
+def _adaptive_max_pool3d_with_index(x, *, output_size):
+    n, c, d, h, w = x.shape
+    od, oh, ow = output_size
+    assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+        "adaptive 3d pooling needs divisible sizes"
+    bd, bh, bw = d // od, h // oh, w // ow
+    x6 = x.reshape(n, c, od, bd, oh, bh, ow, bw)
+    blocks = x6.transpose(0, 1, 2, 4, 6, 3, 5, 7).reshape(
+        n, c, od, oh, ow, bd * bh * bw)
+    amax = jnp.argmax(blocks, axis=-1)
+    di = amax // (bh * bw)
+    dj = (amax // bw) % bh
+    dk = amax % bw
+    zd = jnp.arange(od)[:, None, None] * bd + di
+    zh = jnp.arange(oh)[None, :, None] * bh + dj
+    zw = jnp.arange(ow)[None, None, :] * bw + dk
+    mask = ((zd * h + zh) * w + zw).astype(jnp.int32)
+    return blocks.max(axis=-1), mask
+
+
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        return _adaptive_max_pool3d_with_index(
+            x, output_size=_triple(output_size))
     return _adaptive_pool3d(x, output_size=_triple(output_size),
                             mode="max")
 
@@ -1185,7 +1355,11 @@ def adaptive_avg_pool1d(x, output_size, name=None):
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
     from . import manipulation
     x4 = manipulation.unsqueeze(x, axis=2)
-    out = adaptive_max_pool2d(x4, (1, int(output_size)))
+    out = adaptive_max_pool2d(x4, (1, int(output_size)),
+                              return_mask=return_mask)
+    if return_mask:
+        return (manipulation.squeeze(out[0], axis=2),
+                manipulation.squeeze(out[1], axis=2))
     return manipulation.squeeze(out, axis=2)
 
 

@@ -348,8 +348,10 @@ def test_pool3d_ceil_mode():
         == [1, 1, 3, 3, 3]
     assert F.max_pool3d(x, 3, stride=2, ceil_mode=False).shape \
         == [1, 1, 2, 2, 2]
-    with pytest.raises(NotImplementedError):
-        F.max_pool3d(x, 2, data_format="NDHWC")
+    # NDHWC supported since r3 (transposed around the NCDHW kernel)
+    x_c_last = T(np.random.RandomState(0).randn(1, 6, 6, 6, 2))
+    assert F.max_pool3d(x_c_last, 2, data_format="NDHWC").shape \
+        == [1, 3, 3, 3, 2]
 
 
 def test_grid_sample_border_padding():

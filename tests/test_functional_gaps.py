@@ -1,0 +1,112 @@
+"""Round-2 named functional gaps (VERDICT item 9): SpectralNorm,
+max_pool return_mask, NDHWC pool3d. Reference: spectral_norm_op.cc,
+pool_with_index_op.cc, pool_op.cc (NDHWC attr)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestMaxPoolReturnMask:
+    def test_mask_matches_numpy_argmax(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 8, 8).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                 return_mask=True)
+        o, m = out.numpy(), mask.numpy()
+        assert o.shape == (2, 3, 4, 4) and m.shape == (2, 3, 4, 4)
+        for n in range(2):
+            for c in range(3):
+                for i in range(4):
+                    for j in range(4):
+                        win = x[n, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                        assert o[n, c, i, j] == win.max()
+                        r, co = np.unravel_index(int(m[n, c, i, j]),
+                                                 (8, 8))
+                        assert x[n, c, r, co] == win.max()
+
+    def test_mask_with_padding(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32)
+                             .reshape(1, 1, 4, 4))
+        out, mask = F.max_pool2d(x, 3, 2, padding=1, return_mask=True)
+        # last window's max is input position (3,3) -> flat 15
+        assert int(mask.numpy()[0, 0, -1, -1]) == 15
+
+    def test_max_pool1d_and_3d_masks(self):
+        rs = np.random.RandomState(1)
+        x1 = rs.randn(2, 3, 8).astype(np.float32)
+        o1, m1 = F.max_pool1d(paddle.to_tensor(x1), 2, 2,
+                              return_mask=True)
+        for n in range(2):
+            for c in range(3):
+                for i in range(4):
+                    assert x1[n, c, int(m1.numpy()[n, c, i])] == \
+                        o1.numpy()[n, c, i]
+        x3 = rs.randn(1, 2, 4, 4, 4).astype(np.float32)
+        o3, m3 = F.max_pool3d(paddle.to_tensor(x3), 2, 2,
+                              return_mask=True)
+        flat = x3.reshape(1, 2, -1)
+        for c in range(2):
+            got = np.take(flat[0, c], m3.numpy()[0, c].reshape(-1))
+            np.testing.assert_allclose(got, o3.numpy()[0, c].reshape(-1))
+
+    def test_adaptive_masks(self):
+        rs = np.random.RandomState(2)
+        x = rs.randn(1, 2, 8, 8).astype(np.float32)
+        out, mask = F.adaptive_max_pool2d(paddle.to_tensor(x), 4,
+                                          return_mask=True)
+        flat = x.reshape(1, 2, -1)
+        for c in range(2):
+            got = np.take(flat[0, c], mask.numpy()[0, c].reshape(-1))
+            np.testing.assert_allclose(got, out.numpy()[0, c].reshape(-1))
+
+
+class TestNDHWCPool3d:
+    def test_matches_ncdhw_transposed(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(2, 4, 6, 6, 3).astype(np.float32)  # NDHWC
+        out = F.max_pool3d(paddle.to_tensor(x), 2, 2,
+                           data_format="NDHWC")
+        ref = F.max_pool3d(
+            paddle.to_tensor(x.transpose(0, 4, 1, 2, 3)), 2, 2)
+        np.testing.assert_allclose(out.numpy().transpose(0, 4, 1, 2, 3),
+                                   ref.numpy(), rtol=1e-6)
+        avg = F.avg_pool3d(paddle.to_tensor(x), 2, 2,
+                           data_format="NDHWC")
+        assert avg.numpy().shape == (2, 2, 3, 3, 3)
+
+
+class TestSpectralNorm:
+    def test_sigma_converges_to_largest_singular_value(self):
+        paddle.seed(0)
+        rs = np.random.RandomState(4)
+        w = rs.randn(6, 4).astype(np.float32)
+        sn = nn.SpectralNorm(w.shape, dim=0, power_iters=20)
+        out = sn(paddle.to_tensor(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(out.numpy(), w / sigma, rtol=1e-3)
+
+    def test_conv_weight_and_state_refresh(self):
+        paddle.seed(0)
+        rs = np.random.RandomState(5)
+        w = rs.randn(8, 4, 3, 3).astype(np.float32)
+        sn = nn.SpectralNorm(w.shape, dim=0, power_iters=2)
+        u0 = sn.weight_u.numpy().copy()
+        out = sn(paddle.to_tensor(w))
+        assert out.shape == list(w.shape)
+        assert not np.allclose(sn.weight_u.numpy(), u0)  # state advanced
+        # normalized weight has spectral norm ~<= 1 (power-iter estimate)
+        mat = out.numpy().reshape(8, -1)
+        assert np.linalg.svd(mat, compute_uv=False)[0] < 1.5
+
+    def test_gradient_flows_to_weight(self):
+        paddle.seed(0)
+        w = paddle.to_tensor(
+            np.random.RandomState(6).randn(4, 4).astype(np.float32))
+        w.stop_gradient = False
+        sn = nn.SpectralNorm((4, 4), power_iters=3)
+        sn(w).sum().backward()
+        assert w.grad is not None
+        assert np.isfinite(w.grad.numpy()).all()

