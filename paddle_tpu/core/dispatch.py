@@ -154,7 +154,12 @@ class Op:
                 # allow raw arrays / numpy / python scalars as dynamic inputs
                 slots.append(len(arrays))
                 tensor_args.append(None)
-                arr = a if isinstance(a, jax.Array) else jnp.asarray(a)
+                if isinstance(a, jax.Array):
+                    arr = a
+                elif type(a) in (int, float, bool):
+                    arr = lazy_mod.scalar_const(a)
+                else:
+                    arr = jnp.asarray(a)
                 arrays.append(arr)
 
         from ..amp.auto_cast import _cast_dtype_for
@@ -260,8 +265,17 @@ class Op:
         return bwd
 
 
+_FLOAT_DTYPE_CACHE = {}
+
+
 def _is_float(arr):
-    return jnp.issubdtype(arr.dtype, jnp.floating) or jnp.issubdtype(arr.dtype, jnp.complexfloating)
+    dt = arr.dtype
+    hit = _FLOAT_DTYPE_CACHE.get(dt)
+    if hit is None:
+        hit = bool(jnp.issubdtype(dt, jnp.floating)
+                   or jnp.issubdtype(dt, jnp.complexfloating))
+        _FLOAT_DTYPE_CACHE[dt] = hit
+    return hit
 
 
 def _check_finite(op_name, out_list):

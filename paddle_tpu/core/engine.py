@@ -20,6 +20,8 @@ import jax.numpy as jnp
 
 from . import lazy as lazy_mod
 
+_ones_cache = {}  # backward seed cotangents, keyed by (shape, dtype)
+
 
 class GradNode:
     __slots__ = ("op", "key", "closure", "arrays", "input_tensors",
@@ -147,7 +149,13 @@ def run_backward(loss, grad_tensor=None, retain_graph=False,
     root_node, root_idx = loss._grad_node
     if grad_tensor is None:
         shape, dt = root_node.out_avals[root_idx]
-        init_ct = jnp.ones(shape, dt)
+        ck = (tuple(shape), str(dt))
+        init_ct = _ones_cache.get(ck)
+        if init_ct is None:
+            init_ct = jnp.ones(shape, dt)
+            if len(_ones_cache) > 512:
+                _ones_cache.clear()
+            _ones_cache[ck] = init_ct
     else:
         init_ct = grad_tensor.value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
     if create_graph:

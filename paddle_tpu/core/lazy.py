@@ -49,6 +49,39 @@ _ever_enabled = [False]
 _replay_cache = {}
 _aval_cache = {}
 _vjp_fn_cache = {}
+_intern_ids = {}
+
+
+def _intern(key):
+    """Map a (hashable) structured key to a process-stable small int."""
+    i = _intern_ids.get(key)
+    if i is None:
+        i = len(_intern_ids)
+        _intern_ids[key] = i
+    return i
+
+
+_scalar_cache = {}
+
+
+def scalar_const(v):
+    """Value-keyed python-scalar -> jax array cache: each conversion is
+    a device op (a launch per call on TPU); step loops repeat the same
+    constants every iteration. Floats key on their sign bit too:
+    -0.0 == 0.0 under dict equality but they are different constants
+    (1/x, copysign)."""
+    if type(v) is float:
+        import math
+        ck = (float, v, math.copysign(1.0, v))
+    else:
+        ck = (type(v), v)
+    arr = _scalar_cache.get(ck)
+    if arr is None:
+        if len(_scalar_cache) > 4096:
+            _scalar_cache.clear()
+        arr = jnp.asarray(v)
+        _scalar_cache[ck] = arr
+    return arr
 
 
 class Fallback(Exception):
@@ -333,7 +366,10 @@ class LazyGraph:
         flat_avals, treedef = cached
         node_idx = len(self.nodes)
         node = _Node(fn, fn_key, tuple(refs), treedef, flat_avals)
-        node.cache_key = (fn_key, node.args)
+        # intern the (fn_key, wiring) pair to a small int: the flush key
+        # then hashes a tuple of ints instead of re-hashing every node's
+        # nested attr tuples on every step
+        node.cache_key = _intern((fn_key, node.args))
         self.nodes.append(node)
         outs = []
         for j, aval in enumerate(flat_avals):
@@ -361,8 +397,8 @@ class LazyGraph:
                     live.append((i, j))
                     live_arrays.append(la)
         key = (tuple(n.cache_key for n in self.nodes),
-               tuple((np.shape(c), _dtype_of(c),
-                      bool(getattr(c, "weak_type", False)))
+               tuple(_intern((np.shape(c), _dtype_of(c),
+                              bool(getattr(c, "weak_type", False))))
                      for c in self.consts),
                tuple(live))
         exe = _replay_cache.get(key)
@@ -452,8 +488,10 @@ def _binary(jnp_fn, name, a, b):
     """Lazy-aware elementwise binary (python scalars become consts)."""
     if enabled() and (isinstance(a, LazyArray) or isinstance(b, LazyArray)):
         try:
-            aa = jnp.asarray(a) if isinstance(a, (int, float, bool)) else a
-            bb = jnp.asarray(b) if isinstance(b, (int, float, bool)) else b
+            aa = scalar_const(a) if isinstance(a, (int, float, bool)) \
+                else a
+            bb = scalar_const(b) if isinstance(b, (int, float, bool)) \
+                else b
             return dispatch(jnp_fn, ("lazy_" + name,), [aa, bb])
         except Fallback:
             pass

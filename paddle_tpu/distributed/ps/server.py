@@ -198,16 +198,19 @@ class SSDSparseTable(SparseTable):
             rid, row = self.rows.popitem(last=False)  # oldest-touched
             self._spill(rid, row)
 
+    def _flush_locked(self):
+        for rid in list(self.rows):
+            acc = self._acc.get(rid)  # _spill pops; keep hot copy
+            self._spill(rid, self.rows[rid])
+            if acc is not None:
+                self._acc[rid] = acc
+        self._file.flush()
+
     def flush(self):
         """Spill every hot row to disk (rows stay hot); called before
         state snapshots so the file is complete."""
         with self.lock:
-            for rid in list(self.rows):
-                acc = self._acc.get(rid)  # _spill pops; keep hot copy
-                self._spill(rid, self.rows[rid])
-                if acc is not None:
-                    self._acc[rid] = acc
-            self._file.flush()
+            self._flush_locked()
 
     @property
     def hot_rows(self):
@@ -221,9 +224,10 @@ class SSDSparseTable(SparseTable):
         # point-in-time snapshot: the spill file's CONTENT is copied
         # into the state (referencing the live file would let later
         # evictions mutate the checkpoint, and the path may not exist
-        # on a restore host)
-        self.flush()
+        # on a restore host). One lock scope for flush + read: a push
+        # landing between them would make blob and acc/hot disagree.
         with self.lock:
+            self._flush_locked()
             with open(self._data_path, "rb") as f:
                 blob = f.read()
             return {"dim": self.dim, "optimizer": self.optimizer,

@@ -319,20 +319,26 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 # ---- pooling ---------------------------------------------------------------
 
-def _pool_windows(x, ksize, strides, paddings, pad_value):
+def _pool_windows(x, ksize, strides, paddings, pad_value, ceil_mode=False):
     """Yield the kh*kw strided window slices of x (differentiable pooling
     building block: slice + elementwise reduce only — fuses well on TPU and
     avoids reduce_window, whose vjp does not lower under jit on this
-    backend)."""
+    backend). ceil_mode right-pads so the partial windows exist."""
     kh, kw = ksize
     sh, sw = strides
     ph, pw = paddings
-    if ph or pw:
-        x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
-                    constant_values=pad_value)
-    h, w = x.shape[2], x.shape[3]
-    oh = (h - kh) // sh + 1
-    ow = (w - kw) // sw + 1
+    h0, w0 = x.shape[2], x.shape[3]
+    if ceil_mode:
+        oh = -(-(h0 + 2 * ph - kh) // sh) + 1
+        ow = -(-(w0 + 2 * pw - kw) // sw) + 1
+    else:
+        oh = (h0 + 2 * ph - kh) // sh + 1
+        ow = (w0 + 2 * pw - kw) // sw + 1
+    need_h = max(0, (oh - 1) * sh + kh - (h0 + 2 * ph))
+    need_w = max(0, (ow - 1) * sw + kw - (w0 + 2 * pw))
+    if ph or pw or need_h or need_w:
+        x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph + need_h),
+                        (pw, pw + need_w)), constant_values=pad_value)
     for i in range(kh):
         for j in range(kw):
             yield x[:, :, i:i + (oh - 1) * sh + 1:sh,
@@ -341,22 +347,22 @@ def _pool_windows(x, ksize, strides, paddings, pad_value):
 
 @register_op("pool2d_max")
 def _max_pool2d(x, *, ksize, strides, paddings, ceil_mode):
-    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-           else jnp.iinfo(x.dtype).min)
     out = None
-    for win in _pool_windows(x, ksize, strides, paddings, neg):
+    for win in _pool_windows(x, ksize, strides, paddings,
+                             _neg_min(x.dtype), ceil_mode):
         out = win if out is None else jnp.maximum(out, win)
     return out
 
 
 @register_op("pool2d_max_with_index")
-def _max_pool2d_with_index(x, *, ksize, strides, paddings):
+def _max_pool2d_with_index(x, *, ksize, strides, paddings,
+                           ceil_mode=False):
     """Reference: max_pool2d_with_index op (pool_with_index_op.cc) — the
     mask is each max's flat position in the INPUT feature map (h*w),
     first-max-wins on ties."""
     wins = jnp.stack(
         list(_pool_windows(x, ksize, strides, paddings,
-                           _neg_min(x.dtype))), axis=0)
+                           _neg_min(x.dtype), ceil_mode)), axis=0)
     out = jnp.max(wins, axis=0)
     amax = jnp.argmax(wins, axis=0)        # row-major window slot
     kh, kw = ksize
@@ -376,7 +382,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     st = _pair(stride) if stride is not None else ks
     if return_mask:
         return _max_pool2d_with_index(x, ksize=ks, strides=st,
-                                      paddings=_pair(padding))
+                                      paddings=_pair(padding),
+                                      ceil_mode=bool(ceil_mode))
     return _max_pool2d(x, ksize=ks, strides=st, paddings=_pair(padding),
                        ceil_mode=bool(ceil_mode))
 
@@ -1159,18 +1166,30 @@ def _neg_min(dtype):
             else jnp.iinfo(dtype).min)
 
 
-def _pool_windows3d(x, ksize, strides, paddings, pad_value):
+def _pool_windows3d(x, ksize, strides, paddings, pad_value,
+                    ceil_mode=False):
     """3d counterpart of _pool_windows: yield the kd*kh*kw strided
     window slices (same slice-only building block)."""
     kd, kh, kw = ksize
     sd, sh, sw = strides
     pd, ph, pw = paddings
-    if pd or ph or pw:
-        x = jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw)),
+    d0, h0, w0 = x.shape[2:]
+
+    def out_len(sz, k, s, p):
+        if ceil_mode:
+            return -(-(sz + 2 * p - k) // s) + 1
+        return (sz + 2 * p - k) // s + 1
+
+    od = out_len(d0, kd, sd, pd)
+    oh = out_len(h0, kh, sh, ph)
+    ow = out_len(w0, kw, sw, pw)
+    need = [max(0, (o - 1) * s + k - (sz + 2 * p))
+            for o, s, k, sz, p in zip((od, oh, ow), strides, ksize,
+                                      (d0, h0, w0), paddings)]
+    if pd or ph or pw or any(need):
+        x = jnp.pad(x, ((0, 0), (0, 0), (pd, pd + need[0]),
+                        (ph, ph + need[1]), (pw, pw + need[2])),
                     constant_values=pad_value)
-    od = (x.shape[2] - kd) // sd + 1
-    oh = (x.shape[3] - kh) // sh + 1
-    ow = (x.shape[4] - kw) // sw + 1
     for i in range(kd):
         for j in range(kh):
             for k in range(kw):
@@ -1180,20 +1199,19 @@ def _pool_windows3d(x, ksize, strides, paddings, pad_value):
 
 
 @register_op("pool3d_max_with_index")
-def _max_pool3d_with_index(x, *, ksize, strides, paddings):
+def _max_pool3d_with_index(x, *, ksize, strides, paddings,
+                           ceil_mode=False):
     """Reference: max_pool3d_with_index (pool_with_index_op) — mask is
     the max's flat position in the input d*h*w volume."""
     kd, kh, kw = ksize
     sd, sh, sw = strides
     pd, ph, pw = paddings
     d0, h0, w0 = x.shape[2:]
-    od = (d0 + 2 * pd - kd) // sd + 1
-    oh = (h0 + 2 * ph - kh) // sh + 1
-    ow = (w0 + 2 * pw - kw) // sw + 1
     wins = jnp.stack(
         list(_pool_windows3d(x, ksize, strides, paddings,
-                             _neg_min(x.dtype))), axis=0)
+                             _neg_min(x.dtype), ceil_mode)), axis=0)
     out = jnp.max(wins, axis=0)
+    od, oh, ow = out.shape[2:]
     amax = jnp.argmax(wins, axis=0)
     di = amax // (kh * kw)
     dj = (amax // kw) % kh
@@ -1215,7 +1233,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     pad3 = _triple(padding)
     if return_mask:
         out, mask = _max_pool3d_with_index(x, ksize=ks, strides=st,
-                                           paddings=pad3)
+                                           paddings=pad3,
+                                           ceil_mode=bool(ceil_mode))
         return _from_ncdhw(out, data_format), _from_ncdhw(mask,
                                                           data_format)
     out = _pool3d(x, ksize=ks, strides=st, paddings=pad3, mode="max",

@@ -154,6 +154,12 @@ class Optimizer:
                               else tuple(param.aval_shape()),
                               dtype or param._value.dtype
                               if param._value is not None else jnp.float32)
+            elif callable(init):
+                # callables defer the init array's construction to the
+                # one call that actually creates the accumulator —
+                # `init=jnp.ones(...)` at a per-step call site would
+                # launch a device op every step
+                v = init()
             else:
                 v = init
             store[key] = Tensor(v, name=f"{param.name}_{kind}",

@@ -254,8 +254,8 @@ class Adam(Optimizer):
         shape = tuple(p.aval_shape())
         m = self._acc("moment1", p, shape=shape, dtype=jnp.float32)
         v = self._acc("moment2", p, shape=shape, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
-        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=lambda: jnp.ones((), jnp.float32))
         new_p, m_n, v_n, b1n, b2n = _adam(
             p, g, m, v, b1p, b2p, self._lr_tensor,
             beta1=self._beta1, beta2=self._beta2, epsilon=self._epsilon,
@@ -272,8 +272,8 @@ class Adam(Optimizer):
         shape = tuple(p.aval_shape())
         m = self._acc("moment1", p, shape=shape, dtype=jnp.float32)
         v = self._acc("moment2", p, shape=shape, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
-        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=lambda: jnp.ones((), jnp.float32))
         new_p, m_n, v_n, b1n, b2n = _adam_sparse(
             p, slices.indices, slices.values, m, v, b1p, b2p,
             self._lr_tensor, beta1=self._beta1, beta2=self._beta2,
@@ -335,7 +335,7 @@ class Adamax(Optimizer):
         shape = tuple(p.aval_shape())
         m = self._acc("moment", p, shape=shape, dtype=jnp.float32)
         u = self._acc("inf_norm", p, shape=shape, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.ones((), jnp.float32))
         new_p, m_n, u_n, b1n = _adamax(
             p, g, m, u, b1p, self._lr_tensor, beta1=self._beta1,
             beta2=self._beta2, epsilon=self._epsilon, wd=self._weight_decay)
@@ -356,7 +356,7 @@ class Adagrad(Optimizer):
 
     def _apply_one(self, p, g):
         mom = self._acc("moment", p,
-                        init=jnp.full(tuple(p.aval_shape()), self._init_acc,
+                        init=lambda: jnp.full(tuple(p.aval_shape()), self._init_acc,
                                       jnp.float32))
         new_p, mom_n = _adagrad(p, g, mom, self._lr_tensor,
                                 epsilon=self._epsilon, wd=self._weight_decay)
@@ -402,8 +402,8 @@ class Lamb(Optimizer):
         shape = tuple(p.aval_shape())
         m = self._acc("moment1", p, shape=shape, dtype=jnp.float32)
         v = self._acc("moment2", p, shape=shape, dtype=jnp.float32)
-        b1p = self._acc("beta1_pow", p, init=jnp.ones((), jnp.float32))
-        b2p = self._acc("beta2_pow", p, init=jnp.ones((), jnp.float32))
+        b1p = self._acc("beta1_pow", p, init=lambda: jnp.ones((), jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=lambda: jnp.ones((), jnp.float32))
         wd = self._lamb_wd
         if self._exclude_fn is not None and self._exclude_fn(p):
             wd = 0.0

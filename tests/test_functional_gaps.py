@@ -52,6 +52,32 @@ class TestMaxPoolReturnMask:
             got = np.take(flat[0, c], m3.numpy()[0, c].reshape(-1))
             np.testing.assert_allclose(got, o3.numpy()[0, c].reshape(-1))
 
+    def test_ceil_mode_with_mask_matches_value_path(self):
+        rs = np.random.RandomState(9)
+        x = rs.randn(1, 1, 5, 5).astype(np.float32)
+        plain = F.max_pool2d(paddle.to_tensor(x), 2, 2, ceil_mode=True)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                 ceil_mode=True, return_mask=True)
+        assert out.shape == plain.shape == [1, 1, 3, 3]
+        np.testing.assert_allclose(out.numpy(), plain.numpy())
+        got = np.take(x.reshape(-1), mask.numpy().reshape(-1))
+        np.testing.assert_allclose(got, out.numpy().reshape(-1))
+        x3 = rs.randn(1, 1, 5, 5, 5).astype(np.float32)
+        p3 = F.max_pool3d(paddle.to_tensor(x3), 2, 2, ceil_mode=True)
+        o3, m3 = F.max_pool3d(paddle.to_tensor(x3), 2, 2,
+                              ceil_mode=True, return_mask=True)
+        assert o3.shape == p3.shape == [1, 1, 3, 3, 3]
+        np.testing.assert_allclose(o3.numpy(), p3.numpy())
+
+    def test_ceil_mode_2d_adds_partial_window(self):
+        # pre-r3 the 2d value path silently ignored ceil_mode
+        x = paddle.to_tensor(np.arange(25, dtype=np.float32)
+                             .reshape(1, 1, 5, 5))
+        assert F.max_pool2d(x, 2, 2, ceil_mode=True).shape \
+            == [1, 1, 3, 3]
+        assert F.max_pool2d(x, 2, 2, ceil_mode=False).shape \
+            == [1, 1, 2, 2]
+
     def test_adaptive_masks(self):
         rs = np.random.RandomState(2)
         x = rs.randn(1, 2, 8, 8).astype(np.float32)
