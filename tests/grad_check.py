@@ -1,0 +1,20 @@
+"""Shared central-difference harness for gradient checks
+(OpTest.check_grad analogue, reference op_test.py:1409) — used by
+test_autograd.py and test_op_grads_sweep.py."""
+import numpy as np
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    """d sum(fn)/dx by central differences; fn maps ndarray -> float."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        up = fn(x)
+        flat[i] = orig - eps
+        dn = fn(x)
+        flat[i] = orig
+        gf[i] = (up - dn) / (2 * eps)
+    return g

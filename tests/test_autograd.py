@@ -7,19 +7,7 @@ import paddle_tpu as paddle
 import paddle_tpu.nn.functional as F
 
 
-def numeric_grad(fn, x, eps=1e-3):
-    g = np.zeros_like(x)
-    flat = x.reshape(-1)
-    gf = g.reshape(-1)
-    for i in range(flat.size):
-        orig = flat[i]
-        flat[i] = orig + eps
-        fp = fn(x.copy().reshape(x.shape))
-        flat[i] = orig - eps
-        fm = fn(x.copy().reshape(x.shape))
-        flat[i] = orig
-        gf[i] = (fp - fm) / (2 * eps)
-    return g
+from grad_check import numeric_grad
 
 
 def check_grad(paddle_fn, x_np, rtol=1e-2, atol=1e-3):
