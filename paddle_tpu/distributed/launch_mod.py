@@ -4,12 +4,13 @@ Reference parity: python/paddle/distributed/fleet/launch.py:396 (process
 launcher setting PADDLE_TRAINER_ID/ENDPOINTS per proc) and
 python/paddle/distributed/spawn.py.
 
-TPU-native: one controller process drives all local chips, so there is
-nothing to spawn per device on a single host — `spawn(fn)` simply runs fn
-(nprocs>1 on one host would fight over the TPU). Multi-host launch sets
-the jax.distributed coordination env (PADDLE_COORDINATOR) per host; this
-module can be used as `python -m paddle_tpu.distributed.launch_mod script.py`
-on each host with PADDLE_TRAINER_ID set by the scheduler.
+TPU-native: one controller process normally drives all local chips, so
+`spawn(fn)` simply runs fn — per-DEVICE processes are not a thing here.
+Multi-CONTROLLER runs are: `--nproc_per_node N` spawns N processes that
+jax.distributed.initialize against a coordinator (loopback by default;
+combine with --coordinator/--nnodes/--node_rank for multi-host), each
+seeing the global device set. `--server_num/--worker_num` spawns a local
+parameter-server cluster instead.
 """
 import os
 import runpy
@@ -19,9 +20,11 @@ import sys
 def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
     if nprocs not in (1, -1):
         raise RuntimeError(
-            "paddle_tpu uses single-controller SPMD: one process drives all "
-            "chips. Express device parallelism with fleet hybrid_configs / "
-            "Mesh instead of spawning per-device processes.")
+            "paddle_tpu uses single-controller SPMD: one process drives "
+            "all chips. Express device parallelism with fleet "
+            "hybrid_configs / Mesh, or launch a multi-controller run "
+            "with `python -m paddle_tpu.distributed.launch_mod "
+            "--nproc_per_node N script.py`.")
     return func(*args)
 
 
@@ -65,37 +68,88 @@ def _launch_ps_cluster(server_num, worker_num, script, script_args):
     for kind, p in procs:
         if kind == "worker":
             rc = p.wait() or rc
-    for kind, p in procs:
-        if kind == "server" and p.poll() is None:
+    _reap([p for kind, p in procs if kind == "server"])
+    return rc
+
+
+def _reap(procs):
+    """SIGTERM, bounded wait, then SIGKILL every still-running proc."""
+    import signal
+    import subprocess
+    for p in procs:
+        if p.poll() is None:
             p.send_signal(signal.SIGTERM)
-    for kind, p in procs:
-        if kind == "server":
+    for p in procs:
+        if p.poll() is None:
             try:
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def _launch_collective(nproc, script, script_args, coordinator=None,
+                       nnodes=1, node_rank=0):
+    """Reference: fleet/launch.py collective mode (launch.py:396 spawns
+    nproc trainers with PADDLE_TRAINER_ID/ENDPOINTS). Multi-controller
+    analogue: N processes per node jax.distributed.initialize against a
+    coordinator (loopback when single-node); each sees the global device
+    set (tested end-to-end in tests/test_dist_multiproc.py). A crashed
+    rank terminates the whole job — surviving ranks would deadlock in
+    their next collective waiting for it."""
+    import subprocess
+    import time
+    if coordinator is None:
+        coordinator = f"127.0.0.1:{_free_port()}"
+    base = dict(os.environ)
+    base["PADDLE_COORDINATOR"] = coordinator
+    base["PADDLE_TRAINERS_NUM"] = str(nnodes * nproc)
+    procs = []
+    for i in range(nproc):
+        env = dict(base, PADDLE_TRAINER_ID=str(node_rank * nproc + i))
+        procs.append(subprocess.Popen(
+            [sys.executable, script] + script_args, env=env))
+    rc = 0
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed:
+                rc = failed[0]
+                break
+            if all(c == 0 for c in codes):
+                break
+            time.sleep(0.2)
+    finally:
+        _reap(procs)
     return rc
 
 
 def launch():
     """python -m paddle_tpu.distributed.launch_mod
     [--coordinator host:port] [--nnodes N] [--node_rank R]
+    [--nproc_per_node N]
     [--server_num N --worker_num M]  script.py args...
 
     With --server_num/--worker_num, spawns a local parameter-server
-    cluster (reference: fleet/launch.py PS mode)."""
+    cluster (reference: fleet/launch.py PS mode). With
+    --nproc_per_node N (N>1), spawns a local N-process multi-controller
+    collective run over a loopback coordinator."""
     argv = sys.argv[1:]
     coordinator = None
     nnodes = 1
     node_rank = 0
     server_num = 0
     worker_num = 0
+    nproc_per_node = 1
     script_idx = 0
     i = 0
     while i < len(argv):
         a = argv[i]
         if a == "--coordinator":
             coordinator = argv[i + 1]
+            i += 2
+        elif a == "--nproc_per_node":
+            nproc_per_node = int(argv[i + 1])
             i += 2
         elif a == "--nnodes":
             nnodes = int(argv[i + 1])
@@ -117,6 +171,10 @@ def launch():
     if server_num > 0:
         sys.exit(_launch_ps_cluster(server_num, max(worker_num, 1),
                                     script, script_args))
+    if nproc_per_node > 1:
+        sys.exit(_launch_collective(nproc_per_node, script, script_args,
+                                    coordinator=coordinator,
+                                    nnodes=nnodes, node_rank=node_rank))
     if coordinator and nnodes > 1:
         os.environ["PADDLE_COORDINATOR"] = coordinator
         os.environ["PADDLE_TRAINERS_NUM"] = str(nnodes)

@@ -59,6 +59,44 @@ def _spawn(nproc, local_devices):
     return outs
 
 
+def test_launcher_nproc_per_node_collective():
+    """`launch_mod --nproc_per_node 2 worker.py` spawns the loopback
+    multi-controller run (reference: fleet/launch.py collective mode)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch_mod",
+         "--nproc_per_node", "2", _WORKER],
+        env=env, capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(_WORKER)))
+    assert res.returncode == 0, res.stderr[-3000:]
+    outs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
+            if ln.startswith("{")]
+    assert {o["rank"] for o in outs} == {0, 1}
+    np.testing.assert_allclose(outs[0]["losses"], outs[1]["losses"],
+                               rtol=1e-6)
+
+
+def test_launcher_terminates_peers_when_a_rank_crashes(tmp_path):
+    """A crashed rank must take the job down (surviving ranks would
+    deadlock in their next collective) — launcher polls, reaps, exits
+    nonzero instead of hanging."""
+    crash = tmp_path / "crash_worker.py"
+    crash.write_text(
+        "import os, sys, time\n"
+        "if os.environ['PADDLE_TRAINER_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "time.sleep(120)\n")
+    t0 = __import__("time").monotonic()
+    res = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch_mod",
+         "--nproc_per_node", "2", str(crash)],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 3, (res.returncode, res.stderr[-500:])
+    assert __import__("time").monotonic() - t0 < 30  # no 120s hang
+
+
 def test_two_process_dp_matches_single_process():
     two = _spawn(2, local_devices=2)   # 2 procs x 2 devices = dp 4
     one = _spawn(1, local_devices=4)   # same global mesh in one proc
