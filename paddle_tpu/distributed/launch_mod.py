@@ -42,7 +42,6 @@ def _launch_ps_cluster(server_num, worker_num, script, script_args):
     (TRAINING_ROLE=PSERVER, POD_IP/PADDLE_PORT) and worker processes
     (TRAINING_ROLE=TRAINER, PADDLE_TRAINER_ID), all sharing
     PADDLE_PSERVERS_IP_PORT_LIST / PADDLE_TRAINER_ENDPOINTS."""
-    import signal
     import subprocess
     server_eps = [f"127.0.0.1:{_free_port()}" for _ in range(server_num)]
     worker_eps = [f"127.0.0.1:{_free_port()}" for _ in range(worker_num)]
@@ -168,6 +167,15 @@ def launch():
             break
     script = argv[script_idx]
     script_args = argv[script_idx + 1:]
+    if server_num > 0 and nproc_per_node > 1:
+        sys.exit("--server_num (PS mode) and --nproc_per_node "
+                 "(collective mode) are mutually exclusive")
+    if server_num > 0 and (nnodes > 1 or coordinator):
+        sys.exit("--nnodes/--coordinator do not apply to PS mode "
+                 "(--server_num)")
+    if nnodes > 1 and coordinator is None:
+        sys.exit("--nnodes > 1 needs --coordinator host:port (a "
+                 "per-node loopback coordinator cannot form one job)")
     if server_num > 0:
         sys.exit(_launch_ps_cluster(server_num, max(worker_num, 1),
                                     script, script_args))

@@ -1,0 +1,207 @@
+"""End-to-end 'book' models (reference: python/paddle/fluid/tests/book/
+— fit_a_line, recognize_digits, understand_sentiment, recommender
+system, rnn encoder-decoder). Synthetic data, real convergence checks,
+dygraph AND compiled (to_static) paths."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _linear_data(n=256, d=8, seed=0):
+    rs = np.random.RandomState(seed)
+    w = rs.randn(d, 1).astype(np.float32)
+    b = np.float32(0.7)
+    x = rs.randn(n, d).astype(np.float32)
+    y = x @ w + b + 0.01 * rs.randn(n, 1).astype(np.float32)
+    return x, y, w, b
+
+
+class TestFitALine:
+    def test_dygraph_recovers_weights(self):
+        paddle.seed(0)
+        x_np, y_np, w_true, b_true = _linear_data()
+        net = nn.Linear(8, 1)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        x, y = paddle.to_tensor(x_np), paddle.to_tensor(y_np)
+        for _ in range(150):
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 1e-3
+        np.testing.assert_allclose(net.weight.numpy().reshape(-1, 1),
+                                   w_true, atol=0.05)
+        np.testing.assert_allclose(float(net.bias.numpy()[0]), b_true,
+                                   atol=0.05)
+
+    def test_static_mode_matches(self):
+        paddle.enable_static()
+        try:
+            x_np, y_np, _, _ = _linear_data()
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [None, 8], "float32")
+                y = paddle.static.data("y", [None, 1], "float32")
+                pred = paddle.static.nn.fc(x, 1)
+                loss = F.mse_loss(pred, y)
+                paddle.optimizer.SGD(0.1).minimize(loss)
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            for _ in range(150):
+                (lv,) = exe.run(main, feed={"x": x_np, "y": y_np},
+                                fetch_list=[loss])
+            assert float(lv) < 1e-3
+        finally:
+            paddle.disable_static()
+
+
+class TestRecognizeDigits:
+    def _blob_data(self, n=128, seed=1):
+        # 4 gaussian blobs in pixel space -> 4-way classification
+        rs = np.random.RandomState(seed)
+        labels = rs.randint(0, 4, (n,))
+        centers = rs.randn(4, 1, 8, 8).astype(np.float32) * 2.0
+        x = centers[labels] + 0.3 * rs.randn(n, 1, 8, 8).astype(
+            np.float32)
+        return x, labels.astype(np.int64)
+
+    def test_conv_classifier_dygraph_vs_compiled(self):
+        paddle.seed(0)
+        x_np, y_np = self._blob_data()
+        net = nn.Sequential(
+            nn.Conv2D(1, 8, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2), nn.Flatten(), nn.Linear(8 * 4 * 4, 4))
+        opt = paddle.optimizer.Adam(3e-3, parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        x, y = paddle.to_tensor(x_np), paddle.to_tensor(y_np)
+
+        def step():
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        compiled = paddle.jit.to_static(step)
+        losses = [float(compiled().numpy()) for _ in range(30)]
+        assert losses[-1] < losses[0]
+        pred = np.argmax(net(x).numpy(), axis=1)
+        acc = (pred == y_np).mean()
+        assert acc > 0.95, acc
+
+
+class TestUnderstandSentiment:
+    def test_lstm_classifier_learns(self):
+        # class 0 sequences drawn from tokens 0..9, class 1 from 10..19
+        paddle.seed(0)
+        rs = np.random.RandomState(2)
+        n, seq = 96, 12
+        y_np = rs.randint(0, 2, (n,))
+        ids_np = np.where(y_np[:, None] == 0,
+                          rs.randint(0, 10, (n, seq)),
+                          rs.randint(10, 20, (n, seq))).astype(np.int64)
+
+        class Sentiment(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(20, 16)
+                self.lstm = nn.LSTM(16, 16)
+                self.fc = nn.Linear(16, 2)
+
+            def forward(self, ids):
+                h, _ = self.lstm(self.emb(ids))
+                return self.fc(h[:, -1])
+
+        net = Sentiment()
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        ids, y = paddle.to_tensor(ids_np), paddle.to_tensor(y_np)
+        for _ in range(25):
+            loss = loss_fn(net(ids), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        pred = np.argmax(net(ids).numpy(), axis=1)
+        assert (pred == y_np).mean() > 0.95
+
+
+class TestRecommenderSystem:
+    def test_embedding_dot_rating_regression(self):
+        # rating = <user_vec, item_vec> ground truth; the model recovers
+        # it through its own embeddings (book: recommender_system)
+        paddle.seed(0)
+        rs = np.random.RandomState(3)
+        n_users, n_items, dim, n = 16, 24, 4, 512
+        u_true = rs.randn(n_users, dim).astype(np.float32)
+        i_true = rs.randn(n_items, dim).astype(np.float32)
+        uid = rs.randint(0, n_users, (n,)).astype(np.int64)
+        iid = rs.randint(0, n_items, (n,)).astype(np.int64)
+        rating = (u_true[uid] * i_true[iid]).sum(-1, keepdims=True) \
+            .astype(np.float32)
+
+        class Rec(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.u = nn.Embedding(n_users, dim)
+                self.i = nn.Embedding(n_items, dim)
+
+            def forward(self, uid, iid):
+                return (self.u(uid) * self.i(iid)).sum(-1, keepdim=True)
+
+        net = Rec()
+        opt = paddle.optimizer.Adam(5e-2, parameters=net.parameters())
+        t_u, t_i = paddle.to_tensor(uid), paddle.to_tensor(iid)
+        t_r = paddle.to_tensor(rating)
+        first = None
+        for _ in range(120):
+            loss = F.mse_loss(net(t_u, t_i), t_r)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+        final = float(loss.numpy())
+        assert final < 0.05 * first, (first, final)
+
+
+class TestRNNEncoderDecoder:
+    def test_seq2seq_copy_task(self):
+        # encoder-decoder learns to reproduce the source sequence
+        # (book: rnn_encoder_decoder / machine_translation reduced)
+        paddle.seed(0)
+        rs = np.random.RandomState(4)
+        n, seq, vocab = 64, 6, 12
+        src = rs.randint(2, vocab, (n, seq)).astype(np.int64)
+
+        class Seq2Seq(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(vocab, 24)
+                self.enc = nn.GRU(24, 32)
+                self.dec = nn.GRU(24, 32)
+                self.out = nn.Linear(32, vocab)
+
+            def forward(self, src):
+                _, h = self.enc(self.emb(src))
+                # teacher forcing: decoder input is the shifted target
+                start = paddle.zeros([src.shape[0], 1], "int64")
+                dec_in = paddle.concat([start, src[:, :-1]], axis=1)
+                y, _ = self.dec(self.emb(dec_in), h)
+                return self.out(y)
+
+        net = Seq2Seq()
+        opt = paddle.optimizer.Adam(8e-3, parameters=net.parameters())
+        loss_fn = nn.CrossEntropyLoss()
+        t = paddle.to_tensor(src)
+        for _ in range(150):
+            logits = net(t)
+            loss = loss_fn(logits.reshape((-1, vocab)),
+                           t.reshape((-1,)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        pred = np.argmax(net(t).numpy(), axis=-1)
+        assert (pred == src).mean() > 0.9, (pred == src).mean()
