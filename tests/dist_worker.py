@@ -68,8 +68,10 @@ def main():
 
     wsum = float(np.asarray(
         net[0].weight.value.sum() + net[2].weight.value.sum()))
-    print(json.dumps({"rank": rank, "losses": losses, "wsum": wsum}),
-          flush=True)
+    # one os.write syscall: ranks may share the launcher's stdout pipe,
+    # and print()'s separate payload/newline writes can interleave
+    os.write(1, (json.dumps({"rank": rank, "losses": losses,
+                             "wsum": wsum}) + "\n").encode())
 
 
 if __name__ == "__main__":

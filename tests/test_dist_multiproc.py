@@ -71,8 +71,20 @@ def test_launcher_nproc_per_node_collective():
         env=env, capture_output=True, text=True, timeout=300,
         cwd=os.path.dirname(os.path.dirname(_WORKER)))
     assert res.returncode == 0, res.stderr[-3000:]
-    outs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
-            if ln.startswith("{")]
+    # robust to any residual interleaving: decode every JSON object in
+    # the combined stdout stream
+    dec = json.JSONDecoder()
+    outs, pos = [], 0
+    while True:
+        start = res.stdout.find("{", pos)
+        if start < 0:
+            break
+        try:
+            obj, end = dec.raw_decode(res.stdout, start)
+            outs.append(obj)
+            pos = start + (end - start)
+        except json.JSONDecodeError:
+            pos = start + 1
     assert {o["rank"] for o in outs} == {0, 1}
     np.testing.assert_allclose(outs[0]["losses"], outs[1]["losses"],
                                rtol=1e-6)
