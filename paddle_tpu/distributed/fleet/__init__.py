@@ -48,11 +48,11 @@ class UtilBase:
         first-wins on the server, so the racing trainers share one
         table); a lone worker is the identity. Calls must be collective:
         every worker invokes the same sequence of all_reduce calls."""
-        if mode != "sum":
-            raise NotImplementedError(
-                f"util.all_reduce mode {mode!r}; only 'sum' is supported")
+        if mode not in ("sum", "min", "max"):
+            raise ValueError(f"util.all_reduce mode {mode!r}; expected "
+                             "sum/min/max")
         import numpy as np
-        from .fleet_base import ps_client, worker_num
+        from .fleet_base import ps_client, worker_num, worker_index
         arr = np.asarray(getattr(input, "numpy", lambda: input)())
         client = ps_client()
         n = worker_num()
@@ -60,13 +60,44 @@ class UtilBase:
             return arr  # single worker: reduction of one contribution
         rnd = self._allreduce_round[0]
         self._allreduce_round[0] += 1
-        tid = f"__fleet_util_allreduce__{rnd}"
-        client.create_dense_table(tid, shape=arr.shape, optimizer="sum",
-                                  init=np.zeros_like(arr))
-        client.push_dense(tid, arr)
+        if mode == "sum":
+            tid = f"__fleet_util_allreduce__{rnd}"
+            client.create_dense_table(tid, shape=arr.shape,
+                                      optimizer="sum",
+                                      init=np.zeros_like(arr))
+            client.push_dense(tid, arr)
+            client.barrier(n)
+            out = np.asarray(client.pull_dense(tid))
+            client.barrier(n)
+            return out
+        # min/max: the server tables only sum, so exchange contributions
+        # all-to-all through the shuffle service and reduce locally
+        # (reference gloo supports sum/min/max; same collective
+        # contract). Buckets are namespaced per round and rank — the
+        # integer buckets belong to InMemoryDataset.global_shuffle and
+        # must not be drained or polluted here.
+        import pickle
+        me = worker_index()
+        blob = pickle.dumps(arr)
+        for r in range(n):
+            client._call(r % client.n_servers,
+                         {"cmd": "shuffle_put",
+                          "dest": f"__util_allreduce__{rnd}_{r}",
+                          "blobs": [blob]})
         client.barrier(n)
-        out = np.asarray(client.pull_dense(tid))
+        resp = client._call(me % client.n_servers,
+                            {"cmd": "shuffle_take",
+                             "rank": f"__util_allreduce__{rnd}_{me}"})
+        vals = [pickle.loads(b) for b in resp["blobs"]]
         client.barrier(n)
+        if len(vals) != n:
+            raise RuntimeError(
+                f"util.all_reduce({mode}): received {len(vals)} of {n} "
+                "contributions — a worker missed the collective")
+        red = np.minimum if mode == "min" else np.maximum
+        out = vals[0]
+        for v in vals[1:]:
+            out = red(out, v)
         return out
 
     def barrier(self, comm_world="worker"):

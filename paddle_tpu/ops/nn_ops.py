@@ -864,6 +864,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
             else:
                 n = red_ops.sum(valid)
             return math_ops.divide(s, math_ops.maximum(n, 1e-12))
+        if weight is not None:
+            # soft labels: reference divides by the summed per-sample
+            # weights <label, w> too (loss.py weighted mean)
+            wsum = red_ops.sum(_gather_weight(weight, label, soft_label,
+                                              axis))
+            return math_ops.divide(red_ops.sum(loss),
+                                   math_ops.maximum(wsum, 1e-12))
         return red_ops.mean(loss)
     if reduction == "sum":
         return red_ops.sum(loss)
@@ -892,7 +899,11 @@ def _valid_mask(label, ignore_index, axis):
 def _gather_weight(weight, label, soft_label, axis):
     from . import manipulation
     if soft_label:
-        raise NotImplementedError
+        # reference semantics (loss.py cross_entropy soft_label=True,
+        # weight given): per-sample weight = <soft label, class weight>
+        from . import math as math_ops, reduction as red_ops
+        return red_ops.sum(math_ops.multiply(label, weight),
+                           axis=int(axis))
     return manipulation.gather(weight, label)
 
 

@@ -136,3 +136,35 @@ class TestSpectralNorm:
         sn(w).sum().backward()
         assert w.grad is not None
         assert np.isfinite(w.grad.numpy()).all()
+
+
+class TestSoftLabelWeightedCE:
+    def test_matches_manual_computation(self):
+        rs = np.random.RandomState(11)
+        logits = rs.randn(5, 3).astype(np.float32)
+        soft = rs.rand(5, 3).astype(np.float32)
+        soft /= soft.sum(1, keepdims=True)
+        w = np.asarray([0.5, 1.0, 2.0], np.float32)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(soft),
+                              weight=paddle.to_tensor(w),
+                              soft_label=True, reduction="none")
+        logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+        per = -(soft * logp).sum(1) * (soft * w).sum(1)
+        np.testing.assert_allclose(out.numpy(), per, rtol=1e-5)
+
+    def test_weighted_mean_divides_by_weight_sum(self):
+        rs = np.random.RandomState(12)
+        logits = rs.randn(5, 3).astype(np.float32)
+        soft = rs.rand(5, 3).astype(np.float32)
+        soft /= soft.sum(1, keepdims=True)
+        w = np.asarray([0.5, 1.0, 2.0], np.float32)
+        out = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(soft),
+                              weight=paddle.to_tensor(w),
+                              soft_label=True, reduction="mean")
+        logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+        wsamp = (soft * w).sum(1)
+        per = -(soft * logp).sum(1) * wsamp
+        np.testing.assert_allclose(float(out.numpy()),
+                                   per.sum() / wsamp.sum(), rtol=1e-5)

@@ -517,3 +517,52 @@ else:
     assert rc == 0
     # 2 workers each pushed ones(2) into a sum table: total = 4
     assert float(open(marker).read()) == 4.0
+
+
+def test_fleet_util_allreduce_min_max(tmp_path):
+    """util.all_reduce min/max across 2 real workers (reference: gloo
+    all_reduce modes; sum rides the PS sum table, min/max the shuffle
+    exchange)."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    marker = str(tmp_path / "mm.txt")
+    script = tmp_path / "mm_script.py"
+    script.write_text(f"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker,
+                                          DistributedStrategy, util)
+
+strategy = DistributedStrategy()
+strategy.a_sync = True
+fleet.init(PaddleCloudRoleMaker(), strategy=strategy)
+if fleet.is_server():
+    fleet.init_server()
+    fleet.run_server()
+else:
+    fleet.init_worker()
+    me = fleet.worker_index()
+    arr = np.asarray([1.0 + me, 10.0 - me], np.float32)
+    lo = util.all_reduce(arr, mode="min")
+    hi = util.all_reduce(arr, mode="max")
+    tot = util.all_reduce(arr, mode="sum")
+    if me == 0:
+        with open({marker!r}, "w") as f:
+            f.write(",".join(str(float(v))
+                             for v in list(lo) + list(hi) + list(tot)))
+    fleet.stop_worker()
+""")
+    rc = subprocess.run(
+        [_sys.executable, "-m", "paddle_tpu.distributed.launch_mod",
+         "--server_num", "1", "--worker_num", "2", str(script)],
+        cwd=repo, timeout=180).returncode
+    assert rc == 0
+    vals = [float(v) for v in open(marker).read().split(",")]
+    # worker arrays: [1,10] and [2,9] -> min [1,9], max [2,10], sum [3,19]
+    assert vals == [1.0, 9.0, 2.0, 10.0, 3.0, 19.0], vals
