@@ -72,6 +72,8 @@ _ACT_NAMES = frozenset({
 
 
 def _apply_act(out, act):
+    if act is None:
+        return out
     fn = None
     if act in _ACT_NAMES:
         fn = getattr(nn_ops, act, None) or getattr(math_ops, act, None)
@@ -192,3 +194,1116 @@ def dropout(x, dropout_prob, is_test=False,
 def accuracy(input, label, k=1):
     from ..metric import accuracy as _acc
     return _acc(input, label, k=k)
+
+
+# ---- round-3 surface widening (reference: fluid/layers/nn.py __all__) -----
+# Functional names forward to the modern ops with fluid's signatures
+# (`dim` instead of `axis`, elementwise_* with the broadcast `axis` arg,
+# pool2d with pool_type strings). Parameter-creating layer functions
+# (conv2d, batch_norm, ...) reuse the _reuse_key machinery fc uses.
+
+def _paddle():
+    import paddle_tpu as _p
+    return _p
+
+
+# -- reductions / logic ------------------------------------------------------
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _paddle().min(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _paddle().prod(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _paddle().all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _paddle().any(input, axis=dim, keepdim=keep_dim)
+
+
+def logical_and(x, y, out=None, name=None):
+    return _paddle().logical_and(x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return _paddle().logical_or(x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return _paddle().logical_xor(x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return _paddle().logical_not(x)
+
+
+# -- elementwise with fluid's broadcast `axis` -------------------------------
+
+def _ew(fn, x, y, axis):
+    if axis != -1 and hasattr(y, "ndim") and y.ndim < x.ndim:
+        # fluid semantics: y's dims align with x starting at `axis`
+        from ..ops import manipulation
+        for _ in range(x.ndim - axis - y.ndim):
+            y = manipulation.unsqueeze(y, -1)
+    return fn(x, y)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().add, x, y, axis), act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().subtract, x, y, axis), act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().multiply, x, y, axis), act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().divide, x, y, axis), act)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().maximum, x, y, axis), act)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().minimum, x, y, axis), act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().pow, x, y, axis), act)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().mod, x, y, axis), act)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _apply_act(_ew(_paddle().floor_divide, x, y, axis), act)
+
+
+# -- activations / simple math ----------------------------------------------
+
+def log(x, name=None):
+    return _paddle().log(x)
+
+
+def pow(x, factor=1.0, name=None):  # noqa: A001
+    return _paddle().pow(x, factor)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772,
+         name=None):
+    from ..nn import functional as F
+    return F.selu(x, scale=scale, alpha=alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    from ..nn import functional as F
+    return F.elu(x, alpha=alpha)
+
+
+def relu6(x, threshold=6.0, name=None):
+    from ..nn import functional as F
+    return F.relu6(x)
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    from ..nn import functional as F
+    return F.leaky_relu(x, negative_slope=alpha)
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _paddle().clip(x * slope + offset, 0.0, 1.0)
+
+
+def swish(x, beta=1.0, name=None):
+    from ..ops import nn_ops
+    return x * nn_ops.sigmoid(x * beta)
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    return x * _paddle().clip(x + offset, 0.0, threshold) / scale
+
+
+def mish(x, name=None):
+    from ..nn import functional as F
+    return x * _paddle().tanh(F.softplus(x))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * _paddle().tanh(x * scale_a)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _paddle().clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    clipped = _paddle().clip(x, -threshold, threshold)
+    return _paddle().log(1.0 + _paddle().exp(clipped))
+
+
+def sign(x, name=None):
+    return _paddle().sign(x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True,  # noqa: A002
+          act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return _apply_act(out, act)
+
+
+def clip(x, min, max, name=None):  # noqa: A002
+    return _paddle().clip(x, min, max)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    from ..ops import reduction, math as math_ops
+    norm = _paddle().sqrt(reduction.sum(math_ops.multiply(x, x)))
+    factor = _paddle().minimum(
+        _paddle().to_tensor(1.0), max_norm / _paddle().maximum(
+            norm, _paddle().to_tensor(1e-12)))
+    return x * factor
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    from ..ops import manipulation, linalg
+    import numpy as _np
+    xm = manipulation.reshape(
+        x, (int(_np.prod(x.shape[:x_num_col_dims])), -1))
+    ym = manipulation.reshape(
+        y, (int(_np.prod(y.shape[:y_num_col_dims])), -1))
+    return linalg.matmul(xm, ym)
+
+
+# -- shape / manipulation ----------------------------------------------------
+
+def split(input, num_or_sections, dim=-1, name=None):  # noqa: A002
+    return _paddle().split(input, num_or_sections, axis=dim)
+
+
+def squeeze(input, axes=None, name=None):  # noqa: A002
+    return _paddle().squeeze(input, axis=axes)
+
+
+def unsqueeze(input, axes, name=None):  # noqa: A002
+    return _paddle().unsqueeze(input, axis=axes)
+
+
+def flatten(x, axis=1, name=None):
+    import numpy as _np
+    lead = int(_np.prod(x.shape[:axis])) if axis > 0 else 1
+    return _paddle().reshape(x, (lead, -1))
+
+
+def stack(x, axis=0, name=None):
+    return _paddle().stack(x, axis=axis)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return _paddle().unstack(x, axis=axis, num=num)
+
+
+def unbind(input, axis=0):  # noqa: A002
+    return _paddle().unbind(input, axis=axis)
+
+
+def expand(x, expand_times, name=None):
+    return _paddle().tile(x, expand_times)
+
+
+def expand_as(x, target_tensor, name=None):
+    return _paddle().expand_as(x, target_tensor)
+
+
+def slice(input, axes, starts, ends):  # noqa: A002
+    return _paddle().slice(input, axes, starts, ends)
+
+
+def strided_slice(input, axes, starts, ends, strides):  # noqa: A002
+    return _paddle().strided_slice(input, axes, starts, ends, strides)
+
+
+def shape(input):  # noqa: A002
+    return _paddle().shape(input)
+
+
+def rank(input):  # noqa: A002
+    return _paddle().rank(input)
+
+
+def size(input):  # noqa: A002
+    return _paddle().numel(input)
+
+
+def gather(input, index, overwrite=True):  # noqa: A002
+    return _paddle().gather(input, index)
+
+
+def gather_nd(input, index, name=None):  # noqa: A002
+    return _paddle().gather_nd(input, index)
+
+
+def scatter(input, index, updates, overwrite=True, name=None):  # noqa: A002
+    return _paddle().scatter(input, index, updates, overwrite=overwrite)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return _paddle().scatter_nd_add(ref, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):  # noqa: A002
+    return _paddle().scatter_nd(index, updates, shape)
+
+
+def where(condition):
+    return _paddle().nonzero(condition)
+
+
+def one_hot(input, depth, allow_out_of_range=False):  # noqa: A002
+    from ..nn import functional as F
+    if input.ndim >= 2 and int(input.shape[-1]) == 1:
+        input = input.squeeze(-1)  # fluid replaces the trailing 1-dim
+    return F.one_hot(input, depth)
+
+
+def topk(input, k, name=None):  # noqa: A002
+    return _paddle().topk(input, k)
+
+
+def _unique_appearance(x):
+    import numpy as _np
+    v = _np.asarray(x.numpy()).reshape(-1)
+    sorted_u, first = _np.unique(v, return_index=True)
+    order = _np.argsort(first)          # appearance order
+    out = sorted_u[order]
+    remap = _np.empty(len(sorted_u), _np.int64)
+    remap[order] = _np.arange(len(sorted_u))
+    inv_sorted = _np.searchsorted(sorted_u, v)
+    inverse = remap[inv_sorted]
+    counts = _np.bincount(inverse, minlength=len(out))
+    return out, inverse, counts
+
+
+def unique(x, dtype="int32"):
+    """fluid semantics: appearance-order uniques + a len(x) index
+    mapping every input element into `out`."""
+    out, inverse, _ = _unique_appearance(x)
+    T = _paddle().to_tensor
+    import numpy as _np
+    return T(out), T(inverse.astype(_np.dtype(dtype)))
+
+
+def unique_with_counts(x, dtype="int32"):
+    out, inverse, counts = _unique_appearance(x)
+    T = _paddle().to_tensor
+    import numpy as _np
+    return (T(out), T(inverse.astype(_np.dtype(dtype))),
+            T(counts.astype(_np.int64)))
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    from ..nn import functional as F
+    return F.pad(x, paddings, value=pad_value)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant",  # noqa: A002
+          pad_value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    t, b, l, r = paddings  # fluid order: top/bottom/left/right
+    return F.pad(input, [l, r, t, b], mode=mode.replace(
+        "edge", "replicate"), value=pad_value, data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    import numpy as _np
+    pads = []
+    for xa, ya in zip(x.shape, y.shape):
+        pads += [0, int(xa - ya)]
+    import jax.numpy as _jnp
+    arr = _jnp.pad(_paddle().to_tensor(y).value if not isinstance(
+        y, Tensor) else y.value,
+        [(p0, p1) for p0, p1 in zip(pads[::2], pads[1::2])],
+        constant_values=pad_value)
+    return Tensor(arr)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):  # noqa: A002
+    offs = offsets or [0] * len(shape)
+    from ..ops import manipulation
+    return manipulation.slice(
+        x, list(range(len(shape))), offs,
+        [o + s for o, s in zip(offs, shape)])
+
+
+crop = crop_tensor
+
+
+def shard_index(input, index_num, nshards, shard_id,  # noqa: A002
+                ignore_value=-1):
+    return _paddle().shard_index(input, index_num, nshards, shard_id,
+                                 ignore_value)
+
+
+def sum(x):  # noqa: A001
+    """fluid.layers.sum IS add_n: elementwise sum of the inputs (a lone
+    tensor passes through unchanged — NOT a reduction)."""
+    if isinstance(x, (list, tuple)):
+        out = x[0]
+        for t in x[1:]:
+            out = out + t
+        return out
+    return x
+
+
+# -- normalization / similarity ---------------------------------------------
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    from ..nn import functional as F
+    return F.normalize(x, axis=axis, epsilon=epsilon)
+
+
+def cos_sim(X, Y):
+    from ..nn import functional as F
+    return F.cosine_similarity(X, Y, axis=-1).unsqueeze(-1)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,  # noqa: A002
+        data_format="NCHW"):
+    from ..ops import nn_ops
+    return nn_ops.local_response_norm(input, n, alpha, beta, k)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    from ..nn import functional as F
+    return F.smooth_l1_loss(x, y, reduction="none",
+                            delta=1.0 / ((sigma or 1.0) ** 2)) \
+        .sum(axis=-1, keepdim=True)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    return _paddle().nn.functional.label_smooth(
+        label, prior_dist=prior_dist, epsilon=epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    from ..nn import functional as F
+    return F.log_loss(input, label, epsilon)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    from ..nn import functional as F
+    return F.dice_loss(input, label, epsilon)
+
+
+def mean_iou(input, label, num_classes):  # noqa: A002
+    from ..metric import mean_iou as _miou
+    return _miou(input, label, num_classes)
+
+
+# -- vision-ish --------------------------------------------------------------
+
+def image_resize(input, out_shape=None, scale=None,  # noqa: A002
+                 name=None, resample="BILINEAR", actual_shape=None,
+                 align_corners=True, align_mode=1, data_format="NCHW"):
+    from ..nn import functional as F
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "LINEAR": "linear",
+            "BICUBIC": "bicubic"}[resample.upper()]
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=mode)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,  # noqa: A002
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR")
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,  # noqa: A002
+                   actual_shape=None, align_corners=True,
+                   data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST")
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,  # noqa: A002
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return image_resize(input, out_shape, scale, name, "TRILINEAR")
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,  # noqa: A002
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    return image_resize(input, out_shape, scale, name, "LINEAR")
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002
+    h, w = input.shape[2], input.shape[3]
+    short, other = (h, w) if h < w else (w, h)
+    ratio = out_short_len / float(short)
+    out = (int(round(h * ratio)), int(round(w * ratio)))
+    return image_resize(input, out_shape=out, resample=resample)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,  # noqa: A002
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_num=None):
+    from ..vision.ops import roi_align as _ra
+    return _ra(input, rois, rois_num=rois_num,
+               output_size=(pooled_height, pooled_width),
+               spatial_scale=spatial_scale,
+               sampling_ratio=sampling_ratio)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,  # noqa: A002
+             spatial_scale=1.0, rois_num=None, name=None):
+    # max-pool RoI: reference roi_pool_op; expressed via roi_align with
+    # aligned sampling (close TPU-native analogue; exact argmax pooling
+    # needs dynamic windows XLA can't tile)
+    return roi_align(input, rois, pooled_height, pooled_width,
+                     spatial_scale, rois_num=rois_num)
+
+
+def grid_sampler(x, grid, name=None):
+    from ..nn import functional as F
+    return F.grid_sample(x, grid)
+
+
+def affine_grid(theta, out_shape, name=None):
+    from ..nn import functional as F
+    return F.affine_grid(theta, out_shape)
+
+
+def affine_channel(x, scale=None, bias=None, data_format="NCHW",
+                   act=None, name=None):
+    s = scale.reshape((1, -1, 1, 1)) if scale is not None else 1.0
+    b = bias.reshape((1, -1, 1, 1)) if bias is not None else 0.0
+    return _apply_act(x * s + b, act)
+
+
+def pixel_shuffle(x, upscale_factor):
+    from ..nn import functional as F
+    return F.pixel_shuffle(x, upscale_factor)
+
+
+def space_to_depth(x, blocksize, name=None):
+    n, c, h, w = x.shape
+    bs = int(blocksize)
+    out = _paddle().reshape(x, (n, c, h // bs, bs, w // bs, bs))
+    out = _paddle().transpose(out, (0, 3, 5, 1, 2, 4))
+    return _paddle().reshape(out, (n, c * bs * bs, h // bs, w // bs))
+
+
+def shuffle_channel(x, group, name=None):
+    n, c, h, w = x.shape
+    out = _paddle().reshape(x, (n, group, c // group, h, w))
+    out = _paddle().transpose(out, (0, 2, 1, 3, 4))
+    return _paddle().reshape(out, (n, c, h, w))
+
+
+from ..core.dispatch import register_op as _register_op
+
+
+@_register_op("temporal_shift")
+def _temporal_shift_op(x, *, seg_num, shift_ratio):
+    import jax.numpy as _jnp
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = _jnp.roll(v[:, :, :fold], -1, axis=1).at[:, -1, :].set(0.0)
+    right = _jnp.roll(v[:, :, fold:2 * fold], 1, axis=1) \
+        .at[:, 0, :].set(0.0)
+    out = _jnp.concatenate([left, right, v[:, :, 2 * fold:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    return _temporal_shift_op(x, seg_num=int(seg_num),
+                              shift_ratio=float(shift_ratio))
+
+
+def maxout(x, groups, name=None, axis=1):
+    n, c, h, w = x.shape
+    out = _paddle().reshape(x, (n, c // groups, groups, h, w))
+    return _paddle().max(out, axis=2)
+
+
+@_register_op("fsp_matrix")
+def _fsp_op(x, y):
+    import jax.numpy as _jnp
+    n, cx, h, w = x.shape
+    cy = y.shape[1]
+    xf = x.reshape(n, cx, h * w)
+    yf = y.reshape(n, cy, h * w)
+    return _jnp.einsum("nch,ndh->ncd", xf, yf) / (h * w)
+
+
+def fsp_matrix(x, y):
+    return _fsp_op(x, y)
+
+
+@_register_op("add_position_encoding")
+def _ape_op(x, *, alpha, beta):
+    import jax.numpy as _jnp
+    b, t, c = x.shape
+    half = c // 2
+    pos = _jnp.arange(t, dtype=_jnp.float32)[:, None]
+    div = _jnp.power(10000.0, _jnp.arange(half, dtype=_jnp.float32)
+                     / half)
+    pe = _jnp.concatenate(
+        [_jnp.sin(pos / div), _jnp.cos(pos / div)], axis=1)
+    return alpha * x + beta * pe[None, :, :c].astype(x.dtype)
+
+
+def add_position_encoding(input, alpha, beta, name=None):  # noqa: A002
+    return _ape_op(input, alpha=float(alpha), beta=float(beta))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+           name=None):
+    from ..nn import functional as F
+    return F.unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+@_register_op("multiplex")
+def _multiplex_op(index, *inputs):
+    import jax.numpy as _jnp
+    stacked = _jnp.stack(inputs, axis=0)
+    rows = _jnp.arange(stacked.shape[1])
+    return stacked[index.reshape(-1), rows]
+
+
+def multiplex(inputs, index):
+    return _multiplex_op(index, *inputs)
+
+
+def deformable_conv(input, offset, mask, num_filters,  # noqa: A002
+                    filter_size, stride=1, padding=0, dilation=1,
+                    groups=1, deformable_groups=1, im2col_step=1,
+                    param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    from ..vision.ops import deform_conv2d
+    key = _reuse_key(name, ("deformable_conv", int(input.shape[1]),
+                            num_filters, filter_size))
+    w = _layer_cache.get(key)
+    if w is None:
+        from ..nn import initializer as init_mod
+        import jax.numpy as _jnp
+        ks = filter_size if isinstance(filter_size, (list, tuple)) \
+            else (filter_size, filter_size)
+        from ..core.tensor import Parameter
+        w = Parameter(init_mod.XavierNormal()(
+            (num_filters, int(input.shape[1]) // groups, ks[0], ks[1]),
+            _jnp.float32))
+        _layer_cache[key] = w
+    return deform_conv2d(input, offset, w, mask=mask, stride=stride,
+                         padding=padding, dilation=dilation,
+                         deformable_groups=deformable_groups,
+                         groups=groups)
+
+
+# -- random ------------------------------------------------------------------
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0,  # noqa: A002
+                   seed=0, name=None):
+    return _paddle().uniform(shape, dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    return _paddle().normal(mean=mean, std=std, shape=shape)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",  # noqa: A002
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return uniform_random(shape, dtype, min, max, seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,  # noqa: A002
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = input.shape[input_dim_idx]
+    return gaussian_random(shape, mean, std, seed, dtype)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):  # noqa: A002
+    return _paddle().multinomial(x, num_samples=1).squeeze(-1)
+
+
+def random_crop(x, shape, seed=None):  # noqa: A002
+    import numpy as _np
+    starts = [int(_np.random.randint(0, int(xd) - int(sd) + 1))
+              for xd, sd in zip(x.shape[-len(shape):], shape)]
+    axes = list(range(x.ndim - len(shape), x.ndim))
+    ends = [st + int(sd) for st, sd in zip(starts, shape)]
+    from ..ops import manipulation
+    return manipulation.slice(x, axes, starts, ends)
+
+
+# -- sequence / CRF ----------------------------------------------------------
+
+def linear_chain_crf(input, label, param_attr=None, length=None):  # noqa: A002
+    """Reference: fluid/layers/nn.py linear_chain_crf — creates the
+    [C+2, C] transition parameter and returns per-sequence nll."""
+    from ..ops import sequence as seq_ops
+    from ..core.tensor import Parameter
+    import jax.numpy as _jnp
+    c = int(input.shape[-1])
+    # shared by design between linear_chain_crf and crf_decoding: key on
+    # (name, class-count), never the call stack
+    key = ("crf_transition", getattr(param_attr, "name", param_attr), c)
+    trans = _layer_cache.get(key)
+    if trans is None:
+        from ..nn import initializer as init_mod
+        trans = Parameter(init_mod.Normal(0.0, 0.1)((c + 2, c),
+                                                    _jnp.float32))
+        _layer_cache[key] = trans
+    if length is None:
+        length = _paddle().full([int(input.shape[0])], input.shape[1],
+                                "int64")
+    if label.ndim == 3:
+        label = label.squeeze(-1)
+    return seq_ops.linear_chain_crf(input, trans, label, length), trans
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):  # noqa: A002
+    from ..ops import sequence as seq_ops
+    c = int(input.shape[-1])
+    key = ("crf_transition", getattr(param_attr, "name", param_attr), c)
+    trans = _layer_cache.get(key)
+    if trans is None:
+        raise ValueError("crf_decoding: no trained transition found — "
+                         "call linear_chain_crf first or pass a shared "
+                         "param_attr name")
+    if length is None:
+        length = _paddle().full([int(input.shape[0])], input.shape[1],
+                                "int64")
+    return seq_ops.crf_decoding(input, trans, length)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None,  # noqa: A002
+                       padding_value=0, name=None):
+    """Best-path CTC decode: argmax, merge repeats, drop blanks
+    (reference: ctc_align_op)."""
+    import numpy as _np
+    probs = _np.asarray(input.numpy())
+    ids = probs.argmax(-1)
+    b, t = ids.shape
+    lens = (_np.asarray(input_length.numpy()).reshape(-1)
+            if input_length is not None else _np.full(b, t))
+    outs = _np.full((b, t), padding_value, _np.int64)
+    out_lens = _np.zeros(b, _np.int64)
+    for i in range(b):
+        prev = -1
+        k = 0
+        for j in range(int(lens[i])):
+            tok = int(ids[i, j])
+            if tok != blank and tok != prev:
+                outs[i, k] = tok
+                k += 1
+            prev = tok
+        out_lens[i] = k
+    return _paddle().to_tensor(outs), _paddle().to_tensor(out_lens)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None):
+    """IOB/IOE/IOBES chunk P/R/F1 (reference: chunk_eval_op). Host-side
+    metric (no gradient)."""
+    import numpy as _np
+
+    def _chunks(tags):
+        # tag encoding: tag = chunk_type * tag_num + pos; O is any tag
+        # outside the range. Positions per scheme (chunk_eval_op.h):
+        # IOB: B=0 I=1; IOE: I=0 E=1; IOBES: B=0 I=1 E=2 S=3; plain: 0.
+        spans = []
+        tag_num = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[
+            chunk_scheme]
+        start = ctype = None
+        for i, t in enumerate(list(tags) + [-1]):
+            if t < 0 or t >= num_chunk_types * tag_num:
+                ty, pos = None, None
+            else:
+                ty, pos = divmod(int(t), tag_num)
+            # does this tag CONTINUE an open chunk of ctype?
+            if start is not None:
+                cont = (ty == ctype) and (
+                    (chunk_scheme == "IOB" and pos == 1)
+                    or (chunk_scheme == "IOE" and pos in (0, 1))
+                    or (chunk_scheme == "IOBES" and pos in (1, 2))
+                    or chunk_scheme == "plain")
+                if not cont:
+                    spans.append((start, i - 1, ctype))
+                    start = ctype = None
+            if ty is not None and start is None:
+                start, ctype = i, ty
+            # immediate enders close INCLUDING this position
+            if start is not None and (
+                    (chunk_scheme == "IOE" and pos == 1)
+                    or (chunk_scheme == "IOBES" and pos in (2, 3))):
+                spans.append((start, i, ctype))
+                start = ctype = None
+        if excluded_chunk_types:
+            spans = [s for s in spans if s[2] not in excluded_chunk_types]
+        return set(spans)
+
+    inf = _np.asarray(input.numpy()).reshape(input.shape[0], -1)
+    lab = _np.asarray(label.numpy()).reshape(label.shape[0], -1)
+    lens = (_np.asarray(seq_length.numpy()).reshape(-1)
+            if seq_length is not None
+            else _np.full(inf.shape[0], inf.shape[1]))
+    n_inf = n_lab = n_correct = 0
+    for i in range(inf.shape[0]):
+        ci = _chunks(inf[i, :int(lens[i])])
+        cl = _chunks(lab[i, :int(lens[i])])
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_correct += len(ci & cl)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    T = _paddle().to_tensor
+    return (T(_np.float32(p)), T(_np.float32(r)), T(_np.float32(f1)),
+            T(_np.int64(n_inf)), T(_np.int64(n_lab)),
+            T(_np.int64(n_correct)))
+
+
+# -- parameter-creating layer functions (fc-style _reuse_key reuse) ----------
+
+def _cached_layer(name, config, build):
+    key = _reuse_key(name, config)
+    layer = _layer_cache.get(key)
+    if layer is None:
+        layer = build()
+        _layer_cache[key] = layer
+    return layer
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCHW"):
+    from ..nn.layer.conv import Conv2D
+    cin = int(input.shape[1])
+    layer = _cached_layer(name, ("conv2d", cin, num_filters,
+                                 str(filter_size), str(stride),
+                                 str(padding), str(dilation), groups),
+                          lambda: Conv2D(cin, num_filters, filter_size,
+                                         stride=stride, padding=padding,
+                                         dilation=dilation, groups=groups,
+                                         bias_attr=bias_attr))
+    return _apply_act(layer(input), act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None, data_format="NCDHW"):
+    from ..nn.layer.conv import Conv3D
+    cin = int(input.shape[1])
+    layer = _cached_layer(name, ("conv3d", cin, num_filters,
+                                 str(filter_size), str(stride),
+                                 str(padding), str(dilation), groups),
+                          lambda: Conv3D(cin, num_filters, filter_size,
+                                         stride=stride, padding=padding,
+                                         dilation=dilation, groups=groups,
+                                         bias_attr=bias_attr))
+    return _apply_act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCHW"):
+    from ..nn.layer.conv import Conv2DTranspose
+    cin = int(input.shape[1])
+    layer = _cached_layer(name, ("conv2dT", cin, num_filters,
+                                 str(filter_size), str(stride),
+                                 str(padding), groups),
+                          lambda: Conv2DTranspose(
+                              cin, num_filters, filter_size,
+                              stride=stride, padding=padding,
+                              groups=groups, bias_attr=bias_attr))
+    return _apply_act(layer(input), act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None,  # noqa: A002
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=1, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None,
+                     data_format="NCDHW"):
+    from ..nn.layer.conv import Conv3DTranspose
+    cin = int(input.shape[1])
+    layer = _cached_layer(name, ("conv3dT", cin, num_filters,
+                                 str(filter_size), str(stride),
+                                 str(padding), groups),
+                          lambda: Conv3DTranspose(
+                              cin, num_filters, filter_size,
+                              stride=stride, padding=padding,
+                              groups=groups, bias_attr=bias_attr))
+    return _apply_act(layer(input), act)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from ..nn.layer.norm import BatchNorm2D, BatchNorm1D, BatchNorm3D
+    c = int(input.shape[1])
+    cls = {2: BatchNorm1D, 3: BatchNorm1D, 4: BatchNorm2D,
+           5: BatchNorm3D}[input.ndim]
+    layer = _cached_layer(name, ("bn", c, input.ndim),
+                          lambda: cls(c, momentum=momentum,
+                                      epsilon=epsilon))
+    layer.training = not is_test
+    return _apply_act(layer(input), act)
+
+
+def inplace_abn(input, act=None, **kwargs):  # noqa: A002
+    # activated batch norm; in-place-ness is an allocator detail the
+    # functional runtime absorbs
+    return batch_norm(input, act=act or "leaky_relu", **kwargs)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None,  # noqa: A002
+                  bias_attr=None, name=None):
+    from ..nn.layer.norm import InstanceNorm2D
+    c = int(input.shape[1])
+    layer = _cached_layer(name, ("in", c),
+                          lambda: InstanceNorm2D(c, epsilon=epsilon))
+    return layer(input)
+
+
+def layer_norm(input, scale=True, shift=True,  # noqa: A002
+               begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, name=None):
+    from ..nn.layer.norm import LayerNorm
+    shape = tuple(int(s) for s in input.shape[begin_norm_axis:])
+    layer = _cached_layer(name, ("ln", shape),
+                          lambda: LayerNorm(list(shape),
+                                            epsilon=epsilon))
+    return _apply_act(layer(input), act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,  # noqa: A002
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    from ..nn.layer.norm import GroupNorm
+    c = int(input.shape[1])
+    layer = _cached_layer(name, ("gn", c, groups),
+                          lambda: GroupNorm(groups, c, epsilon=epsilon))
+    return _apply_act(layer(input), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    from ..nn.layer.norm import SpectralNorm
+    layer = _cached_layer(name, ("sn", tuple(weight.shape), dim),
+                          lambda: SpectralNorm(weight.shape, dim=dim,
+                                               power_iters=power_iters,
+                                               eps=eps))
+    return layer(weight)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    from ..core.tensor import Parameter
+    from ..nn import functional as F
+    import jax.numpy as _jnp
+    n = {"all": 1, "channel": int(x.shape[1]),
+         "element": int(np.prod(x.shape[1:]))}[mode]
+    w = _cached_layer(getattr(param_attr, "name", None) or name,
+                      ("prelu", mode, n),
+                      lambda: Parameter(_jnp.full((n,), 0.25,
+                                                  _jnp.float32)))
+    if mode == "channel":
+        wv = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        wv = w.reshape((1,) + tuple(x.shape[1:]))
+    else:
+        wv = w
+    return _paddle().maximum(x, x * 0.0) + wv * _paddle().minimum(
+        x, x * 0.0)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    from ..core.tensor import Parameter
+    import jax.numpy as _jnp
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    from ..nn import initializer as init_mod
+    w = _cached_layer(name, ("bilinear", dx, dy, size),
+                      lambda: Parameter(init_mod.XavierNormal()(
+                          (size, dx, dy), _jnp.float32)))
+    from ..ops import linalg, manipulation
+    # out[b, k] = x[b] @ W[k] @ y[b]: Wy = [size*dx, dy] @ y^T ->
+    # [size, dx, B] -> [B, size, dx], then row-dot with x
+    wy = linalg.matmul(manipulation.reshape(w, (size * dx, dy)),
+                       manipulation.transpose(y, (1, 0)))
+    wy = manipulation.transpose(
+        manipulation.reshape(wy, (size, dx, -1)), (2, 0, 1))
+    out = linalg.matmul(wy, manipulation.unsqueeze(x, -1))
+    return _apply_act(manipulation.reshape(out, (-1, size)), act)
+
+
+# -- pooling (fluid signatures) ----------------------------------------------
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCHW"):
+    from ..nn import functional as F
+    if global_pooling:
+        return (F.adaptive_max_pool2d(input, 1) if pool_type == "max"
+                else F.adaptive_avg_pool2d(input, 1))
+    if pool_type == "max":
+        return F.max_pool2d(input, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode)
+    return F.avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,  # noqa: A002
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCDHW"):
+    from ..nn import functional as F
+    if global_pooling:
+        return adaptive_pool3d(input, 1, pool_type)
+    if pool_type == "max":
+        return F.max_pool3d(input, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode)
+    return F.avg_pool3d(input, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",  # noqa: A002
+                    require_index=False, name=None):
+    from ..nn import functional as F
+    if pool_type == "max":
+        return F.adaptive_max_pool2d(input, pool_size,
+                                     return_mask=require_index)
+    return F.adaptive_avg_pool2d(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",  # noqa: A002
+                    require_index=False, name=None):
+    from ..nn import functional as F
+    if pool_type == "max":
+        return F.adaptive_max_pool3d(input, pool_size,
+                                     return_mask=require_index)
+    return F.adaptive_avg_pool3d(input, pool_size)
+
+
+# -- misc --------------------------------------------------------------------
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Reference: a persistable int64 counter incremented per call."""
+    key = counter_name or "@STEP_COUNTER@"
+    t = _step_counters.get(key)
+    if t is None:
+        t = _paddle().to_tensor(np.asarray([begin], "int64"))
+        _step_counters[key] = t
+    else:
+        t.value = (t + step).value
+    return t
+
+
+def lod_reset(x, y=None, target_lod=None):
+    from ..core.lod import LoDTensor
+    if isinstance(x, LoDTensor):
+        x.set_lod([target_lod] if target_lod is not None else y.lod())
+        return x
+    return x
+
+
+def lod_append(x, level):
+    return x
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Reference: py_func_op — host-python op. The eager runtime IS
+    python: call through (backward via PyLayer if needed)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def merge_selected_rows(x, name=None):
+    from ..core.sparse_grad import IndexedSlices
+    if isinstance(x, IndexedSlices):
+        return x.coalesce()
+    return x
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    from ..core.sparse_grad import IndexedSlices
+    if isinstance(x, IndexedSlices):
+        return Tensor(x.to_dense())
+    return x
+
+
+def gather_tree(ids, parents):
+    """Beam-search path backtrace (reference: gather_tree_op): ids and
+    parents are [T, B, beam]; returns the full paths."""
+    import numpy as _np
+    idv = _np.asarray(ids.numpy())
+    pv = _np.asarray(parents.numpy())
+    t_max, b, beam = idv.shape
+    out = _np.zeros_like(idv)
+    out[-1] = idv[-1]
+    par = _np.tile(_np.arange(beam)[None, :], (b, 1))
+    for t in range(t_max - 2, -1, -1):
+        par = _np.take_along_axis(pv[t + 1], par, axis=-1)
+        out[t] = _np.take_along_axis(idv[t], par, axis=-1)
+    return _paddle().to_tensor(out)
+
+
+def _fluid_unsupported(name, why):
+    def stub(*a, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            f"fluid.layers.{name}: {why} (see PARITY.md fluid-legacy "
+            "descope list)")
+    stub.__name__ = name
+    return stub
+
+
+# CTR-pipeline / niche kernels intentionally not rebuilt (documented in
+# PARITY.md): each names its modern replacement or rationale.
+im2sequence = _fluid_unsupported(
+    "im2sequence", "use unfold() (im2col) + sequence ops")
+row_conv = _fluid_unsupported(
+    "row_conv", "lookahead conv for streaming ASR; use causal conv1d")
+data_norm = _fluid_unsupported(
+    "data_norm", "CTR summary-stat norm; use batch_norm")
+similarity_focus = _fluid_unsupported(
+    "similarity_focus", "niche attention mask op")
+hash = _fluid_unsupported(  # noqa: A001
+    "hash", "CTR feature hashing; hash ids host-side")
+psroi_pool = _fluid_unsupported(
+    "psroi_pool", "position-sensitive RoI; use roi_align")
+prroi_pool = _fluid_unsupported(
+    "prroi_pool", "precise RoI; use roi_align")
+deformable_roi_pooling = _fluid_unsupported(
+    "deformable_roi_pooling", "use deform_conv2d + roi_align")
+filter_by_instag = _fluid_unsupported(
+    "filter_by_instag", "CTR instance-tag filter; filter host-side")
+continuous_value_model = _fluid_unsupported(
+    "continuous_value_model", "CTR CVM op; preprocess host-side")

@@ -11,6 +11,7 @@ turns a python list of variable-length arrays into that representation at
 the host boundary (the only place raggedness can exist).
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import register_op
@@ -125,3 +126,93 @@ def sequence_reverse(x, lengths):
         arr, src.reshape(src.shape + (1,) * (arr.ndim - 2)).astype(jnp.int32),
         axis=1)
     return Tensor(out)
+
+
+# ---- linear-chain CRF (reference: linear_chain_crf_op.h forward
+# algorithm; crf_decoding_op.h viterbi) -------------------------------------
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(emission, transition, label, lengths):
+    """Negative log-likelihood of label paths under a linear-chain CRF.
+
+    emission: [B, T, C] unary scores; transition: [C+2, C] with row 0 =
+    start weights, row 1 = end weights, rows 2.. = pairwise transitions
+    (the reference layout, linear_chain_crf_op.h:66); label: [B, T]
+    int; lengths: [B] valid steps. Log-domain forward algorithm as a
+    lax.scan over time (TPU-friendly: no data-dependent shapes).
+    Returns per-sequence nll [B, 1].
+    """
+    start_w = transition[0]          # [C]
+    end_w = transition[1]            # [C]
+    trans = transition[2:]           # [C, C] from->to
+    b, t_max, c = emission.shape
+    steps = jnp.arange(t_max)
+    valid = steps[None, :] < lengths[:, None]        # [B, T]
+
+    # ---- log partition: alpha recursion -----------------------------
+    alpha0 = start_w[None, :] + emission[:, 0]       # [B, C]
+
+    def fwd(alpha, t):
+        nxt = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + emission[:, t]
+        keep = valid[:, t][:, None]
+        return jnp.where(keep, nxt, alpha), None
+
+    alpha, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, t_max))
+    log_z = jax.scipy.special.logsumexp(alpha + end_w[None], axis=-1)
+
+    # ---- gold path score --------------------------------------------
+    unary = jnp.take_along_axis(emission, label[..., None],
+                                axis=-1)[..., 0]     # [B, T]
+    unary = jnp.where(valid, unary, 0.0).sum(-1)
+    pair = trans[label[:, :-1], label[:, 1:]]        # [B, T-1]
+    pair = jnp.where(valid[:, 1:], pair, 0.0).sum(-1)
+    last_idx = jnp.clip(lengths - 1, 0, t_max - 1)
+    last_lab = jnp.take_along_axis(label, last_idx[:, None], 1)[:, 0]
+    score = (unary + pair + start_w[label[:, 0]] + end_w[last_lab])
+    return (log_z - score)[:, None]
+
+
+@register_op("crf_decoding", differentiable=False)
+def _crf_decoding(emission, transition, lengths):
+    """Viterbi decode (reference: crf_decoding_op.h): returns the argmax
+    label path [B, T] (entries past each length are 0)."""
+    start_w = transition[0]
+    end_w = transition[1]
+    trans = transition[2:]
+    b, t_max, c = emission.shape
+    steps = jnp.arange(t_max)
+    valid = steps[None, :] < lengths[:, None]
+
+    delta0 = start_w[None, :] + emission[:, 0]
+
+    def fwd(delta, t):
+        cand = delta[:, :, None] + trans[None]       # [B, from, to]
+        best = cand.max(axis=1) + emission[:, t]
+        arg = cand.argmax(axis=1)                    # [B, C]
+        keep = valid[:, t][:, None]
+        return jnp.where(keep, best, delta), \
+            jnp.where(keep, arg, jnp.arange(c)[None, :])
+
+    delta, back = jax.lax.scan(fwd, delta0, jnp.arange(1, t_max))
+    # back: [T-1, B, C] backpointers for steps 1..T-1
+    last = jnp.argmax(delta + end_w[None], axis=-1)  # [B]
+
+    def bwd(lab, bp_t):
+        # bp_t = backpointers INTO step t (xs index i <-> step i+1):
+        # ys[i] = label at step i+1; carry walks to label at step i
+        return bp_t[jnp.arange(b), lab], lab
+
+    lab0, path_tail = jax.lax.scan(bwd, last, back, reverse=True)
+    path = jnp.concatenate([lab0[None], path_tail], axis=0).T  # [B, T]
+    return jnp.where(valid, path, 0)
+
+
+def linear_chain_crf(emission, transition, label, length):
+    """Public fluid-compatible CRF NLL (batched dense form; the
+    reference's LoD form maps via sequence_pad)."""
+    return _linear_chain_crf(emission, transition, label, length)
+
+
+def crf_decoding(emission, transition, length):
+    return _crf_decoding(emission, transition, length)
