@@ -11,6 +11,8 @@ from ..ops import (creation, linalg, manipulation, math as math_ops,
 from ..static import data  # noqa: F401
 
 
+_builtin_range = range  # the fluid `range` layer shadows the builtin below
+
 _layer_cache = {}
 
 
@@ -247,7 +249,7 @@ def _ew(fn, x, y, axis):
     if axis != -1 and hasattr(y, "ndim") and y.ndim < x.ndim:
         # fluid semantics: y's dims align with x starting at `axis`
         from ..ops import manipulation
-        for _ in range(x.ndim - axis - y.ndim):
+        for _ in _builtin_range(x.ndim - axis - y.ndim):
             y = manipulation.unsqueeze(y, -1)
     return fn(x, y)
 
@@ -908,10 +910,10 @@ def ctc_greedy_decoder(input, blank, input_length=None,  # noqa: A002
             if input_length is not None else _np.full(b, t))
     outs = _np.full((b, t), padding_value, _np.int64)
     out_lens = _np.zeros(b, _np.int64)
-    for i in range(b):
+    for i in _builtin_range(b):
         prev = -1
         k = 0
-        for j in range(int(lens[i])):
+        for j in _builtin_range(int(lens[i])):
             tok = int(ids[i, j])
             if tok != blank and tok != prev:
                 outs[i, k] = tok
@@ -968,7 +970,7 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
             if seq_length is not None
             else _np.full(inf.shape[0], inf.shape[1]))
     n_inf = n_lab = n_correct = 0
-    for i in range(inf.shape[0]):
+    for i in _builtin_range(inf.shape[0]):
         ci = _chunks(inf[i, :int(lens[i])])
         cl = _chunks(lab[i, :int(lens[i])])
         n_inf += len(ci)
@@ -1269,7 +1271,7 @@ def gather_tree(ids, parents):
     out = _np.zeros_like(idv)
     out[-1] = idv[-1]
     par = _np.tile(_np.arange(beam)[None, :], (b, 1))
-    for t in range(t_max - 2, -1, -1):
+    for t in _builtin_range(t_max - 2, -1, -1):
         par = _np.take_along_axis(pv[t + 1], par, axis=-1)
         out[t] = _np.take_along_axis(idv[t], par, axis=-1)
     return _paddle().to_tensor(out)
@@ -1307,3 +1309,1001 @@ filter_by_instag = _fluid_unsupported(
     "filter_by_instag", "CTR instance-tag filter; filter host-side")
 continuous_value_model = _fluid_unsupported(
     "continuous_value_model", "CTR CVM op; preprocess host-side")
+
+
+# ---- round-3b: remaining fluid.layers submodule surfaces -------------------
+# tensor.py / control_flow.py / loss.py / sequence_lod.py / detection.py /
+# rnn.py / metric_op.py (reference fluid/layers/*). Aliases keep fluid
+# signatures; LoD-taking sequence ops accept the repo's LoDTensor
+# (core/lod.py) or (x, lengths) pairs.
+
+# -- tensor.py ---------------------------------------------------------------
+
+def create_tensor(dtype, name=None, persistable=False):
+    return _paddle().to_tensor(np.zeros((0,), dtype))
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..core.tensor import Parameter
+    from ..nn import initializer as init_mod
+    import jax.numpy as _jnp
+    init = default_initializer or (
+        init_mod.Constant(0.0) if is_bias else init_mod.XavierNormal())
+    key = _reuse_key(name, ("create_parameter", tuple(shape), dtype))
+    p = _layer_cache.get(key)
+    if p is None:
+        p = Parameter(init(tuple(int(s) for s in shape),
+                           _jnp.dtype(dtype)))
+        _layer_cache[key] = p
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    key = _reuse_key(name, ("global_var", tuple(shape), float(value)))
+    t = _layer_cache.get(key)
+    if t is None:
+        t = _paddle().full(shape, value, dtype)
+        t.persistable = persistable
+        _layer_cache[key] = t
+    return t
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):  # noqa: A002
+    from ..ops import manipulation
+    out = (manipulation.stack(list(input), axis=axis) if use_stack
+           else manipulation.concat(list(input), axis=axis))
+    sizes = _paddle().to_tensor(np.asarray(
+        [int(t.shape[axis]) if not use_stack else 1 for t in input],
+        "int32"))
+    return out, sizes
+
+
+def sums(input, out=None):  # noqa: A002
+    res = sum(list(input))
+    if out is not None:
+        out.value = res.value
+        return out
+    return res
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,  # noqa: A002
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return _paddle().full(shape, value, dtype)
+
+
+def argmin(x, axis=0):
+    return _paddle().argmin(x, axis=axis)
+
+
+def argmax(x, axis=0):
+    return _paddle().argmax(x, axis=axis)
+
+
+def argsort(input, axis=-1, descending=False, name=None):  # noqa: A002
+    """fluid returns (sorted_values, indices) — in that order."""
+    return (_paddle().sort(input, axis=axis, descending=descending),
+            _paddle().argsort(input, axis=axis, descending=descending))
+
+
+def reverse(x, axis):
+    return _paddle().flip(x, axis)
+
+
+def has_inf(x):
+    return _paddle().any(_paddle().isinf(x))
+
+
+def has_nan(x):
+    return _paddle().any(_paddle().isnan(x))
+
+
+def isfinite(x):
+    """fluid semantics: ONE bool — are ALL elements finite."""
+    return _paddle().all(_paddle().isfinite(x))
+
+
+def range(start, end, step, dtype, name=None):  # noqa: A001
+    return _paddle().arange(start, end, step, dtype)
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return _paddle().linspace(start, stop, num, dtype)
+
+
+def zeros_like(x, out=None):
+    res = _paddle().zeros_like(x)
+    if out is not None:
+        out.value = res.value
+        return out
+    return res
+
+
+def ones_like(x, out=None):
+    res = _paddle().ones_like(x)
+    if out is not None:
+        out.value = res.value
+        return out
+    return res
+
+
+def diag(diagonal):
+    return _paddle().diag(diagonal)
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32",
+        name=None):
+    out = _paddle().eye(num_rows, num_columns, dtype=dtype)
+    if batch_shape:
+        for _ in batch_shape:
+            out = out.unsqueeze(0)
+        out = _paddle().tile(out, list(batch_shape) + [1, 1])
+    return out
+
+
+def triu(input, diagonal=0, name=None):  # noqa: A002
+    return _paddle().triu(input, diagonal)
+
+
+# -- control_flow.py ---------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    from ..static import nn as static_nn
+    return static_nn.cond(pred, true_fn, false_fn)
+
+
+def while_loop(cond_fn, body, loop_vars, is_test=False, name=None):
+    from ..static import nn as static_nn
+    return static_nn.while_loop(cond_fn, body, loop_vars)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    from ..static import nn as static_nn
+    return static_nn.case(pred_fn_pairs, default)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    from ..static import nn as static_nn
+    return static_nn.switch_case(branch_index, branch_fns, default)
+
+
+def increment(x, value=1.0, in_place=True):
+    out = x + value
+    if in_place:
+        x.value = out.value
+        return x
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):  # noqa: A002
+    return _binop_cond(_paddle().less_than(x, y), cond)
+
+
+def less_equal(x, y, cond=None):  # noqa: A002
+    return _binop_cond(_paddle().less_equal(x, y), cond)
+
+
+def greater_than(x, y, cond=None):  # noqa: A002
+    return _binop_cond(_paddle().greater_than(x, y), cond)
+
+
+def greater_equal(x, y, cond=None):  # noqa: A002
+    return _binop_cond(_paddle().greater_equal(x, y), cond)
+
+
+def equal(x, y, cond=None):  # noqa: A002
+    return _binop_cond(_paddle().equal(x, y), cond)
+
+
+def not_equal(x, y, cond=None):  # noqa: A002
+    return _binop_cond(_paddle().not_equal(x, y), cond)
+
+
+def _binop_cond(res, cond):
+    if cond is not None:
+        cond.value = res.value
+        return cond
+    return res
+
+
+def create_array(dtype):
+    return []
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    idx = int(i.numpy()) if hasattr(i, "numpy") else int(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[int(i.numpy()) if hasattr(i, "numpy") else int(i)]
+
+
+def array_length(array):
+    return _paddle().to_tensor(np.asarray([len(array)], "int64"))
+
+
+def is_empty(x, name=None):
+    return _paddle().to_tensor(np.asarray(
+        int(np.prod(x.shape)) == 0))
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    vals = np.asarray(input.numpy()).reshape(-1)
+    if summarize is not None and summarize >= 0:
+        vals = vals[:summarize]
+    print(f"{message or 'Print'}: shape={list(input.shape)} "
+          f"values={vals}")
+    return input
+
+
+def Assert(cond, data=None, summarize=20, name=None):  # noqa: A002
+    if not bool(np.all(np.asarray(cond.numpy()))):
+        raise AssertionError(
+            f"fluid.layers.Assert failed"
+            + ("" if data is None else
+               f": {[np.asarray(d.numpy()) for d in data]}"))
+    return cond
+
+
+def _program_construct(name):
+    def stub(*a, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            f"fluid.layers.{name}: fluid-1.x program-construct class; "
+            "write python control flow (dy2static) or use "
+            "static.nn.cond/while_loop")
+    stub.__name__ = name
+    return stub
+
+
+While = _program_construct("While")
+Switch = _program_construct("Switch")
+IfElse = _program_construct("IfElse")
+DynamicRNN = _program_construct("DynamicRNN")
+StaticRNN = _program_construct("StaticRNN")
+reorder_lod_tensor_by_rank = _program_construct(
+    "reorder_lod_tensor_by_rank")
+
+
+# -- loss.py -----------------------------------------------------------------
+
+def square_error_cost(input, label):  # noqa: A002
+    from ..nn import functional as F
+    return F.square_error_cost(input, label)
+
+
+def mse_loss(input, label):  # noqa: A002
+    from ..nn import functional as F
+    return F.mse_loss(input, label)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    from ..nn import functional as F
+    return F.kl_div(x, target, reduction=reduction)
+
+
+def huber_loss(input, label, delta):  # noqa: A002
+    diff = _paddle().abs(input - label)
+    quad = 0.5 * diff * diff
+    lin = delta * diff - 0.5 * delta * delta
+    return _paddle().where(diff <= delta, quad, lin)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,
+                                      name=None, normalize=False):
+    from ..nn import functional as F
+    loss = F.binary_cross_entropy_with_logits(x, label,
+                                              reduction="none")
+    mask = (label != float(ignore_index)).astype(x.dtype)
+    loss = loss * mask
+    if normalize:
+        loss = loss / _paddle().maximum(
+            mask.sum(), _paddle().to_tensor(1.0))
+    return loss
+
+
+def rank_loss(label, left, right, name=None):
+    """Reference rank_loss_op: cross entropy of P(left>right) =
+    sigmoid(left-right) against the label:
+    loss = log(1 + exp(d)) - label * d, d = left - right."""
+    d = left - right
+    # log(1+exp(d)) computed stably as softplus
+    from ..nn import functional as F
+    return F.softplus(d) - label * d
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    act = _paddle().maximum(
+        -label * (left - right) + margin,
+        _paddle().zeros_like(label))
+    return act
+
+
+from ..core.dispatch import register_op as _register_op2
+
+
+@_register_op2("bpr_loss")
+def _bpr_loss_op(logits, label):
+    import jax.numpy as _jnp
+    lv = label.reshape(-1)
+    pos = _jnp.take_along_axis(logits, lv[:, None], axis=-1)
+    diff = pos - logits
+    n = logits.shape[-1]
+    loss = _jnp.logaddexp(0.0, -diff)      # -log sigmoid(diff), stable
+    mask = 1.0 - _jnp.eye(n, dtype=logits.dtype)[lv]
+    return (loss * mask).sum(-1, keepdims=True) / (n - 1)
+
+
+def bpr_loss(input, label, name=None):  # noqa: A002
+    """Bayesian personalized ranking (reference bpr_loss_op): mean over
+    negatives of -log sigmoid(pos_logit - neg_logit); differentiable."""
+    return _bpr_loss_op(input, label)
+
+
+def hsigmoid(input, label, num_classes, param_attr=None,  # noqa: A002
+             bias_attr=None, name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    from ..nn import functional as F
+    from ..core.tensor import Parameter
+    import jax.numpy as _jnp
+    from ..nn import initializer as init_mod
+    d = int(input.shape[-1])
+    key = _reuse_key(name, ("hsigmoid", d, num_classes))
+    pw = _layer_cache.get(key)
+    if pw is None:
+        pw = (Parameter(init_mod.XavierNormal()(
+            (num_classes - 1, d), _jnp.float32)),
+            Parameter(_jnp.zeros((num_classes - 1,), _jnp.float32)))
+        _layer_cache[key] = pw
+    return F.hsigmoid_loss(input, label, num_classes, pw[0], pw[1],
+                           path_table=path_table, path_code=path_code)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,  # noqa: A002
+            input_length=None, label_length=None):
+    from ..nn import functional as F
+    return F.ctc_loss(input, label, input_length, label_length,
+                      blank=blank, reduction="none")
+
+
+def edit_distance(input, label, normalized=True,  # noqa: A002
+                  ignored_tokens=None, input_length=None,
+                  label_length=None):
+    """Levenshtein distance per pair (reference edit_distance_op) —
+    host-side DP (metric, no gradient)."""
+    a = np.asarray(input.numpy())
+    b = np.asarray(label.numpy())
+    la = (np.asarray(input_length.numpy()).reshape(-1)
+          if input_length is not None else np.full(a.shape[0], a.shape[1]))
+    lb = (np.asarray(label_length.numpy()).reshape(-1)
+          if label_length is not None else np.full(b.shape[0], b.shape[1]))
+    outs = np.zeros((a.shape[0], 1), np.float32)
+    for i in _builtin_range(a.shape[0]):
+        s1 = [t for t in a[i, :int(la[i])]
+              if not ignored_tokens or t not in ignored_tokens]
+        s2 = [t for t in b[i, :int(lb[i])]
+              if not ignored_tokens or t not in ignored_tokens]
+        m, n = len(s1), len(s2)
+        dp = np.zeros((m + 1, n + 1), np.int64)
+        dp[:, 0] = np.arange(m + 1)
+        dp[0, :] = np.arange(n + 1)
+        for x_ in _builtin_range(1, m + 1):
+            for y_ in _builtin_range(1, n + 1):
+                dp[x_, y_] = min(dp[x_ - 1, y_] + 1, dp[x_, y_ - 1] + 1,
+                                 dp[x_ - 1, y_ - 1]
+                                 + (s1[x_ - 1] != s2[y_ - 1]))
+        d = float(dp[m, n])
+        outs[i, 0] = d / max(n, 1) if normalized else d
+    return (_paddle().to_tensor(outs),
+            _paddle().to_tensor(np.asarray([a.shape[0]], "int64")))
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,  # noqa: A002
+                update_center=True):
+    """Reference center_loss_op: 0.5*||x - c_y||^2 per sample; centers
+    are a non-gradient buffer updated by the class-mean residual rule
+    (grads flow to the input only, as in the reference kernel)."""
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    d = int(input.shape[-1])
+    key = ("center_loss_centers", num_classes, d)
+    centers = _layer_cache.get(key)
+    if centers is None:
+        centers = Tensor(_jnp.zeros((num_classes, d), _jnp.float32),
+                         stop_gradient=True)
+        _layer_cache[key] = centers
+    lv = _lazy.concrete(label.value if isinstance(label, Tensor)
+                        else _jnp.asarray(label)).reshape(-1)
+    cv = _lazy.concrete(centers.value)
+    sel = Tensor(cv[lv])                       # constant wrt autograd
+    diff = input - sel
+    if update_center:
+        dv = _lazy.concrete(diff.value)
+        upd = _jnp.zeros_like(cv).at[lv].add(dv)
+        cnt = _jnp.zeros((num_classes, 1)).at[lv].add(1.0) + 1.0
+        centers.value = cv + alpha * upd / cnt
+    return (0.5 * diff * diff).sum(axis=-1, keepdim=True)
+
+
+_loss_unsupported_names = ("nce", "sampled_softmax_with_cross_entropy",
+                           "teacher_student_sigmoid_loss")
+nce = _fluid_unsupported(
+    "nce", "negative sampling trains fine as full softmax on TPU (MXU); "
+    "use softmax_with_cross_entropy")
+sampled_softmax_with_cross_entropy = _fluid_unsupported(
+    "sampled_softmax_with_cross_entropy",
+    "use full softmax_with_cross_entropy (TPU MXU makes it cheap)")
+teacher_student_sigmoid_loss = _fluid_unsupported(
+    "teacher_student_sigmoid_loss",
+    "CTR distillation loss; compose from sigmoid + log ops")
+
+
+# -- sequence_lod.py ---------------------------------------------------------
+# The repo carries ragged data as LoDTensor (dense + offsets,
+# core/lod.py) or (padded, lengths) pairs (ops/sequence.py). Wrappers
+# accept LoDTensor like the reference's LoD ops.
+
+def _as_padded(x):
+    """LoDTensor -> (padded [B, T, ...], lengths); padded Tensor passes
+    through with full lengths."""
+    from ..core.lod import LoDTensor
+    if isinstance(x, LoDTensor):
+        padded, lengths = x.to_padded()
+        return padded, lengths
+    lens = _paddle().full([int(x.shape[0])], int(x.shape[1]), "int64")
+    return x, lens
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    from ..ops import sequence as seq_ops
+    from ..core.lod import LoDTensor
+    if isinstance(x, LoDTensor):
+        padded, lengths = x.to_padded(pad_value=float(
+            pad_value if not hasattr(pad_value, "numpy")
+            else pad_value.numpy()))
+        return padded, lengths
+    return seq_ops.sequence_pad(x, pad_value=pad_value, maxlen=maxlen)
+
+
+def sequence_unpad(x, length, name=None):
+    from ..ops import sequence as seq_ops
+    return seq_ops.sequence_unpad(x, length)
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):  # noqa: A002
+    from ..ops import sequence as seq_ops
+    padded, lengths = _as_padded(input)
+    return seq_ops.sequence_pool(padded, lengths,
+                                 pool_type=pool_type.upper())
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):  # noqa: A002
+    from ..ops import sequence as seq_ops
+    padded, lengths = _as_padded(input)
+    return seq_ops.sequence_softmax(padded, lengths)
+
+
+def sequence_first_step(input):  # noqa: A002
+    padded, lengths = _as_padded(input)
+    return padded[:, 0]
+
+
+def sequence_last_step(input):  # noqa: A002
+    from ..ops import manipulation
+    padded, lengths = _as_padded(input)
+    idx = (lengths - 1).unsqueeze(-1)
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    pv = _lazy.concrete(padded.value)
+    lv = _lazy.concrete(idx.value).reshape(-1)
+    return Tensor(pv[_jnp.arange(pv.shape[0]), lv])
+
+
+def sequence_reverse(x, name=None):
+    from ..ops import sequence as seq_ops
+    padded, lengths = _as_padded(x)
+    return seq_ops.sequence_reverse(padded, lengths)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    from ..ops import sequence as seq_ops
+    _, y_lens = _as_padded(y)
+    return seq_ops.sequence_expand(x, y_lens)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_concat(input, name=None):  # noqa: A002
+    from ..ops import manipulation
+    return manipulation.concat(list(input), axis=1)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> [B, maxlen] 0/1 mask (reference sequence_mask_op);
+    delegates to the functional implementation."""
+    from ..nn import functional as F
+    return F.sequence_mask(x, maxlen=maxlen, dtype=dtype)
+
+
+def sequence_reshape(input, new_dim):  # noqa: A002
+    from ..ops import manipulation
+    return manipulation.reshape(input, (int(input.shape[0]), -1,
+                                        int(new_dim)))
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+    """Sliding windows of ids (reference sequence_enumerate_op)."""
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    v = _lazy.concrete(input.value if isinstance(input, Tensor)
+                       else _jnp.asarray(input))
+    b, t = v.shape[0], v.shape[1]
+    cols = []
+    for w in _builtin_range(win_size):
+        shifted = _jnp.concatenate(
+            [v[:, int(w):],
+             _jnp.full((b, int(w)), pad_value, v.dtype)], axis=1)
+        cols.append(shifted)
+    return Tensor(_jnp.stack(cols, axis=-1))
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    v = _lazy.concrete(input.value)
+    off = _lazy.concrete(offset.value
+                         if hasattr(offset, "value")
+                         else _jnp.asarray(offset)).reshape(-1)
+    ln = _lazy.concrete(length.value if hasattr(length, "value")
+                        else _jnp.asarray(length)).reshape(-1)
+    out = np.zeros((v.shape[0], int(ln.max())) + v.shape[2:],
+                   np.asarray(v).dtype)
+    vn = np.asarray(v)
+    for i in _builtin_range(v.shape[0]):
+        out[i, :int(ln[i])] = vn[i, int(off[i]):int(off[i]) + int(ln[i])]
+    return Tensor(out), Tensor(np.asarray(ln, "int64"))
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    return _paddle().scatter(input, index, updates, overwrite=False)
+
+
+def sequence_conv(input, num_filters, filter_size=3,  # noqa: A002
+                  filter_stride=1, padding=True, padding_start=None,
+                  bias_attr=None, param_attr=None, act=None, name=None):
+    """Context-window conv over time (reference sequence_conv_op) —
+    conv1d over the padded representation."""
+    from ..nn.layer.conv import Conv1D
+    padded, lengths = _as_padded(input)
+    d = int(padded.shape[-1])
+    layer = _cached_layer(name, ("seq_conv", d, num_filters,
+                                 filter_size),
+                          lambda: Conv1D(d, num_filters, filter_size,
+                                         padding=(filter_size - 1) // 2
+                                         if padding else 0,
+                                         bias_attr=bias_attr))
+    from ..ops import manipulation
+    x = manipulation.transpose(padded, (0, 2, 1))   # [B, D, T]
+    out = layer(x)
+    return _apply_act(manipulation.transpose(out, (0, 2, 1)), act)
+
+
+# -- detection.py ------------------------------------------------------------
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """IoU matrix [N, M] (reference iou_similarity_op)."""
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    a = _lazy.concrete(x.value if isinstance(x, Tensor)
+                       else _jnp.asarray(x))
+    b = _lazy.concrete(y.value if isinstance(y, Tensor)
+                       else _jnp.asarray(y))
+    off = 0.0 if box_normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = _jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = _jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = _jnp.clip(rb - lt + off, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor(inter / (area_a[:, None] + area_b[None, :] - inter))
+
+
+def box_clip(input, im_info, name=None):  # noqa: A002
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    boxes = _lazy.concrete(input.value)
+    info = _lazy.concrete(im_info.value)
+    h = info[0, 0] / info[0, 2] - 1.0
+    w = info[0, 1] / info[0, 2] - 1.0
+    out = _jnp.stack([
+        _jnp.clip(boxes[..., 0], 0, w), _jnp.clip(boxes[..., 1], 0, h),
+        _jnp.clip(boxes[..., 2], 0, w), _jnp.clip(boxes[..., 3], 0, h),
+    ], axis=-1)
+    return Tensor(out)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None, axis=0):
+    """Encode/decode boxes against priors (reference box_coder_op)."""
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    pb = _lazy.concrete(prior_box.value)
+    pbv = (_lazy.concrete(prior_box_var.value)
+           if hasattr(prior_box_var, "value")
+           else _jnp.asarray(prior_box_var, _jnp.float32))
+    tb = _lazy.concrete(target_box.value)
+    off = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + off
+    ph = pb[:, 3] - pb[:, 1] + off
+    px = (pb[:, 2] + pb[:, 0]) / 2
+    py = (pb[:, 3] + pb[:, 1]) / 2
+    if pbv.ndim == 1:
+        pbv = _jnp.broadcast_to(pbv[None, :], (pb.shape[0], 4))
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + off
+        th = tb[:, 3] - tb[:, 1] + off
+        tx = (tb[:, 2] + tb[:, 0]) / 2
+        ty = (tb[:, 3] + tb[:, 1]) / 2
+        out = _jnp.stack([
+            (tx[:, None] - px[None, :]) / pw[None, :],
+            (ty[:, None] - py[None, :]) / ph[None, :],
+            _jnp.log(tw[:, None] / pw[None, :]),
+            _jnp.log(th[:, None] / ph[None, :]),
+        ], axis=-1) / pbv[None, :, :]
+        return Tensor(out)
+    # decode_center_size: target [N, M, 4] deltas against priors
+    if axis == 0:
+        pwv, phv, pxv, pyv = (pw[None, :, None], ph[None, :, None],
+                              px[None, :], py[None, :])
+    else:
+        pwv, phv, pxv, pyv = (pw[:, None, None], ph[:, None, None],
+                              px[:, None], py[:, None])
+    if pbv.ndim == 2:
+        d = tb * (pbv[None, :, :] if axis == 0 else pbv[:, None, :])
+    else:
+        d = tb
+    dx, dy, dw, dh = d[..., 0], d[..., 1], d[..., 2], d[..., 3]
+    cx = dx * pwv[..., 0] + pxv
+    cy = dy * phv[..., 0] + pyv
+    w = _jnp.exp(dw) * pwv[..., 0]
+    h = _jnp.exp(dh) * phv[..., 0]
+    out = _jnp.stack([cx - w / 2 + off / 2, cy - h / 2 + off / 2,
+                      cx + w / 2 - off / 2, cy + h / 2 - off / 2],
+                     axis=-1)
+    return Tensor(out)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    from ..nn import functional as F
+    from ..ops import math as math_ops
+    num = _paddle().cast(fg_num, "float32")
+    oh = one_hot(label, int(x.shape[-1]) + 1)
+    target = oh[:, 1:] if oh.shape[-1] == int(x.shape[-1]) + 1 else oh
+    loss = F.sigmoid_focal_loss(x, target, reduction="none",
+                                gamma=gamma, alpha=alpha)
+    return math_ops.divide(loss, _paddle().maximum(
+        num, _paddle().to_tensor(1.0)))
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    from ..vision.ops import yolo_loss as _yl
+    return _yl(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+               ignore_thresh, downsample_ratio, gt_score=gt_score,
+               use_label_smooth=use_label_smooth, scale_x_y=scale_x_y)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None,
+             scale_x_y=1.0):
+    from ..vision.ops import yolo_box as _yb
+    return _yb(x, img_size, anchors, class_num, conf_thresh,
+               downsample_ratio, clip_bbox=clip_bbox,
+               scale_x_y=scale_x_y)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                   keep_top_k, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Per-class NMS + cross-class top-k (reference multiclass_nms_op);
+    host-side composition over vision.ops.nms."""
+    import jax.numpy as _jnp
+    from ..core import lazy as _lazy
+    from ..vision.ops import nms as _nms
+    bv = np.asarray(_lazy.concrete(bboxes.value))
+    sv = np.asarray(_lazy.concrete(scores.value))
+    outs = []
+    n, c = sv.shape[0], sv.shape[1]
+    for b in _builtin_range(n):
+        dets = []
+        for cls in _builtin_range(c):
+            if cls == background_label:
+                continue
+            sc = sv[b, cls]
+            keep = sc > score_threshold
+            if not keep.any():
+                continue
+            boxes_c = bv[b][keep] if bv.ndim == 3 else bv[keep]
+            sc = sc[keep]
+            order = np.argsort(-sc)[:nms_top_k]
+            kept = _nms(_paddle().to_tensor(boxes_c[order]),
+                        iou_threshold=nms_threshold)
+            kept = np.asarray(kept.numpy())
+            for k in kept:
+                dets.append([float(cls), float(sc[order][k])]
+                            + [float(v) for v in boxes_c[order][k]])
+        dets.sort(key=lambda r: -r[1])
+        outs.append(np.asarray(dets[:keep_top_k], np.float32)
+                    .reshape(-1, 6))
+    flat = np.concatenate(outs, 0) if outs else np.zeros((0, 6),
+                                                         np.float32)
+    lens = np.asarray([len(o) for o in outs], "int64")
+    return _paddle().to_tensor(flat), _paddle().to_tensor(lens)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              name=None, min_max_aspect_ratios_order=False):
+    """SSD prior boxes over the feature-map grid (reference
+    prior_box_op); deterministic host-side construction."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    sw = steps[0] or iw / fw   # reference order: (step_w, step_h)
+    sh = steps[1] or ih / fh
+    ars = []
+    for ar in aspect_ratios:
+        ars.append(ar)
+        if flip and ar != 1.0:
+            ars.append(1.0 / ar)
+    per = []
+    for ms in min_sizes:
+        per.append((ms, ms))
+        for ar in ars:
+            if ar == 1.0:
+                continue
+            per.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+    if max_sizes:
+        for ms, mx in zip(min_sizes, max_sizes):
+            per.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    k = len(per)
+    out = np.zeros((fh, fw, k, 4), np.float32)
+    for i in _builtin_range(fh):
+        for j in _builtin_range(fw):
+            cx = (j + offset) * sw
+            cy = (i + offset) * sh
+            for p, (bw, bh) in enumerate(per):
+                out[i, j, p] = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                                (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return _paddle().to_tensor(out), _paddle().to_tensor(var)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,  # noqa: A002
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None,
+                     offset=0.5, name=None):
+    """RPN anchors over the grid (reference anchor_generator_op)."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    sw, sh = stride              # reference order: [stride_w, stride_h]
+    per = []
+    for size in anchor_sizes:
+        area = float(size) * float(size)
+        for ar in aspect_ratios:
+            w = np.sqrt(area / ar)
+            h = w * ar
+            per.append((w, h))
+    out = np.zeros((fh, fw, len(per), 4), np.float32)
+    for i in _builtin_range(fh):
+        for j in _builtin_range(fw):
+            cx = (j + offset) * sw
+            cy = (i + offset) * sh
+            for p, (w, h) in enumerate(per):
+                out[i, j, p] = [cx - w / 2, cy - h / 2,
+                                cx + w / 2, cy + h / 2]
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return _paddle().to_tensor(out), _paddle().to_tensor(var)
+
+
+_det_pipeline = (
+    "legacy detection-pipeline kernel; modern pipelines compose these "
+    "host-side (PaddleDetection-style python)")
+density_prior_box = _fluid_unsupported("density_prior_box", _det_pipeline)
+multi_box_head = _fluid_unsupported("multi_box_head", _det_pipeline)
+bipartite_match = _fluid_unsupported("bipartite_match", _det_pipeline)
+target_assign = _fluid_unsupported("target_assign", _det_pipeline)
+detection_output = _fluid_unsupported("detection_output", _det_pipeline)
+ssd_loss = _fluid_unsupported("ssd_loss", _det_pipeline)
+rpn_target_assign = _fluid_unsupported("rpn_target_assign",
+                                       _det_pipeline)
+retinanet_target_assign = _fluid_unsupported("retinanet_target_assign",
+                                             _det_pipeline)
+roi_perspective_transform = _fluid_unsupported(
+    "roi_perspective_transform", _det_pipeline)
+generate_proposal_labels = _fluid_unsupported(
+    "generate_proposal_labels", _det_pipeline)
+generate_proposals = _fluid_unsupported("generate_proposals",
+                                        _det_pipeline)
+generate_mask_labels = _fluid_unsupported("generate_mask_labels",
+                                          _det_pipeline)
+polygon_box_transform = _fluid_unsupported("polygon_box_transform",
+                                           _det_pipeline)
+locality_aware_nms = _fluid_unsupported("locality_aware_nms",
+                                        _det_pipeline)
+matrix_nms = _fluid_unsupported("matrix_nms", _det_pipeline)
+retinanet_detection_output = _fluid_unsupported(
+    "retinanet_detection_output", _det_pipeline)
+
+
+# -- rnn.py ------------------------------------------------------------------
+
+def _nn():
+    import paddle_tpu.nn as _n
+    return _n
+
+
+from ..nn.layer.rnn import RNNCellBase as RNNCell  # noqa: N812
+# (a real base class: fluid user code subclasses fluid.layers.RNNCell)
+
+
+def GRUCell(hidden_size, *a, **k):  # noqa: N802
+    return _nn().GRUCell(hidden_size, hidden_size)
+
+
+def LSTMCell(hidden_size, *a, **k):  # noqa: N802
+    return _nn().LSTMCell(hidden_size, hidden_size)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    from ..ops import manipulation
+    x = manipulation.transpose(inputs, (1, 0, 2)) if time_major \
+        else inputs
+    if is_reverse:
+        x = _paddle().flip(x, axis=[1])
+    layer = _nn().RNN(cell)
+    out, state = layer(x, initial_states)
+    if is_reverse:
+        out = _paddle().flip(out, axis=[1])
+    if time_major:
+        out = manipulation.transpose(out, (1, 0, 2))
+    return out, state
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    layer = _nn().BiRNN(cell_fw, cell_bw)
+    return layer(inputs, initial_states)
+
+
+class Decoder:
+    """Abstract decode contract (reference fluid/layers/rnn.py Decoder):
+    subclass and implement initialize/step/finalize, drive with
+    dynamic_decode."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+def BeamSearchDecoder(*a, **k):  # noqa: N802
+    return _nn().BeamSearchDecoder(*a, **k)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    return _nn().dynamic_decode(decoder, inits=inits,
+                                max_step_num=max_step_num, **kwargs)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,  # noqa: A002
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    d = int(input.shape[-1])
+    layer = _cached_layer(name, ("lstm", d, hidden_size, num_layers,
+                                 is_bidirec),
+                          lambda: _nn().LSTM(
+                              d, hidden_size, num_layers=num_layers,
+                              direction="bidirect" if is_bidirec
+                              else "forward"))
+    out, (h, c) = layer(input, (init_h, init_c))
+    return out, h, c
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,  # noqa: A002
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False):
+    d = int(input.shape[-1])
+    layer = _cached_layer(None, ("dyn_gru", d, size),
+                          lambda: _nn().GRU(d, size))
+    x = _paddle().flip(input, axis=[1]) if is_reverse else input
+    out, _ = layer(x, h_0.unsqueeze(0) if h_0 is not None else None)
+    return _paddle().flip(out, axis=[1]) if is_reverse else out
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,  # noqa: A002
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    d = int(input.shape[-1])
+    cell = _cached_layer(None, ("gru_unit", d, size),
+                         lambda: _nn().GRUCell(d, size // 3))
+    h = cell(input, hidden)[1]
+    return h, h, h
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    d = int(x_t.shape[-1])
+    hd = int(hidden_t_prev.shape[-1])
+    cell = _cached_layer(name, ("lstm_unit", d, hd),
+                         lambda: _nn().LSTMCell(d, hd))
+    _, (h, c) = cell(x_t, (hidden_t_prev, cell_t_prev))
+    return h, c
+
+
+dynamic_lstm = _fluid_unsupported(
+    "dynamic_lstm", "use fluid.layers.lstm or paddle.nn.LSTM")
+dynamic_lstmp = _fluid_unsupported(
+    "dynamic_lstmp", "projection LSTM; use paddle.nn.LSTM with proj_size")
+beam_search = _fluid_unsupported(
+    "beam_search", "stepwise beam op; use BeamSearchDecoder + "
+    "dynamic_decode")
+beam_search_decode = _fluid_unsupported(
+    "beam_search_decode", "use gather_tree on dynamic_decode outputs")
+DecodeHelper = _program_construct("DecodeHelper")
+TrainingHelper = _program_construct("TrainingHelper")
+GreedyEmbeddingHelper = _program_construct("GreedyEmbeddingHelper")
+SampleEmbeddingHelper = _program_construct("SampleEmbeddingHelper")
+BasicDecoder = _program_construct("BasicDecoder")
+
+
+# -- metric_op.py ------------------------------------------------------------
+
+def auc(input, label, curve="ROC", num_thresholds=4095,  # noqa: A002
+        topk=1, slide_steps=1):
+    """Streaming-free AUC over this batch (reference auc_op reduced:
+    single-shot; use paddle.metric.Auc for streaming)."""
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input.numpy()), np.asarray(label.numpy()))
+    val = m.accumulate()
+    T = _paddle().to_tensor
+    return (T(np.float32(val)), T(np.float32(val)),
+            [T(np.zeros(1, np.int64))] * 4)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    from ..nn import functional as F
+    return F.npair_loss(anchor, positive, labels, l2_reg=l2_reg)
+
+
+distribute_fpn_proposals = _fluid_unsupported(
+    "distribute_fpn_proposals", _det_pipeline)
+collect_fpn_proposals = _fluid_unsupported(
+    "collect_fpn_proposals", _det_pipeline)
+box_decoder_and_assign = _fluid_unsupported(
+    "box_decoder_and_assign", _det_pipeline)

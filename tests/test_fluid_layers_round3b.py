@@ -1,0 +1,267 @@
+"""fluid.layers submodule surfaces beyond nn.py (reference:
+fluid/layers/{tensor,control_flow,loss,sequence_lod,detection,rnn,
+metric_op}.py — now name-complete; audited here). Numerics checks for
+the newly implemented families."""
+import ast
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import layers
+
+
+def setup_function(_):
+    layers.clear_layer_cache()
+
+
+def test_all_submodules_name_complete():
+    have = set(dir(layers))
+    missing = []
+    for mod in ("nn", "tensor", "control_flow", "loss", "sequence_lod",
+                "detection", "metric_op", "rnn"):
+        path = f"/root/reference/python/paddle/fluid/layers/{mod}.py"
+        if not os.path.exists(path):
+            continue
+        names = []
+        tree = ast.parse(open(path).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", "") == "__all__":
+                        try:
+                            names = ast.literal_eval(node.value)
+                        except Exception:
+                            pass
+        missing += [f"{mod}.{n}" for n in names if n not in have]
+    assert not missing, missing
+
+
+class TestTensorLayer:
+    def test_creation_and_comparisons(self):
+        t = layers.fill_constant_batch_size_like(
+            paddle.to_tensor(np.zeros((5, 2), np.float32)),
+            [-1, 3], "float32", 7.0)
+        assert t.numpy().shape == (5, 3) and float(t.numpy()[0, 0]) == 7.0
+        a = paddle.to_tensor(np.asarray([1.0, 5.0], np.float32))
+        b = paddle.to_tensor(np.asarray([2.0, 2.0], np.float32))
+        assert list(layers.less_than(a, b).numpy()) == [True, False]
+        assert bool(layers.isfinite(a).numpy())
+        assert not bool(layers.isfinite(
+            paddle.to_tensor(np.asarray([np.inf], np.float32))).numpy())
+        vals, idx = layers.argsort(
+            paddle.to_tensor(np.asarray([3.0, 1.0, 2.0], np.float32)))
+        assert list(idx.numpy()) == [1, 2, 0]
+
+    def test_create_parameter_reuse(self):
+        p1 = layers.create_parameter([3, 4], "float32", name="w0")
+        p2 = layers.create_parameter([3, 4], "float32", name="w0")
+        assert p1 is p2
+
+
+class TestControlFlow:
+    def test_increment_and_arrays(self):
+        c = paddle.to_tensor(np.asarray([0.0], np.float32))
+        layers.increment(c)
+        layers.increment(c)
+        assert float(c.numpy()[0]) == 2.0
+        arr = layers.create_array("float32")
+        i = paddle.to_tensor(np.asarray([0], "int64"))
+        layers.array_write(c, i, arr)
+        got = layers.array_read(arr, i)
+        assert float(got.numpy()[0]) == 2.0
+        assert int(layers.array_length(arr).numpy()[0]) == 1
+
+
+class TestLosses:
+    def test_huber_matches_manual(self):
+        x = paddle.to_tensor(np.asarray([0.0, 2.0], np.float32))
+        y = paddle.to_tensor(np.asarray([0.5, 0.0], np.float32))
+        out = layers.huber_loss(x, y, delta=1.0).numpy()
+        np.testing.assert_allclose(out, [0.125, 1.5], rtol=1e-6)
+
+    def test_rank_loss_gradient_and_value(self):
+        label = paddle.to_tensor(np.asarray([[1.0]], np.float32))
+        left = paddle.to_tensor(np.asarray([[2.0]], np.float32))
+        right = paddle.to_tensor(np.asarray([[0.0]], np.float32))
+        out = layers.rank_loss(label, left, right)
+        want = np.log1p(np.exp(2.0)) - 2.0
+        np.testing.assert_allclose(float(out.numpy()), want, rtol=1e-5)
+
+    def test_bpr_loss_prefers_correct_item(self):
+        logits = paddle.to_tensor(
+            np.asarray([[4.0, 0.0, 0.0]], np.float32))
+        good = layers.bpr_loss(logits,
+                               paddle.to_tensor(np.asarray([[0]], "int64")))
+        bad = layers.bpr_loss(logits,
+                              paddle.to_tensor(np.asarray([[1]], "int64")))
+        assert good.numpy().item() < bad.numpy().item()
+
+    def test_edit_distance(self):
+        a = paddle.to_tensor(np.asarray([[1, 2, 3, 0]], "int64"))
+        b = paddle.to_tensor(np.asarray([[1, 3, 3, 0]], "int64"))
+        la = paddle.to_tensor(np.asarray([3], "int64"))
+        lb = paddle.to_tensor(np.asarray([3], "int64"))
+        d, n = layers.edit_distance(a, b, normalized=False,
+                                    input_length=la, label_length=lb)
+        assert float(d.numpy()[0, 0]) == 1.0
+
+    def test_center_loss_moves_centers_and_grads_input(self):
+        x = paddle.to_tensor(np.ones((4, 3), np.float32))
+        x.stop_gradient = False
+        lab = paddle.to_tensor(np.zeros((4,), "int64"))
+        loss = layers.center_loss(x, lab, num_classes=2, alpha=0.5)
+        loss.sum().backward()
+        assert x.grad is not None
+        centers = layers._layer_cache[("center_loss_centers", 2, 3)]
+        assert float(np.abs(centers.numpy()).sum()) > 0  # moved
+
+
+class TestSequenceLod:
+    def test_mask_pool_steps(self):
+        lens = paddle.to_tensor(np.asarray([2, 3], "int64"))
+        m = layers.sequence_mask(lens, maxlen=4)
+        np.testing.assert_array_equal(
+            m.numpy(), [[1, 1, 0, 0], [1, 1, 1, 0]])
+        x = paddle.to_tensor(
+            np.arange(24, dtype=np.float32).reshape(2, 4, 3))
+        first = layers.sequence_first_step(x)
+        np.testing.assert_allclose(first.numpy(), x.numpy()[:, 0])
+        from paddle_tpu.core.lod import create_lod_tensor
+        lt = create_lod_tensor(np.arange(10, dtype=np.float32)
+                               .reshape(5, 2), [[2, 3]])
+        pooled = layers.sequence_pool(lt, "sum")
+        np.testing.assert_allclose(pooled.numpy()[0],
+                                   [0 + 2, 1 + 3])
+
+    def test_sequence_enumerate(self):
+        x = paddle.to_tensor(np.asarray([[1, 2, 3]], "int64"))
+        out = layers.sequence_enumerate(x, 2, pad_value=0).numpy()
+        np.testing.assert_array_equal(out[0],
+                                      [[1, 2], [2, 3], [3, 0]])
+
+
+class TestDetection:
+    def test_iou_similarity(self):
+        a = paddle.to_tensor(np.asarray([[0, 0, 2, 2]], np.float32))
+        b = paddle.to_tensor(np.asarray(
+            [[0, 0, 2, 2], [1, 1, 3, 3], [4, 4, 5, 5]], np.float32))
+        iou = layers.iou_similarity(a, b).numpy()
+        np.testing.assert_allclose(iou[0], [1.0, 1.0 / 7.0, 0.0],
+                                   rtol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        priors = paddle.to_tensor(np.asarray(
+            [[0, 0, 2, 2], [1, 1, 4, 5]], np.float32))
+        var = paddle.to_tensor(np.asarray([0.1, 0.1, 0.2, 0.2],
+                                          np.float32))
+        targets = paddle.to_tensor(np.asarray(
+            [[0.5, 0.5, 2.5, 3.0]], np.float32))
+        enc = layers.box_coder(priors, var, targets,
+                               code_type="encode_center_size")
+        dec = layers.box_coder(priors, var, enc,
+                               code_type="decode_center_size", axis=0)
+        np.testing.assert_allclose(
+            dec.numpy()[0, 0], targets.numpy()[0], rtol=1e-4, atol=1e-4)
+
+    def test_prior_box_grid(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, var = layers.prior_box(feat, img, min_sizes=[16.0],
+                                      aspect_ratios=[1.0, 2.0],
+                                      clip=True)
+        assert boxes.numpy().shape == (2, 2, 2, 4)
+        assert (boxes.numpy() >= 0).all() and (boxes.numpy() <= 1).all()
+
+    def test_multiclass_nms_shapes(self):
+        boxes = paddle.to_tensor(np.asarray(
+            [[[0, 0, 1, 1], [0, 0, 1.01, 1.01], [3, 3, 4, 4]]],
+            np.float32))
+        scores = paddle.to_tensor(np.asarray(
+            [[[0.0, 0.0, 0.0], [0.9, 0.85, 0.1], [0.0, 0.0, 0.8]]],
+            np.float32)).transpose((0, 2, 1))
+        out, lens = layers.multiclass_nms(boxes, scores,
+                                          score_threshold=0.5,
+                                          nms_top_k=10, keep_top_k=10,
+                                          background_label=-1)
+        # overlapping boxes suppressed per class; two survivors expected
+        assert int(lens.numpy()[0]) >= 2
+        assert out.numpy().shape[1] == 6
+
+
+class TestRNNSurface:
+    def test_lstm_and_units(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 5, 8).astype("float32"))
+        h0 = paddle.zeros([1, 2, 16])
+        c0 = paddle.zeros([1, 2, 16])
+        out, h, c = layers.lstm(x, h0, c0, max_len=5, hidden_size=16,
+                                num_layers=1)
+        assert out.numpy().shape == (2, 5, 16)
+        ht, ct = layers.lstm_unit(
+            paddle.to_tensor(np.ones((2, 8), np.float32)),
+            paddle.zeros([2, 16]), paddle.zeros([2, 16]))
+        assert ht.numpy().shape == (2, 16)
+
+    def test_rnn_functional(self):
+        paddle.seed(0)
+        import paddle_tpu.nn as nn
+        cell = nn.SimpleRNNCell(4, 6)
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(3, 7, 4).astype("float32"))
+        out, state = layers.rnn(cell, x)
+        assert out.numpy().shape == (3, 7, 6)
+
+
+def test_auc_single_shot():
+    scores = paddle.to_tensor(np.asarray(
+        [[0.2, 0.8], [0.9, 0.1], [0.3, 0.7], [0.6, 0.4]], np.float32))
+    labels = paddle.to_tensor(np.asarray([[1], [0], [1], [0]], "int64"))
+    val, _, _ = layers.auc(scores, labels)
+    assert float(val.numpy()) == 1.0  # perfectly separable
+
+
+def test_argsort_returns_values_then_indices():
+    vals, idx = layers.argsort(
+        paddle.to_tensor(np.asarray([3.0, 1.0, 2.0], np.float32)))
+    assert list(vals.numpy()) == [1.0, 2.0, 3.0]
+    assert list(idx.numpy()) == [1, 2, 0]
+
+
+def test_rnncell_and_decoder_are_subclassable():
+    class MyCell(layers.RNNCell):
+        pass
+
+    class MyDecoder(layers.Decoder):
+        pass
+
+    assert issubclass(MyCell, layers.RNNCell)
+
+
+def test_prior_box_rectangular_steps():
+    feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+    img = paddle.to_tensor(np.zeros((1, 3, 32, 64), np.float32))
+    boxes, _ = layers.prior_box(feat, img, min_sizes=[8.0],
+                                steps=(32.0, 16.0), offset=0.5)
+    b = boxes.numpy()[0, 0, 0]     # first cell center: (16, 8) px
+    cx = (b[0] + b[2]) / 2 * 64    # denormalize by image width
+    cy = (b[1] + b[3]) / 2 * 32
+    np.testing.assert_allclose([cx, cy], [16.0, 8.0], atol=1e-4)
+
+
+def test_box_coder_decode_axis1():
+    priors = paddle.to_tensor(np.asarray(
+        [[0, 0, 2, 2], [1, 1, 4, 5]], np.float32))
+    var = np.asarray([[0.1, 0.1, 0.2, 0.2],
+                      [0.1, 0.1, 0.2, 0.2]], np.float32)
+    deltas = paddle.to_tensor(
+        np.zeros((2, 3, 4), np.float32))   # [N_prior, M, 4]
+    dec = layers.box_coder(priors, paddle.to_tensor(var), deltas,
+                           code_type="decode_center_size", axis=1)
+    # zero deltas decode back to the priors, broadcast along axis 1
+    np.testing.assert_allclose(dec.numpy()[0, 0], [0, 0, 2, 2],
+                               atol=1e-5)
+    np.testing.assert_allclose(dec.numpy()[1, 2], [1, 1, 4, 5],
+                               atol=1e-5)
