@@ -2307,3 +2307,107 @@ collect_fpn_proposals = _fluid_unsupported(
     "collect_fpn_proposals", _det_pipeline)
 box_decoder_and_assign = _fluid_unsupported(
     "box_decoder_and_assign", _det_pipeline)
+
+
+# -- learning_rate_scheduler.py ---------------------------------------------
+# fluid's decay functions return the CURRENT lr value given the global
+# step counter (autoincreased_step_counter); modern code uses
+# optimizer.lr schedulers — these forward to the same math.
+
+def _global_step():
+    t = _step_counters.get("@LR_DECAY_COUNTER@")
+    if t is None:
+        t = _paddle().to_tensor(np.asarray([0], "int64"))
+        _step_counters["@LR_DECAY_COUNTER@"] = t
+    return t
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _paddle().cast(_global_step(), "float32")
+    exp = step / decay_steps
+    if staircase:
+        exp = _paddle().floor(exp)
+    return learning_rate * (decay_rate ** exp)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _paddle().cast(_global_step(), "float32")
+    exp = step / decay_steps
+    if staircase:
+        exp = _paddle().floor(exp)
+    return learning_rate * _paddle().exp(-1.0 * decay_rate * exp)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _paddle().cast(_global_step(), "float32")
+    frac = step / decay_steps
+    if staircase:
+        frac = _paddle().floor(frac)
+    return learning_rate / (1.0 + decay_rate * frac)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    step = _paddle().cast(_global_step(), "float32")
+    if cycle:
+        div = _paddle().ceil(_paddle().maximum(
+            step / decay_steps, _paddle().to_tensor(1.0)))
+        decay = decay_steps * div
+    else:
+        decay = float(decay_steps)
+        step = _paddle().minimum(step, _paddle().to_tensor(decay))
+    return ((learning_rate - end_learning_rate)
+            * ((1.0 - step / decay) ** power)) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    step = int(_global_step().numpy()[0])
+    for b, v in zip(boundaries, values):
+        if step < b:
+            return _paddle().to_tensor(np.float32(v))
+    return _paddle().to_tensor(np.float32(values[len(boundaries)]))
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    step = _paddle().cast(_global_step(), "float32") + 1.0
+    return (learning_rate * (d_model ** -0.5)
+            * _paddle().minimum(step ** -0.5,
+                                step * (warmup_steps ** -1.5)))
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _paddle().cast(_global_step(), "float32")
+    epoch = _paddle().floor(step / step_each_epoch)
+    return learning_rate * 0.5 * (
+        _paddle().cos(epoch * float(np.pi) / epochs) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _paddle().cast(_global_step(), "float32")
+    warm = start_lr + (end_lr - start_lr) * step / warmup_steps
+    base = learning_rate if not hasattr(learning_rate, "numpy") \
+        else learning_rate
+    cond = step < float(warmup_steps)
+    return _paddle().where(cond, warm * _paddle().ones_like(step),
+                           base * _paddle().ones_like(step))
+
+
+# -- io.py / distributions re-exports ---------------------------------------
+
+def load(out, file_path, load_as_fp16=None):
+    v = _paddle().load(file_path)
+    out.value = (v.value if hasattr(v, "value")
+                 else _paddle().to_tensor(v).value)
+    return out
+
+
+read_file = _program_construct("read_file")
+double_buffer = _program_construct("double_buffer")
+py_reader = _program_construct("py_reader")
+create_py_reader_by_data = _program_construct("create_py_reader_by_data")
+
+from ..distribution import (  # noqa: E402,F401
+    Uniform, Normal, Categorical, MultivariateNormalDiag)

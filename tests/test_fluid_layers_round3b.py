@@ -20,7 +20,9 @@ def test_all_submodules_name_complete():
     have = set(dir(layers))
     missing = []
     for mod in ("nn", "tensor", "control_flow", "loss", "sequence_lod",
-                "detection", "metric_op", "rnn"):
+                "detection", "metric_op", "rnn",
+                "learning_rate_scheduler", "io", "device", "collective",
+                "distributions"):
         path = f"/root/reference/python/paddle/fluid/layers/{mod}.py"
         if not os.path.exists(path):
             continue
@@ -265,3 +267,38 @@ def test_box_coder_decode_axis1():
                                atol=1e-5)
     np.testing.assert_allclose(dec.numpy()[1, 2], [1, 1, 4, 5],
                                atol=1e-5)
+
+
+class TestLRSchedulers:
+    def test_decay_math(self):
+        layers._step_counters.clear()
+        # step 0
+        lr = layers.exponential_decay(0.1, 10, 0.5)
+        np.testing.assert_allclose(float(lr.numpy()), 0.1, rtol=1e-6)
+        layers._step_counters["@LR_DECAY_COUNTER@"].value = \
+            paddle.to_tensor(np.asarray([10], "int64")).value
+        np.testing.assert_allclose(
+            float(layers.exponential_decay(0.1, 10, 0.5).numpy()),
+            0.05, rtol=1e-6)
+        np.testing.assert_allclose(
+            float(layers.inverse_time_decay(0.1, 10, 1.0).numpy()),
+            0.05, rtol=1e-6)
+        np.testing.assert_allclose(
+            float(layers.piecewise_decay([5, 20], [0.1, 0.01, 0.001])
+                  .numpy()), 0.01, rtol=1e-6)
+        noam = float(layers.noam_decay(512, 4000).numpy())
+        want = 512 ** -0.5 * min(11 ** -0.5, 11 * 4000 ** -1.5)
+        np.testing.assert_allclose(noam, want, rtol=1e-5)
+        layers._step_counters.clear()
+
+    def test_warmup_switches(self):
+        layers._step_counters.clear()
+        lr = layers.linear_lr_warmup(0.1, warmup_steps=100,
+                                     start_lr=0.0, end_lr=0.1)
+        np.testing.assert_allclose(float(lr.numpy()), 0.0, atol=1e-7)
+        layers._step_counters["@LR_DECAY_COUNTER@"].value = \
+            paddle.to_tensor(np.asarray([200], "int64")).value
+        np.testing.assert_allclose(
+            float(layers.linear_lr_warmup(0.1, 100, 0.0, 0.1).numpy()),
+            0.1, rtol=1e-6)
+        layers._step_counters.clear()
