@@ -208,9 +208,12 @@ def linear(x, weight, bias=None, name=None):
 
 @register_op("conv2d")
 def _conv2d(x, w, b, *, strides, paddings, dilations, groups, data_format):
+    # the layer stores weights OIHW for BOTH data formats (conv.py
+    # _ConvNd); only the feature layout changes with data_format
     dn = jax.lax.conv_dimension_numbers(
         x.shape, w.shape,
-        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"))
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW"
+        else ("NHWC", "OIHW", "NHWC"))
     if isinstance(paddings, str):
         pad = paddings  # SAME / VALID
     else:
@@ -277,8 +280,10 @@ def _conv3d(x, w, b, *, strides, paddings, dilations, groups):
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
     pad = padding.upper() if isinstance(padding, str) else _pair(padding, 3)
-    return _conv3d(x, weight, bias, strides=_pair(stride, 3), paddings=pad,
-                   dilations=_pair(dilation, 3), groups=int(groups))
+    x = _to_ncdhw(x, data_format)   # NDHWC handled by transposition
+    out = _conv3d(x, weight, bias, strides=_pair(stride, 3), paddings=pad,
+                  dilations=_pair(dilation, 3), groups=int(groups))
+    return _from_ncdhw(out, data_format)
 
 
 @register_op("conv2d_transpose")

@@ -1,0 +1,107 @@
+"""True int8 inference execution (reference analogue: slim
+quantization_pass INT8 kernel conversion). W8A8 linears accumulate in
+int32 on the int8 MXU path; convs run weight-only int8."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import convert_to_int8, Int8Linear
+
+
+class TestInt8Inference:
+    def _model(self):
+        paddle.seed(3)
+        return nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(8 * 8 * 8, 32), nn.ReLU(),
+            nn.Linear(32, 10))
+
+    def test_accuracy_close_to_fp32(self):
+        m = self._model()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 3, 8, 8).astype("float32"))
+        ref = m(x).numpy()
+        convert_to_int8(m)
+        q = m(x).numpy()
+        # top-1 agreement and bounded error
+        assert (ref.argmax(1) == q.argmax(1)).all()
+        rel = np.abs(ref - q).max() / (np.abs(ref).max() + 1e-6)
+        assert rel < 0.1, rel
+
+    def test_weights_are_int8(self):
+        m = self._model()
+        convert_to_int8(m)
+        int8_layers = [s for s in m._sub_layers.values()
+                       if isinstance(s, Int8Linear)]
+        assert len(int8_layers) == 2
+        for layer in int8_layers:
+            assert str(layer.w_q.numpy().dtype) == "int8"
+
+    def test_int32_accumulation_path(self):
+        # the op really runs int8 x int8 -> int32 (not a dequant matmul):
+        # saturating inputs at +-127 keeps products exact in int32
+        lin = nn.Linear(4, 2)
+        lin.weight.set_value(np.full((4, 2), 1.0, np.float32))
+        lin.bias.set_value(np.zeros(2, np.float32))
+        q = Int8Linear(lin)
+        out = q(paddle.to_tensor(np.full((1, 4), 2.0, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [[8.0, 8.0]], rtol=1e-3)
+
+    def test_state_dict_contains_quantized_weights(self):
+        m = self._model()
+        convert_to_int8(m)
+        sd = m.state_dict()
+        assert any("w_q" in k for k in sd), list(sd)[:8]
+
+    def test_converts_qat_wrapped_model(self):
+        from paddle_tpu.quantization import ImperativeQuantAware
+        m = self._model()
+        ImperativeQuantAware().quantize(m)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(2, 3, 8, 8).astype("float32"))
+        m(x)                       # calibrate observers once
+        convert_to_int8(m)
+        int8_layers = [s for s in m._sub_layers.values()
+                       if isinstance(s, Int8Linear)]
+        assert len(int8_layers) == 2   # QAT wrappers were converted
+        out = m(x)
+        assert np.isfinite(out.numpy()).all()
+
+    def test_nhwc_conv_preserved(self):
+        conv = nn.Conv2D(3, 4, 3, padding=1, data_format="NHWC")
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(1, 8, 8, 3).astype("float32"))
+        ref = conv(x).numpy()
+        from paddle_tpu.quantization import Int8Conv2D
+        q = Int8Conv2D(conv)
+        got = q(x).numpy()
+        assert got.shape == ref.shape
+        assert np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6) < 0.1
+
+
+class TestConvDataFormatParity:
+    def test_nhwc_conv2d_matches_nchw(self):
+        """Pre-r3 regression: NHWC declared HWIO weights while the layer
+        stores OIHW — silently broken shapes."""
+        paddle.seed(0)
+        a = nn.Conv2D(3, 4, 3, padding=1)
+        b = nn.Conv2D(3, 4, 3, padding=1, data_format="NHWC")
+        b.weight.set_value(a.weight.numpy())
+        b.bias.set_value(a.bias.numpy())
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype("float32")
+        ref = a(paddle.to_tensor(x)).numpy()
+        out = b(paddle.to_tensor(x.transpose(0, 2, 3, 1))).numpy()
+        np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_ndhwc_conv3d_matches_ncdhw(self):
+        import paddle_tpu.nn.functional as F
+        rs = np.random.RandomState(1)
+        x = rs.randn(1, 3, 4, 4, 4).astype("float32")
+        w = rs.randn(4, 3, 2, 2, 2).astype("float32")
+        ref = F.conv3d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        out = F.conv3d(paddle.to_tensor(x.transpose(0, 2, 3, 4, 1)),
+                       paddle.to_tensor(w),
+                       data_format="NDHWC").numpy()
+        np.testing.assert_allclose(out.transpose(0, 4, 1, 2, 3), ref,
+                                   rtol=1e-4, atol=1e-5)
