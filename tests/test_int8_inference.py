@@ -105,3 +105,26 @@ class TestConvDataFormatParity:
                        data_format="NDHWC").numpy()
         np.testing.assert_allclose(out.transpose(0, 4, 1, 2, 3), ref,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestInt8Deployment:
+    def test_jit_save_load_and_predictor(self, tmp_path):
+        """int8-converted models export through jit.save and serve via
+        the inference Predictor with no special casing (the int8 ops are
+        ordinary registered ops in the traced program)."""
+        paddle.seed(0)
+        m = nn.Sequential(nn.Flatten(), nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 4))
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 16).astype("float32"))
+        convert_to_int8(m)
+        q = m(x).numpy()
+        path = str(tmp_path / "int8_model")
+        paddle.jit.save(m, path, input_spec=[
+            paddle.static.InputSpec([None, 16], "float32")])
+        loaded = paddle.jit.load(path)
+        np.testing.assert_allclose(loaded(x).numpy(), q, rtol=1e-4)
+        from paddle_tpu.inference import Config, create_predictor
+        pred = create_predictor(Config(path + ".pdmodel"))
+        outs = pred.run([np.asarray(x.numpy())])
+        np.testing.assert_allclose(outs[0], q, rtol=1e-4)
