@@ -79,8 +79,29 @@ def _latest_artifact():
     return None
 
 
+_attempt_log = []  # (utc ts, detail) records for the wedge report
+
+
+def _write_wedge_report(err):
+    """Persist the failure evidence to bench_artifacts/ so a wedged run
+    leaves an auditable trail in git (timestamps of every attempt), not
+    just a 0.0 in the driver's JSON."""
+    try:
+        path = os.path.join(
+            _ARTIFACT_DIR,
+            "wedge_report_" + time.strftime("%Y%m%dT%H%M%SZ",
+                                            time.gmtime()) + ".json")
+        with open(path, "w") as fh:
+            json.dump({"error": err, "attempts": _attempt_log}, fh,
+                      indent=1)
+        return os.path.basename(path)
+    except Exception:
+        return None
+
+
 def _emit_fallback(err):
     """Emit the cached measurement with provenance, or a diagnostic 0."""
+    report = _write_wedge_report(err)
     cached = _latest_artifact()
     if cached is not None:
         art, fname = cached
@@ -93,12 +114,16 @@ def _emit_fallback(err):
             "measured_at": art.get("timestamp"),
             "artifact": f"bench_artifacts/{fname}",
             "error": f"live measurement failed this run: {err}",
+            "evidence": (f"bench_artifacts/{report}" if report
+                         else None),
         })
     else:
         _emit({
             "metric": _METRIC, "value": 0.0, "unit": "samples/sec",
             "vs_baseline": 0.0,
             "error": f"{err} (and no cached artifact available)",
+            "evidence": (f"bench_artifacts/{report}" if report
+                         else None),
         })
 
 
@@ -323,6 +348,8 @@ def main():
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         print(f"# [{now}] attempt {attempt} failed: {last_err}",
               file=sys.stderr)
+        _attempt_log.append({"ts": now, "attempt": attempt,
+                             "error": last_err})
         sleep_s = backoff[min(attempt - 1, len(backoff) - 1)]
         sleep_s = min(sleep_s, max(0.0, t_end - time.time() - 120))
         if sleep_s > 0:
