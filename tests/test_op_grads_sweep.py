@@ -17,6 +17,16 @@ import paddle_tpu.nn.functional as F
 from grad_check import numeric_grad as _numeric_grad
 
 
+@pytest.fixture(autouse=True, params=["lazy", "immediate"])
+def _both_engines(request):
+    """Every grad check runs through BOTH eager executors (deferred
+    micro-graph and per-op immediate) — their vjps must agree."""
+    prev = paddle.get_flags(["FLAGS_lazy_eager"])["FLAGS_lazy_eager"]
+    paddle.set_flags({"FLAGS_lazy_eager": request.param == "lazy"})
+    yield
+    paddle.set_flags({"FLAGS_lazy_eager": prev})
+
+
 def _check(fn, x_np, rtol=2e-2, atol=2e-3):
     """Analytic grad of sum(fn(x)) via engine backward vs central diff."""
     def scalar(x):
@@ -94,6 +104,50 @@ UNARY_CASES = {
     "huber_smooth_l1": (
         lambda t: F.smooth_l1_loss(t, paddle.to_tensor(
             np.zeros((3, 4), np.float32))), _X),
+    # round-3 extension: more activations / math / manipulation
+    "tanhshrink": (lambda t: F.tanhshrink(t), _X),
+    "hardtanh": (lambda t: F.hardtanh(t, -5.0, 5.0), _X),
+    "celu": (lambda t: F.celu(t), _X),
+    "mish": (lambda t: F.mish(t), _X),
+    "log1p": (lambda t: t.log1p(), _POS),
+    "expm1": (lambda t: t.expm1(), _X),
+    "sinh": (lambda t: t.sinh(), _UNIT),
+    "cosh": (lambda t: t.cosh(), _UNIT),
+    "tan": (lambda t: t.tan(), _UNIT),
+    "acos": (lambda t: t.acos(), _UNIT),
+    "prod_axis": (lambda t: t.prod(axis=1), _POS),
+    "amax_distinct": (lambda t: t.max(axis=1), _X),
+    "roll": (lambda t: t.roll(1, axis=1), _X),
+    "index_select": (
+        lambda t: paddle.index_select(
+            t, paddle.to_tensor(np.asarray([2, 0], "int64")), axis=0),
+        _X),
+    "broadcast_to": (lambda t: t.unsqueeze(0).expand((2, 3, 4)), _X),
+    "kron_like_outer": (
+        lambda t: t.reshape((12, 1)).matmul(t.reshape((1, 12))), _X),
+    "logcumsumexp_like": (
+        lambda t: t.cumsum(axis=1).exp().log(), _UNIT),
+    "avg_pool1d": (
+        lambda t: F.avg_pool1d(t.reshape((3, 1, 4)), 2), _X),
+    "trilinear_interp": (
+        lambda t: F.interpolate(t.reshape((1, 1, 3, 2, 2)),
+                                size=(6, 4, 4), mode="trilinear"), _X),
+    "group_norm_fn": (
+        lambda t: F.group_norm(t.reshape((1, 4, 3, 1)), 2,
+                               epsilon=1e-5), _X),
+    "bce_with_logits": (
+        lambda t: F.binary_cross_entropy_with_logits(
+            t, paddle.to_tensor(
+                (np.abs(_X) > 1.0).astype(np.float32))), _X),
+    "kl_div_logtarget": (
+        lambda t: F.kl_div(F.log_softmax(t, axis=-1), paddle.to_tensor(
+            np.full((3, 4), 0.25, np.float32))), _X),
+    "margin_ranking": (
+        lambda t: F.margin_ranking_loss(
+            t, paddle.to_tensor(_POS.astype(np.float32)),
+            paddle.to_tensor(np.sign(_X - _POS).astype(np.float32)),
+            margin=0.1), _X),
+    "logsigmoid": (lambda t: F.log_sigmoid(t), _X),
 }
 
 
