@@ -302,3 +302,43 @@ class TestLRSchedulers:
             float(layers.linear_lr_warmup(0.1, 100, 0.0, 0.1).numpy()),
             0.1, rtol=1e-6)
         layers._step_counters.clear()
+
+
+def test_fluid_net_under_to_static():
+    """fluid-style imperative code (implicit params via call-site reuse)
+    compiles through to_static: losses decrease continuously across the
+    eager -> record -> compiled transitions."""
+    paddle.seed(0)
+    layers.clear_layer_cache()
+    x_np = np.random.RandomState(0).randn(8, 3, 8, 8).astype("float32")
+    y_np = np.random.RandomState(0).randint(0, 4, (8,)).astype("int64")
+
+    def net(x):
+        h = layers.conv2d(x, 8, 3, padding=1, act="relu", name="c1")
+        h = layers.pool2d(h, 2, "max", 2)
+        h = layers.flatten(h, axis=1)
+        return layers.fc(h, 4, name="out")
+
+    state = {"opt": None}
+
+    def step(x, y):
+        logits = net(x)
+        loss = layers.reduce_mean(
+            layers.softmax_with_cross_entropy(logits, y.unsqueeze(-1)))
+        if state["opt"] is None:
+            params = []
+            for item in layers._layer_cache.values():
+                params.extend(item.parameters()
+                              if hasattr(item, "parameters") else [item])
+            state["opt"] = paddle.optimizer.Adam(5e-3, parameters=params)
+        loss.backward()
+        state["opt"].step()
+        state["opt"].clear_grad()
+        return loss
+
+    compiled = paddle.jit.to_static(step)
+    losses = [float(compiled(paddle.to_tensor(x_np),
+                             paddle.to_tensor(y_np)).numpy())
+              for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert all(b < a for a, b in zip(losses, losses[1:])), losses

@@ -98,3 +98,30 @@ class TestSSDTableOverPS:
         finally:
             client.close()
             server.stop()
+
+
+class TestConcurrentPushes:
+    def test_threaded_pushes_are_not_lost(self, tmp_path):
+        """The PS server is threaded: concurrent pushes to one SSD table
+        (including evictions mid-push) must all land (lock coverage)."""
+        import threading
+        t = SSDSparseTable(dim=1, lr=1.0, cache_rows=8,
+                           path=str(tmp_path))
+        ids = np.arange(64)
+        t.pull(ids)                       # init rows (spills most)
+        before = t.pull(ids).copy()
+        n_threads, pushes_each = 4, 25
+
+        def worker():
+            for _ in range(pushes_each):
+                t.push(ids, np.ones((64, 1), np.float32))
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        after = t.pull(ids)
+        np.testing.assert_allclose(
+            after, before - n_threads * pushes_each, rtol=1e-5)
