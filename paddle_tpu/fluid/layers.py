@@ -46,15 +46,14 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     """Reference: fluid/layers/nn.py fc — creates (or reuses, see
     _reuse_key) a Linear over the flattened trailing dims."""
     from ..nn.layer.common import Linear
-    in_features = int(np.prod(input.shape[num_flatten_dims:]))
+    from ..ops.nn_ops import fc_flatten
+    x, in_features = fc_flatten(input, num_flatten_dims)
     key = _reuse_key(name, ("fc", in_features, size))
     layer = _layer_cache.get(key)
     if layer is None:
         layer = Linear(in_features, size, weight_attr=param_attr,
                        bias_attr=bias_attr)
         _layer_cache[key] = layer
-    x = manipulation.reshape(input, list(input.shape[:num_flatten_dims])
-                             + [in_features])
     out = layer(x)
     if act is not None:
         out = _apply_act(out, act)

@@ -200,6 +200,34 @@ def _linear(x, w, b):
     return out
 
 
+def fc_flatten(x, num_flatten_dims):
+    """Shared fc input normalization (reference paddle.static.nn.fc /
+    fluid.layers.fc): trailing dims from num_flatten_dims flatten into
+    the feature axis. Validates 1 <= num_flatten_dims <= rank-1 and
+    demands concrete non-batch leading dims (one -1 covers the batch).
+    Returns (flattened_x, in_features)."""
+    rank = len(x.shape)
+    if not 1 <= num_flatten_dims <= rank - 1:
+        raise ValueError(
+            f"fc: num_flatten_dims must be in [1, {rank - 1}] for a "
+            f"rank-{rank} input, got {num_flatten_dims}")
+    trailing = [int(s) for s in x.shape[num_flatten_dims:]]
+    if any(d < 0 for d in trailing):
+        raise ValueError(
+            "fc: trailing (feature) dims must be concrete, got "
+            f"{tuple(x.shape)}")
+    in_dim = int(np.prod(trailing))
+    if rank == num_flatten_dims + 1:
+        return x, in_dim
+    lead = [int(s) for s in x.shape[1:num_flatten_dims]]
+    if any(d < 0 for d in lead):
+        raise ValueError(
+            "fc: leading dims beyond the batch must be concrete when "
+            f"num_flatten_dims > 1, got {tuple(x.shape)}")
+    from . import manipulation
+    return manipulation.reshape(x, (-1, *lead, in_dim)), in_dim
+
+
 def linear(x, weight, bias=None, name=None):
     """Reference: python/paddle/nn/functional/common.py:1398 (weight is
     [in_features, out_features], NOT transposed — paddle convention)."""

@@ -128,8 +128,9 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
     program only (so separate Programs never share weights)."""
     from ..nn.layer.common import Linear
     from ..ops import nn_ops as _F
+    from ..ops.nn_ops import fc_flatten
     from .program import building_program
-    in_dim = int(x.shape[-1])
+    x, in_dim = fc_flatten(x, num_flatten_dims)
     prog = building_program()
     cache = prog._layer_cache if prog is not None else {}
     key = ("fc", name, in_dim, int(size)) if name is not None else None
@@ -146,3 +147,45 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
             raise ValueError(f"unknown activation {activation!r}")
         out = act(out)
     return out
+
+
+# ---- fluid-layer forwards (reference: paddle/static/nn/__init__.py
+# __all__ — the static op-assembly API IS the fluid.layers surface).
+# Lazily resolved via PEP 562 to avoid a circular import (fluid.layers
+# imports static.data at module load).
+
+_FLUID_FORWARDS = (
+    "batch_norm", "embedding", "bilinear_tensor_product", "conv2d",
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "crf_decoding",
+    "data_norm", "group_norm", "instance_norm",
+    "layer_norm", "multi_box_head", "nce", "prelu", "py_func",
+    "row_conv", "spectral_norm", "sequence_conv", "sequence_softmax",
+    "sequence_pool", "sequence_concat", "sequence_first_step",
+    "sequence_last_step", "sequence_slice", "sequence_expand",
+    "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+    "sequence_reverse",
+)
+
+
+def __getattr__(name):
+    if name in _FLUID_FORWARDS:
+        from ..fluid import layers as _fl
+        return getattr(_fl, name)
+    if name == "deform_conv2d":
+        from ..fluid import layers as _fl
+        return _fl.deformable_conv
+    if name == "sparse_embedding":
+        from ..fluid import layers as _fl
+
+        def sparse_embedding(input, size, **kw):  # noqa: A002
+            kw.setdefault("is_sparse", True)
+            return _fl.embedding(input, size, **kw)
+        return sparse_embedding
+    raise AttributeError(f"module 'paddle.static.nn' has no attribute "
+                         f"{name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_FLUID_FORWARDS)
+                  | {"sparse_embedding", "deform_conv2d"})

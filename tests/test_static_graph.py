@@ -299,3 +299,33 @@ def test_static_amp_autocast_records(static_mode):
     assert str(hv.dtype) == "bfloat16"
     l2 = exe.run(prog, feed={"x": xb}, fetch_list=[loss])[0]
     assert float(l2) < float(l1)  # still trains under bf16
+
+
+def test_static_nn_fc_flattens_conv_output():
+    """fc's reference contract: weight [prod(shape[nfd:]), size] — conv
+    feature maps flatten into the fc (was silently per-position)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [None, 3, 8, 8], "float32")
+            h = paddle.static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+            out = paddle.static.nn.fc(h, 2)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        (o,) = exe.run(main,
+                       feed={"x": np.ones((5, 3, 8, 8), np.float32)},
+                       fetch_list=[out])
+        assert o.shape == (5, 2), o.shape
+    finally:
+        paddle.disable_static()
+
+
+def test_static_nn_fluid_forwards_resolve():
+    import paddle_tpu as paddle
+    for n in ("batch_norm", "conv2d", "sequence_pool", "crf_decoding",
+              "sparse_embedding", "deform_conv2d"):
+        assert callable(getattr(paddle.static.nn, n)), n
