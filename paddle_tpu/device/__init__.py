@@ -14,41 +14,6 @@ def get_available_device():
     return [f"tpu:{i}" for i in range(device_count())]
 
 
-class cuda:  # namespace shim for paddle.device.cuda users
-    @staticmethod
-    def device_count():
-        return 0
-
-    @staticmethod
-    def synchronize(device=None):
-        import jax
-        (jax.device_put(0) + 0).block_until_ready()
-
-    # memory-query API (reference: python/paddle/device/cuda/__init__.py
-    # max_memory_allocated etc., backed by allocator_facade.cc stats) —
-    # forwarded to the accelerator (HBM) equivalents so reference code
-    # keeps running unchanged on TPU.
-    @staticmethod
-    def memory_allocated(device=None):
-        return memory_allocated(device)
-
-    @staticmethod
-    def max_memory_allocated(device=None):
-        return max_memory_allocated(device)
-
-    @staticmethod
-    def memory_reserved(device=None):
-        return memory_reserved(device)
-
-    @staticmethod
-    def max_memory_reserved(device=None):
-        return max_memory_reserved(device)
-
-    @staticmethod
-    def empty_cache():
-        empty_cache()
-
-
 def synchronize(device=None):
     import jax
     jax.effects_barrier()
@@ -188,3 +153,25 @@ def program_memory_analysis(fn, *args, **kwargs):
     out["total_bytes"] = (out["temp_bytes"] + out["argument_bytes"]
                           + out["output_bytes"] - out["alias_bytes"])
     return out
+
+
+def get_cudnn_version():
+    """No cuDNN on this backend (reference returns None when absent)."""
+    return None
+
+
+from ..core.device import (  # noqa: E402,F401
+    XPUPlace, is_compiled_with_xpu, is_compiled_with_rocm,
+    is_compiled_with_npu)
+
+
+# paddle.device.cuda is a real module (Stream/Event/current_stream/
+# synchronize shims); the memory-query API attaches here so reference
+# code reading HBM stats through the cuda namespace keeps working.
+from . import cuda as cuda  # noqa: E402
+
+cuda.memory_allocated = memory_allocated
+cuda.max_memory_allocated = max_memory_allocated
+cuda.memory_reserved = memory_reserved
+cuda.max_memory_reserved = max_memory_reserved
+cuda.empty_cache = empty_cache

@@ -1,0 +1,58 @@
+"""paddle.device.cuda module (reference:
+python/paddle/device/cuda/__init__.py __all__ = [Stream, Event,
+current_stream, synchronize]). On TPU/PjRt, streams are the runtime's
+(one compute stream per device, async dispatch); these shims keep
+reference code importable and give the memory queries real backends."""
+import jax
+
+
+class Stream:
+    """PjRt owns stream scheduling; a Stream is a token object whose
+    synchronize() is a device sync (reference: core.CUDAStream)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._recorded = False
+
+    def record(self, stream=None):
+        self._recorded = True
+
+    def query(self):
+        return True  # dispatch is async but effects_barrier-ordered
+
+    def synchronize(self):
+        jax.effects_barrier()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+def synchronize(device=None):
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def device_count():
+    return 0  # no CUDA devices on this backend (TPU path is paddle.device)

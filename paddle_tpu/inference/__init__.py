@@ -120,3 +120,41 @@ def create_predictor(config):
 PrecisionType = type("PrecisionType", (), {"Float32": 0, "Half": 1,
                                            "Bfloat16": 2, "Int8": 3})
 PlaceType = type("PlaceType", (), {"CPU": 0, "GPU": 1, "XPU": 2, "TPU": 4})
+
+
+class DataType:  # reference: paddle_infer.DataType enum
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+_DTYPE_BYTES = {DataType.FLOAT32: 4, DataType.INT64: 8,
+                DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+                DataType.FLOAT16: 2, DataType.BFLOAT16: 2}
+
+
+def get_num_bytes_of_data_type(dtype):
+    return _DTYPE_BYTES[dtype]
+
+
+def get_version():
+    from .. import __version__
+    return f"paddle_tpu inference {__version__}"
+
+
+class PredictorPool:
+    """Reference: paddle_infer.PredictorPool — N predictors sharing one
+    config (thread-per-predictor serving). Programs are jit-compiled
+    and shared via the XLA executable cache, so clones are cheap."""
+
+    def __init__(self, config, size=1):
+        self._predictors = [Predictor(config) for _ in range(int(size))]
+
+    def retrive(self, idx):  # reference spells it 'retrive'
+        return self._predictors[idx]
+
+    retrieve = retrive

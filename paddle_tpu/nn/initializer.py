@@ -160,3 +160,37 @@ class ParamAttr:
         if arg is False:
             return False
         return ParamAttr()
+
+
+class Bilinear(Initializer):
+    """Reference: nn/initializer/Bilinear — bilinear-upsample kernel for
+    transposed convs (weight [out, in, kh, kw])."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        out_c, in_c, kh, kw = shape
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h)
+        cw = (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f_h - ch))
+                * (1 - abs(og[1] / f_w - cw))).astype(np.float32)
+        # reference BilinearInitializer writes the filter at EVERY
+        # (out, in) channel pair (fluid/initializer.py flat loop)
+        w = np.broadcast_to(filt, shape).copy()
+        return jnp.asarray(w, dtype)
+
+
+_global_initializer = [None, None]  # (weight init, bias init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference: nn/initializer/set_global_initializer — default
+    initializers used when a layer's attr doesn't specify one. Pass
+    (None, None) to reset."""
+    _global_initializer[0] = weight_init
+    _global_initializer[1] = bias_init
+
+
+def get_global_initializer(is_bias=False):
+    return _global_initializer[1 if is_bias else 0]

@@ -100,6 +100,12 @@ class Layer:
                          default_initializer=None):
         """Reference: layers.py create_parameter + ParamAttr resolution."""
         dtype = dtype or self._dtype or "float32"
+        # the global initializer overrides any layer-passed default
+        # (reference layer_helper_base.py:324: only attr.initializer
+        # beats _global_weight_initializer)
+        _g = init_mod.get_global_initializer(is_bias)
+        if _g is not None:
+            default_initializer = _g
         if default_initializer is None:
             if is_bias:
                 default_initializer = init_mod.Constant(0.0)

@@ -1,0 +1,110 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/__init__.py —
+weight_norm / remove_weight_norm / spectral_norm hooks).
+
+Reparameterizations run as forward-pre-hooks recomputing the layer's
+weight from the stored factors each call, so the factors (not the fused
+weight) are what the optimizer trains — the reference hook contract
+(nn/utils/weight_norm_hook.py, spectral_norm_hook.py)."""
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter
+from ..core.dispatch import register_op
+
+
+@register_op("weight_norm_recompose")
+def _wn_recompose(g, v, *, dim, eps):
+    if dim < 0:  # dim=None semantics: scalar g, whole-tensor norm
+        norm = jnp.sqrt(jnp.sum(v * v) + eps)
+        return v / norm * g
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    norm = jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True) + eps)
+    shape = [1] * v.ndim
+    shape[dim] = -1
+    return v / norm * g.reshape(shape)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v/||v|| (reference weight_norm_hook.py). Trains g and v;
+    recomputes `name` before each forward."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # reference: norm over the WHOLE tensor, scalar g
+    wv = np.asarray(w.numpy())
+    if dim < 0:
+        g0 = np.sqrt((wv * wv).sum())
+    else:
+        axes = tuple(i for i in range(wv.ndim) if i != dim)
+        g0 = np.sqrt((wv * wv).sum(axis=axes))
+    v = Parameter(jnp.asarray(wv), name=f"{w.name}_v")
+    g = Parameter(jnp.asarray(g0.astype(np.float32)),
+                  name=f"{w.name}_g")
+    setattr(layer, f"{name}_v", v)
+    setattr(layer, f"{name}_g", g)
+    # the fused weight becomes derived state, not a trained Parameter
+    object.__setattr__(layer, name, None)
+    layer._parameters.pop(name, None)
+
+    def _pre_hook(lyr, inputs):
+        fused = _wn_recompose(g, v, dim=int(dim), eps=1e-12)
+        object.__setattr__(lyr, name, fused)
+        return None
+
+    helper = layer.register_forward_pre_hook(_pre_hook)
+    layer.__dict__.setdefault("_weight_norm_hooks", {})[name] = \
+        (helper, dim)
+    _pre_hook(layer, None)  # materialize once for immediate inspection
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain trained Parameter."""
+    hooks = layer.__dict__.get("_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"no weight_norm hook on {name!r}")
+    helper, dim = hooks.pop(name)
+    helper.remove()
+    g = getattr(layer, f"{name}_g")
+    v = getattr(layer, f"{name}_v")
+    fused = _wn_recompose(g, v, dim=int(dim), eps=1e-12)
+    base = v.name[:-2] if v.name.endswith("_v") else v.name
+    p = Parameter(fused.value, name=base)
+    setattr(layer, name, p)
+    object.__setattr__(layer, f"{name}_g", None)
+    object.__setattr__(layer, f"{name}_v", None)
+    layer._parameters.pop(f"{name}_g", None)
+    layer._parameters.pop(f"{name}_v", None)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide `name` by its largest singular value each forward
+    (reference spectral_norm_hook.py), reusing the SpectralNorm layer's
+    power-iteration op."""
+    from .layer.norm import SpectralNorm
+    from .layer.common import Linear
+    w = getattr(layer, name)
+    if dim is None:
+        # reference spectral_norm_hook.py: Linear / transposed convs
+        # iterate around the OUTPUT axis (dim 1), others dim 0
+        cls = type(layer).__name__
+        dim = 1 if isinstance(layer, Linear) or "Transpose" in cls             else 0
+    sn = SpectralNorm(list(w.shape), dim=int(dim),
+                      power_iters=int(n_power_iterations), eps=float(eps))
+    orig = Parameter(w.value, name=f"{w.name}_orig")
+    setattr(layer, f"{name}_orig", orig)
+    # attach the power-iteration state as a sublayer: its weight_u/
+    # weight_v buffers then checkpoint with the host layer
+    setattr(layer, f"_{name}_spectral_norm", sn)
+    object.__setattr__(layer, name, None)
+    layer._parameters.pop(name, None)
+
+    def _pre_hook(lyr, inputs):
+        object.__setattr__(lyr, name, sn(orig))
+        return None
+
+    helper = layer.register_forward_pre_hook(_pre_hook)
+    layer.__dict__.setdefault("_spectral_norm_hooks", {})[name] = helper
+    _pre_hook(layer, None)
+    return layer

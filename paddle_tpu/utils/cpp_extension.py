@@ -106,3 +106,29 @@ def CUDAExtension(*args, **kwargs):
         "CUDA extensions do not exist on TPU; write device kernels as "
         "jax/Pallas functions and register with register_custom_op, or "
         "host C++ ops via cpp_extension.load")
+
+
+def get_build_directory(verbose=False):
+    """Reference: cpp_extension/extension_utils.py get_build_directory —
+    where JIT-built extensions land (PADDLE_EXTENSION_DIR overrides)."""
+    import os
+    path = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "extensions")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """Reference: cpp_extension.setup — setuptools-style build entry for
+    custom ops. Here extensions JIT-compile straight into the build
+    directory via load() (no egg/install step: import side effects
+    register the ops)."""
+    mods = ext_modules if isinstance(ext_modules, (list, tuple)) \
+        else ([ext_modules] if ext_modules is not None else [])
+    built = []
+    for ext in mods:
+        srcs = getattr(ext, "sources", None) or []
+        ext_name = getattr(ext, "name", None) or name
+        built.append(load(ext_name, srcs,
+                          build_directory=get_build_directory()))
+    return built
