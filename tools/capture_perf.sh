@@ -1,0 +1,28 @@
+#!/bin/bash
+# One-command perf evidence capture (run when the TPU tunnel is healthy;
+# never run two TPU processes at once — they corrupt each other's
+# timings over the tunnel). Produces committed-able artifacts:
+#   bench_artifacts/resnet50_<ts>.json      (bench.py worker evidence)
+#   bench_artifacts/baseline_<ts>.log       (LeNet eager/lazy/compiled +
+#                                            BERT MFU lines)
+set -u
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+
+echo "== probing backend (90s cap)..."
+timeout 90 python -c "
+import jax; d = jax.devices(); print(d[0].platform, len(d))
+" || { echo 'tunnel wedged; aborting'; exit 1; }
+
+echo "== bench.py worker (ResNet-50)..."
+timeout 900 python bench.py --worker 128 20 \
+    "bench_artifacts/resnet50_${ts}.json" \
+    2> "bench_artifacts/resnet50_${ts}.stderr.log"
+echo "rc=$?"
+
+echo "== baseline_bench (LeNet + BERT)..."
+timeout 1200 python tools/baseline_bench.py all \
+    > "bench_artifacts/baseline_${ts}.log" 2>&1
+echo "rc=$?"
+ls -la bench_artifacts/ | tail -5
+echo "commit these artifacts + update BASELINE.md citations"
