@@ -1,0 +1,65 @@
+"""Longer-horizon training integration (beyond the dryrun's 5 steps):
+40 compiled steps of the hybrid-sharded GPT on the 8-device mesh. This
+is where state-threading bugs live — optimizer moments, RNG streams,
+grad clip, and LR state must round-trip the compiled step every
+iteration (reference analogue: the dist_se_resnext/dist_transformer
+long-run convergence checks in test_dist_base)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet, topology
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.text.models import TransformerLMConfig, GPTForCausalLM
+
+
+def test_hybrid_gpt_40_steps_converges():
+    topology._HYBRID = None
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                               "sharding_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        cfg = TransformerLMConfig(vocab_size=128, hidden_size=64,
+                                  num_layers=2, num_heads=4,
+                                  max_seq_len=32, dropout=0.1,
+                                  use_mp=True)
+        model = GPTForCausalLM(cfg)
+        model = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+            1e-3, parameters=model.parameters(),
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(1.0)))
+
+        @paddle.jit.to_static
+        def train_step(ids, labels):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rs = np.random.RandomState(0)
+        # tiny corpus of 4 fixed batches -> the model can memorize;
+        # cycling them exercises cache reuse with varying data
+        batches = [(rs.randint(0, 128, (4, 32)).astype("int64"))
+                   for _ in range(4)]
+        losses = []
+        for i in range(40):
+            ids = paddle.to_tensor(batches[i % 4])
+            loss = train_step(ids, paddle.to_tensor(batches[i % 4]))
+            losses.append(float(loss.numpy()))
+        assert np.isfinite(losses).all()
+        first = np.mean(losses[:4])
+        last = np.mean(losses[-4:])
+        # measured ~0.80x after 40 steps at this lr/dropout; 0.9 bar
+        # with a monotone-trend check catches real state-threading bugs
+        assert last < 0.9 * first, (first, last, losses[::8])
+        mid = np.mean(losses[18:22])
+        assert last < mid < first, (first, mid, last)
+        # dropout active: the same batch must NOT produce an identical
+        # loss twice in a row of training (RNG state threads through
+        # the compiled step)
+        same_batch = [losses[i] for i in range(0, 40, 4)]
+        assert len(set(round(v, 6) for v in same_batch)) > 5
+    finally:
+        topology._HYBRID = None
