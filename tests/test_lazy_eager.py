@@ -91,3 +91,50 @@ class TestLazyNumerics:
         lazy_mod.flush()                 # b now concrete
         c = b * 2.0 + a                  # mixes flushed + fresh const
         np.testing.assert_allclose(c.numpy(), [5.0, 5.0, 5.0])
+
+
+class TestLazyWithAmp:
+    def test_grad_scaler_training_under_lazy(self):
+        """AMP O1 + GradScaler in plain eager: the scaler's found_inf
+        check materializes each step (a flush point mid-step) — scaled
+        grads, unscale, and the skip logic must compose with deferral."""
+        paddle.set_flags({"FLAGS_lazy_eager": True})
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 4))
+        opt = paddle.optimizer.Adam(1e-2, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+        loss_fn = nn.CrossEntropyLoss()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 8).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 4, (8,)).astype("int64"))
+        losses = []
+        for _ in range(6):
+            with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                loss = loss_fn(net(x), y)
+            scaled = scaler.scale(loss)
+            scaled.backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    def test_inf_step_is_skipped_under_lazy(self):
+        paddle.set_flags({"FLAGS_lazy_eager": True})
+        paddle.seed(0)
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+        w0 = lin.weight.numpy().copy()
+        x = paddle.to_tensor(
+            np.full((2, 4), np.finfo(np.float32).max / 4, np.float32))
+        loss = (lin(x) * 1e30).sum()          # overflows the grads
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+        np.testing.assert_allclose(lin.weight.numpy(), w0)  # skipped
+        assert float(scaler._scale.numpy()) < 8.0  # backed off
