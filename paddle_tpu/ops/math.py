@@ -58,7 +58,20 @@ add = _binary("elementwise_add", lambda x, y: jnp.add(x, y))
 subtract = _binary("elementwise_sub", lambda x, y: jnp.subtract(x, y))
 multiply = _binary("elementwise_mul", lambda x, y: jnp.multiply(x, y))
 divide = _binary("elementwise_div", lambda x, y: jnp.divide(x, y))
-floor_divide = _binary("elementwise_floordiv", lambda x, y: jnp.floor_divide(x, y),
+def _trunc_div(x, y):
+    # reference FloorDivFunctor is std::trunc(a/b) — toward-ZERO
+    # division despite the name (elementwise_floordiv_op.h:42), not
+    # python floor division. lax.div IS C trunc division for ints
+    # (abs-based formulations overflow on INT_MIN).
+    rt = jnp.result_type(x, y)
+    if jnp.issubdtype(rt, jnp.integer):
+        x, y = jnp.broadcast_arrays(jnp.asarray(x, rt),
+                                    jnp.asarray(y, rt))
+        return jax.lax.div(x, y)
+    return jnp.trunc(jnp.divide(x, y))
+
+
+floor_divide = _binary("elementwise_floordiv", _trunc_div,
                        differentiable=False)
 remainder = _binary("elementwise_mod", lambda x, y: jnp.mod(x, y),
                     differentiable=False)

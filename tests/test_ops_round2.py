@@ -155,3 +155,21 @@ def test_renorm_grad_flows():
     out = paddle.renorm(x, p=2.0, axis=0, max_norm=1.0)
     out.sum().backward()
     assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+def test_floor_divide_truncates_toward_zero():
+    """Reference FloorDivFunctor = std::trunc(a/b)
+    (elementwise_floordiv_op.h:42) — NOT python floor division."""
+    a = paddle.to_tensor(np.asarray([-7, 7, -7, 7], "int64"))
+    b = paddle.to_tensor(np.asarray([2, 2, -2, -2], "int64"))
+    out = paddle.floor_divide(a, b).numpy()
+    assert list(out) == [-3, 3, 3, -3], out  # trunc, not floor (-4...)
+    f = paddle.floor_divide(
+        paddle.to_tensor(np.asarray([-7.0], "float32")),
+        paddle.to_tensor(np.asarray([2.0], "float32"))).numpy()
+    assert float(f[0]) == -3.0
+    # INT_MIN must not overflow through an abs()
+    m = paddle.floor_divide(
+        paddle.to_tensor(np.asarray([-2 ** 31], "int32")),
+        paddle.to_tensor(np.asarray([2], "int32"))).numpy()
+    assert int(m[0]) == -2 ** 30, m
