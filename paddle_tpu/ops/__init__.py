@@ -21,8 +21,18 @@ def _patch():
     T.__rsub__ = lambda self, o: m.subtract(o, self)
     T.__mul__ = lambda self, o: m.multiply(self, o)
     T.__rmul__ = lambda self, o: m.multiply(o, self)
-    T.__truediv__ = lambda self, o: m.divide(self, o)
-    T.__rtruediv__ = lambda self, o: m.divide(o, self)
+    def _true_div(a, b):
+        # reference math_op_patch.py:190: the / OPERATOR casts int
+        # tensors to float32 before elementwise_div (true division),
+        # while the divide() API keeps the kernel's integer division
+        def _c(t):
+            if isinstance(t, Tensor) and "int" in str(t.dtype):
+                return t.astype("float32")
+            return t
+        return m.divide(_c(a), _c(b))
+
+    T.__truediv__ = lambda self, o: _true_div(self, o)
+    T.__rtruediv__ = lambda self, o: _true_div(o, self)
     T.__floordiv__ = lambda self, o: m.floor_divide(self, o)
     T.__mod__ = lambda self, o: m.remainder(self, o)
     T.__pow__ = lambda self, o: m.pow(self, o)

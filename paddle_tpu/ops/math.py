@@ -57,7 +57,16 @@ def _unary(name, fn, differentiable=True):
 add = _binary("elementwise_add", lambda x, y: jnp.add(x, y))
 subtract = _binary("elementwise_sub", lambda x, y: jnp.subtract(x, y))
 multiply = _binary("elementwise_mul", lambda x, y: jnp.multiply(x, y))
-divide = _binary("elementwise_div", lambda x, y: jnp.divide(x, y))
+def _ref_divide(x, y):
+    # reference DivFunctor is plain C a/b per dtype: INTEGER division
+    # for int tensors (test_elementwise_div_op.py:203 expects X // Y),
+    # true division for floats
+    if jnp.issubdtype(jnp.result_type(x, y), jnp.integer):
+        return _trunc_div(x, y)
+    return jnp.divide(x, y)
+
+
+divide = _binary("elementwise_div", _ref_divide)
 def _trunc_div(x, y):
     # reference FloorDivFunctor is std::trunc(a/b) — toward-ZERO
     # division despite the name (elementwise_floordiv_op.h:42), not
@@ -130,7 +139,17 @@ acosh = _unary("acosh", lambda x: jnp.arccosh(x))
 atanh = _unary("atanh", lambda x: jnp.arctanh(x))
 floor = _unary("floor", lambda x: jnp.floor(x), differentiable=False)
 ceil = _unary("ceil", lambda x: jnp.ceil(x), differentiable=False)
-round = _unary("round", lambda x: jnp.round(x), differentiable=False)  # noqa: A001
+def _round_half_away(x):
+    # Eigen x.round() = std::round = half AWAY from zero; jnp.round is
+    # banker's half-to-even (2.5 -> 2). Only exact halves may differ,
+    # so override just those (floor(|x|+0.5) would corrupt values near
+    # the .5 boundary and large exact integers via fp addition).
+    frac = x - jnp.trunc(x)
+    return jnp.where(jnp.abs(frac) == 0.5,
+                     jnp.trunc(x) + jnp.sign(x), jnp.round(x))
+
+
+round = _unary("round", _round_half_away, differentiable=False)  # noqa: A001
 trunc = _unary("trunc", lambda x: jnp.trunc(x), differentiable=False)
 frac = _unary("frac", lambda x: x - jnp.trunc(x))
 sign = _unary("sign", lambda x: jnp.sign(x), differentiable=False)

@@ -173,3 +173,44 @@ def test_floor_divide_truncates_toward_zero():
         paddle.to_tensor(np.asarray([-2 ** 31], "int32")),
         paddle.to_tensor(np.asarray([2], "int32"))).numpy()
     assert int(m[0]) == -2 ** 30, m
+
+
+def test_divide_int_is_integer_division():
+    """Reference DivFunctor: C a/b per dtype — int tensors divide to
+    ints (test_elementwise_div_op.py:203)."""
+    a = paddle.to_tensor(np.asarray([7, -7, 9], "int64"))
+    b = paddle.to_tensor(np.asarray([2, 2, 3], "int64"))
+    out = paddle.divide(a, b)
+    assert "int" in str(out.numpy().dtype)
+    assert list(out.numpy()) == [3, -3, 3]
+    f = paddle.divide(paddle.to_tensor(np.asarray([7.0], "float32")),
+                      paddle.to_tensor(np.asarray([2.0], "float32")))
+    np.testing.assert_allclose(f.numpy(), [3.5])
+
+
+def test_round_half_away_from_zero():
+    """Eigen/std::round semantics, not banker's rounding."""
+    x = paddle.to_tensor(np.asarray([0.5, 1.5, 2.5, -0.5, -2.5],
+                                    "float32"))
+    out = paddle.round(x).numpy()
+    assert list(out) == [1.0, 2.0, 3.0, -1.0, -3.0], out
+
+
+def test_truediv_operator_casts_ints_to_float():
+    """Reference math_op_patch.py:190: `/` casts int tensors to float32
+    (true division) — only the divide() API keeps integer division."""
+    a = paddle.to_tensor(np.asarray([7], "int64"))
+    b = paddle.to_tensor(np.asarray([2], "int64"))
+    out = (a / b).numpy()
+    assert "float" in str(out.dtype)
+    np.testing.assert_allclose(out, [3.5])
+    out2 = (7 / b).numpy()
+    np.testing.assert_allclose(out2, [3.5])
+
+
+def test_round_edge_values_exact():
+    # near-half value below 0.5 must NOT round up; large exact ints
+    # must pass through unchanged
+    x = paddle.to_tensor(np.asarray([0.49999997, 8388609.0], "float32"))
+    out = paddle.round(x).numpy()
+    assert list(out) == [0.0, 8388609.0], out
