@@ -43,7 +43,9 @@ def _topk(x, *, k, axis, largest, sorted_):
         vals = -neg_vals
     else:
         vals, idx = jax.lax.top_k(jnp.moveaxis(x, axis, -1), k)
-    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+    # reference top_k_v2 emits int64 indices
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
 
 
 def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
@@ -115,7 +117,7 @@ def _kthvalue(x, *, k, axis, keepdim):
     idxs = jnp.argsort(x, axis=axis)
     take = jax.lax.index_in_dim(vals, k - 1, axis, keepdims=keepdim)
     take_i = jax.lax.index_in_dim(idxs, k - 1, axis, keepdims=keepdim)
-    return take, take_i
+    return take, take_i.astype(jnp.int64)  # reference: int64 indices
 
 
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):

@@ -214,3 +214,19 @@ def test_round_edge_values_exact():
     x = paddle.to_tensor(np.asarray([0.49999997, 8388609.0], "float32"))
     out = paddle.round(x).numpy()
     assert list(out) == [0.0, 8388609.0], out
+
+
+def test_index_output_dtypes_are_int64():
+    """Reference index-emitting ops (top_k_v2, kthvalue, argsort,
+    arg_max, where_index) all output int64 indices."""
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(3, 5).astype("float32"))
+    _, idx = paddle.topk(x, 2)
+    assert str(idx.numpy().dtype) == "int64"
+    _, kidx = paddle.kthvalue(x, 2)
+    assert str(kidx.numpy().dtype) == "int64"
+    assert str(paddle.argsort(x).numpy().dtype) == "int64"
+    assert str(paddle.argmax(x).numpy().dtype) == "int64"
+    nz = paddle.nonzero(paddle.to_tensor(np.asarray([0, 3, 0, 5])))
+    assert str(nz.numpy().dtype) == "int64"
+    assert str(paddle.shape(x).numpy().dtype) == "int32"  # shape op: i32
