@@ -17,8 +17,10 @@ def _argmax(x, *, axis, keepdim, flatten):
 
 
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
-    return _argmax(x, axis=axis if axis is None else int(axis),
-                   keepdim=bool(keepdim), flatten=axis is None)
+    out = _argmax(x, axis=axis if axis is None else int(axis),
+                  keepdim=bool(keepdim), flatten=axis is None)
+    from .math import cast
+    return out if dtype in ("int64", None) else cast(out, dtype)
 
 
 @register_op("arg_min", differentiable=False)
@@ -32,8 +34,10 @@ def _argmin(x, *, axis, keepdim, flatten):
 
 
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
-    return _argmin(x, axis=axis if axis is None else int(axis),
-                   keepdim=bool(keepdim), flatten=axis is None)
+    out = _argmin(x, axis=axis if axis is None else int(axis),
+                  keepdim=bool(keepdim), flatten=axis is None)
+    from .math import cast
+    return out if dtype in ("int64", None) else cast(out, dtype)
 
 
 @register_op("top_k_v2")
@@ -94,7 +98,11 @@ def _searchsorted(sorted_seq, values, *, right):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False):
-    return _searchsorted(sorted_sequence, values, right=bool(right))
+    out = _searchsorted(sorted_sequence, values, right=bool(right))
+    if out_int32:
+        from .math import cast
+        return cast(out, "int32")
+    return out
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,

@@ -230,3 +230,15 @@ def test_index_output_dtypes_are_int64():
     nz = paddle.nonzero(paddle.to_tensor(np.asarray([0, 3, 0, 5])))
     assert str(nz.numpy().dtype) == "int64"
     assert str(paddle.shape(x).numpy().dtype) == "int32"  # shape op: i32
+
+
+def test_index_dtype_args_honored():
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(3, 4).astype("float32"))
+    assert str(paddle.argmax(x, dtype="int32").numpy().dtype) == "int32"
+    assert str(paddle.argmin(x, axis=1, dtype="int32")
+               .numpy().dtype) == "int32"
+    seq = paddle.to_tensor(np.asarray([1.0, 3.0, 5.0], "float32"))
+    v = paddle.to_tensor(np.asarray([2.0], "float32"))
+    assert str(paddle.searchsorted(seq, v, out_int32=True)
+               .numpy().dtype) == "int32"
