@@ -23,12 +23,28 @@ def _reshape(x, *, shape):
     return jnp.reshape(x, shape)
 
 
+def _resolve_reshape(x, shape):
+    """Reference reshape_op semantics: a 0 entry copies the
+    corresponding input dim (position-wise); -1 infers as usual."""
+    tgt = list(_shape_tuple(shape))
+    in_shape = tuple(int(s) for s in x.shape)
+    for i, d in enumerate(tgt):
+        if d == 0:
+            if i >= len(in_shape):
+                from ..core.errors import InvalidArgumentError
+                raise InvalidArgumentError(
+                    f"reshape: 0 at position {i} has no corresponding "
+                    f"input dim (input rank {len(in_shape)})")
+            tgt[i] = in_shape[i]
+    return tuple(tgt)
+
+
 def reshape(x, shape, name=None):
-    return _reshape(x, shape=_shape_tuple(shape))
+    return _reshape(x, shape=_resolve_reshape(x, shape))
 
 
 def reshape_(x, shape, name=None):
-    x.value = jnp.reshape(x.value, _shape_tuple(shape))
+    x.value = jnp.reshape(x.value, _resolve_reshape(x, shape))
     return x
 
 

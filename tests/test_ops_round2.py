@@ -242,3 +242,14 @@ def test_index_dtype_args_honored():
     v = paddle.to_tensor(np.asarray([2.0], "float32"))
     assert str(paddle.searchsorted(seq, v, out_int32=True)
                .numpy().dtype) == "int32"
+
+
+def test_reshape_zero_copies_input_dim():
+    """Reference reshape_op: shape entry 0 copies the corresponding
+    input dimension."""
+    x = paddle.to_tensor(np.zeros((2, 3, 4), np.float32))
+    assert paddle.reshape(x, [0, 12]).numpy().shape == (2, 12)
+    assert paddle.reshape(x, [0, 0, 4]).numpy().shape == (2, 3, 4)
+    assert paddle.reshape(x, [0, -1]).numpy().shape == (2, 12)
+    with pytest.raises(Exception):
+        paddle.reshape(x, [1, 1, 1, 0])  # 0 beyond input rank
