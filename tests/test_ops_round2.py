@@ -253,3 +253,14 @@ def test_reshape_zero_copies_input_dim():
     assert paddle.reshape(x, [0, -1]).numpy().shape == (2, 12)
     with pytest.raises(Exception):
         paddle.reshape(x, [1, 1, 1, 0])  # 0 beyond input rank
+
+
+def test_manipulation_edge_semantics_pinned():
+    """Reference edge semantics that silently regress easily: expand -1
+    keeps the input dim, squeeze ignores non-1 axes, split -1 infers."""
+    x = paddle.to_tensor(np.zeros((2, 1, 4), np.float32))
+    assert paddle.expand(x, [-1, 3, -1]).numpy().shape == (2, 3, 4)
+    assert paddle.squeeze(x, axis=0).numpy().shape == (2, 1, 4)
+    parts = paddle.split(paddle.to_tensor(np.zeros((6, 2), np.float32)),
+                         [2, -1], axis=0)
+    assert [p.numpy().shape for p in parts] == [(2, 2), (4, 2)]
