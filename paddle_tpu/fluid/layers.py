@@ -620,31 +620,35 @@ def image_resize(input, out_shape=None, scale=None,  # noqa: A002
             "TRILINEAR": "trilinear", "LINEAR": "linear",
             "BICUBIC": "bicubic"}[resample.upper()]
     return F.interpolate(input, size=out_shape, scale_factor=scale,
-                         mode=mode)
+                         mode=mode, align_corners=bool(align_corners))
 
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None,  # noqa: A002
                     actual_shape=None, align_corners=True, align_mode=1,
                     data_format="NCHW"):
-    return image_resize(input, out_shape, scale, name, "BILINEAR")
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners=align_corners)
 
 
 def resize_nearest(input, out_shape=None, scale=None, name=None,  # noqa: A002
                    actual_shape=None, align_corners=True,
                    data_format="NCHW"):
-    return image_resize(input, out_shape, scale, name, "NEAREST")
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners=align_corners)
 
 
 def resize_trilinear(input, out_shape=None, scale=None, name=None,  # noqa: A002
                      actual_shape=None, align_corners=True, align_mode=1,
                      data_format="NCDHW"):
-    return image_resize(input, out_shape, scale, name, "TRILINEAR")
+    return image_resize(input, out_shape, scale, name, "TRILINEAR",
+                        align_corners=align_corners)
 
 
 def resize_linear(input, out_shape=None, scale=None, name=None,  # noqa: A002
                   actual_shape=None, align_corners=True, align_mode=1,
                   data_format="NCW"):
-    return image_resize(input, out_shape, scale, name, "LINEAR")
+    return image_resize(input, out_shape, scale, name, "LINEAR",
+                        align_corners=align_corners)
 
 
 def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002

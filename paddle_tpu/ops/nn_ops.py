@@ -1109,10 +1109,72 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 
 # ---- misc ------------------------------------------------------------------
 
-@register_op("interpolate_nearest")
+def _axis_resize(x, axis, out_len, kind, align_corners):
+    """Separable 1-axis resize. align_corners=True samples the corner
+    grid pos = i*(in-1)/(out-1) (reference interpolate_op.h); False is
+    half-pixel (what jax.image.resize implements)."""
+    in_len = x.shape[axis]
+    if out_len == in_len:
+        return x
+    if align_corners:
+        # reference: ratio = (in-1)/(out-1), and 0 when out == 1
+        ratio = (in_len - 1) / (out_len - 1) if out_len > 1 else 0.0
+        pos = jnp.arange(out_len) * ratio
+    else:
+        pos = (jnp.arange(out_len) + 0.5) * (in_len / out_len) - 0.5
+    if kind == "nearest":
+        if align_corners:
+            # reference kernel: static_cast<int>(ratio*i + 0.5) — half UP
+            idx = jnp.clip(jnp.floor(pos + 0.5), 0,
+                           in_len - 1).astype(jnp.int32)
+        else:
+            # reference non-aligned nearest: floor(i * in/out)
+            idx = jnp.clip(
+                jnp.floor(jnp.arange(out_len) * (in_len / out_len)),
+                0, in_len - 1).astype(jnp.int32)
+        return jnp.take(x, idx, axis=axis)
+    base = jnp.floor(pos)
+    frac = (pos - base).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    frac = frac.reshape(shape)
+    if kind == "linear":
+        i0 = jnp.clip(base, 0, in_len - 1).astype(jnp.int32)
+        i1 = jnp.clip(base + 1, 0, in_len - 1).astype(jnp.int32)
+        return (jnp.take(x, i0, axis=axis) * (1 - frac)
+                + jnp.take(x, i1, axis=axis) * frac)
+    # cubic convolution, a=-0.75 (the reference's bicubic kernel)
+    a = -0.75
+
+    def w0(t):
+        return ((a + 2) * t - (a + 3)) * t * t + 1
+
+    def w1(t):
+        return ((a * t - 5 * a) * t + 8 * a) * t - 4 * a
+
+    taps = []
+    weights = [w1(frac + 1), w0(frac), w0(1 - frac), w1(2 - frac)]
+    for off in (-1, 0, 1, 2):
+        idx = jnp.clip(base + off, 0, in_len - 1).astype(jnp.int32)
+        taps.append(jnp.take(x, idx, axis=axis))
+    return sum(t * w for t, w in zip(taps, weights))
+
+
+@register_op("interpolate")
 def _interp(x, *, size, method, align_corners):
     n, c = x.shape[:2]
     out_shape = (n, c) + size
+    kind = {"nearest": "nearest", "bilinear": "linear",
+            "linear": "linear", "trilinear": "linear",
+            "bicubic": "cubic"}[method]
+    if align_corners or method == "nearest":
+        # nearest also needs the reference's asymmetric floor(i*in/out)
+        # indexing, which jax.image.resize does not implement
+        out = x
+        for i, s in enumerate(size):
+            out = _axis_resize(out, 2 + i, int(s), kind,
+                               bool(align_corners))
+        return out
     jmethod = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
                "trilinear": "linear", "linear": "linear"}[method]
     return jax.image.resize(x, out_shape, method=jmethod)

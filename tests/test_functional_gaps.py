@@ -168,3 +168,88 @@ class TestSoftLabelWeightedCE:
         per = -(soft * logp).sum(1) * wsamp
         np.testing.assert_allclose(float(out.numpy()),
                                    per.sum() / wsamp.sum(), rtol=1e-5)
+
+
+class TestInterpolateAlignCorners:
+    def test_bilinear_align_corners_exact(self):
+        """align_corners=True samples pos=i*(in-1)/(out-1) (reference
+        interpolate_op.h); previously the flag was silently ignored."""
+        rs = np.random.RandomState(13)
+        x = rs.randn(1, 1, 3, 3).astype(np.float32)
+        out = F.interpolate(paddle.to_tensor(x), size=(5, 5),
+                            mode="bilinear", align_corners=True).numpy()
+        # manual separable bilinear with corner-aligned grid
+        def interp1d(v, out_len):
+            in_len = v.shape[0]
+            pos = np.arange(out_len) * (in_len - 1) / (out_len - 1)
+            i0 = np.clip(np.floor(pos), 0, in_len - 1).astype(int)
+            i1 = np.clip(i0 + 1, 0, in_len - 1)
+            w = (pos - i0).astype(np.float32)
+            return v[i0] * (1 - w) + v[i1] * w
+        ref = x[0, 0]
+        ref = np.stack([interp1d(ref[:, j], 5) for j in
+                        range(ref.shape[1])], 1)
+        ref = np.stack([interp1d(ref[i, :], 5) for i in
+                        range(ref.shape[0])], 0)
+        np.testing.assert_allclose(out[0, 0], ref, rtol=1e-5, atol=1e-6)
+        # corners are preserved exactly under align_corners=True
+        np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 0, 0, 0],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out[0, 0, -1, -1], x[0, 0, -1, -1],
+                                   rtol=1e-6)
+
+    def test_align_corners_differs_from_half_pixel(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32)
+                             .reshape(1, 1, 4, 4))
+        a = F.interpolate(x, size=(7, 7), mode="bilinear",
+                          align_corners=True).numpy()
+        b = F.interpolate(x, size=(7, 7), mode="bilinear",
+                          align_corners=False).numpy()
+        assert not np.allclose(a, b)
+
+    def test_bicubic_align_corners_preserves_corners(self):
+        rs = np.random.RandomState(14)
+        x = rs.randn(1, 2, 4, 4).astype(np.float32)
+        out = F.interpolate(paddle.to_tensor(x), size=(9, 9),
+                            mode="bicubic", align_corners=True).numpy()
+        np.testing.assert_allclose(out[0, :, 0, 0], x[0, :, 0, 0],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[0, :, -1, -1], x[0, :, -1, -1],
+                                   rtol=1e-5)
+
+    def test_grad_flows_through_align_corners(self):
+        x = paddle.to_tensor(np.random.RandomState(15)
+                             .randn(1, 1, 3, 3).astype(np.float32))
+        x.stop_gradient = False
+        F.interpolate(x, size=(6, 6), mode="bilinear",
+                      align_corners=True).sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_nearest_indexing_matches_reference(self):
+        # non-aligned nearest: floor(i*in/out); aligned: half-UP rounding
+        x = paddle.to_tensor(np.asarray([[[10.0, 20.0]]], np.float32))
+        out = F.interpolate(x, size=(3,), mode="nearest").numpy()
+        assert list(out[0, 0]) == [10.0, 10.0, 20.0]  # floor(i*2/3)
+        x3 = paddle.to_tensor(
+            np.asarray([[[1.0, 2.0, 3.0]]], np.float32))
+        out2 = F.interpolate(x3, size=(5,), mode="nearest",
+                             align_corners=True).numpy()
+        assert list(out2[0, 0]) == [1.0, 2.0, 2.0, 3.0, 3.0]  # half-up
+
+    def test_align_corners_out_len_one_samples_origin(self):
+        x = paddle.to_tensor(np.arange(9, dtype=np.float32)
+                             .reshape(1, 1, 3, 3))
+        out = F.interpolate(x, size=(1, 1), mode="bilinear",
+                            align_corners=True).numpy()
+        assert float(out[0, 0, 0, 0]) == 0.0  # ratio=0 -> index 0
+
+    def test_fluid_resize_honors_align_corners_default(self):
+        from paddle_tpu.fluid import layers
+        x = paddle.to_tensor(np.random.RandomState(16)
+                             .randn(1, 1, 3, 3).astype(np.float32))
+        out = layers.resize_bilinear(x, out_shape=(5, 5)).numpy()
+        # fluid default align_corners=True: corners preserved
+        np.testing.assert_allclose(out[0, 0, 0, 0],
+                                   float(x.numpy()[0, 0, 0, 0]),
+                                   rtol=1e-6)
