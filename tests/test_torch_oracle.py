@@ -1,0 +1,146 @@
+"""Cross-framework numeric oracle: ops with unambiguous shared
+semantics are checked against torch CPU with identical weights/inputs
+(an independent implementation, unlike our numpy-mirroring tests).
+Reference parity rationale: the reference framework's kernels agree
+with torch on these ops' definitions."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+_rs = np.random.RandomState(0)
+
+
+def _close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+
+
+class TestTorchOracle:
+    def test_conv2d_forward_and_input_grad(self):
+        x = _rs.randn(2, 3, 8, 8).astype(np.float32)
+        w = (_rs.randn(5, 3, 3, 3) * 0.2).astype(np.float32)
+        b = _rs.randn(5).astype(np.float32)
+
+        tx = torch.tensor(x, requires_grad=True)
+        tout = torch.nn.functional.conv2d(
+            tx, torch.tensor(w), torch.tensor(b), stride=2, padding=1)
+        tout.sum().backward()
+
+        px = paddle.to_tensor(x)
+        px.stop_gradient = False
+        pout = F.conv2d(px, paddle.to_tensor(w), paddle.to_tensor(b),
+                        stride=2, padding=1)
+        pout.sum().backward()
+        _close(pout.numpy(), tout.detach().numpy())
+        _close(px.grad.numpy(), tx.grad.numpy())
+
+    def test_batch_norm_eval_and_layer_norm(self):
+        x = _rs.randn(4, 6, 5, 5).astype(np.float32)
+        rm = _rs.rand(6).astype(np.float32)
+        rv = (_rs.rand(6) + 0.5).astype(np.float32)
+        g = _rs.randn(6).astype(np.float32)
+        be = _rs.randn(6).astype(np.float32)
+        t = torch.nn.functional.batch_norm(
+            torch.tensor(x), torch.tensor(rm), torch.tensor(rv),
+            torch.tensor(g), torch.tensor(be), training=False, eps=1e-5)
+        p = F.batch_norm(paddle.to_tensor(x), paddle.to_tensor(rm),
+                         paddle.to_tensor(rv), paddle.to_tensor(g),
+                         paddle.to_tensor(be), training=False,
+                         epsilon=1e-5)
+        _close(p.numpy(), t.numpy())
+
+        ln_g = _rs.randn(5).astype(np.float32)
+        ln_b = _rs.randn(5).astype(np.float32)
+        t2 = torch.nn.functional.layer_norm(
+            torch.tensor(x), (5,), torch.tensor(ln_g),
+            torch.tensor(ln_b), eps=1e-5)
+        p2 = F.layer_norm(paddle.to_tensor(x), (5,),
+                          paddle.to_tensor(ln_g),
+                          paddle.to_tensor(ln_b), 1e-5)
+        _close(p2.numpy(), t2.numpy())
+
+    def test_activations_and_softmax(self):
+        x = _rs.randn(4, 7).astype(np.float32) * 2
+        pairs = [
+            (lambda v: torch.nn.functional.gelu(v),
+             lambda v: F.gelu(v)),
+            (lambda v: torch.nn.functional.silu(v),
+             lambda v: F.silu(v)),
+            (lambda v: torch.nn.functional.softmax(v, -1),
+             lambda v: F.softmax(v, axis=-1)),
+            (lambda v: torch.nn.functional.log_softmax(v, -1),
+             lambda v: F.log_softmax(v, axis=-1)),
+            (lambda v: torch.nn.functional.softplus(v),
+             lambda v: F.softplus(v)),
+            (lambda v: torch.erf(v), lambda v: v.erf()),
+        ]
+        for tfn, pfn in pairs:
+            _close(pfn(paddle.to_tensor(x)).numpy(),
+                   tfn(torch.tensor(x)).numpy())
+
+    def test_cross_entropy_and_nll(self):
+        logits = _rs.randn(6, 5).astype(np.float32)
+        labels = _rs.randint(0, 5, (6,)).astype(np.int64)
+        t = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels))
+        p = F.cross_entropy(paddle.to_tensor(logits),
+                            paddle.to_tensor(labels))
+        _close(float(p.numpy()), float(t.numpy()))
+        w = (_rs.rand(5) + 0.5).astype(np.float32)
+        t2 = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels),
+            weight=torch.tensor(w))
+        p2 = F.cross_entropy(paddle.to_tensor(logits),
+                             paddle.to_tensor(labels),
+                             weight=paddle.to_tensor(w))
+        _close(float(p2.numpy()), float(t2.numpy()))
+
+    def test_pooling(self):
+        x = _rs.randn(2, 3, 8, 8).astype(np.float32)
+        t = torch.nn.functional.max_pool2d(torch.tensor(x), 3, 2, 1)
+        p = F.max_pool2d(paddle.to_tensor(x), 3, 2, 1)
+        _close(p.numpy(), t.numpy())
+        t2 = torch.nn.functional.avg_pool2d(torch.tensor(x), 2, 2)
+        p2 = F.avg_pool2d(paddle.to_tensor(x), 2, 2)
+        _close(p2.numpy(), t2.numpy())
+
+    def test_interpolate_both_alignments(self):
+        x = _rs.randn(1, 2, 5, 5).astype(np.float32)
+        for ac in (True, False):
+            t = torch.nn.functional.interpolate(
+                torch.tensor(x), size=(8, 8), mode="bilinear",
+                align_corners=ac)
+            p = F.interpolate(paddle.to_tensor(x), size=(8, 8),
+                              mode="bilinear", align_corners=ac)
+            _close(p.numpy(), t.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_grid_sample(self):
+        x = _rs.randn(1, 2, 6, 6).astype(np.float32)
+        grid = (_rs.rand(1, 4, 4, 2) * 1.6 - 0.8).astype(np.float32)
+        for ac in (True, False):
+            t = torch.nn.functional.grid_sample(
+                torch.tensor(x), torch.tensor(grid), mode="bilinear",
+                padding_mode="zeros", align_corners=ac)
+            p = F.grid_sample(paddle.to_tensor(x),
+                              paddle.to_tensor(grid), mode="bilinear",
+                              padding_mode="zeros", align_corners=ac)
+            _close(p.numpy(), t.numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_matmul_and_einsum_style(self):
+        a = _rs.randn(3, 4, 5).astype(np.float32)
+        b = _rs.randn(3, 5, 6).astype(np.float32)
+        _close(paddle.matmul(paddle.to_tensor(a),
+                             paddle.to_tensor(b)).numpy(),
+               torch.matmul(torch.tensor(a), torch.tensor(b)).numpy())
+
+    def test_conv_transpose2d(self):
+        x = _rs.randn(1, 4, 5, 5).astype(np.float32)
+        w = (_rs.randn(4, 3, 3, 3) * 0.2).astype(np.float32)
+        t = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1)
+        p = F.conv2d_transpose(paddle.to_tensor(x),
+                               paddle.to_tensor(w), stride=2,
+                               padding=1)
+        _close(p.numpy(), t.numpy(), rtol=1e-4, atol=1e-4)
