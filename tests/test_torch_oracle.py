@@ -144,3 +144,70 @@ class TestTorchOracle:
                                paddle.to_tensor(w), stride=2,
                                padding=1)
         _close(p.numpy(), t.numpy(), rtol=1e-4, atol=1e-4)
+
+    def test_pad_modes(self):
+        x = _rs.randn(1, 2, 4, 5).astype(np.float32)
+        for mode in ("reflect", "replicate", "constant"):
+            t = torch.nn.functional.pad(torch.tensor(x), (1, 2, 2, 1),
+                                        mode=mode)
+            p = F.pad(paddle.to_tensor(x), [1, 2, 2, 1], mode=mode)
+            _close(p.numpy(), t.numpy())
+
+    def test_pixel_shuffle_and_unfold(self):
+        x = _rs.randn(2, 8, 3, 3).astype(np.float32)
+        _close(F.pixel_shuffle(paddle.to_tensor(x), 2).numpy(),
+               torch.nn.functional.pixel_shuffle(torch.tensor(x),
+                                                 2).numpy())
+        y = _rs.randn(1, 3, 6, 6).astype(np.float32)
+        _close(F.unfold(paddle.to_tensor(y), 2, 2, 0, 1).numpy(),
+               torch.nn.functional.unfold(torch.tensor(y), 2,
+                                          stride=2).numpy())
+
+    def test_embedding_and_weight_grad(self):
+        w = _rs.randn(10, 4).astype(np.float32)
+        ids = np.asarray([1, 3, 3, 7], np.int64)
+        tw = torch.tensor(w, requires_grad=True)
+        tout = torch.nn.functional.embedding(torch.tensor(ids), tw)
+        tout.sum().backward()
+        pw = paddle.to_tensor(w)
+        pw.stop_gradient = False
+        pout = F.embedding(paddle.to_tensor(ids), pw)
+        pout.sum().backward()
+        _close(pout.numpy(), tout.detach().numpy())
+        _close(pw.grad.numpy(), tw.grad.numpy())
+
+    def test_losses_kl_bce_huber(self):
+        logp = np.log(_rs.dirichlet(np.ones(4), 5).astype(np.float32))
+        tgt = _rs.dirichlet(np.ones(4), 5).astype(np.float32)
+        t = torch.nn.functional.kl_div(torch.tensor(logp),
+                                       torch.tensor(tgt),
+                                       reduction="sum")
+        p = F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(tgt),
+                     reduction="sum")
+        _close(float(p.numpy()), float(t.numpy()))
+
+        x = _rs.randn(6).astype(np.float32)
+        lab = (_rs.rand(6) > 0.5).astype(np.float32)
+        t2 = torch.nn.functional.binary_cross_entropy_with_logits(
+            torch.tensor(x), torch.tensor(lab))
+        p2 = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(x), paddle.to_tensor(lab))
+        _close(float(p2.numpy()), float(t2.numpy()))
+
+        a = _rs.randn(8).astype(np.float32) * 3
+        b = _rs.randn(8).astype(np.float32)
+        t3 = torch.nn.functional.smooth_l1_loss(torch.tensor(a),
+                                                torch.tensor(b))
+        p3 = F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))
+        _close(float(p3.numpy()), float(t3.numpy()))
+
+    def test_cosine_similarity_and_logsigmoid(self):
+        a = _rs.randn(5, 8).astype(np.float32)
+        b = _rs.randn(5, 8).astype(np.float32)
+        _close(F.cosine_similarity(paddle.to_tensor(a),
+                                   paddle.to_tensor(b),
+                                   axis=1).numpy(),
+               torch.nn.functional.cosine_similarity(
+                   torch.tensor(a), torch.tensor(b), dim=1).numpy())
+        _close(F.log_sigmoid(paddle.to_tensor(a)).numpy(),
+               torch.nn.functional.logsigmoid(torch.tensor(a)).numpy())
