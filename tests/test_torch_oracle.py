@@ -211,3 +211,30 @@ class TestTorchOracle:
                    torch.tensor(a), torch.tensor(b), dim=1).numpy())
         _close(F.log_sigmoid(paddle.to_tensor(a)).numpy(),
                torch.nn.functional.logsigmoid(torch.tensor(a)).numpy())
+
+    def test_lstm_gru_weight_copy_equivalence(self):
+        """Same parameter names/layouts/gate order as torch: a direct
+        state-dict copy must reproduce outputs exactly (checkpoint
+        portability for the recurrent stack)."""
+        import paddle_tpu.nn as nn
+        x = _rs.randn(2, 5, 4).astype(np.float32)
+
+        tl = torch.nn.LSTM(4, 6, batch_first=True)
+        pl = nn.LSTM(4, 6)
+        sd = {n: p.detach().numpy() for n, p in tl.named_parameters()}
+        for n, p in pl.named_parameters():
+            p.set_value(sd[n.split(".")[-1]])
+        tout, (th, tc) = tl(torch.tensor(x))
+        pout, (ph, pc) = pl(paddle.to_tensor(x))
+        _close(pout.numpy(), tout.detach().numpy(), rtol=1e-5)
+        _close(ph.numpy(), th.detach().numpy(), rtol=1e-5)
+        _close(pc.numpy(), tc.detach().numpy(), rtol=1e-5)
+
+        tg = torch.nn.GRU(4, 6, batch_first=True)
+        pg = nn.GRU(4, 6)
+        sd = {n: p.detach().numpy() for n, p in tg.named_parameters()}
+        for n, p in pg.named_parameters():
+            p.set_value(sd[n.split(".")[-1]])
+        tout2, _ = tg(torch.tensor(x))
+        pout2, _ = pg(paddle.to_tensor(x))
+        _close(pout2.numpy(), tout2.detach().numpy(), rtol=1e-5)
