@@ -238,3 +238,79 @@ class TestTorchOracle:
         tout2, _ = tg(torch.tensor(x))
         pout2, _ = pg(paddle.to_tensor(x))
         _close(pout2.numpy(), tout2.detach().numpy(), rtol=1e-5)
+
+    def test_conv1d_conv3d(self):
+        x1 = _rs.randn(2, 3, 9).astype(np.float32)
+        w1 = (_rs.randn(4, 3, 3) * 0.3).astype(np.float32)
+        _close(F.conv1d(paddle.to_tensor(x1), paddle.to_tensor(w1),
+                        stride=2, padding=1).numpy(),
+               torch.nn.functional.conv1d(torch.tensor(x1),
+                                          torch.tensor(w1), stride=2,
+                                          padding=1).numpy())
+        x3 = _rs.randn(1, 2, 5, 5, 5).astype(np.float32)
+        w3 = (_rs.randn(3, 2, 2, 2, 2) * 0.3).astype(np.float32)
+        _close(F.conv3d(paddle.to_tensor(x3), paddle.to_tensor(w3),
+                        stride=1, padding=0).numpy(),
+               torch.nn.functional.conv3d(torch.tensor(x3),
+                                          torch.tensor(w3)).numpy())
+
+    def test_norms_instance_group(self):
+        x = _rs.randn(3, 6, 4, 4).astype(np.float32)
+        g = _rs.randn(6).astype(np.float32)
+        b = _rs.randn(6).astype(np.float32)
+        _close(F.instance_norm(paddle.to_tensor(x),
+                               weight=paddle.to_tensor(g),
+                               bias=paddle.to_tensor(b)).numpy(),
+               torch.nn.functional.instance_norm(
+                   torch.tensor(x), weight=torch.tensor(g),
+                   bias=torch.tensor(b)).numpy(), rtol=2e-4)
+        _close(F.group_norm(paddle.to_tensor(x), 3,
+                            weight=paddle.to_tensor(g),
+                            bias=paddle.to_tensor(b)).numpy(),
+               torch.nn.functional.group_norm(
+                   torch.tensor(x), 3, torch.tensor(g),
+                   torch.tensor(b)).numpy(), rtol=2e-4)
+
+    def test_more_activations(self):
+        x = _rs.randn(5, 6).astype(np.float32) * 2
+        pairs = [
+            (lambda v: torch.nn.functional.elu(v), lambda v: F.elu(v)),
+            (lambda v: torch.nn.functional.selu(v),
+             lambda v: F.selu(v)),
+            (lambda v: torch.nn.functional.celu(v),
+             lambda v: F.celu(v)),
+            (lambda v: torch.nn.functional.mish(v),
+             lambda v: F.mish(v)),
+            (lambda v: torch.nn.functional.hardswish(v),
+             lambda v: F.hardswish(v)),
+            (lambda v: torch.nn.functional.hardtanh(v),
+             lambda v: F.hardtanh(v)),
+            (lambda v: torch.nn.functional.tanhshrink(v),
+             lambda v: F.tanhshrink(v)),
+            (lambda v: torch.nn.functional.leaky_relu(v, 0.1),
+             lambda v: F.leaky_relu(v, 0.1)),
+        ]
+        for tfn, pfn in pairs:
+            _close(pfn(paddle.to_tensor(x)).numpy(),
+                   tfn(torch.tensor(x)).numpy())
+
+    def test_adaptive_pools(self):
+        x = _rs.randn(2, 3, 8, 8).astype(np.float32)
+        _close(F.adaptive_avg_pool2d(paddle.to_tensor(x), 4).numpy(),
+               torch.nn.functional.adaptive_avg_pool2d(
+                   torch.tensor(x), 4).numpy())
+        _close(F.adaptive_max_pool2d(paddle.to_tensor(x), 2).numpy(),
+               torch.nn.functional.adaptive_max_pool2d(
+                   torch.tensor(x), 2).numpy())
+
+    def test_prelu_and_glu(self):
+        x = _rs.randn(2, 4, 3).astype(np.float32)
+        w = np.asarray([0.1, 0.2, 0.3, 0.4], np.float32)
+        _close(F.prelu(paddle.to_tensor(x),
+                       paddle.to_tensor(w)).numpy(),
+               torch.nn.functional.prelu(torch.tensor(x),
+                                         torch.tensor(w)).numpy())
+        y = _rs.randn(3, 8).astype(np.float32)
+        _close(F.glu(paddle.to_tensor(y), axis=-1).numpy(),
+               torch.nn.functional.glu(torch.tensor(y),
+                                       dim=-1).numpy())
