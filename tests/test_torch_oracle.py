@@ -314,3 +314,44 @@ class TestTorchOracle:
         _close(F.glu(paddle.to_tensor(y), axis=-1).numpy(),
                torch.nn.functional.glu(torch.tensor(y),
                                        dim=-1).numpy())
+
+    def test_transformer_encoder_layer_equivalence(self):
+        """Flagship-stack validation: our TransformerEncoderLayer equals
+        torch's with mapped weights (torch packs qkv as in_proj
+        [3E, E] out-major; ours keeps separate [in, out] projections)."""
+        import paddle_tpu.nn as nn
+        E, H, FF = 8, 2, 16
+        x = _rs.randn(2, 5, E).astype(np.float32)
+        tl = torch.nn.TransformerEncoderLayer(E, H, FF, dropout=0.0,
+                                              batch_first=True)
+        tl.eval()
+        pl = nn.TransformerEncoderLayer(d_model=E, nhead=H,
+                                        dim_feedforward=FF, dropout=0.0)
+        pl.eval()
+        tsd = {n: p.detach().numpy() for n, p in tl.named_parameters()}
+        qkv_w = tsd["self_attn.in_proj_weight"]
+        qkv_b = tsd["self_attn.in_proj_bias"]
+        mapping = {
+            "self_attn.q_proj.weight": qkv_w[:E].T,
+            "self_attn.q_proj.bias": qkv_b[:E],
+            "self_attn.k_proj.weight": qkv_w[E:2 * E].T,
+            "self_attn.k_proj.bias": qkv_b[E:2 * E],
+            "self_attn.v_proj.weight": qkv_w[2 * E:].T,
+            "self_attn.v_proj.bias": qkv_b[2 * E:],
+            "self_attn.out_proj.weight":
+                tsd["self_attn.out_proj.weight"].T,
+            "self_attn.out_proj.bias": tsd["self_attn.out_proj.bias"],
+            "linear1.weight": tsd["linear1.weight"].T,
+            "linear1.bias": tsd["linear1.bias"],
+            "linear2.weight": tsd["linear2.weight"].T,
+            "linear2.bias": tsd["linear2.bias"],
+            "norm1.weight": tsd["norm1.weight"],
+            "norm1.bias": tsd["norm1.bias"],
+            "norm2.weight": tsd["norm2.weight"],
+            "norm2.bias": tsd["norm2.bias"],
+        }
+        for n, p in pl.named_parameters():
+            p.set_value(mapping[n])
+        _close(pl(paddle.to_tensor(x)).numpy(),
+               tl(torch.tensor(x)).detach().numpy(), rtol=1e-4,
+               atol=1e-5)
