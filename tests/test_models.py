@@ -106,3 +106,22 @@ def test_summary():
     from paddle_tpu.vision.models import LeNet
     info = paddle.summary(LeNet())
     assert info["total_params"] > 60000
+
+
+def test_zoo_canonical_parameter_counts():
+    """Architecture-structure check: parameter counts must equal the
+    canonical (torch/paddle-published) values — wrong strides, channel
+    widths, or missing layers all shift these."""
+    import numpy as np
+    from paddle_tpu.vision.models import (resnet50, resnet18, vgg16,
+                                          mobilenet_v2, LeNet)
+
+    def count(m):
+        return sum(int(np.prod(p.aval_shape())) for p in m.parameters())
+
+    paddle.seed(0)
+    assert count(resnet50(num_classes=1000)) == 25557032
+    assert count(resnet18(num_classes=1000)) == 11689512
+    assert count(vgg16(num_classes=1000)) == 138357544
+    assert count(mobilenet_v2(num_classes=1000)) == 3504872
+    assert count(LeNet()) == 61610
