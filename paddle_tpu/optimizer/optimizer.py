@@ -177,14 +177,40 @@ class Optimizer:
         sd["LR_Scheduler"] = {"last_lr": self.get_lr()}
         if self._lr_scheduler is not None:
             sd["LR_Scheduler"].update(self._lr_scheduler.state_dict())
+        # auto param names (param_N) are NOT structure-stable across
+        # fresh model instances; record the save-time parameter order
+        # (inside the existing metadata entry, so consumers iterating
+        # tensor values keep their `k != "LR_Scheduler"` filter) for
+        # positional restore into renamed params
+        sd["LR_Scheduler"]["param_order"] = [p.name for p in params]
         return sd
 
     def set_state_dict(self, state_dict):
         params = self._parameter_list()
-        # longest-name-first so a param name that prefixes another's
-        # ("fc" vs "fc_w") cannot steal the longer param's accumulator
-        by_len = sorted(((p.name, id(p)) for p in params),
-                        key=lambda kv: -len(kv[0]))
+        # prefer matching by the CURRENT params' own names (correct
+        # under reordered parameter lists and rejects foreign
+        # checkpoints); fall back to save-order positional mapping only
+        # when no key matches — the fresh-instance case where auto
+        # names (param_N) were re-numbered
+        cur_names = sorted((p.name for p in params), key=len,
+                           reverse=True)
+        acc_keys = [k for k in state_dict if k != "LR_Scheduler"]
+        name_hits = sum(
+            1 for k in acc_keys
+            if any(k.startswith(n + "_") for n in cur_names))
+        saved_order = state_dict.get("LR_Scheduler", {}) \
+            .get("param_order") if isinstance(
+                state_dict.get("LR_Scheduler"), dict) else None
+        if name_hits == 0 and saved_order is not None \
+                and len(saved_order) == len(params):
+            by_len = sorted(((saved, id(params[i]))
+                             for i, saved in enumerate(saved_order)),
+                            key=lambda kv: -len(kv[0]))
+        else:
+            # longest-name-first so a param name that prefixes
+            # another's cannot steal the longer param's accumulator
+            by_len = sorted(((p.name, id(p)) for p in params),
+                            key=lambda kv: -len(kv[0]))
         for key, val in state_dict.items():
             if key == "LR_Scheduler":
                 if self._lr_scheduler is not None and "last_epoch" in val:

@@ -84,3 +84,69 @@ def test_hapi_model_save_load(tmp_path):
     net.fc[0].weight.set_value(np.zeros_like(w))
     model.load(path)
     np.testing.assert_array_equal(net.fc[0].weight.numpy(), w)
+
+
+def test_resume_training_is_bit_equivalent(tmp_path):
+    """The resume contract: save at step 5, restore into FRESH model +
+    optimizer instances, continue to step 10 — losses and final params
+    must equal the uninterrupted run exactly."""
+    def make():
+        paddle.seed(11)
+        net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(),
+                            nn.Linear(12, 3))
+        opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+        return net, opt
+
+    rs = np.random.RandomState(3)
+    xs = [rs.randn(4, 6).astype("float32") for _ in range(10)]
+    ys = [rs.randint(0, 3, (4,)).astype("int64") for _ in range(10)]
+    loss_fn = nn.CrossEntropyLoss()
+
+    def step(net, opt, i):
+        loss = loss_fn(net(paddle.to_tensor(xs[i])),
+                       paddle.to_tensor(ys[i]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return float(loss.numpy())
+
+    # uninterrupted
+    net_a, opt_a = make()
+    losses_a = [step(net_a, opt_a, i) for i in range(10)]
+
+    # interrupted at 5
+    net_b, opt_b = make()
+    losses_b = [step(net_b, opt_b, i) for i in range(5)]
+    paddle.save(net_b.state_dict(), str(tmp_path / "m.pdparams"))
+    paddle.save(opt_b.state_dict(), str(tmp_path / "o.pdopt"))
+
+    net_c, opt_c = make()                       # fresh instances
+    net_c.set_state_dict(paddle.load(str(tmp_path / "m.pdparams")))
+    opt_c.set_state_dict(paddle.load(str(tmp_path / "o.pdopt")))
+    losses_b += [step(net_c, opt_c, i) for i in range(5, 10)]
+
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-6)
+    for (n1, p1), (n2, p2) in zip(net_a.named_parameters(),
+                                  net_c.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6)
+
+
+def test_optimizer_restore_prefers_name_matching_on_reorder(tmp_path):
+    """Same live params in a DIFFERENT order: name matching must win
+    over positional fallback or accumulators land on wrong params."""
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    w, b = net.weight, net.bias
+    opt = paddle.optimizer.Adam(1e-2, parameters=[w, b])
+    x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+    net(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    paddle.save(opt.state_dict(), str(tmp_path / "o.pdopt"))
+    m_w = opt._accumulators["moment1"][id(w)].numpy()
+
+    opt2 = paddle.optimizer.Adam(1e-2, parameters=[b, w])  # reordered
+    opt2.set_state_dict(paddle.load(str(tmp_path / "o.pdopt")))
+    np.testing.assert_allclose(
+        opt2._accumulators["moment1"][id(w)].numpy(), m_w)
+    assert opt2._accumulators["moment1"][id(b)].numpy().shape == (4,)
