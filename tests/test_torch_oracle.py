@@ -355,3 +355,44 @@ class TestTorchOracle:
         _close(pl(paddle.to_tensor(x)).numpy(),
                tl(torch.tensor(x)).detach().numpy(), rtol=1e-4,
                atol=1e-5)
+
+    def test_optimizer_update_rules(self):
+        """Single-step update equivalence with identical params+grads:
+        Adam, AdamW (decoupled decay), SGD+momentum."""
+        import paddle_tpu.nn as nn
+        w0 = _rs.randn(4, 3).astype(np.float32)
+        g0 = _rs.randn(4, 3).astype(np.float32)
+
+        def torch_step(make_opt, steps=3):
+            p = torch.nn.Parameter(torch.tensor(w0.copy()))
+            opt = make_opt([p])
+            for _ in range(steps):
+                opt.zero_grad()
+                p.grad = torch.tensor(g0.copy())
+                opt.step()
+            return p.detach().numpy()
+
+        def paddle_step(make_opt, steps=3):
+            from paddle_tpu.core.tensor import Parameter, Tensor
+            p = Parameter(w0.copy())
+            opt = make_opt([p])
+            for _ in range(steps):
+                p._grad = Tensor(np.asarray(g0.copy()))
+                opt.step()
+                opt.clear_grad()
+            return np.asarray(p.numpy())
+
+        _close(paddle_step(lambda ps: paddle.optimizer.Adam(
+                   1e-2, parameters=ps)),
+               torch_step(lambda ps: torch.optim.Adam(ps, 1e-2)),
+               rtol=1e-5, atol=1e-6)
+        _close(paddle_step(lambda ps: paddle.optimizer.AdamW(
+                   1e-2, parameters=ps, weight_decay=0.1)),
+               torch_step(lambda ps: torch.optim.AdamW(
+                   ps, 1e-2, weight_decay=0.1)),
+               rtol=1e-5, atol=1e-6)
+        _close(paddle_step(lambda ps: paddle.optimizer.Momentum(
+                   1e-2, momentum=0.9, parameters=ps)),
+               torch_step(lambda ps: torch.optim.SGD(
+                   ps, 1e-2, momentum=0.9)),
+               rtol=1e-5, atol=1e-6)
