@@ -396,3 +396,45 @@ class TestTorchOracle:
                torch_step(lambda ps: torch.optim.SGD(
                    ps, 1e-2, momentum=0.9)),
                rtol=1e-5, atol=1e-6)
+
+    def test_lr_schedule_sequences(self):
+        """10-epoch lr sequences equal torch's for Step/MultiStep/
+        Exponential/CosineAnnealing schedules."""
+        def torch_seq(make):
+            p = torch.nn.Parameter(torch.zeros(1))
+            opt = torch.optim.SGD([p], lr=0.1)
+            sch = make(opt)
+            out = []
+            for _ in range(10):
+                out.append(opt.param_groups[0]["lr"])
+                opt.step()
+                sch.step()
+            return out
+
+        def paddle_seq(make):
+            sch = make()
+            out = []
+            for _ in range(10):
+                out.append(float(sch.get_lr()))
+                sch.step()
+            return out
+
+        _close(paddle_seq(lambda: paddle.optimizer.lr.StepDecay(
+                   0.1, step_size=3, gamma=0.5)),
+               torch_seq(lambda o: torch.optim.lr_scheduler.StepLR(
+                   o, step_size=3, gamma=0.5)))
+        _close(paddle_seq(lambda: paddle.optimizer.lr.MultiStepDecay(
+                   0.1, milestones=[2, 5], gamma=0.1)),
+               torch_seq(lambda o: torch.optim.lr_scheduler.MultiStepLR(
+                   o, milestones=[2, 5], gamma=0.1)))
+        _close(paddle_seq(lambda: paddle.optimizer.lr.ExponentialDecay(
+                   0.1, gamma=0.8)),
+               torch_seq(
+                   lambda o: torch.optim.lr_scheduler.ExponentialLR(
+                       o, gamma=0.8)))
+        _close(paddle_seq(
+                   lambda: paddle.optimizer.lr.CosineAnnealingDecay(
+                       0.1, T_max=10)),
+               torch_seq(
+                   lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(
+                       o, T_max=10)), rtol=1e-5)
