@@ -438,3 +438,34 @@ class TestTorchOracle:
                torch_seq(
                    lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(
                        o, T_max=10)), rtol=1e-5)
+
+    def test_distributions_log_prob(self):
+        """Normal/Categorical log_prob and Normal KL vs
+        torch.distributions."""
+        from paddle_tpu.distribution import Normal, Categorical
+        import paddle_tpu
+        loc, scale = 0.3, 1.7
+        v = _rs.randn(6).astype(np.float32)
+        tn = torch.distributions.Normal(loc, scale)
+        pn = Normal(loc, scale)
+        _close(pn.log_prob(paddle.to_tensor(v)).numpy(),
+               tn.log_prob(torch.tensor(v)).numpy(), rtol=1e-5)
+        _close(float(np.asarray(pn.entropy().numpy()).reshape(-1)[0]),
+               float(tn.entropy().numpy()), rtol=1e-5)
+
+        logits = _rs.randn(4).astype(np.float32)
+        # reference Categorical treats its input as LOGITS (softmax
+        # normalization, distribution.py:820) — compare on that basis
+        tc = torch.distributions.Categorical(
+            logits=torch.tensor(logits))
+        pc = Categorical(paddle.to_tensor(logits))
+        ids = np.asarray([0, 2, 3], np.int64)
+        _close(pc.log_prob(paddle.to_tensor(ids)).numpy(),
+               tc.log_prob(torch.tensor(ids)).numpy(), rtol=1e-5)
+
+        tn2 = torch.distributions.Normal(1.0, 2.0)
+        pn2 = Normal(1.0, 2.0)
+        _close(float(np.asarray(pn.kl_divergence(pn2).numpy())
+                     .reshape(-1)[0]),
+               float(torch.distributions.kl_divergence(tn, tn2)
+                     .numpy()), rtol=1e-5)
