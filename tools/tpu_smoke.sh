@@ -1,0 +1,32 @@
+#!/bin/bash
+# On-hardware validation sweep: run the single-chip-safe slice of the
+# test suite against the REAL TPU (PADDLE_TPU_TEST_BACKEND=tpu skips
+# mesh-dependent modules via conftest). This is correctness evidence —
+# the CPU suite can't see TPU-only behavior (bf16 matmul passes, Mosaic
+# compilation of the Pallas flash kernels, tunnel D2H semantics).
+#
+# Never run concurrently with a bench (shared tunnel). Output goes to
+# bench_artifacts/tpu_smoke_<ts>.log for the evidence trail.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+ts=$(date -u +%Y%m%dT%H%M%SZ)
+out="bench_artifacts/tpu_smoke_${ts}.log"
+
+echo "== probing backend (90s cap)..."
+timeout 90 python -c "
+import jax; d = jax.devices(); print(d[0].platform, d[0].device_kind)
+" || { echo 'tunnel wedged; aborting'; exit 1; }
+
+# Curated single-chip slice: core numerics, autograd, layers, models,
+# jit, AMP, optimizers, and the Pallas flash kernels compiled for real
+# (the CPU suite only exercises them in interpret mode).
+FILES="tests/test_tensor.py tests/test_autograd.py tests/test_ops.py \
+tests/test_nn_layers.py tests/test_optimizer.py tests/test_amp.py \
+tests/test_to_static.py tests/test_models.py tests/test_flash_backward.py"
+
+PADDLE_TPU_TEST_BACKEND=tpu timeout 5400 \
+    python -m pytest $FILES -q -p no:cacheprovider \
+    2>&1 | tee "$out"
+rc=$?
+echo "rc=$rc (log: $out)"
+exit $rc
