@@ -23,6 +23,7 @@ from .. import optimizer  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import layers  # noqa: F401
 from . import io  # noqa: F401
+from . import incubate  # noqa: F401
 from ..nn import initializer  # noqa: F401
 from ..nn.clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,

@@ -1,0 +1,7 @@
+"""Pre-2.0 incubate namespace (reference: python/paddle/fluid/incubate/).
+
+The TPU build keeps the legacy fleet surface alive as a thin delegation
+layer over `paddle.distributed.fleet` (the modern runtime); see
+fleet/ subpackage.
+"""
+from . import fleet  # noqa: F401

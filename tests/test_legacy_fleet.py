@@ -1,0 +1,86 @@
+"""Legacy (pre-2.0) fluid.incubate.fleet skins over the modern runtime
+(reference: python/paddle/fluid/incubate/fleet/ — base/fleet_base.py:42,
+collective/__init__.py:196, parameter_server/distribute_transpiler/
+__init__.py:714 and its distributed_strategy.py StrategyFactory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_legacy_namespaces_importable():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu.fluid.incubate.fleet.base import role_maker
+    from paddle_tpu.fluid.incubate.fleet.base.mode import Mode
+    assert fluid.incubate.fleet is not None
+    assert Mode.TRANSPILER == 1 and Mode.COLLECTIVE == 3
+    assert role_maker.PaddleCloudRoleMaker is not None
+    with pytest.raises(NotImplementedError):
+        role_maker.MPISymetricRoleMaker()
+
+
+def test_legacy_strategy_factory_maps_to_modern():
+    from paddle_tpu.fluid.incubate.fleet.parameter_server. \
+        distribute_transpiler.distributed_strategy import StrategyFactory
+
+    sync = StrategyFactory.create_sync_strategy().to_modern()
+    assert sync.a_sync is False
+
+    asyncs = StrategyFactory.create_async_strategy().to_modern()
+    assert asyncs.a_sync is True
+    assert not asyncs.a_sync_configs.get("k_steps")
+
+    half = StrategyFactory.create_half_async_strategy().to_modern()
+    assert half.a_sync is True
+
+    geo = StrategyFactory.create_geo_strategy(7).to_modern()
+    assert geo.a_sync is True and geo.a_sync_configs["k_steps"] == 7
+
+    cfg = StrategyFactory.create_sync_strategy() \
+        .get_trainer_runtime_config().get_communicator_flags()
+    assert "communicator_max_merge_var_num" in cfg
+
+
+def test_legacy_collective_fleet_trains(monkeypatch):
+    """The legacy collective skin must run a real train step through the
+    modern mesh runtime: init -> distributed_optimizer -> minimize."""
+    from paddle_tpu.fluid.incubate.fleet.base import role_maker
+    from paddle_tpu.fluid.incubate.fleet.collective import fleet
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    rm = role_maker.PaddleCloudRoleMaker(is_collective=True)
+    fleet.init(rm)
+    assert fleet.is_worker() and not fleet.is_server()
+    assert fleet.worker_index() == 0
+    assert fleet.is_first_worker()
+
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    dist_opt = fleet.distributed_optimizer(opt)
+
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(np.zeros((16, 4), "float32"))
+    losses = []
+    for _ in range(3):
+        loss = ((net(x) - y) ** 2).mean()
+        w_before = np.asarray(net.weight.value).copy()
+        dist_opt.minimize(loss)
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        assert not np.allclose(w_before, np.asarray(net.weight.value))
+    assert losses[-1] < losses[0]
+
+
+def test_legacy_split_files(monkeypatch):
+    from paddle_tpu.fluid.incubate.fleet.base import role_maker
+    from paddle_tpu.fluid.incubate.fleet.collective import fleet
+
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    fleet.init(role_maker.PaddleCloudRoleMaker(is_collective=True))
+    files = [f"part-{i}" for i in range(5)]
+    shard = fleet.split_files(files)
+    # single worker: gets everything
+    assert shard == files

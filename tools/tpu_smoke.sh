@@ -14,8 +14,12 @@ out="bench_artifacts/tpu_smoke_${ts}.log"
 
 echo "== probing backend (90s cap)..."
 timeout 90 python -c "
-import jax; d = jax.devices(); print(d[0].platform, d[0].device_kind)
-" || { echo 'tunnel wedged; aborting'; exit 1; }
+import sys
+import jax
+d = jax.devices()
+print(d[0].platform, d[0].device_kind)
+sys.exit(0 if d[0].platform == 'tpu' else 1)  # CPU fallback is NOT evidence
+" || { echo 'no TPU (wedged tunnel or CPU fallback); aborting'; exit 1; }
 
 # Curated single-chip slice: core numerics, autograd, layers, models,
 # jit, AMP, optimizers, and the Pallas flash kernels compiled for real
