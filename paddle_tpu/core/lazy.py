@@ -41,6 +41,24 @@ import jax.numpy as jnp
 from . import flags as flags_mod
 from . import trace as trace_mod
 
+
+def static_int_exponent(base_is_inexact, y):
+    """Exponent for the exact-multiply-chain pow fast path
+    (lax.integer_pow), or None to take the general jnp.power path.
+    Guards: bools excluded; float exponents only promote-safely on
+    float bases (int_array ** 2.0 must yield float via jnp.power);
+    negative exponents on integer bases are integer division in
+    integer_pow (wrong), so those also fall through."""
+    if isinstance(y, bool) or not isinstance(y, (int, float)):
+        return None
+    fy = float(y)
+    if not fy.is_integer() or not -64 <= fy <= 64:
+        return None
+    n = int(fy)
+    if not base_is_inexact and (n < 0 or isinstance(y, float)):
+        return None
+    return n
+
 _MAX_NODES = 4096
 _MAX_CACHED_REPLAYS = 64
 _state = threading.local()
@@ -214,6 +232,19 @@ class LazyArray:
         return _binary(jnp.matmul, "matmul", self, other)
 
     def __pow__(self, other):
+        # static integer exponents lower to an exact multiply chain
+        # (lax.integer_pow); lax.pow is exp(y*log(x)) whose TPU
+        # transcendentals make even x**2 inexact (9.000011 for 3**2)
+        n = static_int_exponent(
+            jnp.issubdtype(self.dtype, jnp.inexact), other)
+        if n is not None:
+            if enabled():
+                try:
+                    return dispatch(lambda x: jax.lax.integer_pow(x, n),
+                                    ("lazy_ipow", n), [self])
+                except Fallback:
+                    pass
+            return jax.lax.integer_pow(self.materialize(), n)
         return _binary(jnp.power, "pow", self, other)
 
     def __mod__(self, other):

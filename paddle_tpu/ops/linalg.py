@@ -1,4 +1,6 @@
 """Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -7,7 +9,49 @@ from .math import matmul, bmm, dot, mv  # noqa: F401  re-export
 from .reduction import norm, dist  # noqa: F401
 
 
+def _f32_on_tpu(fn):
+    """TPU linear-algebra custom-calls implement only f32/c64 (the
+    compiler rejects f64, e.g. "Only F32 and C64 types are implemented
+    in LuDecomposition") — there is no f64 hardware path. On the TPU
+    backend, compute f64/c128 inputs in f32/c64 and cast results back,
+    keeping the reference dtype contract (f64 in -> f64 out)."""
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if jax.default_backend() != "tpu":
+            return fn(*args, **kwargs)
+        demoted = [False]
+
+        def dem(a):
+            dt = getattr(a, "dtype", None)
+            if dt == jnp.float64:
+                demoted[0] = True
+                return a.astype(jnp.float32)
+            if dt == jnp.complex128:
+                demoted[0] = True
+                return a.astype(jnp.complex64)
+            return a
+
+        args = jax.tree_util.tree_map(dem, args)
+        out = fn(*args, **kwargs)
+        if not demoted[0]:
+            return out
+
+        def prom(a):
+            dt = getattr(a, "dtype", None)
+            if dt == jnp.float32:
+                return a.astype(jnp.float64)
+            if dt == jnp.complex64:
+                return a.astype(jnp.complex128)
+            return a  # integer outputs (pivots, infos) pass through
+
+        return jax.tree_util.tree_map(prom, out)
+
+    return wrapped
+
+
 @register_op("cholesky")
+@_f32_on_tpu
 def _cholesky(x, *, upper):
     L = jnp.linalg.cholesky(x)
     return jnp.swapaxes(L, -1, -2) if upper else L
@@ -18,6 +62,7 @@ def cholesky(x, upper=False, name=None):
 
 
 @register_op("inverse")
+@_f32_on_tpu
 def _inv(x):
     return jnp.linalg.inv(x)
 
@@ -30,6 +75,7 @@ inverse = inv
 
 
 @register_op("matrix_power")
+@_f32_on_tpu
 def _matrix_power(x, *, n):
     return jnp.linalg.matrix_power(x, n)
 
@@ -39,6 +85,7 @@ def matrix_power(x, n, name=None):
 
 
 @register_op("det")
+@_f32_on_tpu
 def _det(x):
     return jnp.linalg.det(x)
 
@@ -48,6 +95,7 @@ def det(x, name=None):
 
 
 @register_op("slogdet")
+@_f32_on_tpu
 def _slogdet(x):
     sign, logdet = jnp.linalg.slogdet(x)
     return sign, logdet
@@ -58,6 +106,7 @@ def slogdet(x, name=None):
 
 
 @register_op("solve")
+@_f32_on_tpu
 def _solve(a, b):
     return jnp.linalg.solve(a, b)
 
@@ -67,6 +116,7 @@ def solve(x, y, name=None):
 
 
 @register_op("triangular_solve")
+@_f32_on_tpu
 def _triangular_solve(a, b, *, upper, transpose, unitriangular):
     return jax.scipy.linalg.solve_triangular(
         a, b, lower=not upper, trans=1 if transpose else 0,
@@ -80,6 +130,7 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
 
 
 @register_op("svd", differentiable=False)
+@_f32_on_tpu
 def _svd(x, *, full_matrices):
     return jnp.linalg.svd(x, full_matrices=full_matrices)
 
@@ -89,6 +140,7 @@ def svd(x, full_matrices=False, name=None):
 
 
 @register_op("qr", differentiable=False)
+@_f32_on_tpu
 def _qr(x, *, mode):
     return jnp.linalg.qr(x, mode=mode)
 
@@ -98,6 +150,7 @@ def qr(x, mode="reduced", name=None):
 
 
 @register_op("eigh", differentiable=False)
+@_f32_on_tpu
 def _eigh(x, *, uplo):
     return jnp.linalg.eigh(x, UPLO=uplo)
 
@@ -107,6 +160,7 @@ def eigh(x, UPLO="L", name=None):
 
 
 @register_op("eigvalsh", differentiable=False)
+@_f32_on_tpu
 def _eigvalsh(x, *, uplo):
     return jnp.linalg.eigvalsh(x, UPLO=uplo)
 
@@ -116,6 +170,7 @@ def eigvalsh(x, UPLO="L", name=None):
 
 
 @register_op("pinv", differentiable=False)
+@_f32_on_tpu
 def _pinv(x, *, rcond):
     return jnp.linalg.pinv(x, rtol=rcond)
 
@@ -125,6 +180,7 @@ def pinv(x, rcond=1e-15, hermitian=False, name=None):
 
 
 @register_op("matrix_rank", differentiable=False)
+@_f32_on_tpu
 def _matrix_rank(x, *, tol):
     return jnp.linalg.matrix_rank(x, rtol=tol)
 
@@ -134,6 +190,7 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 
 
 @register_op("lstsq", differentiable=False)
+@_f32_on_tpu
 def _lstsq(a, b):
     sol, res, rank, sv = jnp.linalg.lstsq(a, b)
     return sol, res, rank, sv
@@ -153,6 +210,7 @@ def multi_dot(x, name=None):
 
 
 @register_op("cond_number", differentiable=False)
+@_f32_on_tpu
 def _cond(x, *, p):
     return jnp.linalg.cond(x, p=p)
 
@@ -162,6 +220,7 @@ def cond(x, p=None, name=None):
 
 
 @register_op("lu", differentiable=False)
+@_f32_on_tpu
 def _lu(x):
     lu, pivots, _ = jax.lax.linalg.lu(x)
     return lu, pivots + 1  # paddle pivots are 1-based (reference lu_op)
@@ -180,6 +239,7 @@ def lu(x, pivot=True, get_infos=False, name=None):
 
 
 @register_op("cholesky_solve")
+@_f32_on_tpu
 def _cholesky_solve(y, x, *, upper):
     return jax.scipy.linalg.cho_solve((x, not upper), y)
 
@@ -191,6 +251,7 @@ def cholesky_solve(x, y, upper=False, name=None):
 
 
 @register_op("householder_product", differentiable=False)
+@_f32_on_tpu
 def _householder_product(x, tau):
     return jax.lax.linalg.householder_product(x, tau)
 
@@ -200,6 +261,7 @@ def householder_product(x, tau, name=None):
 
 
 @register_op("eig", differentiable=False)
+@_f32_on_tpu
 def _eig(x):
     return jnp.linalg.eig(x)
 

@@ -101,7 +101,23 @@ outer = _binary("outer", lambda x, y: jnp.outer(x, y))
 kron = _binary("kron", lambda x, y: jnp.kron(x, y))
 
 
+@register_op("pow_int")
+def _pow_int(x, *, n):
+    return jax.lax.integer_pow(x, n)
+
+
 def pow(x, y, name=None):  # noqa: A001
+    # static integer exponents lower to an exact multiply chain
+    # (lax.integer_pow, matching the reference pow kernel's repeated
+    # multiply); lax.pow is exp(y*log(x)) whose TPU transcendentals make
+    # even x**2 inexact
+    from ..core.dtype import to_jax_dtype
+    from ..core.lazy import static_int_exponent
+    inexact = jnp.issubdtype(
+        to_jax_dtype(getattr(x, "dtype", "float32")), jnp.inexact)
+    n = static_int_exponent(inexact, y)
+    if n is not None:
+        return _pow_int(x, n=n)
     return pow_(x, y)
 
 

@@ -226,7 +226,7 @@ class _SlowDs(Dataset):
     """Fixed per-sample latency (decode/read proxy). Worker processes
     overlap these latencies with each other and with the consumer."""
 
-    def __init__(self, n=24, delay=0.15):
+    def __init__(self, n=24, delay=0.25):
         self.n = n
         self.delay = delay
 
@@ -241,10 +241,13 @@ class _SlowDs(Dataset):
 def test_mp_loader_overlaps_sample_latency():
     ds = _SlowDs()
 
-    # Timing-based: a loaded machine (e.g. a concurrent bench run) can
-    # stretch worker spawn enough to eat the margin, so take the best of
-    # a few attempts before declaring overlap broken. The serial
-    # baseline (sleep-bound) is measured once.
+    # Timing-based: the property under test is that worker processes
+    # OVERLAP per-sample latency (sleeps overlap even on a starved
+    # machine; only worker spawn competes for CPU). The serial pass is
+    # sleep-bound at >= n*delay = 6s; 6 workers ideally take ~1s, so
+    # >1.6x still proves overlap while surviving a machine loaded by a
+    # concurrent bench/compile (spawn can cost seconds there). Take the
+    # best of 3 attempts.
     t0 = time.perf_counter()
     n0 = sum(1 for _ in DataLoader(ds, batch_size=4, num_workers=0))
     serial = time.perf_counter() - t0
@@ -257,11 +260,11 @@ def test_mp_loader_overlaps_sample_latency():
 
         assert n0 == n1 == 6
         best = max(best, serial / parallel)
-        if best > 2.0:
+        if best > 1.6:
             break
 
-    assert best > 2.0, (
-        f"expected >2x speedup from worker processes on the best of 3 "
+    assert best > 1.6, (
+        f"expected >1.6x speedup from worker processes on the best of 3 "
         f"attempts; best {best:.2f}x (serial {serial:.2f}s)")
 
 

@@ -177,10 +177,13 @@ class TestManipulation:
     def test_where_masked(self):
         x = np.random.randn(4)
         cond = x > 0
-        np.testing.assert_array_equal(
-            paddle.where(t(cond), t(x), t(-x)).numpy(), np.abs(x))
+        # rtol instead of exact: the TPU backend has no f64, so f64
+        # inputs run demoted to f32
+        np.testing.assert_allclose(
+            paddle.where(t(cond), t(x), t(-x)).numpy(), np.abs(x),
+            rtol=1e-6)
         sel = paddle.masked_select(t(x), t(cond))
-        np.testing.assert_array_equal(sel.numpy(), x[cond])
+        np.testing.assert_allclose(sel.numpy(), x[cond], rtol=1e-6)
 
     def test_pad(self):
         x = np.random.randn(1, 1, 3, 3).astype("float32")
@@ -207,8 +210,9 @@ class TestSearch:
         vals, idx = paddle.topk(t(x), 3, axis=1)
         np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :3],
                                    rtol=1e-6)
-        np.testing.assert_array_equal(paddle.sort(t(x), axis=1).numpy(),
-                                      np.sort(x, 1))
+        # rtol: f64 demotes to f32 on the TPU backend
+        np.testing.assert_allclose(paddle.sort(t(x), axis=1).numpy(),
+                                   np.sort(x, 1), rtol=1e-6)
         nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
         np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
         u = paddle.unique(t(np.array([3, 1, 3, 2])))
@@ -238,8 +242,11 @@ class TestLinalg:
         np.testing.assert_allclose(paddle.linalg.det(t(spd)).numpy(),
                                    np.linalg.det(spd), rtol=1e-5)
         b = np.random.randn(3, 2)
+        # atol floor: tiny elements of an f32-computed solve (the TPU
+        # backend has no f64) carry ~1e-8 absolute error
         np.testing.assert_allclose(paddle.linalg.solve(t(spd), t(b)).numpy(),
-                                   np.linalg.solve(spd, b), rtol=1e-4)
+                                   np.linalg.solve(spd, b), rtol=1e-4,
+                                   atol=1e-6)
 
 
 class TestNNOps:
@@ -352,6 +359,30 @@ class TestTopLevelParity:
         np.testing.assert_allclose(paddle.add_n([a, a, a]).numpy(),
                                    3 * a.numpy())
 
+    def test_pow_integer_exponent_exact(self):
+        """Static integer exponents must lower to exact multiply chains
+        (lax.integer_pow) on every backend — lax.pow's exp(y*log(x))
+        made even 3**2 = 9.000011 on TPU (r3 smoke-sweep finding)."""
+        x = t(np.array([1.0, 2.0, 3.0], 'float32'))
+        np.testing.assert_array_equal((x ** 2).numpy(), [1, 4, 9])
+        np.testing.assert_array_equal(paddle.pow(x, 3).numpy(), [1, 8, 27])
+        np.testing.assert_allclose((x ** -2).numpy(), [1, 0.25, 1 / 9],
+                                   rtol=1e-6)
+        # non-integer exponents take the general pow path
+        np.testing.assert_allclose(paddle.pow(x, 0.5).numpy(),
+                                   np.sqrt([1, 2, 3]), rtol=1e-5)
+        # integer dtype: scalar adopts the tensor dtype (paddle
+        # semantics) and stays integer
+        xi = t(np.array([1, 2, 3], 'int32'))
+        r = (xi ** 2).numpy()
+        assert r.dtype.kind == 'i'
+        np.testing.assert_array_equal(r, [1, 4, 9])
+        # exact grads through the multiply chain: d/dx x^4 = 4x^3
+        g = t(np.float32(3.0))
+        g.stop_gradient = False
+        (g ** 4).backward()
+        assert float(g.grad.numpy()) == 108.0
+
     def test_cross_diagonal(self):
         x = t(np.array([1., 0, 0], 'float32'))
         y = t(np.array([0., 1, 0], 'float32'))
@@ -385,8 +416,9 @@ class TestTopLevelParity:
     def test_inplace_variants(self):
         b = t(np.ones((2, 2), 'float32'))
         paddle.tanh_(b)
+        # 1e-5: TPU's tanh approximation is ~3e-6 off in f32
         np.testing.assert_allclose(b.numpy(), np.tanh(np.ones((2, 2))),
-                                   rtol=1e-6)
+                                   rtol=1e-5)
         b2 = t(np.ones((1, 2, 2), 'float32'))
         paddle.squeeze_(b2, 0)
         assert b2.shape == [2, 2]

@@ -21,12 +21,19 @@ print(d[0].platform, d[0].device_kind)
 sys.exit(0 if d[0].platform == 'tpu' else 1)  # CPU fallback is NOT evidence
 " || { echo 'no TPU (wedged tunnel or CPU fallback); aborting'; exit 1; }
 
-# Curated single-chip slice: core numerics, autograd, layers, models,
-# jit, AMP, optimizers, and the Pallas flash kernels compiled for real
-# (the CPU suite only exercises them in interpret mode).
+# Curated single-chip slice: core numerics, autograd, layers,
+# optimizers, AMP, and the Pallas flash kernels compiled for real (the
+# CPU suite only exercises them in interpret mode).
+#
+# NOT in the slice: test_to_static / test_models — their eager
+# discovery passes are per-op ~65ms tunnel round trips, so each test
+# runs for minutes-to-tens-of-minutes on the tunneled chip (observed
+# 36+ min on one model-scale parity test). Their compiled paths ARE
+# exercised on hardware by the benches (bench.py ResNet-50,
+# tools/baseline_bench.py BERT/GPT are whole to_static train steps).
 FILES="tests/test_tensor.py tests/test_autograd.py tests/test_ops.py \
 tests/test_nn_layers.py tests/test_optimizer.py tests/test_amp.py \
-tests/test_to_static.py tests/test_models.py tests/test_flash_backward.py"
+tests/test_flash_backward.py"
 
 PADDLE_TPU_TEST_BACKEND=tpu timeout 5400 \
     python -m pytest $FILES -q -p no:cacheprovider \
