@@ -153,9 +153,14 @@ def run_backward(loss, grad_tensor=None, retain_graph=False,
         init_ct = _ones_cache.get(ck)
         if init_ct is None:
             init_ct = jnp.ones(shape, dt)
-            if len(_ones_cache) > 512:
-                _ones_cache.clear()
-            _ones_cache[ck] = init_ct
+            # under an active jax trace jnp.ones returns a TRACER;
+            # caching it would leak it into every later trace as a
+            # foreign constant (observed as "+2 buffers" executable
+            # mismatches across tests) — cache concrete arrays only
+            if not isinstance(init_ct, jax.core.Tracer):
+                if len(_ones_cache) > 512:
+                    _ones_cache.clear()
+                _ones_cache[ck] = init_ct
     else:
         init_ct = grad_tensor.value if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
     if create_graph:

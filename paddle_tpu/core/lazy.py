@@ -95,9 +95,11 @@ def scalar_const(v):
         ck = (type(v), v)
     arr = _scalar_cache.get(ck)
     if arr is None:
+        arr = jnp.asarray(v)
+        if isinstance(arr, jax.core.Tracer):
+            return arr  # never cache tracers (leak into later traces)
         if len(_scalar_cache) > 4096:
             _scalar_cache.clear()
-        arr = jnp.asarray(v)
         _scalar_cache[ck] = arr
     return arr
 

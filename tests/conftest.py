@@ -54,3 +54,36 @@ def _seed_everything():
     np.random.seed(0)
     paddle.seed(1234)
     yield
+
+
+def make_traced_train_step(net, opt, loss_fn):
+    """jax-jittable closure running one REAL paddle train step (model +
+    optimizer via the op registry) under a TraceContext — shared by the
+    HLO-inspection tests (DDP reducer / fused optimizer absorption).
+    Signature: train_step(param_vals, x_arr, y_arr) -> (loss, params);
+    optimizer accumulators created in-trace stay internal (compile-time
+    state), only params thread through.
+    """
+    from paddle_tpu.core import trace as trace_mod
+    from paddle_tpu.core.tensor import Tensor
+
+    state = {t.name: t for t in net.parameters()}
+    names = list(state)
+
+    def train_step(param_vals, x_arr, y_arr):
+        ctx = trace_mod.TraceContext("jit")
+        with trace_mod.trace_guard(ctx):
+            for n, v in zip(names, param_vals):
+                ctx.bind(state[n], v)
+            x = Tensor(x_arr)
+            y = Tensor(y_arr)
+            ctx.register_created(x)
+            ctx.register_created(y)
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            new_params = [ctx.final_value(state[n]) for n in names]
+            return loss.value, new_params
+
+    return train_step, names, state

@@ -16,8 +16,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
-from paddle_tpu.core import trace as trace_mod
-from paddle_tpu.core.tensor import Tensor
 
 
 def _mesh():
@@ -60,31 +58,16 @@ class TestReducerAbsorbed:
         """The same property through the paddle surface: a DP train step
         (model + SGD via the op registry) compiles to O(1) fused grad
         all-reduces for its 6 parameters."""
+        from conftest import make_traced_train_step
+
         mesh = _mesh()
         paddle.seed(0)
         net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
                             nn.Linear(32, 16), nn.ReLU(),
                             nn.Linear(16, 4))
         opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
-        loss_fn = nn.CrossEntropyLoss()
-        state = {t.name: t for t in net.parameters()}
-        names = list(state)
-
-        def train_step(param_vals, x_arr, y_arr):
-            ctx = trace_mod.TraceContext("jit")
-            with trace_mod.trace_guard(ctx):
-                for n, v in zip(names, param_vals):
-                    ctx.bind(state[n], v)
-                x = Tensor(x_arr)
-                y = Tensor(y_arr)
-                ctx.register_created(x)
-                ctx.register_created(y)
-                loss = loss_fn(net(x), y)
-                loss.backward()
-                opt.step()
-                opt.clear_grad()
-                new_params = [ctx.final_value(state[n]) for n in names]
-                return loss.value, new_params
+        train_step, names, state = make_traced_train_step(
+            net, opt, nn.CrossEntropyLoss())
 
         rng = np.random.RandomState(1)
         shard = NamedSharding(mesh, P("dp"))
@@ -100,7 +83,21 @@ class TestReducerAbsorbed:
         assert n_ar <= 3, (
             f"{n_ar} all-reduces for {len(names)} params — combiner "
             "not engaged")
-        # and the compiled step still trains
-        loss1, pv = jax.jit(train_step)(pv, x, y)
-        loss2, _ = jax.jit(train_step)(pv, x, y)
+        # and the same step's math trains: FRESH instances (a model/
+        # optimizer pair is traced exactly once in its lifetime — a
+        # re-trace after accumulator creation bakes a different capture
+        # set), mesh-free, one jit wrapper, second call a cache hit.
+        paddle.seed(0)
+        net2 = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                             nn.Linear(32, 16), nn.ReLU(),
+                             nn.Linear(16, 4))
+        opt2 = paddle.optimizer.SGD(0.1, parameters=net2.parameters())
+        step2, names2, state2 = make_traced_train_step(
+            net2, opt2, nn.CrossEntropyLoss())
+        f = jax.jit(step2)
+        pv0 = [state2[n].value for n in names2]
+        x_h = jnp.asarray(np.asarray(x))
+        y_h = jnp.asarray(np.asarray(y))
+        loss1, pv1 = f(pv0, x_h, y_h)
+        loss2, _ = f(pv1, x_h, y_h)
         assert float(loss2) < float(loss1)
