@@ -3,9 +3,25 @@ python/paddle/fluid/layers/nn.py 36k LoC). The heavily-used subset
 forwards to the modern functional ops; names keep fluid's signatures
 (e.g. fc(input, size), reduce_mean, cross_entropy with soft labels off).
 """
+import os as _os
+
 import numpy as np
 
 from ..core.tensor import Tensor
+
+# paddle_tpu package root, for separating user frames from framework
+# frames in _reuse_key (trailing sep so a sibling dir sharing the
+# prefix, e.g. .../paddle_tpu_examples, is not misclassified)
+_PKG_ROOT = _os.path.dirname(
+    _os.path.dirname(_os.path.abspath(__file__))) + _os.sep
+# the jit/to_static machinery re-invokes the user body once per phase
+# (eager/record/compile) from phase-specific lines; frames at or above
+# it are phase-variant and must not enter the reuse key
+_JIT_DIR = _PKG_ROOT + "jit" + _os.sep
+
+import itertools as _itertools  # noqa: E402
+
+_instance_tokens = _itertools.count()
 from ..ops import (creation, linalg, manipulation, math as math_ops,
                    nn_ops, reduction)
 from ..static import data  # noqa: F401
@@ -27,16 +43,47 @@ def _reuse_key(name, config):
     builds each layers.* call ONCE into a program; eager loops re-execute
     the python line each step, so the same call site (or explicit `name`)
     must map to the same parameters or nothing trains. Key: user name if
-    given, else the full user call stack + config — two logically distinct
-    layers built through a shared helper differ in an outer frame, so they
-    do not alias. Pass `name` to share parameters deliberately."""
+    given, else the USER portion of the call stack + config — two
+    logically distinct layers built through a shared helper differ in an
+    outer frame, so they do not alias. Framework-internal frames are
+    excluded: under jit/to_static the machinery frames above the user
+    body differ per phase (eager/record/compile), and keying on them
+    would re-initialize the layer's parameters every pass. Pass `name`
+    to share parameters deliberately."""
     if name is not None:
         return ("name", name) + config
     import sys
+
+    from ..nn.layer_base import Layer as _Layer
     frames = []
     f = sys._getframe(2)
     while f is not None:
-        frames.append((f.f_code.co_filename, f.f_lineno))
+        fn = f.f_code.co_filename
+        if fn.startswith(_JIT_DIR):
+            # jit/to_static runner: phase-variant — stop here so the
+            # same call site keys identically across eager/record/
+            # compile passes
+            break
+        if not fn.startswith(_PKG_ROOT):
+            # keep user frames (outer frames distinguish layers built
+            # through shared helpers); skip framework-internal ones
+            frames.append((fn, f.f_lineno))
+            slf = f.f_locals.get("self")
+            if isinstance(slf, _Layer):
+                # an nn.Layer method: the INSTANCE identity subsumes
+                # everything above it — two module objects sharing
+                # forward() code never alias (even called from one
+                # line), and repeat calls on one instance from
+                # different lines still reuse. A monotonic token stored
+                # on the instance (not id(): CPython recycles freed
+                # addresses, which would alias a new module to a dead
+                # one's parameters) provides the identity.
+                tok = getattr(slf, "_fluid_reuse_token", None)
+                if tok is None:
+                    tok = next(_instance_tokens)
+                    object.__setattr__(slf, "_fluid_reuse_token", tok)
+                frames.append(("<layer-instance>", tok))
+                break
         f = f.f_back
     return (tuple(frames),) + config
 

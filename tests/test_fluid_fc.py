@@ -10,3 +10,77 @@ def test_fluid_fc_any_registered_act():
     assert ((out.numpy() > 0) & (out.numpy() < 1)).all()
     with pytest.raises(ValueError):
         fluid.layers.fc(x, size=3, act="not_an_act")
+
+
+def test_fluid_fc_stable_across_to_static_phases():
+    """_reuse_key must exclude framework frames: under jit/to_static the
+    machinery frames above the user body differ per phase
+    (eager/record/compile), which used to re-key — and silently
+    RE-INITIALIZE — the layer's parameters every pass (r3 finding)."""
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(4, 8).astype("float32"))
+
+    @paddle.jit.to_static
+    def f(inp):
+        return fluid.layers.fc(inp, size=6)
+
+    r1, r2, r3 = f(x).numpy(), f(x).numpy(), f(x).numpy()
+    np.testing.assert_allclose(r1, r2)
+    np.testing.assert_allclose(r2, r3)
+
+    # distinct call sites still get distinct parameters
+    @paddle.jit.to_static
+    def two(inp):
+        a = fluid.layers.fc(inp, size=6)
+        b = fluid.layers.fc(inp, size=6)
+        return a, b
+
+    a, b = two(x)
+    assert not np.allclose(a.numpy(), b.numpy())
+
+
+def test_fluid_fc_trains_under_to_static():
+    """A name-shared fluid fc trains end-to-end through the compiled
+    path (the call-site cache hands the same parameters to every
+    phase and the optimizer)."""
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 8).astype("float32"))
+    lbl = paddle.to_tensor(np.zeros((4, 6), "float32"))
+
+    fluid.layers.fc(x, size=6, name="ts_fc_m")
+    layer = [v for k, v in fluid.layers._layer_cache.items()
+             if k[:2] == ("name", "ts_fc_m")][0]
+    opt = paddle.optimizer.SGD(0.5, parameters=list(layer.parameters()))
+
+    @paddle.jit.to_static
+    def train(inp):
+        out = fluid.layers.fc(inp, size=6, name="ts_fc_m")
+        loss = ((out - lbl) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(train(x).numpy()) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_fluid_fc_instance_keying():
+    """fluid.layers.* inside an nn.Layer method keys on the INSTANCE:
+    two module objects sharing forward() code never alias (even when
+    invoked from one source line), and repeat calls on one instance
+    from different lines still reuse its parameters."""
+    import paddle_tpu.nn as nn
+
+    x = paddle.to_tensor(np.random.RandomState(2)
+                         .randn(4, 8).astype("float32"))
+
+    class Block(nn.Layer):
+        def forward(self, inp):
+            return fluid.layers.fc(inp, size=6)
+
+    a, b = Block(), Block()
+    ra, rb = a(x).numpy(), b(x).numpy()  # one line: ids distinguish
+    assert not np.allclose(ra, rb)
+    ra2 = a(x).numpy()                   # new line: instance reuses
+    np.testing.assert_allclose(ra, ra2)
