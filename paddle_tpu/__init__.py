@@ -103,6 +103,9 @@ from . import regularizer  # noqa: F401
 from . import hub  # noqa: F401
 from . import onnx  # noqa: F401
 from . import reader  # noqa: F401
+from . import compat  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import dataset  # noqa: F401
 from . import fluid  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from .ops import linalg  # noqa: F401

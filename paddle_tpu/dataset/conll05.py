@@ -1,0 +1,35 @@
+"""Reference: dataset/conll05.py — SRL test reader + dict/embedding
+queries."""
+import numpy as np
+
+__all__ = []
+
+
+def _ds():
+    from ..text.datasets import Conll05st
+    return Conll05st()
+
+
+def get_dict():
+    ds = _ds()
+    return ds.word_dict, ds.predicate_dict, ds.label_dict
+
+
+def get_embedding():
+    """Reference returns the downloaded emb file's contents; offline we
+    derive a deterministic embedding table sized to the word dict."""
+    word_dict = _ds().word_dict
+    rng = np.random.RandomState(0)
+    return rng.randn(len(word_dict), 32).astype("float32")
+
+
+def test():
+    def reader():
+        for sample in _ds():
+            yield tuple(np.asarray(f).reshape(-1) for f in sample)
+
+    return reader
+
+
+def fetch():
+    pass

@@ -1,0 +1,36 @@
+"""Reference: dataset/flowers.py — train/test/valid reader creators
+yielding (CHW float32 image, int label)."""
+import numpy as np
+
+__all__ = []
+
+
+def _reader(mode, cycle=False):
+    from ..vision.datasets import Flowers
+    ds = Flowers(mode=mode)  # once per creator
+
+    def reader():
+        while True:
+            for img, label in ds:
+                yield (np.asarray(img, "float32"),
+                       int(np.asarray(label).reshape(-1)[0]))
+            if not cycle:
+                break
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader("train", cycle)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _reader("test", cycle)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader("valid")
+
+
+def fetch():
+    pass

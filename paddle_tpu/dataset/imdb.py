@@ -1,0 +1,34 @@
+"""Reference: dataset/imdb.py — word_dict() + train/test(word_idx)
+reader creators yielding (word-id sequence, 0/1 label)."""
+import numpy as np
+
+__all__ = []
+
+
+def word_dict():
+    from ..text.datasets import Imdb
+    return dict(Imdb(mode="train").word_idx)
+
+
+def _reader(mode, word_idx):
+    from ..text.datasets import Imdb
+    ds = Imdb(mode=mode)  # once per creator
+
+    def reader():
+        for doc, label in ds:
+            yield list(np.asarray(doc).reshape(-1)), int(
+                np.asarray(label).reshape(-1)[0])
+
+    return reader
+
+
+def train(word_idx):
+    return _reader("train", word_idx)
+
+
+def test(word_idx):
+    return _reader("test", word_idx)
+
+
+def fetch():
+    pass

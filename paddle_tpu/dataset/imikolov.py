@@ -1,0 +1,47 @@
+"""Reference: dataset/imikolov.py — build_dict() + train/test(word_idx,
+n) reader creators yielding n-gram tuples (or (src, trg) in SEQ
+mode)."""
+import numpy as np
+
+__all__ = []
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    from ..text.datasets import Imikolov
+    return dict(Imikolov(mode="train",
+                         min_word_freq=min_word_freq).word_idx)
+
+
+def _reader(mode, word_idx, n, data_type):
+    from ..text.datasets import Imikolov
+    dtype = "NGRAM" if data_type == DataType.NGRAM else "SEQ"
+    ds = Imikolov(data_type=dtype, window_size=n, mode=mode)
+
+    def reader():
+        for sample in ds:
+            if dtype == "NGRAM":
+                yield tuple(int(np.asarray(s).reshape(-1)[0])
+                            if np.ndim(s) == 0 else s for s in sample)
+            else:
+                src, trg = sample
+                yield (list(np.asarray(src).reshape(-1)),
+                       list(np.asarray(trg).reshape(-1)))
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader("train", word_idx, n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader("test", word_idx, n, data_type)
+
+
+def fetch():
+    pass

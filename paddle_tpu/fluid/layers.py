@@ -20,8 +20,13 @@ _PKG_ROOT = _os.path.dirname(
 _JIT_DIR = _PKG_ROOT + "jit" + _os.sep
 
 import itertools as _itertools  # noqa: E402
+import weakref as _weakref  # noqa: E402
 
 _instance_tokens = _itertools.count()
+# side table (NOT an instance attribute: copy.deepcopy of a module
+# would carry an attribute over and alias the copy to the original's
+# cached parameters); weak keys also let dead instances drop out
+_instance_token_map = _weakref.WeakKeyDictionary()
 from ..ops import (creation, linalg, manipulation, math as math_ops,
                    nn_ops, reduction)
 from ..static import data  # noqa: F401
@@ -74,14 +79,14 @@ def _reuse_key(name, config):
                 # everything above it — two module objects sharing
                 # forward() code never alias (even called from one
                 # line), and repeat calls on one instance from
-                # different lines still reuse. A monotonic token stored
-                # on the instance (not id(): CPython recycles freed
-                # addresses, which would alias a new module to a dead
-                # one's parameters) provides the identity.
-                tok = getattr(slf, "_fluid_reuse_token", None)
+                # different lines still reuse. A monotonic token in a
+                # weak side table (not id(): CPython recycles freed
+                # addresses; not an instance attribute: deepcopy would
+                # carry it and alias the copy) provides the identity.
+                tok = _instance_token_map.get(slf)
                 if tok is None:
                     tok = next(_instance_tokens)
-                    object.__setattr__(slf, "_fluid_reuse_token", tok)
+                    _instance_token_map[slf] = tok
                 frames.append(("<layer-instance>", tok))
                 break
         f = f.f_back

@@ -1,0 +1,22 @@
+"""Build-config queries (reference: python/paddle/sysconfig.py —
+get_include/get_lib for compiling extensions against the framework).
+
+The TPU build's native pieces live in runtime_cpp/ and custom ops build
+via utils.cpp_extension (C ABI, no framework headers required), so
+these return the package-local include/lib locations.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory containing C/C++ headers shipped with the package."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "include")
+
+
+def get_lib():
+    """Directory containing the native runtime shared objects."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "runtime_cpp")

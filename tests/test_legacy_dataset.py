@@ -1,0 +1,127 @@
+"""Legacy paddle.dataset / paddle.compat / paddle.sysconfig surfaces
+(reference: python/paddle/dataset/ reader creators, compat.py,
+sysconfig.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _take(reader, n):
+    out = []
+    for i, sample in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(sample)
+    return out
+
+
+def test_mnist_reader():
+    samples = _take(paddle.dataset.mnist.train(), 3)
+    assert len(samples) == 3
+    img, label = samples[0]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert 0 <= label <= 9
+
+
+def test_cifar_readers():
+    img, label = _take(paddle.dataset.cifar.train10(), 1)[0]
+    assert img.shape == (3072,) and 0 <= label <= 9
+    img, label = _take(paddle.dataset.cifar.test100(), 1)[0]
+    assert img.shape == (3072,) and 0 <= label <= 99
+
+
+def test_uci_housing_reader():
+    feat, price = _take(paddle.dataset.uci_housing.train(), 1)[0]
+    assert feat.shape == (13,) and price.shape == (1,)
+
+
+def test_imdb_and_imikolov():
+    wd = paddle.dataset.imdb.word_dict()
+    assert len(wd) > 0
+    doc, label = _take(paddle.dataset.imdb.train(wd), 1)[0]
+    assert len(doc) > 0 and label in (0, 1)
+
+    widx = paddle.dataset.imikolov.build_dict()
+    gram = _take(paddle.dataset.imikolov.train(widx, 5), 1)[0]
+    assert len(gram) == 5
+
+
+def test_movielens_metadata():
+    s = _take(paddle.dataset.movielens.train(), 1)[0]
+    assert len(s) == 8
+    assert paddle.dataset.movielens.max_user_id() >= 1
+    assert paddle.dataset.movielens.max_movie_id() >= 1
+    info = paddle.dataset.movielens.movie_info()
+    mid = next(iter(info))
+    assert info[mid].index == mid and len(info[mid].categories) > 0
+
+
+def test_wmt_readers():
+    src, trg, trg_next = _take(paddle.dataset.wmt14.train(1000), 1)[0]
+    assert len(src) > 0 and len(trg) == len(trg_next)
+    en, fr = paddle.dataset.wmt14.get_dict(100)
+    assert len(en) == 100 and len(fr) == 100
+    s16 = _take(paddle.dataset.wmt16.train(500, 500), 1)[0]
+    assert len(s16) == 3
+
+
+def test_conll05():
+    w, p, l = paddle.dataset.conll05.get_dict()
+    emb = paddle.dataset.conll05.get_embedding()
+    assert emb.shape[0] == len(w)
+    sample = _take(paddle.dataset.conll05.test(), 1)[0]
+    assert len(sample) >= 2
+
+
+def test_image_utils():
+    im = (np.arange(40 * 30 * 3) % 255).reshape(40, 30, 3).astype("uint8")
+    r = paddle.dataset.image.resize_short(im, 24)
+    assert min(r.shape[:2]) == 24
+    c = paddle.dataset.image.center_crop(r, 20)
+    assert c.shape[:2] == (20, 20)
+    chw = paddle.dataset.image.to_chw(c)
+    assert chw.shape[0] == 3
+    t = paddle.dataset.image.simple_transform(im, 32, 24, is_train=True,
+                                              mean=[1.0, 2.0, 3.0])
+    assert t.shape == (3, 24, 24) and t.dtype == np.float32
+
+
+def test_compat():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert paddle.compat.round(0.5) == 1.0     # half away from zero
+    assert paddle.compat.round(-0.5) == -1.0
+    assert paddle.compat.round(2.675, 2) == 2.68
+    assert paddle.compat.floor_division(7, 2) == 3
+    assert paddle.compat.get_exception_message(ValueError("x")) == "x"
+
+
+def test_sysconfig():
+    import os
+    assert isinstance(paddle.sysconfig.get_include(), str)
+    assert os.path.basename(paddle.sysconfig.get_lib()) == "runtime_cpp"
+
+
+def test_deepcopy_layer_gets_fresh_fluid_params():
+    """The instance token lives in a weak side table, NOT an instance
+    attribute — copy.deepcopy of a module must not alias the copy to
+    the original's cached implicit parameters."""
+    import copy
+
+    import paddle_tpu.fluid as fluid
+    import paddle_tpu.nn as nn
+
+    x = paddle.to_tensor(np.random.RandomState(3)
+                         .randn(4, 8).astype("float32"))
+
+    class Block(paddle.nn.Layer):
+        def forward(self, inp):
+            return fluid.layers.fc(inp, size=6)
+
+    a = Block()
+    ra = a(x).numpy()
+    b = copy.deepcopy(a)
+    rb = b(x).numpy()
+    assert not np.allclose(ra, rb), "deepcopy aliased the original"
