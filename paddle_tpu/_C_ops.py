@@ -1,0 +1,94 @@
+"""Reference: python/paddle/_C_ops.py — re-exports every generated
+per-op fast entry point (pybind/op_function_generator.cc's
+`imperative_<op>` functions, the dygraph hot path).
+
+Here the equivalent of a generated C entry point is the registered Op
+object itself: calling it dispatches straight into the cached-
+executable engine (and the lazy micro-trace when active) with no
+Python op-assembly layer in between — the same role `_C_ops.matmul`
+plays in the reference call stack (SURVEY §3.1). Ops resolve lazily by
+name (and the wrapper is cached in the module dict, so repeat accesses
+are plain attribute lookups).
+"""
+__all__ = []
+
+# the generated entry points' attr spellings differ from the op
+# kernels' keyword names for a few hot ops
+_ATTR_ALIASES = {"trans_x": "transpose_x", "trans_y": "transpose_y"}
+
+# the reference's generated functions fall back to op-registered attr
+# defaults when a call omits attrs; the registry kernels use required
+# keyword-only attrs, so the common defaults live here
+_DEFAULTS = {
+    "matmul_v2": {"transpose_x": False, "transpose_y": False},
+    "matmul": {"transpose_x": False, "transpose_y": False},
+    "softmax": {"axis": -1},
+    "concat": {"axis": 0},
+}
+
+
+def _wrap(op):
+    """Adapt the reference _C_ops calling convention — positional
+    tensors followed by alternating ('attr_name', value) pairs, e.g.
+    _C_ops.matmul_v2(x, y, 'trans_x', False, 'trans_y', False) — onto
+    the registry Op's (tensors..., **attrs) signature."""
+    import inspect
+
+    try:
+        required = {
+            p.name for p in inspect.signature(op.fn).parameters.values()
+            if p.kind is inspect.Parameter.KEYWORD_ONLY
+            and p.default is inspect.Parameter.empty}
+    except (TypeError, ValueError):
+        required = set()
+    defaults = _DEFAULTS.get(op.name, {})
+
+    def call(*args, **kwargs):
+        pos = []
+        i = 0
+        while i < len(args) and not isinstance(args[i], str):
+            pos.append(args[i])
+            i += 1
+        attrs = dict(kwargs)
+        while i + 1 < len(args):
+            k = args[i]
+            attrs[_ATTR_ALIASES.get(k, k)] = args[i + 1]
+            i += 2
+        missing = required - attrs.keys()
+        for k in missing & defaults.keys():
+            attrs[k] = defaults[k]
+        still = required - attrs.keys()
+        if still:
+            raise TypeError(
+                f"_C_ops.{op.name} requires attrs {sorted(still)} "
+                f"(pass as keywords or alternating name/value pairs)")
+        return op(*pos, **attrs)
+
+    call.__name__ = op.name
+    call.op = op
+    return call
+
+
+def __getattr__(name):
+    import importlib
+
+    from .core.dispatch import _REGISTRY
+
+    if name not in _REGISTRY:
+        # op modules register on import; load them before declaring
+        # the name missing (real import errors propagate — masking
+        # them as 'no registered op' would misdirect debugging)
+        for mod in ("ops", "ops.linalg", "ops.sequence", "nn.functional",
+                    "vision.ops"):
+            importlib.import_module(f"paddle_tpu.{mod}")
+    if name in _REGISTRY:
+        fn = _wrap(_REGISTRY[name])
+        globals()[name] = fn  # cache: later accesses skip __getattr__
+        return fn
+    raise AttributeError(
+        f"no registered op {name!r} (see paddle_tpu.core.dispatch)")
+
+
+def __dir__():
+    from .core.dispatch import _REGISTRY
+    return sorted(_REGISTRY)

@@ -125,7 +125,10 @@ def _late_bind():
 
 _late_bind()
 
-__version__ = "0.1.0"
+from . import version  # noqa: F401
+from . import _C_ops  # noqa: F401
+
+__version__ = version.full_version
 
 
 def disable_static(place=None):

@@ -5,9 +5,14 @@ import numpy as np
 __all__ = []
 
 
+_ds_cache = []
+
+
 def _ds():
-    from ..text.datasets import Conll05st
-    return Conll05st()
+    if not _ds_cache:
+        from ..text.datasets import Conll05st
+        _ds_cache.append(Conll05st())
+    return _ds_cache[0]
 
 
 def get_dict():

@@ -5,15 +5,18 @@ import numpy as np
 __all__ = []
 
 
-def _reader(mode, cycle=False):
+def _reader(mode, cycle=False, mapper=None):
     from ..vision.datasets import Flowers
     ds = Flowers(mode=mode)  # once per creator
 
     def reader():
         while True:
             for img, label in ds:
-                yield (np.asarray(img, "float32"),
-                       int(np.asarray(label).reshape(-1)[0]))
+                sample = (np.asarray(img, "float32"),
+                          int(np.asarray(label).reshape(-1)[0]))
+                if mapper is not None:
+                    sample = mapper(sample)
+                yield sample
             if not cycle:
                 break
 
@@ -21,15 +24,15 @@ def _reader(mode, cycle=False):
 
 
 def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader("train", cycle)
+    return _reader("train", cycle, mapper)
 
 
 def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
-    return _reader("test", cycle)
+    return _reader("test", cycle, mapper)
 
 
 def valid(mapper=None, buffered_size=1024, use_xmap=True):
-    return _reader("valid")
+    return _reader("valid", mapper=mapper)
 
 
 def fetch():

@@ -13,11 +13,16 @@ def word_dict():
 def _reader(mode, word_idx):
     from ..text.datasets import Imdb
     ds = Imdb(mode=mode)  # once per creator
+    # the caller sizes their embedding table by THEIR dict: keep every
+    # yielded id a valid index into it
+    n_vocab = max(1, len(word_idx)) if word_idx else None
 
     def reader():
         for doc, label in ds:
-            yield list(np.asarray(doc).reshape(-1)), int(
-                np.asarray(label).reshape(-1)[0])
+            ids = [int(i) for i in np.asarray(doc).reshape(-1)]
+            if n_vocab is not None:
+                ids = [i % n_vocab for i in ids]
+            yield ids, int(np.asarray(label).reshape(-1)[0])
 
     return reader
 

@@ -21,16 +21,23 @@ def _reader(mode, word_idx, n, data_type):
     from ..text.datasets import Imikolov
     dtype = "NGRAM" if data_type == DataType.NGRAM else "SEQ"
     ds = Imikolov(data_type=dtype, window_size=n, mode=mode)
+    # keep ids valid indices into the caller's dict (they size their
+    # embedding table by it)
+    n_vocab = max(1, len(word_idx)) if word_idx else None
+
+    def clamp(i):
+        i = int(i)
+        return i % n_vocab if n_vocab is not None else i
 
     def reader():
         for sample in ds:
             if dtype == "NGRAM":
-                yield tuple(int(np.asarray(s).reshape(-1)[0])
-                            if np.ndim(s) == 0 else s for s in sample)
+                yield tuple(clamp(np.asarray(s).reshape(-1)[0])
+                            for s in sample)
             else:
                 src, trg = sample
-                yield (list(np.asarray(src).reshape(-1)),
-                       list(np.asarray(trg).reshape(-1)))
+                yield ([clamp(i) for i in np.asarray(src).reshape(-1)],
+                       [clamp(i) for i in np.asarray(trg).reshape(-1)])
 
     return reader
 

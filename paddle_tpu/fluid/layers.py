@@ -23,10 +23,27 @@ import itertools as _itertools  # noqa: E402
 import weakref as _weakref  # noqa: E402
 
 _instance_tokens = _itertools.count()
-# side table (NOT an instance attribute: copy.deepcopy of a module
-# would carry an attribute over and alias the copy to the original's
-# cached parameters); weak keys also let dead instances drop out
-_instance_token_map = _weakref.WeakKeyDictionary()
+# identity-keyed side table (NOT an instance attribute: copy.deepcopy
+# of a module would carry an attribute over and alias the copy to the
+# original's cached parameters; NOT a WeakKeyDictionary: that keys by
+# __eq__/__hash__, so a Layer subclass defining __eq__ would crash or
+# value-alias). id() keys are guarded against address recycling by a
+# liveness check plus a weakref finalizer that evicts dead entries.
+_instance_token_map = {}
+
+
+def _instance_token(slf):
+    key = id(slf)
+    ent = _instance_token_map.get(key)
+    if ent is not None and ent[0]() is slf:
+        return ent[1]
+    tok = next(_instance_tokens)
+
+    def _evict(_ref, _key=key):
+        _instance_token_map.pop(_key, None)
+
+    _instance_token_map[key] = (_weakref.ref(slf, _evict), tok)
+    return tok
 from ..ops import (creation, linalg, manipulation, math as math_ops,
                    nn_ops, reduction)
 from ..static import data  # noqa: F401
@@ -83,11 +100,7 @@ def _reuse_key(name, config):
                 # weak side table (not id(): CPython recycles freed
                 # addresses; not an instance attribute: deepcopy would
                 # carry it and alias the copy) provides the identity.
-                tok = _instance_token_map.get(slf)
-                if tok is None:
-                    tok = next(_instance_tokens)
-                    _instance_token_map[slf] = tok
-                frames.append(("<layer-instance>", tok))
+                frames.append(("<layer-instance>", _instance_token(slf)))
                 break
         f = f.f_back
     return (tuple(frames),) + config

@@ -125,3 +125,27 @@ def test_deepcopy_layer_gets_fresh_fluid_params():
     b = copy.deepcopy(a)
     rb = b(x).numpy()
     assert not np.allclose(ra, rb), "deepcopy aliased the original"
+
+
+def test_c_ops_module():
+    """paddle._C_ops (reference: python/paddle/_C_ops.py re-exporting
+    the generated per-op fast entry points) — ops resolve by name and
+    accept the reference's alternating ('attr', value) calling
+    convention."""
+    from paddle_tpu import _C_ops
+
+    assert len(dir(_C_ops)) > 250
+    x = paddle.to_tensor(np.ones((2, 3), "float32"))
+    y = paddle.to_tensor(np.ones((3, 4), "float32"))
+    out = _C_ops.matmul_v2(x, y, "trans_x", False, "trans_y", False)
+    assert out.shape == [2, 4]
+    np.testing.assert_allclose(out.numpy(), np.full((2, 4), 3.0))
+    r = _C_ops.relu(paddle.to_tensor(np.array([-1.0, 2.0], "float32")))
+    np.testing.assert_allclose(r.numpy(), [0.0, 2.0])
+
+
+def test_version_module():
+    """paddle.version (reference: generated version.py)."""
+    assert paddle.__version__ == paddle.version.full_version
+    assert paddle.version.major == "2"
+    paddle.utils.require_version("2.0")  # v2.1-compatible gate
