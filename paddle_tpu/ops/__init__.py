@@ -148,6 +148,12 @@ def _patch():
     for name, fn in methods.items():
         setattr(T, name, meth(fn))
 
+    # exported for patch_symbolic (static Variable gets the same
+    # method surface — reference: fluid/layers/math_op_patch.py
+    # monkey_patch_variable)
+    global _METHOD_TABLE
+    _METHOD_TABLE = dict(methods)
+
     def rank_m(self):
         return creation.to_tensor(self.ndim)
     T.rank = rank_m
@@ -196,3 +202,19 @@ def _patch():
 
 
 _patch()
+
+
+def patch_symbolic(V):
+    """Attach the Tensor method surface to the static Variable class
+    (reference: fluid/layers/math_op_patch.py monkey_patch_variable —
+    the method-style API works identically on symbolic variables; the
+    op layer records instead of executing). Arithmetic dunders are
+    Variable's own; comparison dunders are deliberately NOT attached
+    (an elementwise __eq__ would null Variable's hashability)."""
+
+    for name, fn in _METHOD_TABLE.items():
+        if name.endswith("_"):
+            continue  # in-place mutators bypass the recording op layer
+        if not hasattr(V, name):
+            # plain functions bind self when assigned as class attrs
+            setattr(V, name, fn)

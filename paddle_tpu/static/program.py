@@ -33,6 +33,10 @@ _state = threading.local()
 def _register_with_dispatch():
     from ..core import dispatch
     dispatch._static_variable_cls = Variable
+    # full method surface on symbolic variables (reference:
+    # fluid/layers/math_op_patch.py monkey_patch_variable)
+    from .. import ops as ops_mod
+    ops_mod.patch_symbolic(Variable)
 
 
 def building_program():

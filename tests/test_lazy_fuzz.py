@@ -84,3 +84,90 @@ def test_lazy_vs_immediate_values_and_grads(seed):
     np.testing.assert_allclose(v_lazy, v_imm, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(gx_lazy, gx_imm, rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(gy_lazy, gy_imm, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(20, 32))
+def test_three_way_parity_with_compiled(seed):
+    """Third leg: the same random pipeline under jit.to_static (whole
+    program: forward + backward as ONE compiled executable) must match
+    both eager engines — including when the traced function is built
+    and compiled TWICE in one process (regression for the r3
+    tracer-leak class: a cache that captures a tracer poisons the next
+    trace)."""
+    rs = np.random.RandomState(seed)
+    prog = _random_program(rs, depth=rs.randint(3, 9))
+    x_np = rs.randn(4, 4).astype("float32") * 0.5
+    y_np = rs.randn(4, 4).astype("float32") * 0.5
+
+    # immediate-eager ground truth
+    paddle.set_flags({"FLAGS_lazy_eager": False})
+    try:
+        x = paddle.to_tensor(x_np)
+        y = paddle.to_tensor(y_np)
+        x.stop_gradient = False
+        y.stop_gradient = False
+        out = prog(x, y)
+        out.backward()
+        ref = (float(out.numpy()), np.asarray(x.grad.numpy()),
+               np.asarray(y.grad.numpy()))
+    finally:
+        paddle.set_flags({"FLAGS_lazy_eager": True})
+
+    for attempt in range(2):  # second build re-traces from scratch
+        x = paddle.to_tensor(x_np)
+        y = paddle.to_tensor(y_np)
+        x.stop_gradient = False
+        y.stop_gradient = False
+
+        @paddle.jit.to_static
+        def step():
+            out = prog(x, y)
+            out.backward()
+            return out
+
+        vals = [float(step().numpy())
+                for _ in range(3)]  # eager -> record -> compiled
+        assert all(abs(v - vals[0]) < 1e-5 for v in vals), vals
+        np.testing.assert_allclose(vals[-1], ref[0], rtol=1e-5,
+                                   atol=1e-6)
+        # grads accumulate across the 3 calls: compare against 3x ref
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   3 * ref[1], rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y.grad.numpy()),
+                                   3 * ref[2], rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", range(32, 40))
+def test_static_program_leg_matches_eager(seed):
+    """Fourth leg: the same random pipeline recorded as a
+    paddle.static Program (symbolic Variables, Executor compiles the
+    whole program per feed signature) must match immediate-eager
+    forward values."""
+    from paddle_tpu import static
+
+    rs = np.random.RandomState(seed)
+    prog_fn = _random_program(rs, depth=rs.randint(3, 7))
+    x_np = rs.randn(4, 4).astype("float32") * 0.5
+    y_np = rs.randn(4, 4).astype("float32") * 0.5
+
+    paddle.set_flags({"FLAGS_lazy_eager": False})
+    try:
+        out = prog_fn(paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+        ref = float(out.numpy())
+    finally:
+        paddle.set_flags({"FLAGS_lazy_eager": True})
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            xv = static.data("x", [4, 4], "float32")
+            yv = static.data("y", [4, 4], "float32")
+            loss = prog_fn(xv, yv)
+            exe = static.Executor()
+            res, = exe.run(prog, feed={"x": x_np, "y": y_np},
+                           fetch_list=[loss])
+    finally:
+        paddle.disable_static()
+    np.testing.assert_allclose(float(np.asarray(res)), ref,
+                               rtol=1e-5, atol=1e-6)
