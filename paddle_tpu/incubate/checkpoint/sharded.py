@@ -23,9 +23,11 @@ def _checkpointer():
 def _to_arrays(state_dict):
     """paddle state_dict (name -> Tensor) -> name -> jax array."""
     import numpy as np
+
+    from ...core.lazy import concrete
     out = {}
     for k, v in state_dict.items():
-        val = getattr(v, "value", v)
+        val = concrete(getattr(v, "value", v))  # flush LazyArrays
         if isinstance(val, (int, float, np.ndarray)):
             val = jax.numpy.asarray(val)
         out[k] = val
