@@ -469,3 +469,112 @@ class TestTorchOracle:
                      .reshape(-1)[0]),
                float(torch.distributions.kl_divergence(tn, tn2)
                      .numpy()), rtol=1e-5)
+
+
+class TestTorchOracleRound3b:
+    def test_multihead_attention_equivalence(self):
+        """paddle.nn.MultiHeadAttention vs torch.nn.MultiheadAttention
+        under direct weight copy (separate q/k/v projections here map
+        onto torch's packed in_proj)."""
+        import paddle_tpu.nn as nn
+
+        d, h, b, s = 16, 4, 2, 5
+        x = _rs.randn(b, s, d).astype(np.float32)
+
+        paddle.seed(0)
+        pm = nn.MultiHeadAttention(d, h, dropout=0.0)
+        tm = torch.nn.MultiheadAttention(d, h, dropout=0.0,
+                                         batch_first=True)
+        qw = np.asarray(pm.q_proj.weight.numpy())
+        kw = np.asarray(pm.k_proj.weight.numpy())
+        vw = np.asarray(pm.v_proj.weight.numpy())
+        qb = np.asarray(pm.q_proj.bias.numpy())
+        kb = np.asarray(pm.k_proj.bias.numpy())
+        vb = np.asarray(pm.v_proj.bias.numpy())
+        with torch.no_grad():
+            # paddle Linear weight is [in, out]; torch packs q/k/v as
+            # [3d, d] with out-first rows
+            tm.in_proj_weight.copy_(torch.tensor(
+                np.concatenate([qw.T, kw.T, vw.T], 0)))
+            tm.in_proj_bias.copy_(torch.tensor(
+                np.concatenate([qb, kb, vb], 0)))
+            tm.out_proj.weight.copy_(torch.tensor(
+                np.asarray(pm.out_proj.weight.numpy()).T))
+            tm.out_proj.bias.copy_(torch.tensor(
+                np.asarray(pm.out_proj.bias.numpy())))
+
+        pm.eval()
+        po = pm(paddle.to_tensor(x), paddle.to_tensor(x),
+                paddle.to_tensor(x))
+        to, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                   need_weights=False)
+        _close(po.numpy(), to.detach().numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_train_running_stats(self):
+        """Train-mode running-stat updates: paddle's momentum m keeps
+        m*running + (1-m)*batch (reference batch_norm_op), i.e. torch's
+        momentum is (1 - paddle_momentum)."""
+        import paddle_tpu.nn as nn
+
+        x1 = _rs.randn(8, 6, 4, 4).astype(np.float32)
+        x2 = _rs.randn(8, 6, 4, 4).astype(np.float32)
+
+        pbn = nn.BatchNorm2D(6, momentum=0.9)
+        tbn = torch.nn.BatchNorm2d(6, momentum=0.1)
+        pbn.train()
+        tbn.train()
+        for xb in (x1, x2):
+            p_out = pbn(paddle.to_tensor(xb))
+            t_out = tbn(torch.tensor(xb))
+            _close(p_out.numpy(), t_out.detach().numpy(),
+                   rtol=1e-4, atol=1e-5)
+        _close(np.asarray(pbn._mean.numpy()),
+               tbn.running_mean.numpy(), rtol=1e-4, atol=1e-5)
+        # running VARIANCE conventions deliberately differ: torch feeds
+        # the UNBIASED batch variance into running_var; the reference
+        # paddle batch_norm uses the BIASED one — check ours against a
+        # numpy reconstruction of the reference rule
+        exp_var = np.ones(6, np.float32)
+        for xb in (x1, x2):
+            bvar = xb.transpose(1, 0, 2, 3).reshape(6, -1).var(axis=1)
+            exp_var = 0.9 * exp_var + 0.1 * bvar
+        _close(np.asarray(pbn._variance.numpy()), exp_var,
+               rtol=1e-4, atol=1e-5)
+        # eval mode consumes OUR accumulated stats (torch's eval output
+        # differs by the same variance-convention delta): check against
+        # the closed-form normalization with the reconstructed stats
+        pbn.eval()
+        rm = np.asarray(pbn._mean.numpy()).reshape(1, 6, 1, 1)
+        rv = exp_var.reshape(1, 6, 1, 1)
+        w = np.asarray(pbn.weight.numpy()).reshape(1, 6, 1, 1)
+        bb = np.asarray(pbn.bias.numpy()).reshape(1, 6, 1, 1)
+        expect = (x1 - rm) / np.sqrt(rv + 1e-5) * w + bb
+        _close(pbn(paddle.to_tensor(x1)).numpy(), expect,
+               rtol=1e-4, atol=1e-5)
+
+    def test_clip_grad_by_global_norm(self):
+        """ClipGradByGlobalNorm vs torch clip_grad_norm_: same scaling
+        of every gradient when the global norm exceeds the cap."""
+        import paddle_tpu.nn as nn
+
+        shapes = [(6, 4), (4,), (4, 2)]
+        grads = [(_rs.randn(*s) * 3).astype(np.float32) for s in shapes]
+
+        tps = [torch.zeros(*s, requires_grad=True) for s in shapes]
+        for t, g in zip(tps, grads):
+            t.grad = torch.tensor(g)
+        torch.nn.utils.clip_grad_norm_(tps, max_norm=1.0)
+
+        params = [paddle.Parameter(np.zeros(s, np.float32))
+                  for s in shapes]
+        opt = paddle.optimizer.SGD(
+            1.0, parameters=params,
+            grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        from paddle_tpu.core.tensor import Tensor
+        for p, g in zip(params, grads):
+            p._grad = Tensor(g.copy())
+        opt.step()
+        # SGD lr=1 from zero params: new param == -clipped_grad
+        for p, t in zip(params, tps):
+            _close(-np.asarray(p.numpy()), t.grad.numpy(),
+                   rtol=1e-5, atol=1e-6)
