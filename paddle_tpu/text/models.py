@@ -211,8 +211,13 @@ class GPTForCausalLM(nn.Layer):
         the fluid-era GPT examples build per-step in Python — here the
         whole decode is compiler-scheduled.
 
-        Single-chip path (TP decode would shard the caches over 'mp';
-        raises under an active mp mesh)."""
+        Works for TP-configured models too: parameters are FULL logical
+        arrays (GSPMD shards activations inside the pjit'd train step,
+        not the stored weights), so decode reads them directly and runs
+        as a single-device program — correct for any model whose
+        weights + caches fit one chip. Sharding the decode itself over
+        the mesh (for models that NEED TP at inference) would add
+        in_shardings over the head axis; not done here."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -220,10 +225,6 @@ class GPTForCausalLM(nn.Layer):
         from ..core.lazy import concrete
         from ..core.tensor import Tensor
 
-        if _mp_active():
-            raise NotImplementedError(
-                "generate() is the single-chip decode path; under an "
-                "mp mesh run the sharded forward step instead")
         cfg = self.cfg
         nh = cfg.num_heads
         hd = cfg.hidden_size // nh
