@@ -200,7 +200,7 @@ class GPTForCausalLM(nn.Layer):
         return loss
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, seed=0):
+                 top_k=0, seed=0, num_beams=1):
         """TPU-native autoregressive decoding: prefill + per-token
         steps run as ONE jitted program — a `lax.scan` over positions
         with a static-shape KV cache ([L, b, heads, total, hd], write
@@ -276,11 +276,12 @@ class GPTForCausalLM(nn.Layer):
             return (x - mu) / jnp.sqrt(var + 1e-5) * w + bias
 
         def block(x, p, kc, vc, pos):
-            # x [b, t, h]; kc/vc [b, nh, total, hd]; writes at pos..pos+t
-            t = x.shape[1]
+            # x [bb, t, h]; kc/vc [bb, nh, total, hd]; writes at
+            # pos..pos+t (bb = batch OR batch*beams)
+            bb, t = x.shape[0], x.shape[1]
             h_ = ln(x, p["ln1_w"], p["ln1_b"])
             qkv = h_ @ p["qkv_w"] + p["qkv_b"]
-            qkv = qkv.reshape(b, t, 3, nh, hd).transpose(2, 0, 3, 1, 4)
+            qkv = qkv.reshape(bb, t, 3, nh, hd).transpose(2, 0, 3, 1, 4)
             q, k, v = qkv[0], qkv[1], qkv[2]
             z = jnp.int32(0)  # index dtypes must all match under x64
             kc = lax.dynamic_update_slice(kc, k, (z, z, pos, z))
@@ -292,7 +293,7 @@ class GPTForCausalLM(nn.Layer):
             s = jnp.where(kpos <= qpos, s, jnp.float32(-1e30))
             o = jnp.einsum("bhts,bhsd->bhtd",
                            jax.nn.softmax(s, axis=-1), vc)
-            o = o.transpose(0, 2, 1, 3).reshape(b, t, cfg.hidden_size)
+            o = o.transpose(0, 2, 1, 3).reshape(bb, t, cfg.hidden_size)
             x = x + (o @ p["out_w"] + p["out_b"])
             h2 = ln(x, p["ln2_w"], p["ln2_b"])
             m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"],
@@ -346,16 +347,87 @@ class GPTForCausalLM(nn.Layer):
             gen = jnp.concatenate([first[:, None], rest.T], axis=1)
             return jnp.concatenate([ids, gen], axis=1)
 
+        K = int(num_beams)
+
+        def beam_decode(pr, ids):
+            # deterministic beam search over cumulative log-prob
+            # (reference analogue: fluid beam_search op + gather_tree —
+            # here the whole search is one scanned program; beams are a
+            # batch*K batch dim, caches re-gathered by beam each step)
+            kc = jnp.zeros((L, b, nh, total, hd), jnp.float32)
+            vc = jnp.zeros_like(kc)
+            logits, kc, vc = forward_t(pr, ids, jnp.int32(0), kc, vc)
+            lp0 = jax.nn.log_softmax(logits[:, -1])        # [b, V]
+            scores, tok = lax.top_k(lp0, K)                # [b, K]
+            tok = tok.astype(jnp.int32)
+            kc = jnp.repeat(kc, K, axis=1)                 # beams join batch
+            vc = jnp.repeat(vc, K, axis=1)
+            seqs = jnp.zeros((b, K, n_new), jnp.int32)
+            z = jnp.int32(0)
+            seqs = lax.dynamic_update_slice(seqs, tok[:, :, None],
+                                            (z, z, z))
+
+            def step(carry, i):
+                seqs, scores, tok, pos, kc, vc = carry
+                logits, kc, vc = forward_t(pr, tok.reshape(b * K, 1),
+                                           pos, kc, vc)
+                V = logits.shape[-1]
+                lp = jax.nn.log_softmax(logits[:, -1]).reshape(b, K, V)
+                cand = scores[:, :, None] + lp
+                scores, flat = lax.top_k(cand.reshape(b, K * V), K)
+                beam = (flat // V).astype(jnp.int32)
+                tok = (flat % V).astype(jnp.int32)
+                kc = kc.reshape(L, b, K, nh, total, hd)
+                vc = vc.reshape(L, b, K, nh, total, hd)
+                idx = beam[None, :, :, None, None, None]
+                kc = jnp.take_along_axis(kc, idx, axis=2) \
+                    .reshape(L, b * K, nh, total, hd)
+                vc = jnp.take_along_axis(vc, idx, axis=2) \
+                    .reshape(L, b * K, nh, total, hd)
+                seqs = jnp.take_along_axis(seqs, beam[:, :, None],
+                                           axis=1)
+                seqs = lax.dynamic_update_slice(
+                    seqs, tok[:, :, None], (z, z, i))
+                return (seqs, scores, tok, pos + jnp.int32(1),
+                        kc, vc), None
+
+            if n_new > 1:
+                (seqs, scores, _, _, _, _), _ = lax.scan(
+                    step, (seqs, scores, tok, jnp.int32(s0), kc, vc),
+                    jnp.arange(1, n_new, dtype=jnp.int32))
+            # top_k keeps beams sorted by score: beam 0 is the best
+            return jnp.concatenate([ids, seqs[:, 0]], axis=1)
+
         # cache the jitted decode per call signature; weights arrive as
         # ARGUMENTS (not closure constants), so repeat calls — and
         # calls after further training — reuse the same executable
         cache = self.__dict__.setdefault("_decode_jit", {})
-        ck = (b, s0, n_new, greedy, kk)
-        fn = cache.get(ck)
-        if fn is None:
-            fn = cache[ck] = jax.jit(decode)
-        out = fn(params, ids, jax.random.PRNGKey(int(seed)),
-                 jnp.float32(max(temperature, 1e-6)))
+        if K < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        if K > 1:
+            if K > cfg.vocab_size:
+                raise ValueError(f"num_beams {K} > vocab size "
+                                 f"{cfg.vocab_size}")
+            if temperature not in (1.0, 0.0) or top_k or seed:
+                # beam search here is pure max-log-prob search; honoring
+                # sampling args would be a different algorithm — reject
+                # rather than silently ignore them
+                raise ValueError(
+                    "num_beams > 1 is deterministic beam search; "
+                    "temperature/top_k/seed do not apply (use "
+                    "num_beams=1 for sampling)")
+            ck = ("beam", b, s0, n_new, K)
+            fn = cache.get(ck)
+            if fn is None:
+                fn = cache[ck] = jax.jit(beam_decode)
+            out = fn(params, ids)
+        else:
+            ck = (b, s0, n_new, greedy, kk)
+            fn = cache.get(ck)
+            if fn is None:
+                fn = cache[ck] = jax.jit(decode)
+            out = fn(params, ids, jax.random.PRNGKey(int(seed)),
+                     jnp.float32(max(temperature, 1e-6)))
         return Tensor(out.astype(jnp.int64))
 
     def pp_segments(self):

@@ -104,3 +104,55 @@ def test_generate_under_tp_mesh():
         np.testing.assert_array_equal(out, cur)
     finally:
         topology._HYBRID = None
+
+
+def test_beam_search_decode():
+    """num_beams: beam-0 sequence's cumulative log-prob must be >= the
+    greedy sequence's (beam search explores a superset), computed via
+    teacher-forced full forwards; beams join the batch dimension and
+    caches re-gather by beam each step inside one scanned program."""
+    m = _model()
+    rs = np.random.RandomState(9)
+    ids = rs.randint(0, 97, (2, 4)).astype("int64")
+    n_new = 6
+
+    greedy = np.asarray(m.generate(paddle.to_tensor(ids),
+                                   max_new_tokens=n_new,
+                                   temperature=0.0).numpy())
+    beam = np.asarray(m.generate(paddle.to_tensor(ids),
+                                 max_new_tokens=n_new,
+                                 num_beams=4).numpy())
+    assert beam.shape == greedy.shape
+    np.testing.assert_array_equal(beam[:, :4], ids)
+
+    def seq_logprob(full):
+        """Sum of log p(token_t | prefix) over the generated part."""
+        import jax
+        total = np.zeros(full.shape[0])
+        for t in range(4, full.shape[1]):
+            logits = m(paddle.to_tensor(full[:, :t])).numpy()[:, -1]
+            lp = np.asarray(jax.nn.log_softmax(logits))
+            total += lp[np.arange(full.shape[0]), full[:, t]]
+        return total
+
+    # beam-vs-greedy log-prob dominance is the expected outcome but is
+    # NOT a hard guarantee of beam search (the greedy prefix can be
+    # pruned mid-search); assert it only on the deterministic CPU
+    # backend where these seeds are known-good, plus sound invariants
+    # everywhere: reproducibility and sampling-arg rejection.
+    import jax
+
+    if jax.default_backend() == "cpu":
+        lp_beam = seq_logprob(beam)
+        lp_greedy = seq_logprob(greedy)
+        assert (lp_beam >= lp_greedy - 1e-4).all(), (lp_beam, lp_greedy)
+    beam2 = np.asarray(m.generate(paddle.to_tensor(ids),
+                                  max_new_tokens=n_new,
+                                  num_beams=4).numpy())
+    np.testing.assert_array_equal(beam, beam2)
+    import pytest
+    with pytest.raises(ValueError):
+        m.generate(paddle.to_tensor(ids), max_new_tokens=2,
+                   num_beams=4, top_k=5)
+    with pytest.raises(ValueError):
+        m.generate(paddle.to_tensor(ids), max_new_tokens=2, num_beams=0)
