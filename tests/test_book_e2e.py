@@ -205,3 +205,88 @@ class TestRNNEncoderDecoder:
             opt.clear_grad()
         pred = np.argmax(net(t).numpy(), axis=-1)
         assert (pred == src).mean() > 0.9, (pred == src).mean()
+
+
+class TestWord2VecBook:
+    def test_ngram_embedding_predictor_learns(self):
+        """Reference book/test_word2vec_book.py: n-gram context words ->
+        embedding concat -> hidden -> softmax over vocab; fed here from
+        the legacy paddle.dataset.imikolov reader (reader-creator API)."""
+        widx = paddle.dataset.imikolov.build_dict()
+        n = 5
+        grams = []
+        for i, g in enumerate(paddle.dataset.imikolov.train(widx, n)()):
+            if i >= 256:
+                break
+            grams.append(g)
+        grams = np.asarray(grams, "int64")      # [256, 5]
+        ctx, tgt = grams[:, :-1], grams[:, -1]
+        vocab = max(int(grams.max()) + 1, 64)
+
+        paddle.seed(0)
+
+        class W2V(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(vocab, 16)
+                self.fc = nn.Linear(16 * (n - 1), 64)
+                self.out = nn.Linear(64, vocab)
+
+            def forward(self, c):
+                e = self.emb(c)                     # [b, n-1, 16]
+                e = paddle.reshape(e, (e.shape[0], -1))
+                return self.out(F.tanh(self.fc(e)))
+
+        net = W2V()
+        opt = paddle.optimizer.Adam(5e-3, parameters=net.parameters())
+        x = paddle.to_tensor(ctx)
+        y = paddle.to_tensor(tgt)
+
+        @paddle.jit.to_static
+        def step():
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        losses = [float(step().numpy()) for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.5, losses[::20]
+
+
+class TestLabelSemanticRolesBook:
+    def test_crf_tagger_learns(self):
+        """Reference book/test_label_semantic_roles.py shape: token
+        features -> emissions -> linear-chain CRF loss, viterbi decode
+        recovers the planted tag sequence."""
+        rs = np.random.RandomState(0)
+        b, T, ntags = 16, 8, 5
+        feats = rs.randn(b, T, 12).astype("float32")
+        # planted rule: tag = argmax of a fixed random projection
+        proj = rs.randn(12, ntags).astype("float32")
+        tags = (feats @ proj).argmax(-1).astype("int64")
+
+        paddle.seed(0)
+        emit = nn.Linear(12, ntags)
+        opt = paddle.optimizer.Adam(5e-2, parameters=emit.parameters())
+        x = paddle.to_tensor(feats.reshape(b * T, 12))
+
+        from paddle_tpu.ops import sequence as seq_ops
+        trans = paddle.Parameter(
+            (0.1 * rs.randn(ntags + 2, ntags)).astype("float32"))
+        opt2 = paddle.optimizer.Adam(5e-2, parameters=[trans])
+
+        y = paddle.to_tensor(tags)
+        lens = paddle.to_tensor(np.full((b,), T, 'int64'))
+        for _ in range(40):
+            em = paddle.reshape(emit(x), (b, T, ntags))
+            nll = seq_ops.linear_chain_crf(em, trans, y, lens).mean()
+            nll.backward()
+            opt.step()
+            opt2.step()
+            opt.clear_grad()
+            opt2.clear_grad()
+        em = paddle.reshape(emit(x), (b, T, ntags))
+        decoded = seq_ops.crf_decoding(em, trans, lens)
+        acc = float((decoded.numpy() == tags).mean())
+        assert acc > 0.9, acc
