@@ -310,3 +310,56 @@ def test_double_grad_uses_forward_time_values():
     w.value = np.float32(100.0)  # simulate opt.step mutation
     (g,) = paddle.grad(y, w, create_graph=True)
     assert float(g.numpy()) == 6.0
+
+
+def test_double_grad_analytic_sweep():
+    """Second-order grads vs closed forms for transcendental and
+    composite ops (reference: PartialGradEngine create_graph path —
+    partial_grad_engine.cc double-grad)."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    v = np.array([0.3, -0.7, 1.1], np.float32)
+
+    cases = [
+        # (fn, d2/dx2 closed form)
+        (lambda t: t.tanh(),
+         lambda x: -2 * np.tanh(x) * (1 - np.tanh(x) ** 2)),
+        (lambda t: t.sigmoid(),
+         lambda x: (s := 1 / (1 + np.exp(-x))) * (1 - s) * (1 - 2 * s)),
+        (lambda t: t.exp(), np.exp),
+        (lambda t: (t * t * t), lambda x: 6 * x),
+        (lambda t: t.square().log(), lambda x: -2 / x ** 2),
+    ]
+    for fn, d2 in cases:
+        x = paddle.to_tensor(v.copy())
+        x.stop_gradient = False
+        y = fn(x).sum()
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        (g2,) = paddle.grad(g1.sum(), x)
+        np.testing.assert_allclose(np.asarray(g2.numpy()), d2(v),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_double_grad_matmul_mixed():
+    """Mixed second-order through matmul: grad wrt B of sum(A@B * C)
+    is A^T C; the grad wrt A of ||A^T C||^2 must equal the closed form
+    2 C (A^T C)^T."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    rs = np.random.RandomState(0)
+    A = rs.randn(3, 4).astype(np.float32)
+    B = rs.randn(4, 2).astype(np.float32)
+    C = rs.randn(3, 2).astype(np.float32)
+
+    a = paddle.to_tensor(A.copy()); a.stop_gradient = False
+    bt = paddle.to_tensor(B.copy()); bt.stop_gradient = False
+    c = paddle.to_tensor(C.copy())
+    y = (a.matmul(bt) * c).sum()
+    (gb,) = paddle.grad(y, bt, create_graph=True)   # = A^T @ C
+    z = (gb * gb).sum()
+    (ga,) = paddle.grad(z, a)                       # = 2 C @ (A^T C)^T
+    expect = 2 * C @ (A.T @ C).T
+    np.testing.assert_allclose(np.asarray(ga.numpy()), expect,
+                               rtol=1e-4, atol=1e-5)
