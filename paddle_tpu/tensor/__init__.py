@@ -1,0 +1,50 @@
+"""`paddle.tensor` namespace (reference: python/paddle/tensor/ — the
+tensor-function home whose names are ALSO re-exported at top level).
+
+The implementations live in paddle_tpu/ops/; this package exposes them
+under the reference submodule layout (`paddle.tensor.math.add`,
+`paddle.tensor.creation.to_tensor`, ...).
+"""
+from ..ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
+from ..ops import reduction as stat  # noqa: F401  (mean/std/var/median/numel home)
+from . import array, attribute, random, to_string  # noqa: F401
+
+__all__ = []
+
+
+def __getattr__(name):
+    # the reference re-exports every tensor function at this level too
+    # (paddle.tensor.add == paddle.add); forward instead of wildcard
+    # imports, which would drag module internals (jnp, register_op...)
+    # into the namespace
+    import types
+
+    import paddle_tpu as paddle
+
+    # inplace variants live as Tensor METHODS; expose the reference's
+    # free-function form paddle.tensor.add_(x, ...)
+    from ..core.tensor import Tensor
+    if name.endswith("_") and hasattr(Tensor, name):
+        meth = getattr(Tensor, name)
+
+        def free(x, *a, **k):
+            return meth(x, *a, **k)
+
+        free.__name__ = name
+        return free
+    # LoD tensor-array ops live on the fluid surface
+    if name in ("create_array", "array_read", "array_write",
+                "array_length"):
+        from .. import fluid
+        return getattr(fluid.layers, name)
+    try:
+        attr = getattr(paddle, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'paddle.tensor' has no attribute {name!r}") from None
+    if isinstance(attr, types.ModuleType):
+        # don't mirror sibling namespaces (paddle.tensor.nn etc. do not
+        # exist in the reference surface)
+        raise AttributeError(
+            f"module 'paddle.tensor' has no attribute {name!r}")
+    return attr

@@ -1,0 +1,8 @@
+"""Reference: tensor/array.py — LoD tensor-array ops (create_array /
+array_read / array_write / array_length live on the fluid surface
+here; this module forwards)."""
+
+
+def __getattr__(name):
+    from .. import fluid
+    return getattr(fluid.layers, name)

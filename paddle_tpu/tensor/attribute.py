@@ -1,0 +1,7 @@
+"""Reference: tensor/attribute.py — shape/rank/real/imag/is_complex
+etc.; implemented at the paddle top level, forwarded here."""
+
+
+def __getattr__(name):
+    import paddle_tpu as paddle
+    return getattr(paddle, name)

@@ -1,0 +1,8 @@
+"""Reference: tensor/random.py — rand/randn/randint/randperm/uniform/
+normal/multinomial etc.; implemented at the paddle top level (stateless
+PRNG under the hood), forwarded here."""
+
+
+def __getattr__(name):
+    import paddle_tpu as paddle
+    return getattr(paddle, name)

@@ -1,0 +1,7 @@
+"""Reference: tensor/to_string.py — tensor printing options
+(implemented at the paddle top level, forwarded here)."""
+
+
+def __getattr__(name):
+    import paddle_tpu as paddle
+    return getattr(paddle, name)

@@ -316,9 +316,6 @@ def test_double_grad_analytic_sweep():
     """Second-order grads vs closed forms for transcendental and
     composite ops (reference: PartialGradEngine create_graph path —
     partial_grad_engine.cc double-grad)."""
-    import numpy as np
-    import paddle_tpu as paddle
-
     v = np.array([0.3, -0.7, 1.1], np.float32)
 
     cases = [
@@ -345,9 +342,6 @@ def test_double_grad_matmul_mixed():
     """Mixed second-order through matmul: grad wrt B of sum(A@B * C)
     is A^T C; the grad wrt A of ||A^T C||^2 must equal the closed form
     2 C (A^T C)^T."""
-    import numpy as np
-    import paddle_tpu as paddle
-
     rs = np.random.RandomState(0)
     A = rs.randn(3, 4).astype(np.float32)
     B = rs.randn(4, 2).astype(np.float32)
