@@ -42,15 +42,19 @@ from . import flags as flags_mod
 from . import trace as trace_mod
 
 
-def static_int_exponent(base_is_inexact, y):
+def static_int_exponent(base_dtype, y):
     """Exponent for the exact-multiply-chain pow fast path
     (lax.integer_pow), or None to take the general jnp.power path.
-    Guards: bools excluded; float exponents only promote-safely on
-    float bases (int_array ** 2.0 must yield float via jnp.power);
-    negative exponents on integer bases are integer division in
-    integer_pow (wrong), so those also fall through."""
+    Guards: bool exponents AND bool bases excluded (integer_pow rejects
+    bool; jnp.power promotes it to int32); float exponents only
+    promote-safely on float bases (int_array ** 2.0 must yield float
+    via jnp.power); negative exponents on integer bases are integer
+    division in integer_pow (wrong), so those also fall through."""
     if isinstance(y, bool) or not isinstance(y, (int, float)):
         return None
+    if jnp.issubdtype(base_dtype, jnp.bool_):
+        return None
+    base_is_inexact = jnp.issubdtype(base_dtype, jnp.inexact)
     fy = float(y)
     if not fy.is_integer() or not -64 <= fy <= 64:
         return None
@@ -237,8 +241,7 @@ class LazyArray:
         # static integer exponents lower to an exact multiply chain
         # (lax.integer_pow); lax.pow is exp(y*log(x)) whose TPU
         # transcendentals make even x**2 inexact (9.000011 for 3**2)
-        n = static_int_exponent(
-            jnp.issubdtype(self.dtype, jnp.inexact), other)
+        n = static_int_exponent(self.dtype, other)
         if n is not None:
             if enabled():
                 try:

@@ -61,9 +61,25 @@ class Fleet:
                                       comm_world="worker")
         if output is not None:
             # legacy contract: the caller-provided buffer receives the
-            # reduction (reference fleet_base.py:222)
+            # reduction (reference fleet_base.py:222). np.asarray on a
+            # list/Tensor would copy, silently dropping the write, so
+            # only buffers we can genuinely mutate are accepted.
             import numpy as np
-            np.asarray(output)[...] = np.asarray(res)
+            arr = np.asarray(res)
+            if isinstance(output, np.ndarray):
+                output[...] = arr
+            elif isinstance(output, list):
+                output[:] = np.atleast_1d(arr).tolist()
+            elif hasattr(output, "set_value"):  # paddle_tpu Tensor —
+                # set_value validates shape and goes through the
+                # trace-aware value setter (a raw _value write would be
+                # invisible to an active trace)
+                output.set_value(arr)
+            else:
+                raise TypeError(
+                    "all_reduce_worker: cannot write in place into "
+                    f"{type(output).__name__}; pass an ndarray/list/"
+                    "Tensor or use the return value")
         return res
 
     # --- lifecycle ---

@@ -56,6 +56,8 @@ def load_sharded(path, target=None, shardings=None):
     """
     import os
 
+    import numpy as np
+
     import orbax.checkpoint as ocp
     ckptr = _checkpointer()
     apath = os.path.abspath(str(path))
@@ -63,6 +65,36 @@ def load_sharded(path, target=None, shardings=None):
         ref = {}
         src = target if target is not None else {}
         tree = ckptr.metadata(apath).item_metadata.tree
+        if target is not None:
+            # validate BEFORE the restore reads anything from disk: a
+            # mismatch on a multi-GB checkpoint must not cost the full
+            # restore I/O (or die inside orbax with an opaque
+            # incompatible-sharding error) before the friendly
+            # per-parameter message fires
+            missing = [k for k in target if k not in tree]
+            if missing:
+                raise KeyError(
+                    f"checkpoint at {path} has no entries for target "
+                    f"keys {sorted(missing)} — a silently half-restored "
+                    f"model would compute with its random init for "
+                    f"those parameters (reference set_state_dict "
+                    f"surfaces missing keys the same way)")
+            for k, t in target.items():
+                m = tree[k]
+                cur = getattr(t, "value", t)
+                cur_shape = tuple(getattr(cur, "shape", ()) or ())
+                if tuple(m.shape) != cur_shape:
+                    raise ValueError(
+                        f"checkpoint parameter {k!r} has shape "
+                        f"{tuple(m.shape)} but the target expects "
+                        f"{cur_shape} — restoring it would defer the "
+                        f"failure to a confusing downstream shape "
+                        f"error")
+                if (hasattr(cur, "dtype") and
+                        np.dtype(m.dtype) != np.dtype(cur.dtype)):
+                    raise ValueError(
+                        f"checkpoint parameter {k!r} has dtype "
+                        f"{m.dtype} but the target expects {cur.dtype}")
         for k, m in tree.items():
             sh = (shardings or {}).get(k)
             if sh is None and target is not None and k in src:
@@ -74,14 +106,6 @@ def load_sharded(path, target=None, shardings=None):
     else:
         restored = ckptr.restore(apath)
     if target is not None:
-        missing = [k for k in target if k not in restored]
-        if missing:
-            raise KeyError(
-                f"checkpoint at {path} has no entries for target keys "
-                f"{sorted(missing)} — a silently half-restored model "
-                f"would compute with its random init for those "
-                f"parameters (reference set_state_dict surfaces "
-                f"missing keys the same way)")
         for k, t in target.items():
             if hasattr(t, "value"):
                 t.value = restored[k]

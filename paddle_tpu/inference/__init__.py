@@ -13,9 +13,26 @@ from ..core.tensor import Tensor
 from ..jit.save_load import load as _jit_load
 
 
+_warned_knobs = set()
+
+
+def _warn_unsupported(knob, equivalent):
+    """One warning per unsupported Config knob per process — these are
+    accepted for source compat but MUST not be silent no-ops (a user
+    flipping enable_use_gpu deserves to learn what actually runs)."""
+    if knob in _warned_knobs:
+        return
+    _warned_knobs.add(knob)
+    import warnings
+    warnings.warn(
+        f"paddle.inference.Config.{knob} has no effect on TPU: "
+        f"{equivalent}", UserWarning, stacklevel=3)
+
+
 class Config:
     """Reference: AnalysisConfig. Model path + execution knobs; GPU/TRT
-    options accepted for compat and ignored (XLA owns optimization)."""
+    options accepted for source compat but warn once (XLA owns
+    optimization; the TPU equivalent is named in the warning)."""
 
     def __init__(self, prog_file=None, params_file=None):
         if prog_file is not None and prog_file.endswith(".pdmodel"):
@@ -31,19 +48,30 @@ class Config:
         return self._model_prefix
 
     def enable_use_gpu(self, *a, **k):
-        pass
+        _warn_unsupported(
+            "enable_use_gpu",
+            "the predictor runs on the TPU (or CPU) jax backend; "
+            "device selection follows JAX_PLATFORMS")
 
     def enable_memory_optim(self, flag=True):
         self._enable_memory_optim = flag
 
     def switch_ir_optim(self, flag=True):
-        pass
+        if not flag:
+            _warn_unsupported(
+                "switch_ir_optim(False)",
+                "XLA compilation IS the IR-optimization pipeline here "
+                "and cannot be disabled")
 
     def enable_tensorrt_engine(self, *a, **k):
-        pass
+        _warn_unsupported(
+            "enable_tensorrt_engine",
+            "XLA is the execution engine; for int8 use "
+            "paddle.quantization PTQ/QAT which runs W8A8 on the int8 "
+            "MXU")
 
     def disable_glog_info(self):
-        pass
+        pass  # genuinely a logging knob; nothing to warn about
 
 
 class _IOHandle:

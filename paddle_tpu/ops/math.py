@@ -113,9 +113,8 @@ def pow(x, y, name=None):  # noqa: A001
     # even x**2 inexact
     from ..core.dtype import to_jax_dtype
     from ..core.lazy import static_int_exponent
-    inexact = jnp.issubdtype(
-        to_jax_dtype(getattr(x, "dtype", "float32")), jnp.inexact)
-    n = static_int_exponent(inexact, y)
+    n = static_int_exponent(
+        to_jax_dtype(getattr(x, "dtype", "float32")), y)
     if n is not None:
         return _pow_int(x, n=n)
     return pow_(x, y)

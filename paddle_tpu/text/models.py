@@ -199,6 +199,24 @@ class GPTForCausalLM(nn.Layer):
             manipulation.reshape(labels, (-1,)))
         return loss
 
+    _DECODE_CACHE_MAX = 16
+
+    @staticmethod
+    def _decode_cache_get(cache, key, build):
+        """LRU get-or-jit on the per-shape decode cache: each distinct
+        call signature compiles its own executable, and serving loops
+        with arbitrary prompt lengths must not retain unboundedly
+        many."""
+        import jax
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = jax.jit(build)
+            while len(cache) > GPTForCausalLM._DECODE_CACHE_MAX:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+        return fn
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=0, seed=0, num_beams=1):
         """TPU-native autoregressive decoding: prefill + per-token
@@ -400,8 +418,15 @@ class GPTForCausalLM(nn.Layer):
 
         # cache the jitted decode per call signature; weights arrive as
         # ARGUMENTS (not closure constants), so repeat calls — and
-        # calls after further training — reuse the same executable
-        cache = self.__dict__.setdefault("_decode_jit", {})
+        # calls after further training — reuse the same executable.
+        # Every distinct (batch, prompt_len, max_new_tokens) compiles
+        # its own executable; an LRU cap keeps variable-length serving
+        # loops from retaining unboundedly many (callers who want zero
+        # recompiles should pad prompts to a fixed length themselves,
+        # since padding here would let attention see the pad tokens).
+        import collections
+        cache = self.__dict__.setdefault("_decode_jit",
+                                         collections.OrderedDict())
         if K < 1:
             raise ValueError(f"num_beams must be >= 1, got {num_beams}")
         if K > 1:
@@ -417,15 +442,11 @@ class GPTForCausalLM(nn.Layer):
                     "temperature/top_k/seed do not apply (use "
                     "num_beams=1 for sampling)")
             ck = ("beam", b, s0, n_new, K)
-            fn = cache.get(ck)
-            if fn is None:
-                fn = cache[ck] = jax.jit(beam_decode)
+            fn = self._decode_cache_get(cache, ck, beam_decode)
             out = fn(params, ids)
         else:
             ck = (b, s0, n_new, greedy, kk)
-            fn = cache.get(ck)
-            if fn is None:
-                fn = cache[ck] = jax.jit(decode)
+            fn = self._decode_cache_get(cache, ck, decode)
             out = fn(params, ids, jax.random.PRNGKey(int(seed)),
                      jnp.float32(max(temperature, 1e-6)))
         return Tensor(out.astype(jnp.int64))

@@ -1,0 +1,87 @@
+"""Round-4 fixes for the round-3 advisor findings (ADVICE.md):
+bool-base pow fast path, legacy all_reduce_worker in-place contract,
+sharded-checkpoint shape/dtype validation, decode-cache LRU cap."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_pow_bool_base_promotes():
+    """ADVICE #1: bool_tensor ** 2 must take the jnp.power path (bool
+    promotes to int32) instead of raising in lax.integer_pow."""
+    b = paddle.to_tensor(np.array([True, False, True]))
+    out = b ** 2
+    np.testing.assert_array_equal(np.asarray(out.numpy()), [1, 0, 1])
+    out2 = paddle.pow(b, 2)
+    np.testing.assert_array_equal(np.asarray(out2.numpy()), [1, 0, 1])
+    # fast path still takes exact multiply chains for numeric bases
+    x = paddle.to_tensor(np.array([3.0], np.float32))
+    np.testing.assert_allclose((x ** 2).numpy(), [9.0], rtol=0, atol=0)
+
+
+def test_all_reduce_worker_inplace_contract():
+    """ADVICE #2: the caller-provided buffer must actually receive the
+    reduction for ndarray/list/Tensor outputs; unsupported buffer types
+    raise instead of silently dropping the write."""
+    from paddle_tpu.fluid.incubate.fleet.collective import fleet
+
+    src = np.array([1.0, 2.0], np.float32)
+
+    buf = np.zeros(2, np.float32)
+    fleet.all_reduce_worker(src, buf)
+    np.testing.assert_array_equal(buf, src)
+
+    lst = [0.0, 0.0]
+    fleet.all_reduce_worker(src, lst)
+    assert lst == [1.0, 2.0]
+
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    fleet.all_reduce_worker(src, t)
+    np.testing.assert_array_equal(np.asarray(t.numpy()), src)
+
+    sc = [0.0]  # scalar (0-d) reduction into a one-slot list buffer
+    fleet.all_reduce_worker(np.float32(3.0), sc)
+    assert sc == [3.0]
+
+    with pytest.raises(TypeError, match="in place"):
+        fleet.all_reduce_worker(src, (0.0, 0.0))
+
+
+def test_load_sharded_validates_shape_dtype(tmp_path):
+    """ADVICE #3: restoring a checkpoint into a target with a mismatched
+    parameter shape/dtype raises naming the parameter, instead of
+    deferring to a downstream shape error."""
+    from paddle_tpu.incubate.checkpoint.sharded import (load_sharded,
+                                                        save_sharded)
+
+    lin = paddle.nn.Linear(4, 3)
+    path = tmp_path / "ckpt"
+    save_sharded(lin.state_dict(), path)
+
+    wrong_shape = paddle.nn.Linear(4, 5)
+    with pytest.raises(ValueError, match="shape"):
+        load_sharded(path, target=wrong_shape.state_dict())
+
+    ok = paddle.nn.Linear(4, 3)
+    load_sharded(path, target=ok.state_dict())
+    np.testing.assert_array_equal(ok.weight.numpy(), lin.weight.numpy())
+
+
+def test_generate_decode_cache_capped():
+    """ADVICE #4: the per-shape decode-executable cache is LRU-capped so
+    variable-length serving loops can't grow it unboundedly."""
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    cfg = TransformerLMConfig(vocab_size=31, hidden_size=16,
+                              num_layers=1, num_heads=2,
+                              max_seq_len=128, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    cap = GPTForCausalLM._DECODE_CACHE_MAX
+    for s0 in range(1, cap + 4):  # cap+3 distinct prompt lengths
+        ids = paddle.to_tensor(np.ones((1, s0), np.int64))
+        m.generate(ids, max_new_tokens=2, temperature=0.0)
+    assert len(m._decode_jit) <= cap
+    # most-recent entry survives (LRU, not clear-on-full)
+    assert (1, cap + 3, 2, True, 0) in m._decode_jit

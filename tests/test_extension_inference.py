@@ -61,3 +61,66 @@ def test_inference_predictor_api(tmp_path):
     np.testing.assert_allclose(result, net(paddle.to_tensor(x)).numpy(),
                                atol=1e-5)
     assert result.sum() == pytest.approx(1.0, rel=1e-4)
+
+
+def test_unsupported_config_knobs_warn_once(tmp_path):
+    """GPU/TRT knobs must warn (once) naming the TPU equivalent, not
+    silently no-op (reference AnalysisConfig surface,
+    analysis_predictor.h:82)."""
+    import warnings
+    from paddle_tpu import inference
+    inference._warned_knobs.clear()
+    cfg = inference.Config()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cfg.enable_use_gpu(100, 0)
+        cfg.enable_use_gpu(100, 0)  # second call: no second warning
+        cfg.enable_tensorrt_engine(max_batch_size=4)
+        cfg.switch_ir_optim(True)   # supported direction: no warning
+        cfg.switch_ir_optim(False)
+    msgs = [str(x.message) for x in w]
+    assert sum("enable_use_gpu" in m for m in msgs) == 1
+    assert sum("enable_tensorrt_engine" in m for m in msgs) == 1
+    assert sum("switch_ir_optim" in m for m in msgs) == 1
+    assert any("JAX_PLATFORMS" in m for m in msgs)  # equivalent named
+
+
+def test_predictor_pool_concurrent(tmp_path):
+    """PredictorPool: N predictors over one config serve concurrently
+    from separate threads with per-predictor staged inputs kept
+    isolated (reference paddle_infer.PredictorPool semantics)."""
+    import threading
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu import inference
+    net = nn.Sequential(nn.Linear(4, 3))
+    net.eval()
+    path = str(tmp_path / "m")
+    paddle.jit.save(net, path, input_spec=[InputSpec([1, 4], "float32")])
+
+    pool = inference.PredictorPool(inference.Config(path + ".pdmodel"),
+                                   size=4)
+    xs = [np.random.randn(1, 4).astype("float32") for _ in range(4)]
+    want = [net(paddle.to_tensor(x)).numpy() for x in xs]
+    got = [None] * 4
+    errs = []
+
+    def serve(i):
+        try:
+            p = pool.retrieve(i)
+            name = p.get_input_names()[0]
+            for _ in range(5):  # repeat to give interleaving a chance
+                p.get_input_handle(name).copy_from_cpu(xs[i])
+                assert p.run()
+                out = p.get_output_handle(
+                    p.get_output_names()[0]).copy_to_cpu()
+            got[i] = out
+        except Exception as e:  # noqa: BLE001
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=serve, args=(i,))
+               for i in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs, errs
+    for i in range(4):
+        np.testing.assert_allclose(got[i], want[i], atol=1e-5)
