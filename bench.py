@@ -1,6 +1,7 @@
 """Headline benchmark: ResNet-50 training step, single chip (BASELINE.md
-config 2). Prints ONE JSON line:
+config 2). Prints JSON lines of the form
 {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...provenance}
+— the driver tail-parses, so the LAST line printed is the round's record.
 
 vs_baseline is measured samples/sec divided by 0.9x of a published-class
 A100 ResNet-50 fp16 training throughput (~1500 img/s single GPU), i.e. the
@@ -8,23 +9,28 @@ BASELINE.md north-star target (>=0.9x A100+NCCL); >1.0 means target met.
 Runs bf16 compute via AMP autocast, whole step compiled with to_static
 (the reference's static-graph mode).
 
+Round-4 emission contract (the r3 postmortem: the run overran the
+driver's own cap and died rc=124 with only the cached number):
+
+  1. the best CACHED measurement from bench_artifacts/ is printed
+     IMMEDIATELY at startup — from that point on, whatever happens, a
+     nonzero artifact-backed line exists;
+  2. the live measurement is attempted in fresh subprocesses within a
+     total budget from $BENCH_DEADLINE_SECS, defaulting to 1200 s —
+     deliberately WELL under any plausible driver cap;
+  3. on success the live line is printed LAST (tail-parse upgrades the
+     record to source:"live"); on failure a final cached line carrying
+     the wedge-report evidence is printed last; either way exit 0.
+
 Wedge-survival architecture (round 3): the tunneled TPU backend can hang
 indefinitely (not fail) during init, and a hung init poisons the whole
-process (jax's backend cache + init lock). So:
-
-  * every measurement attempt runs in a FRESH SUBPROCESS
-    (``bench.py --worker``) — a wedge dies with its subprocess and the
-    orchestrator stays healthy;
-  * attempts are spread over the whole run budget with exponential
-    backoff (1 min -> 10 min sleeps), not burned in a 12-minute burst;
-  * every successful measurement persists full raw evidence (per-phase
-    warmup timings, repeated timed runs, device info) to
-    ``bench_artifacts/`` which is kept in git;
-  * on total failure the orchestrator emits the most recent CACHED
-    measurement from bench_artifacts/ with explicit provenance
-    ("source": "cached", "measured_at": ..., "error": ...) instead of a
-    bare 0.0 — and a SIGTERM handler + watchdog guarantee the one JSON
-    line is printed even if the driver kills us or the deadline passes.
+process (jax's backend cache + init lock). So every measurement attempt
+runs in a FRESH SUBPROCESS (``bench.py --worker``) — a wedge dies with
+its subprocess and the orchestrator stays healthy; every successful
+measurement persists full raw evidence (per-phase warmup timings,
+repeated timed runs, device info) to ``bench_artifacts/`` which is kept
+in git; a SIGTERM handler + watchdog guarantee the final line is
+printed even if the driver kills us or the deadline passes.
 
 Timing method (see bench_artifacts/README.md): chained steps with ONE
 final device-to-host sync. block_until_ready() can return early over the
@@ -44,17 +50,20 @@ _TARGET = 0.9 * 1500.0  # 0.9x A100-class ResNet-50 fp16 throughput
 _ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_artifacts")
 _print_lock = threading.Lock()
-_printed = False
+_final_printed = False
 
 
-def _emit(payload):
-    """Print the one JSON result line exactly once (watchdog thread and
-    main thread can race here)."""
-    global _printed
+def _emit(payload, final=True):
+    """Print a JSON result line. The driver tail-parses, so lines are
+    ordered worst-to-best: a provisional cached line first (final=False),
+    the definitive line last. Only ONE final line is ever printed
+    (watchdog / SIGTERM handler / main thread can race here)."""
+    global _final_printed
     with _print_lock:
-        if _printed:
-            return
-        _printed = True
+        if final:
+            if _final_printed:
+                return
+            _final_printed = True
         print(json.dumps(payload), flush=True)
 
 
@@ -99,24 +108,33 @@ def _write_wedge_report(err):
         return None
 
 
-def _emit_fallback(err):
-    """Emit the cached measurement with provenance, or a diagnostic 0."""
-    report = _write_wedge_report(err)
+def _cached_payload():
+    """Best cached measurement as an emit payload, or None."""
     cached = _latest_artifact()
-    if cached is not None:
-        art, fname = cached
-        _emit({
-            "metric": _METRIC,
-            "value": art["samples_per_sec"],
-            "unit": "samples/sec",
-            "vs_baseline": round(art["samples_per_sec"] / _TARGET, 4),
-            "source": "cached",
-            "measured_at": art.get("timestamp"),
-            "artifact": f"bench_artifacts/{fname}",
-            "error": f"live measurement failed this run: {err}",
-            "evidence": (f"bench_artifacts/{report}" if report
-                         else None),
-        })
+    if cached is None:
+        return None
+    art, fname = cached
+    return {
+        "metric": _METRIC,
+        "value": art["samples_per_sec"],
+        "unit": "samples/sec",
+        "vs_baseline": round(art["samples_per_sec"] / _TARGET, 4),
+        "source": "cached",
+        "measured_at": art.get("timestamp"),
+        "artifact": f"bench_artifacts/{fname}",
+    }
+
+
+def _emit_fallback(err):
+    """Emit the final cached line with failure provenance, or a
+    diagnostic 0."""
+    report = _write_wedge_report(err)
+    payload = _cached_payload()
+    if payload is not None:
+        payload["error"] = f"live measurement failed this run: {err}"
+        payload["evidence"] = (f"bench_artifacts/{report}" if report
+                               else None)
+        _emit(payload)
     else:
         _emit({
             "metric": _METRIC, "value": 0.0, "unit": "samples/sec",
@@ -265,11 +283,20 @@ def main():
 
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
-    # total budget for ALL attempts; the r2 postmortem: 8x90s in-process
-    # retries burned 12 min of a longer window against one wedged client
-    deadline = float(os.environ.get("BENCH_DEADLINE_SECS", "2700"))
+    # total budget for ALL attempts, deliberately WELL under any driver
+    # cap (r3 died rc=124: its 2700 s default overran the driver's own
+    # timeout, so the live upgrade never got to print)
+    deadline = float(os.environ.get("BENCH_DEADLINE_SECS", "1200"))
     t_end = time.time() + deadline
     os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+
+    # contract step 1: the best cached line goes out IMMEDIATELY —
+    # from here on even a SIGKILL leaves a nonzero artifact-backed line
+    provisional = _cached_payload()
+    if provisional is not None:
+        provisional["note"] = ("provisional pre-attempt line; a later "
+                               "line supersedes this one")
+        _emit(provisional, final=False)
 
     last_err = "no attempt completed"
 
@@ -279,7 +306,7 @@ def main():
         # print — return instead and let it finish
         if not _print_lock.acquire(timeout=2.0):
             return
-        already = _printed
+        already = _final_printed
         _print_lock.release()
         if not already:
             _emit_fallback(f"terminated by signal {signum}; "
