@@ -29,16 +29,16 @@ from .. import topology
 from ...core import trace as trace_mod
 
 
-def _shard_spec(shape, deg):
+def _shard_spec(shape, deg, axis="sharding"):
     spec = [None] * len(shape)
     for i, s in enumerate(shape):
         if s % deg == 0 and s >= deg:
-            spec[i] = "sharding"
+            spec[i] = axis
             break
     return spec
 
 
-def _place_once(t, mesh, deg, placed):
+def _place_once(t, mesh, deg, placed, axis="sharding"):
     """Physically shard a state tensor's array over the sharding axis
     (eager, one-time)."""
     if id(t) in placed:
@@ -46,7 +46,7 @@ def _place_once(t, mesh, deg, placed):
     v = t._value
     if v is None or getattr(v, "ndim", 0) == 0:
         return
-    spec = _shard_spec(v.shape, deg)
+    spec = _shard_spec(v.shape, deg, axis)
     if not any(spec):
         return
     try:
@@ -59,15 +59,21 @@ def _place_once(t, mesh, deg, placed):
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            group=None, offload=False, sync_buffers=False,
                            buffer_max_size=2 ** 23, segment_size=2 ** 20,
-                           sync_comm=False):
+                           sync_comm=False, axis="sharding"):
     """Reference: python/paddle/distributed/sharding/group_sharded.py.
-    level: 'os' (ZeRO-1), 'os_g' (ZeRO-2), 'p_g_os' (ZeRO-3)."""
+    level: 'os' (ZeRO-1), 'os_g' (ZeRO-2), 'p_g_os' (ZeRO-3).
+
+    axis: mesh axis the shards live on. The default is the dedicated
+    'sharding' axis; pass "dp" for the reference's standard hybrid where
+    ZeRO is folded into data parallelism (sharding_optimizer.py:118-138
+    — dp replicas double as shard owners, so dp x mp x pp meshes get
+    ZeRO without a fourth axis)."""
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"unknown sharding level {level!r}")
     mesh = topology.get_mesh()
-    if mesh is None or int(mesh.shape.get("sharding", 1)) == 1:
+    if mesh is None or int(mesh.shape.get(axis, 1)) == 1:
         return model, optimizer, scaler
-    deg = int(mesh.shape["sharding"])
+    deg = int(mesh.shape[axis])
 
     from ..fleet.meta_parallel.mp_layers import shard_constraint
     shard_grads = level in ("os_g", "p_g_os")
@@ -78,7 +84,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
 
     if shard_params:
         for p in params:
-            _place_once(p, mesh, deg, placed)
+            _place_once(p, mesh, deg, placed, axis)
 
     def sharded_step():
         in_trace = trace_mod.current_trace() is not None
@@ -90,7 +96,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                 if g is None:
                     continue
                 shape = g.aval_shape()
-                spec = _shard_spec(shape, deg) if shape else []
+                spec = _shard_spec(shape, deg, axis) if shape else []
                 if any(spec):
                     out = shard_constraint(g, spec, mesh=mesh)
                     if out is not g:
@@ -101,7 +107,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                 shape = t.aval_shape()
                 if not shape:
                     continue
-                spec = _shard_spec(shape, deg)
+                spec = _shard_spec(shape, deg, axis)
                 if not any(spec):
                     continue
                 if in_trace:
@@ -109,12 +115,12 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                     if out is not t:
                         t.value = out.value
                 else:
-                    _place_once(t, mesh, deg, placed)
+                    _place_once(t, mesh, deg, placed, axis)
         for p in params:
             shape = p.aval_shape()
             if not shape:
                 continue
-            spec = _shard_spec(shape, deg) if shard_params \
+            spec = _shard_spec(shape, deg, axis) if shard_params \
                 else [None] * len(shape)
             if shard_params and not any(spec):
                 continue
@@ -127,7 +133,7 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                 if out is not p:
                     p.value = out.value
             elif shard_params:
-                _place_once(p, mesh, deg, placed)
+                _place_once(p, mesh, deg, placed, axis)
 
     optimizer.step = sharded_step
     return model, optimizer, scaler
