@@ -24,9 +24,17 @@ def _to_arr(v):
 
 
 def _wrap_out(tree):
+    """Wrap raw arrays into Tensors, REGISTERED with the active trace:
+    an unregistered Tensor read later in the same record pass would be
+    captured as an external input holding a trace-local value (a leaked
+    tracer at replay time)."""
     if isinstance(tree, (tuple, list)):
         return type(tree)(_wrap_out(t) for t in tree)
-    return Tensor(tree)
+    t = Tensor(tree)
+    ctx = trace_mod.current_trace()
+    if ctx is not None:
+        ctx.register_created(t)
+    return t
 
 
 def _lift(fn):

@@ -1,0 +1,353 @@
+"""dy2static loop family: for-range/for-iter -> lax loops,
+break/continue transformation, list append rewriting (reference:
+loop_transformer.py:486 LoopTransformer, break_continue_transformer.py:87
+BreakContinueTransformer, list_transformer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _trace_count(fn):
+    """Number of compiled entries a to_static fn accumulated."""
+    return len(fn.entries)
+
+
+def test_for_range_tensor_bound_single_program():
+    """Data-dependent trip count: for over a Tensor bound must compile
+    to ONE lax.while_loop program, not a python unroll — the SAME
+    compiled program must then serve a different bound value."""
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+        return s
+
+    xp = np.full((3,), 2.0, np.float32)
+    for _ in range(3):  # eager -> record -> compiled
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(5)))
+    np.testing.assert_allclose(out.numpy(), xp * 5)
+    # different trip count through the SAME executable (no retrace for
+    # a data-dependent bound: the loop is inside the program)
+    n_entries = _trace_count(f)
+    out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(9)))
+    np.testing.assert_allclose(out.numpy(), xp * 9)
+    assert _trace_count(f) == n_entries
+
+
+def test_for_range_python_bounds_keep_python_semantics():
+    @paddle.jit.to_static
+    def f(x):
+        s = x * 0.0
+        for i in range(4):
+            s = s + x * float(i)
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp))
+    np.testing.assert_allclose(out.numpy(), xp * 6.0)  # 0+1+2+3
+
+
+def test_for_range_start_stop_step_tensor():
+    @paddle.jit.to_static
+    def f(x, start, stop):
+        s = x * 0.0
+        for i in range(start, stop, 2):
+            s = s + x
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(1)),
+                paddle.to_tensor(np.int64(8)))
+    np.testing.assert_allclose(out.numpy(), xp * 4)  # 1,3,5,7
+
+
+def test_break_with_tensor_predicate_in_tensor_loop():
+    """The reference's BreakContinueTransformer flag scheme: a Tensor
+    break predicate folds into the traced loop condition."""
+    @paddle.jit.to_static
+    def f(x, n, cap):
+        s = x * 0.0
+        for i in range(n):
+            if s.sum() >= cap:
+                break
+            s = s + x
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(100)),
+                paddle.to_tensor(np.float32(6.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 3)  # sum hits 6 at s=3x
+    # same program, different cap
+    out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(100)),
+            paddle.to_tensor(np.float32(10.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 5)
+
+
+def test_continue_with_tensor_predicate():
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0.0
+        t = paddle.to_tensor(np.float32(0.0))
+        for i in range(n):
+            t = t + 1.0
+            if paddle.sum(t % 2.0) > 0.5:  # odd ticks skipped
+                continue
+            s = s + x
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(10)))
+    np.testing.assert_allclose(out.numpy(), xp * 5)  # even ticks only
+
+
+def test_break_continue_python_loop_python_preds():
+    """Pure-python loops keep exact python semantics (incl. early exit)."""
+    @paddle.jit.to_static
+    def f(x, n):
+        s = x * 0.0
+        for i in range(n):
+            if i == 2:
+                continue
+            if i >= 5:
+                break
+            s = s + x * float(i)
+        return s
+
+    xp = np.ones((2,), np.float32)
+    out = f(paddle.to_tensor(xp), 100)
+    np.testing.assert_allclose(out.numpy(), xp * 8.0)  # 0+1+3+4
+
+
+def test_break_tensor_pred_in_python_bounded_loop():
+    """Python bounds + Tensor break predicate: the loop unrolls but the
+    guards mask post-break statements — still compiles to one program."""
+    @paddle.jit.to_static
+    def f(x, cap):
+        s = x * 0.0
+        for i in range(10):
+            if s.sum() >= cap:
+                break
+            s = s + x
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.float32(4.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 2)
+    out = f(paddle.to_tensor(xp), paddle.to_tensor(np.float32(12.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 6)
+
+
+def test_while_with_break_flags():
+    @paddle.jit.to_static
+    def f(x, cap):
+        s = x * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 100.0:
+            if s.sum() >= cap:
+                break
+            s = s + x
+            i = i + 1.0
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.float32(6.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 3)
+
+
+def test_for_iter_over_tensor_rows():
+    """for x in tensor iterates rows through ONE dynamic-gather loop."""
+    @paddle.jit.to_static
+    def f(m):
+        s = m[0] * 0.0
+        for row in m:
+            s = s + row * 2.0
+        return s
+
+    mp = np.arange(12, dtype=np.float32).reshape(4, 3)
+    for _ in range(3):
+        out = f(paddle.to_tensor(mp))
+    np.testing.assert_allclose(out.numpy(), mp.sum(0) * 2.0)
+
+
+def test_for_iter_python_list_unchanged():
+    @paddle.jit.to_static
+    def f(x, ks):
+        s = x * 0.0
+        for k in ks:
+            s = s + x * float(k)
+        return s
+
+    xp = np.ones((2,), np.float32)
+    out = f(paddle.to_tensor(xp), [1, 2, 3])
+    np.testing.assert_allclose(out.numpy(), xp * 6.0)
+
+
+def test_list_append_in_python_loop():
+    """list_transformer slice: appends become carried rebindings, so
+    they survive conversion and stack afterwards."""
+    @paddle.jit.to_static
+    def f(x):
+        outs = []
+        for i in range(3):
+            outs.append(x * float(i + 1))
+        return paddle.stack(outs).sum(0)
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp))
+    np.testing.assert_allclose(out.numpy(), xp * 6.0)
+
+
+def test_list_append_under_tensor_trip_count_raises():
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x, n):
+        outs = []
+        for i in range(n):
+            outs.append(x)
+        return outs
+
+    g = convert_to_static(f)
+    with pytest.raises(TypeError, match="static shapes"):
+        g(paddle.to_tensor(np.ones(2, np.float32)),
+          paddle.to_tensor(np.int64(3)))
+
+
+def test_nested_loops_inner_break_stays_inner():
+    @paddle.jit.to_static
+    def f(x):
+        s = x * 0.0
+        for i in range(3):
+            for j in range(5):
+                if j >= 2:
+                    break
+                s = s + x
+        return s
+
+    xp = np.ones((2,), np.float32)
+    out = f(paddle.to_tensor(xp))
+    np.testing.assert_allclose(out.numpy(), xp * 6.0)  # 3 outer x 2 inner
+
+
+def test_loop_eager_matches_compiled():
+    """The converted function must produce identical results eagerly
+    (flag machinery dispatches on python values there)."""
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x, n, cap):
+        s = x * 0.0
+        for i in range(n):
+            if s.sum() >= cap:
+                break
+            s = s + x
+        return s
+
+    g = convert_to_static(f)
+    xp = np.ones((2,), np.float32)
+    out = g(paddle.to_tensor(xp), paddle.to_tensor(np.int64(50)),
+            paddle.to_tensor(np.float32(7.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 4)
+
+
+def test_break_tensor_pred_accumulate_before_check():
+    """Review finding: statements BEFORE the break check must also be
+    masked on iterations after a Tensor break fires (python break
+    semantics: the accumulate on the breaking iteration runs, later
+    iterations run nothing)."""
+    @paddle.jit.to_static
+    def f(x, cap):
+        s = x * 0.0
+        for i in range(10):
+            s = s + x
+            if s.sum() >= cap:
+                break
+        return s
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.float32(4.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 2)  # sum hits 4 at s=2x
+    out = f(paddle.to_tensor(xp), paddle.to_tensor(np.float32(100.0)))
+    np.testing.assert_allclose(out.numpy(), xp * 10)  # never breaks
+
+
+def test_while_tensor_cond_append_raises_friendly():
+    """Review finding: append in a Tensor-cond while (no break) must hit
+    the friendly static-shapes error, not leak a tracer into a list."""
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x, n):
+        lst = []
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            lst.append(x)
+            i = i + 1.0
+        return lst
+
+    g = convert_to_static(f)
+    with pytest.raises(TypeError, match="static shapes"):
+        g(paddle.to_tensor(np.ones(2, np.float32)),
+          paddle.to_tensor(np.float32(3.0)))
+
+
+def test_cross_iteration_undefined_carry_names_variable():
+    """Review finding: a variable carried across iterations of a
+    Tensor-bounded loop but first assigned inside it raises an
+    UnboundLocalError NAMING it and the fix."""
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x, n):
+        s = x * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < n:
+            if i > 0.5:
+                s = s + prev
+            prev = s + x
+            i = i + 1.0
+        return s
+
+    g = convert_to_static(f)
+    with pytest.raises(UnboundLocalError, match="prev"):
+        g(paddle.to_tensor(np.ones(2, np.float32)),
+          paddle.to_tensor(np.float32(3.0)))
+
+
+def test_python_break_does_not_leak_loop_variable():
+    """Review finding: after a python break, the loop variable must hold
+    the breaking iteration's value (no extra header run) and a shared
+    iterator must not lose an element."""
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def f(x):
+        last = -1
+        for i in range(10):
+            if i >= 5:
+                break
+            last = i
+        return x + float(i), last
+
+    g = convert_to_static(f)
+    out, last = g(paddle.to_tensor(np.zeros(1, np.float32)))
+    assert float(out.numpy()[0]) == 5.0  # i stopped AT the break point
+    assert last == 4
+
+    def h(x, it):
+        for v in it:
+            if v >= 3:
+                break
+        return x
+
+    it = iter([1, 2, 3, 4, 5])
+    # a python iterator is not converted (no tensor), but must also not
+    # have an extra element consumed by the rewrite
+    g2 = convert_to_static(h)
+    g2(paddle.to_tensor(np.zeros(1, np.float32)), it)
+    assert list(it) == [4, 5]
