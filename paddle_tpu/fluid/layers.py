@@ -210,6 +210,16 @@ def transpose(x, perm, name=None):
 
 
 def fill_constant(shape, dtype, value, name=None):
+    from ..static.program import building_program
+    prog = building_program()
+    if prog is not None:
+        # symbolic: the While/StaticRNN patterns build loop state from
+        # fill_constant, which must be a PROGRAM variable there
+        from ..core.dtype import to_jax_dtype
+        import jax.numpy as jnp
+        return prog.const_var(
+            jnp.full(tuple(int(s) for s in shape), value,
+                     to_jax_dtype(dtype)), hint="fill_constant")
     return creation.full(shape, value, dtype=dtype)
 
 
@@ -222,6 +232,16 @@ def ones(shape, dtype="float32", name=None):
 
 
 def assign(input, output=None):
+    from ..static.program import building_program, Variable as _SVar
+    if isinstance(input, _SVar) or isinstance(output, _SVar):
+        prog = building_program()
+        src = input if isinstance(input, _SVar) \
+            else prog.const_var(np.asarray(
+                input.numpy() if isinstance(input, Tensor) else input),
+                hint="assign")
+        if output is not None:
+            return prog.alias(src, output)
+        return src
     t = Tensor(np.asarray(input)) if not isinstance(input, Tensor) \
         else input.clone()
     if output is not None:
@@ -1349,8 +1369,8 @@ def _fluid_unsupported(name, why):
     def stub(*a, **k):
         from ..core.errors import UnimplementedError
         raise UnimplementedError(
-            f"fluid.layers.{name}: {why} (see PARITY.md fluid-legacy "
-            "descope list)")
+            f"fluid.layers.{name}: {why} (explicitly descoped — see "
+            "PARITY.md 'Known descopes')")
     stub.__name__ = name
     return stub
 
@@ -1540,11 +1560,14 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 
 
 def increment(x, value=1.0, in_place=True):
+    from ..static.program import building_program, Variable as _SVar
     out = x + value
-    if in_place:
-        x.value = out.value
-        return x
-    return out
+    if not in_place:
+        return out
+    if isinstance(x, _SVar):
+        return building_program().alias(out, x)
+    x.value = out.value
+    return x
 
 
 def less_than(x, y, force_cpu=None, cond=None):  # noqa: A002
@@ -1572,10 +1595,15 @@ def not_equal(x, y, cond=None):  # noqa: A002
 
 
 def _binop_cond(res, cond):
-    if cond is not None:
-        cond.value = res.value
-        return cond
-    return res
+    if cond is None:
+        return res
+    from ..static.program import building_program, Variable as _SVar
+    if isinstance(cond, _SVar):
+        # fluid in-place contract inside a While body: cond reads as
+        # res from here on (the loop condition update)
+        return building_program().alias(res, cond)
+    cond.value = res.value
+    return cond
 
 
 def create_array(dtype):
@@ -1626,6 +1654,219 @@ def Assert(cond, data=None, summarize=20, name=None):  # noqa: A002
     return cond
 
 
+class While:
+    """fluid-1.x While sub-block (reference: control_flow.py:973).
+
+    TPU-native: ops recorded inside ``block()`` become the body of ONE
+    ``lax.while_loop``; the loop state is exactly the pre-existing
+    variables the body writes through the fluid in-place contract
+    (``increment(in_place=True)``, ``less_than(..., cond=cond)``,
+    ``assign(..., output=...)``). Requires static mode — the construct
+    IS a program-building construct. Reverse-mode AD through a While is
+    a lax limitation; train recurrences with StaticRNN (lax.scan).
+
+    Usage (the reference's canonical counter loop)::
+
+        i = layers.fill_constant([1], 'int64', 0)
+        n = layers.fill_constant([1], 'int64', 10)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            ...body ops...
+            i = layers.increment(i, in_place=True)
+            layers.less_than(i, n, cond=cond)
+    """
+
+    def __init__(self, cond, is_test=False, name=None):
+        from ..static.program import building_program, Variable as _SVar
+        prog = building_program()
+        if prog is None or not isinstance(cond, _SVar):
+            raise TypeError(
+                "fluid.layers.While requires static mode with a "
+                "program-variable cond (paddle.enable_static(), then "
+                "build cond via fill_constant/less_than)")
+        self._prog = prog
+        self._cond = cond
+
+    def block(self):
+        return _WhileBlockGuard(self)
+
+
+class _WhileBlockGuard:
+    def __init__(self, w):
+        self._w = w
+
+    def __enter__(self):
+        self._start = len(self._w._prog.ops)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is not None:
+            return False
+        from ..static.program import (AliasRecord, ConstRecord, OpRecord,
+                                      ScanRecord, WhileRecord)
+        prog = self._w._prog
+        body = prog.ops[self._start:]
+        del prog.ops[self._start:]
+        # loop carry = variables that exist BEFORE the block and are
+        # written inside it (alias targets); names produced inside the
+        # body are per-iteration temporaries
+        produced, writes = set(), []
+
+        def collect(records):
+            for r in records:
+                if isinstance(r, OpRecord):
+                    produced.update(r.out_names)
+                elif isinstance(r, ConstRecord):
+                    produced.add(r.name)
+                elif isinstance(r, AliasRecord):
+                    if r.dst not in writes:
+                        writes.append(r.dst)
+                elif isinstance(r, WhileRecord):
+                    collect(r.body)
+                    for n in r.carry_names:
+                        if n not in writes:
+                            writes.append(n)
+                elif isinstance(r, ScanRecord):
+                    collect(r.body)
+
+        collect(body)
+        carry = [self._w._cond.name] + [n for n in writes
+                                        if n not in produced
+                                        and n != self._w._cond.name]
+        prog.ops.append(WhileRecord(self._w._cond.name, body, carry))
+        return False
+
+
+class StaticRNN:
+    """fluid-1.x StaticRNN (reference: control_flow.py:451 -> the
+    recurrent_op). TPU-native: the step block becomes the body of ONE
+    ``lax.scan`` over the sequence axis — memories are the carry, step
+    inputs the xs, step outputs stacked ys. scan is reverse-mode
+    differentiable, so ``append_backward`` trains through it (the
+    book-era PTB/seq-tagging recipes)."""
+
+    def __init__(self, name=None):
+        from ..static.program import building_program
+        prog = building_program()
+        if prog is None:
+            raise TypeError(
+                "fluid.layers.StaticRNN requires static mode "
+                "(paddle.enable_static())")
+        self._prog = prog
+        self._seq_inputs = []   # (placeholder_name, src_name)
+        self._mems = []         # [mem_name, init_spec, new_name]
+        self._out_names = []    # body out names
+        self._out_meta = []     # (shape, dtype) per output
+        self._seq_len = None
+        self._out_vars = []
+        self._done = False
+
+    def step(self):
+        return _RNNStepGuard(self)
+
+    def step_input(self, x):
+        shape = x.shape
+        if shape[0] in (-1, None):
+            raise ValueError(
+                "StaticRNN.step_input needs a static sequence length "
+                f"(leading dim of {x.name} is dynamic)")
+        if self._seq_len is None:
+            self._seq_len = int(shape[0])
+        elif int(shape[0]) != self._seq_len:
+            raise ValueError("StaticRNN step inputs disagree on "
+                             "sequence length")
+        ph = self._prog.placeholder_var(shape[1:], x._dtype,
+                                        "rnn_step_in")
+        self._seq_inputs.append((ph.name, x.name))
+        return ph
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0,
+               ref_batch_dim_idx=1):
+        import numpy as _np
+        from ..core.tensor import Tensor as _T
+        if init is not None:
+            if isinstance(init, _T):
+                self._prog.register_persist(init)
+                name, shp, dt = init.name, init.aval_shape(), \
+                    init._value.dtype
+            else:
+                name, shp, dt = init.name, init.shape, init._dtype
+            ph = self._prog.placeholder_var(shp, dt, "rnn_mem")
+            spec = name
+        else:
+            if shape is None:
+                raise ValueError("StaticRNN.memory needs init= or shape=")
+            dt = (batch_ref._dtype if batch_ref is not None
+                  else _np.dtype("float32"))
+            ph = self._prog.placeholder_var(shape, dt, "rnn_mem")
+            spec = ("zeros", tuple(shape), float(init_value),
+                    _np.dtype(dt).name)
+        self._mems.append([ph.name, spec, None])
+        return ph
+
+    def update_memory(self, mem, x):
+        for m in self._mems:
+            if m[0] == mem.name:
+                m[2] = x.name
+                return
+        raise ValueError(f"{mem.name} is not a memory of this StaticRNN")
+
+    def step_output(self, o):
+        self._out_names.append(o.name)
+        self._out_meta.append((o.shape, o._dtype))
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        if not self._done:
+            raise RuntimeError("call the StaticRNN after its step() "
+                               "block closes")
+        if len(self._out_vars) == 1:
+            return self._out_vars[0]
+        return list(self._out_vars)
+
+
+class _RNNStepGuard:
+    def __init__(self, rnn):
+        self._rnn = rnn
+
+    def __enter__(self):
+        self._start = len(self._rnn._prog.ops)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        if et is not None:
+            return False
+        from ..static.program import ScanRecord, Variable as _SVar
+        rnn, prog = self._rnn, self._rnn._prog
+        body = prog.ops[self._start:]
+        del prog.ops[self._start:]
+        if not rnn._seq_inputs:
+            raise ValueError("StaticRNN needs at least one step_input")
+        missing = [m[0] for m in rnn._mems if m[2] is None]
+        if missing:
+            raise ValueError(
+                f"StaticRNN memories never updated: {missing} — call "
+                "update_memory(mem, new_value) inside the step block")
+        out_pairs = []
+        for bname, (shp, dt) in zip(rnn._out_names, rnn._out_meta):
+            name = prog._new_name("rnn_out")
+            v = _SVar(name, [rnn._seq_len] + list(shp), dt, prog,
+                      stop_gradient=False)
+            prog.vars[name] = v
+            rnn._out_vars.append(v)
+            out_pairs.append((bname, name))
+        prog.ops.append(ScanRecord(body, list(rnn._seq_inputs),
+                                   [tuple(m) for m in rnn._mems],
+                                   out_pairs))
+        rnn._done = True
+        return False
+
+
 def _program_construct(name):
     def stub(*a, **k):
         from ..core.errors import UnimplementedError
@@ -1637,13 +1878,28 @@ def _program_construct(name):
     return stub
 
 
-While = _program_construct("While")
-Switch = _program_construct("Switch")
-IfElse = _program_construct("IfElse")
-DynamicRNN = _program_construct("DynamicRNN")
-StaticRNN = _program_construct("StaticRNN")
-reorder_lod_tensor_by_rank = _program_construct(
-    "reorder_lod_tensor_by_rank")
+def _descoped_construct(name, reason):
+    def stub(*a, **k):
+        from ..core.errors import UnimplementedError
+        raise UnimplementedError(
+            f"fluid.layers.{name} is explicitly descoped on TPU "
+            f"(PARITY.md 'Known descopes'): {reason}")
+    stub.__name__ = name
+    return stub
+
+
+Switch = _descoped_construct(
+    "Switch", "use static.nn.case/switch_case (lax.switch) — same "
+    "semantics, compiler-friendly")
+IfElse = _descoped_construct(
+    "IfElse", "use static.nn.cond (lax.cond) or dy2static if/else")
+DynamicRNN = _descoped_construct(
+    "DynamicRNN", "LoD-walking dynamic recurrence needs the fluid "
+    "interpreter's dynamic shapes; on XLA use StaticRNN over padded "
+    "batches (pad + sequence_mask)")
+reorder_lod_tensor_by_rank = _descoped_construct(
+    "reorder_lod_tensor_by_rank",
+    "DynamicRNN's LoD-rank companion; padded batches make it moot")
 
 
 # -- loss.py -----------------------------------------------------------------

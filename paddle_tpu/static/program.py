@@ -185,6 +185,85 @@ class OpRecord:
         return f"{{{self.type}: ({', '.join(ins)}) -> {self.out_names}}}"
 
 
+class ConstRecord:
+    """A materialized constant bound to a program variable (the symbolic
+    form of fill_constant — the reference records a fill_constant op)."""
+
+    __slots__ = ("name", "array")
+    type = "fill_constant"
+
+    def __init__(self, name, array):
+        self.name = name
+        self.array = array
+
+    def __repr__(self):
+        return f"{{fill_constant -> {self.name}}}"
+
+
+class AliasRecord:
+    """env[dst] = env[src]: the fluid in-place contract (increment
+    in_place=True, less_than(cond=...), assign(output=...)) expressed
+    functionally — a later read of dst sees src's value."""
+
+    __slots__ = ("src", "dst")
+    type = "@alias"
+
+    def __init__(self, src, dst):
+        self.src = src
+        self.dst = dst
+
+    def __repr__(self):
+        return f"{{@alias: {self.src} -> {self.dst}}}"
+
+
+class WhileRecord:
+    """fluid.layers.While sub-block (reference: control_flow.py:973
+    While -> while_op over a sub-block ProgramDesc). TPU-native: the
+    captured body records replay inside ONE lax.while_loop; the loop
+    state is exactly the pre-existing variables the body aliases into
+    (cond + increment/assign targets). Reverse-mode AD through a While
+    is not supported (lax.while_loop limitation) — train with StaticRNN
+    (lax.scan) instead."""
+
+    __slots__ = ("cond_name", "body", "carry_names")
+    type = "while"
+
+    def __init__(self, cond_name, body, carry_names):
+        self.cond_name = cond_name
+        self.body = body
+        self.carry_names = carry_names
+
+    def __repr__(self):
+        return (f"{{while[{self.cond_name}]: {len(self.body)} body ops, "
+                f"carry {self.carry_names}}}")
+
+
+class ScanRecord:
+    """fluid.layers.StaticRNN sub-block (reference: control_flow.py:451
+    StaticRNN -> recurrent_op). TPU-native: lax.scan over the sequence
+    axis — memories are the carry, step inputs are xs, step outputs are
+    stacked ys; fully reverse-differentiable, so append_backward trains
+    through it."""
+
+    __slots__ = ("body", "seq_inputs", "mems", "out_pairs")
+    type = "recurrent"
+
+    def __init__(self, body, seq_inputs, mems, out_pairs):
+        self.body = body
+        # list of (placeholder_name, source_seq_name)
+        self.seq_inputs = seq_inputs
+        # list of (mem_name, init_spec, updated_name); init_spec is a
+        # source var name, or ("zeros", shape, value) with -1 batch dims
+        # resolved from the sequence batch at run time
+        self.mems = mems
+        # list of (body_out_name, program_out_name)
+        self.out_pairs = out_pairs
+
+    def __repr__(self):
+        return (f"{{recurrent: {len(self.body)} body ops, "
+                f"xs {self.seq_inputs}, mems {self.mems}}}")
+
+
 class GradRecord:
     """Gradient boundary (reference: the grad-op chain append_backward
     inserts). At run time: jax.grad of the interpreted forward
@@ -235,6 +314,31 @@ class Program:
         if tensor.name not in self.persist:
             self.persist[tensor.name] = tensor
         return tensor.name
+
+    def const_var(self, array, hint="fill_constant"):
+        """Record a constant-producing op and return its Variable (the
+        symbolic fill_constant the fluid While pattern builds loop
+        state from)."""
+        array = jnp.asarray(array)
+        name = self._new_name(hint)
+        v = Variable(name, array.shape, array.dtype, self)
+        self.vars[name] = v
+        self.ops.append(ConstRecord(name, array))
+        return v
+
+    def placeholder_var(self, shape, dtype, hint):
+        """A named variable bound at run time by an enclosing control-
+        flow record (StaticRNN step inputs / memories)."""
+        name = self._new_name(hint)
+        v = Variable(name, shape, dtype, self)
+        self.vars[name] = v
+        return v
+
+    def alias(self, src_var, dst_var):
+        """Record fluid in-place semantics: dst reads as src from here
+        on (increment in_place / less_than(cond=...) / assign(output))."""
+        self.ops.append(AliasRecord(src_var.name, dst_var.name))
+        return dst_var
 
     def append_op(self, op, args, attrs, cast_dtype=None):
         """Called from Op.__call__ when building: records instead of
@@ -337,7 +441,7 @@ class Program:
             for r in c.ops:
                 if isinstance(r, GradRecord):
                     break
-                if r.writebacks:
+                if getattr(r, "writebacks", None):
                     r2 = OpRecord(r.op, r.in_refs, r.out_names, r.attrs,
                                   cast=r.cast)
                     fwd.append(r2)
@@ -392,6 +496,58 @@ def _maybe_cast(a, cast_dtype):
 def _interpret(records, env, persist_written):
     """Execute op records over an env of name -> array."""
     for rec in records:
+        if isinstance(rec, ConstRecord):
+            env[rec.name] = rec.array
+            continue
+        if isinstance(rec, AliasRecord):
+            env[rec.dst] = env[rec.src]
+            continue
+        if isinstance(rec, WhileRecord):
+            names = list(rec.carry_names)
+            cidx = names.index(rec.cond_name)
+
+            def w_cond(carry):
+                return jnp.reshape(carry[cidx], ()).astype(bool)
+
+            def w_body(carry):
+                env2 = dict(env)
+                env2.update(zip(names, carry))
+                _interpret(rec.body, env2, persist_written)
+                return tuple(env2[n] for n in names)
+
+            final = jax.lax.while_loop(w_cond, w_body,
+                                       tuple(env[n] for n in names))
+            env.update(zip(names, final))
+            continue
+        if isinstance(rec, ScanRecord):
+            xs = tuple(env[src] for _, src in rec.seq_inputs)
+            batch = xs[0].shape[1] if xs and xs[0].ndim > 1 else 1
+            init = []
+            for _, spec, _ in rec.mems:
+                if isinstance(spec, str):
+                    init.append(env[spec])
+                else:
+                    _, shape, value, dt = spec
+                    shape = tuple(batch if s in (-1, None) else int(s)
+                                  for s in shape)
+                    init.append(jnp.full(shape, value, dt))
+            ph_names = [ph for ph, _ in rec.seq_inputs]
+            mem_names = [m for m, _, _ in rec.mems]
+            new_names = [n for _, _, n in rec.mems]
+            out_names = [o for o, _ in rec.out_pairs]
+
+            def s_body(carry, xts):
+                env2 = dict(env)
+                env2.update(zip(mem_names, carry))
+                env2.update(zip(ph_names, xts))
+                _interpret(rec.body, env2, persist_written)
+                return (tuple(env2[n] for n in new_names),
+                        tuple(env2[o] for o in out_names))
+
+            _, ys = jax.lax.scan(s_body, tuple(init), xs)
+            for (_, prog_out), y in zip(rec.out_pairs, ys):
+                env[prog_out] = y
+            continue
         if isinstance(rec, GradRecord):
             pnames = [p.name for p in rec.params]
 
@@ -427,7 +583,8 @@ def rec_slice(records, grad_rec):
 
 def _run_forward(records, env):
     sink = set()
-    _interpret([r for r in records if isinstance(r, OpRecord)], env, sink)
+    _interpret([r for r in records if not isinstance(r, GradRecord)],
+               env, sink)
 
 
 class Executor:
@@ -514,27 +671,43 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 # ProgramDesc protobuf + persistables, fluid/io.py:668; here the op-list
 # IR serializes by op NAME — ops rebind from the registry at load) --------
 
+def _serialize_record(rec):
+    if isinstance(rec, GradRecord):
+        return {"kind": "grad", "loss": rec.loss_name,
+                "params": [p.name for p in rec.params],
+                "grad_names": list(rec.grad_names),
+                "upto": rec.upto}
+    if isinstance(rec, ConstRecord):
+        return {"kind": "const", "name": rec.name,
+                "array": np.asarray(rec.array)}
+    if isinstance(rec, AliasRecord):
+        return {"kind": "alias", "src": rec.src, "dst": rec.dst}
+    if isinstance(rec, WhileRecord):
+        return {"kind": "while", "cond": rec.cond_name,
+                "body": [_serialize_record(r) for r in rec.body],
+                "carry": list(rec.carry_names)}
+    if isinstance(rec, ScanRecord):
+        return {"kind": "scan",
+                "body": [_serialize_record(r) for r in rec.body],
+                "seq_inputs": list(rec.seq_inputs),
+                "mems": list(rec.mems),
+                "out_pairs": list(rec.out_pairs)}
+    return {
+        "kind": "op", "type": rec.op.name,
+        "in_refs": [r if (r is None or isinstance(r, str))
+                    else ("#const", np.asarray(r[1]))
+                    for r in rec.in_refs],
+        "out_names": list(rec.out_names),
+        "attrs": rec.attrs,
+        "cast": None if rec.cast is None
+        else np.dtype(rec.cast).name,
+        "writebacks": {i: t.name
+                       for i, t in rec.writebacks.items()},
+    }
+
+
 def _serialize_program(program):
-    recs = []
-    for rec in program.ops:
-        if isinstance(rec, GradRecord):
-            recs.append({"kind": "grad", "loss": rec.loss_name,
-                         "params": [p.name for p in rec.params],
-                         "grad_names": list(rec.grad_names),
-                         "upto": rec.upto})
-        else:
-            recs.append({
-                "kind": "op", "type": rec.op.name,
-                "in_refs": [r if (r is None or isinstance(r, str))
-                            else ("#const", np.asarray(r[1]))
-                            for r in rec.in_refs],
-                "out_names": list(rec.out_names),
-                "attrs": rec.attrs,
-                "cast": None if rec.cast is None
-                else np.dtype(rec.cast).name,
-                "writebacks": {i: t.name
-                               for i, t in rec.writebacks.items()},
-            })
+    recs = [_serialize_record(rec) for rec in program.ops]
     var_meta = {n: (list(v._shape), v._dtype.name, v.stop_gradient)
                 for n, v in program.vars.items()}
     persist = {n: (np.asarray(t._value),
@@ -546,8 +719,46 @@ def _serialize_program(program):
             "counter": program._counter[0]}
 
 
-def _deserialize_program(blob):
+def _deserialize_record(r, prog):
     from ..core.dispatch import _REGISTRY
+    kind = r["kind"]
+    if kind == "grad":
+        return GradRecord(
+            r["loss"], [prog.persist[p] for p in r["params"]],
+            list(r["grad_names"]), int(r["upto"]))
+    if kind == "const":
+        return ConstRecord(r["name"], jnp.asarray(r["array"]))
+    if kind == "alias":
+        return AliasRecord(r["src"], r["dst"])
+    if kind == "while":
+        return WhileRecord(r["cond"],
+                           [_deserialize_record(b, prog)
+                            for b in r["body"]],
+                           list(r["carry"]))
+    if kind == "scan":
+        return ScanRecord([_deserialize_record(b, prog)
+                           for b in r["body"]],
+                          [tuple(p) for p in r["seq_inputs"]],
+                          [tuple(m) for m in r["mems"]],
+                          [tuple(p) for p in r["out_pairs"]])
+    op = _REGISTRY.get(r["type"])
+    if op is None:
+        raise ValueError(
+            f"program references unknown op {r['type']!r}; is the "
+            "op registered in this build?")
+    rec = OpRecord(op,
+                   [x if (x is None or isinstance(x, str))
+                    else ("#const", jnp.asarray(x[1]))
+                    for x in r["in_refs"]],
+                   list(r["out_names"]), dict(r["attrs"]),
+                   cast=None if r.get("cast") is None
+                   else jnp.dtype(r["cast"]))
+    rec.writebacks = {int(i): prog.persist[name]
+                      for i, name in r["writebacks"].items()}
+    return rec
+
+
+def _deserialize_program(blob):
     prog = Program()
     prog.feed_names = list(blob["feed_names"])
     prog._counter = [int(blob.get("counter", 0))]
@@ -560,26 +771,7 @@ def _deserialize_program(blob):
         t.trainable = trainable
         prog.persist[n] = t
     for r in blob["records"]:
-        if r["kind"] == "grad":
-            prog.ops.append(GradRecord(
-                r["loss"], [prog.persist[p] for p in r["params"]],
-                list(r["grad_names"]), int(r["upto"])))
-            continue
-        op = _REGISTRY.get(r["type"])
-        if op is None:
-            raise ValueError(
-                f"program references unknown op {r['type']!r}; is the "
-                "op registered in this build?")
-        rec = OpRecord(op,
-                       [x if (x is None or isinstance(x, str))
-                        else ("#const", jnp.asarray(x[1]))
-                        for x in r["in_refs"]],
-                       list(r["out_names"]), dict(r["attrs"]),
-                       cast=None if r.get("cast") is None
-                       else jnp.dtype(r["cast"]))
-        rec.writebacks = {int(i): prog.persist[name]
-                          for i, name in r["writebacks"].items()}
-        prog.ops.append(rec)
+        prog.ops.append(_deserialize_record(r, prog))
     return prog
 
 
