@@ -138,14 +138,21 @@ class SparseTable:
 class SSDSparseTable(SparseTable):
     """Disk-backed sparse table (reference:
     distributed/table/ssd_sparse_table.h — embedding tables larger than
-    RAM: a bounded in-memory hot set with LRU eviction, cold rows in a
-    fixed-record random-access file; rocksdb there, a flat record file
-    keyed by an in-memory slot index here).
+    RAM: a bounded in-memory hot set with LRU eviction, cold rows on
+    disk; rocksdb there, an append-log with per-record checksums here).
 
-    Record layout: dim float32 row values + 1 float32 adagrad
-    accumulator. Rows enter the hot set on first touch (disk read or
-    fresh init) and spill oldest-first when the hot set exceeds
-    cache_rows."""
+    Crash durability (r4, the rocksdb-atomicity analogue): spills
+    APPEND fixed-size records `[rid int64 | dim+1 float32 | crc32]` —
+    the in-memory index advances to a record only after its bytes are
+    fully written, and recovery (`recover()` / opening an existing
+    path) scans the log keeping the LAST checksum-valid record per rid
+    and truncates at the first torn/invalid one. A kill mid-spill
+    therefore loses at most the record being written, never corrupts
+    older data, and is detected — not silently read back as garbage.
+    The log compacts in place (write-temp + atomic rename) when stale
+    versions dominate."""
+
+    _MAGIC = b"SSDT\x01"
 
     def __init__(self, dim, optimizer="sgd", lr=0.01, init_std=0.01,
                  seed=0, cache_rows=4096, path=None):
@@ -157,25 +164,102 @@ class SSDSparseTable(SparseTable):
         self._dir = path or tempfile.mkdtemp(prefix="ps_ssd_table_")
         os.makedirs(self._dir, exist_ok=True)
         self._data_path = os.path.join(self._dir, "rows.bin")
-        # r+b/w+b, NOT a+b: append mode would send every _spill write to
-        # the file end regardless of seek(), silently dropping updates
-        self._file = open(self._data_path,
-                          "r+b" if os.path.exists(self._data_path)
-                          else "w+b")
-        self._slots = {}              # rid -> record slot in the file
-        self._rec = (self.dim + 1) * 4
+        self._rec = 8 + (self.dim + 1) * 4 + 4
+        self._slots = {}              # rid -> byte offset of last record
+        if os.path.exists(self._data_path):
+            self._open_and_recover()
+        else:
+            self._file = open(self._data_path, "w+b")
+            self._file.write(self._MAGIC
+                             + np.uint32(self.dim).tobytes())
+            self._file.flush()
+            self._end = self._file.tell()
+
+    # -- log format -------------------------------------------------------
+    def _encode(self, rid, row, acc):
+        import zlib
+        payload = np.int64(rid).tobytes()
+        rec = np.empty(self.dim + 1, np.float32)
+        rec[:self.dim] = row
+        rec[self.dim] = acc
+        payload += rec.tobytes()
+        return payload + np.uint32(
+            zlib.crc32(payload) & 0xFFFFFFFF).tobytes()
+
+    def _decode(self, buf):
+        """(rid, row, acc) or None if torn/corrupt."""
+        import zlib
+        if len(buf) != self._rec:
+            return None
+        payload, crc = buf[:-4], buf[-4:]
+        if np.frombuffer(crc, np.uint32)[0] != (
+                zlib.crc32(payload) & 0xFFFFFFFF):
+            return None
+        rid = int(np.frombuffer(payload[:8], np.int64)[0])
+        vals = np.frombuffer(payload[8:], np.float32)
+        return rid, vals[:self.dim].copy(), float(vals[self.dim])
+
+    def _open_and_recover(self):
+        """Scan an existing log: keep the last valid record per rid,
+        truncate at the first torn/invalid record (everything before it
+        was written completely — append-log atomicity)."""
+        self._file = open(self._data_path, "r+b")
+        head = self._file.read(len(self._MAGIC) + 4)
+        if len(head) < len(self._MAGIC) + 4:
+            # crash in the window between file creation and the header
+            # landing on disk: nothing was ever stored — reinitialize
+            # as an empty log rather than refusing to restart
+            self._file.seek(0)
+            self._file.truncate(0)
+            self._file.write(self._MAGIC + np.uint32(self.dim).tobytes())
+            self._file.flush()
+            self._end = self._file.tell()
+            return
+        if head[:len(self._MAGIC)] != self._MAGIC:
+            raise RuntimeError(
+                f"{self._data_path} is not an SSDSparseTable log "
+                "(bad magic)")
+        fdim = int(np.frombuffer(head[len(self._MAGIC):], np.uint32)[0])
+        if fdim != self.dim:
+            raise RuntimeError(
+                f"SSDSparseTable log at {self._data_path} has dim "
+                f"{fdim}, table expects {self.dim}")
+        pos = len(head)
+        while True:
+            buf = self._file.read(self._rec)
+            if not buf:
+                break
+            dec = self._decode(buf)
+            if dec is None:
+                # torn tail (kill mid-spill): discard it and everything
+                # after — records are appended, so nothing valid follows
+                self._file.truncate(pos)
+                break
+            self._slots[dec[0]] = pos
+            pos += self._rec
+        self._file.seek(0, os.SEEK_END)
+        self._end = self._file.tell()
+
+    @classmethod
+    def recover(cls, path, dim, **kw):
+        """Reopen a table directory after a crash; torn tail records
+        from a kill mid-spill are detected (checksum) and dropped."""
+        return cls(dim, path=path, **kw)
 
     def _row(self, rid):
         r = self.rows.get(rid)
         if r is not None:
             self.rows.move_to_end(rid)
             return r
-        slot = self._slots.get(rid)
-        if slot is not None:
-            self._file.seek(slot * self._rec)
-            buf = np.frombuffer(self._file.read(self._rec), np.float32)
-            r = buf[:self.dim].copy()
-            acc = float(buf[self.dim])
+        off = self._slots.get(rid)
+        if off is not None:
+            self._file.seek(off)
+            dec = self._decode(self._file.read(self._rec))
+            if dec is None or dec[0] != rid:
+                raise RuntimeError(
+                    f"SSDSparseTable: corrupt record for row {rid} at "
+                    f"offset {off} (checksum mismatch)")
+            r, acc = dec[1], dec[2]
             if acc:
                 self._acc[rid] = acc
         else:
@@ -186,17 +270,45 @@ class SSDSparseTable(SparseTable):
         return r
 
     def _spill(self, rid, row):
-        slot = self._slots.setdefault(rid, len(self._slots))
-        rec = np.empty(self.dim + 1, np.float32)
-        rec[:self.dim] = row
-        rec[self.dim] = self._acc.pop(rid, 0.0)
-        self._file.seek(slot * self._rec)
-        self._file.write(rec.tobytes())
+        buf = self._encode(rid, row, self._acc.pop(rid, 0.0))
+        self._file.seek(self._end)
+        self._file.write(buf)
+        # the index advances ONLY after the full record is written: a
+        # crash inside write() leaves the old index target intact
+        self._slots[rid] = self._end
+        self._end += self._rec
 
     def _evict(self):
         while len(self.rows) > self.cache_rows:
             rid, row = self.rows.popitem(last=False)  # oldest-touched
             self._spill(rid, row)
+        self._maybe_compact()
+
+    def _maybe_compact(self):
+        live = max(1, len(self._slots))
+        total = (self._end - len(self._MAGIC) - 4) // self._rec
+        if total > 2 * live + 64:
+            self._compact()
+
+    def _compact(self):
+        """Rewrite live records to a temp file and atomically rename —
+        a crash mid-compaction leaves the original log untouched."""
+        tmp = self._data_path + ".compact"
+        with open(tmp, "wb") as f:
+            f.write(self._MAGIC + np.uint32(self.dim).tobytes())
+            new_slots = {}
+            for rid, off in self._slots.items():
+                self._file.seek(off)
+                new_slots[rid] = f.tell()
+                f.write(self._file.read(self._rec))
+            f.flush()
+            os.fsync(f.fileno())
+        self._file.close()
+        os.replace(tmp, self._data_path)
+        self._file = open(self._data_path, "r+b")
+        self._file.seek(0, os.SEEK_END)
+        self._end = self._file.tell()
+        self._slots = new_slots
 
     def _flush_locked(self):
         for rid in list(self.rows):
@@ -205,10 +317,15 @@ class SSDSparseTable(SparseTable):
             if acc is not None:
                 self._acc[rid] = acc
         self._file.flush()
+        os.fsync(self._file.fileno())
+        # all-hot workloads never reach _evict's compaction check, but
+        # every flush appends a fresh record per hot row — compact here
+        # too or periodic snapshots grow the log without bound
+        self._maybe_compact()
 
     def flush(self):
-        """Spill every hot row to disk (rows stay hot); called before
-        state snapshots so the file is complete."""
+        """Spill every hot row to disk (fsynced; rows stay hot); called
+        before state snapshots so the file is complete."""
         with self.lock:
             self._flush_locked()
 
@@ -253,9 +370,11 @@ class SSDSparseTable(SparseTable):
         with open(self._data_path, "wb") as f:
             f.write(s["data_blob"])
         self._file = open(self._data_path, "r+b")
+        self._file.seek(0, os.SEEK_END)
+        self._end = self._file.tell()
         self._slots = dict(s["slots"])
         self._acc = dict(s["acc"])
-        self._rec = (self.dim + 1) * 4
+        self._rec = 8 + (self.dim + 1) * 4 + 4
         self.rows = collections.OrderedDict()
         for rid in s["hot_ids"]:      # rewarm the previously-hot set
             self._row(rid)
