@@ -29,7 +29,7 @@ def _free_port():
     return port
 
 
-def _spawn(nproc, local_devices):
+def _spawn(nproc, local_devices, mode="dp"):
     port = _free_port()
     procs = []
     base = {k: v for k, v in os.environ.items()
@@ -42,6 +42,7 @@ def _spawn(nproc, local_devices):
             PADDLE_COORDINATOR=f"127.0.0.1:{port}",
             PADDLE_TRAINERS_NUM=str(nproc),
             PADDLE_TRAINER_ID=str(rank),
+            PADDLE_TEST_MODE=mode,
         )
         procs.append(subprocess.Popen(
             [sys.executable, _WORKER], env=env,
@@ -120,3 +121,30 @@ def test_two_process_dp_matches_single_process():
     # and multi-process == single-process numerics
     np.testing.assert_allclose(r0["losses"], one[0]["losses"], rtol=1e-5)
     assert r0["losses"][0] > r0["losses"][-1]  # it actually trained
+
+
+def test_two_process_tensor_parallel_matches_single_process():
+    """VERDICT r3 item 4: the mp axis SPANS the process boundary — one
+    mp group of 8 covers 2 procs x 4 devices, so the TP matmul psums
+    and the ParallelCrossEntropy reduction cross the process edge
+    (reference: hybrid_parallel_mp_layers.py)."""
+    two = _spawn(2, local_devices=4, mode="mp")   # mp8 across 2 procs
+    one = _spawn(1, local_devices=8, mode="mp")   # same mesh, one proc
+    r0, r1 = sorted(two, key=lambda o: o["rank"])
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+    np.testing.assert_allclose(r0["losses"], one[0]["losses"], rtol=1e-5)
+    assert r0["losses"][0] > r0["losses"][-1]  # it actually trained
+
+
+def test_two_process_pipeline_parallel_matches_single_process():
+    """VERDICT r3 item 4: pp=2 over [2 procs x 2 devices] puts stage 0
+    in process 0 and stage 1 in process 1 — every per-tick ppermute
+    activation/grad transfer crosses the process edge (reference:
+    test_parallel_dygraph_pipeline_parallel.py,
+    pp_utils/p2p_communication.py:84-116)."""
+    two = _spawn(2, local_devices=2, mode="pp")   # pp boundary = proc edge
+    one = _spawn(1, local_devices=4, mode="pp")   # same topology, one proc
+    r0, r1 = sorted(two, key=lambda o: o["rank"])
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+    np.testing.assert_allclose(r0["losses"], one[0]["losses"], rtol=1e-5)
+    assert r0["losses"][0] > r0["losses"][-1]
