@@ -1,0 +1,111 @@
+"""Collective-mode elastic worker (test_elastic_collective.py).
+
+One rank of a jax.distributed multi-controller training job: dp mesh
+over the global device set, per-step orbax SHARDED checkpoint + a
+rank-0 'latest' pointer written only after the collective save
+completes, heartbeats into the shared FileStore. On (re)start it
+resumes from the latest complete checkpoint — including onto a SMALLER
+world than the one that wrote it (the reshard-restore path).
+
+Reference flow: fleet/elastic.py:101 collective-job membership watch +
+relaunch with updated endpoints; sharded save/load semantics of
+dist_sharding_save.py.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    (rank_s, nproc_s, coord, ckpt_dir, store_root, log_path,
+     ndev_s) = sys.argv[1:8]
+    rank, nproc, ndev = int(rank_s), int(nproc_s), int(ndev_s)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={ndev}")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=rank)
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed import topology
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      FileStore)
+    from paddle_tpu.incubate.checkpoint.sharded import (load_sharded,
+                                                        save_sharded)
+
+    paddle.set_flags({"FLAGS_compilation_cache_dir": ""})
+    em = ElasticManager(node_id=f"w{rank}",
+                        store=FileStore(store_root, ttl=2.0),
+                        heartbeat_interval=0.4)
+    em.start()
+
+    def log(payload):
+        payload["rank"] = rank
+        with open(log_path, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+
+    topology.HybridCommunicateGroup(dp=jax.device_count())
+    mesh = topology.get_mesh()
+    repl = NamedSharding(mesh, P())
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+
+    start_step = 0
+    latest = os.path.join(ckpt_dir, "latest.txt")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            start_step = int(f.read().strip())
+        sd = model.state_dict()
+        # restore ONTO this (possibly smaller) world's mesh: explicit
+        # shardings reshard the checkpoint written by the old topology
+        load_sharded(os.path.join(ckpt_dir, f"step_{start_step}"),
+                     target=sd, shardings={k: repl for k in sd})
+    log({"event": "start", "resumed_from": start_step,
+         "world_devices": jax.device_count()})
+
+    # identical global data every step on every rank (reference
+    # test_dist_base seeds data identically); the dp mesh shards it
+    rs = np.random.RandomState(42)
+    all_x = rs.randn(64, 8, 8).astype(np.float32)
+    all_y = rs.randint(0, 4, (64, 8, 1)).astype(np.int64)
+
+    for step in range(start_step, 64):
+        x = paddle.Tensor(jax.device_put(all_x[step], repl))
+        y = paddle.Tensor(jax.device_put(all_y[step], repl))
+        loss = loss_fn(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        log({"event": "step", "step": step,
+             "loss": float(np.asarray(jax.device_get(loss.value)))})
+        # collective sharded save; the pointer advances only AFTER the
+        # save completed on every rank, so a kill mid-save leaves the
+        # previous complete checkpoint as latest
+        sd = model.state_dict()
+        for t in sd.values():  # global (replicated) arrays for orbax
+            t._value = jax.device_put(jax.device_get(t.value), repl)
+        save_sharded(sd, os.path.join(ckpt_dir, f"step_{step + 1}"))
+        if rank == 0:
+            tmp = latest + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(str(step + 1))
+            os.replace(tmp, latest)
+
+    em.stop()
+    log({"event": "done"})
+
+
+if __name__ == "__main__":
+    main()

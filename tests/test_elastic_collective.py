@@ -1,0 +1,143 @@
+"""Collective-mode elastic recovery (VERDICT r3 item 7; reference:
+fleet/elastic.py:101 — membership watch + relaunch covers COLLECTIVE
+jobs, not just the PS path tested in test_aux_systems).
+
+Flow proven end-to-end: a 2-process jax.distributed training job
+checkpoints (orbax sharded) every step and heartbeats into the shared
+FileStore; the launcher SIGKILLs one rank, DETECTS the death via
+heartbeat expiry, tears down the survivors (they would deadlock in the
+next collective), relaunches a 1-process world on HALF the devices, and
+the new world resumes from the latest complete sharded checkpoint —
+restored onto the smaller mesh — with loss continuity against the
+original run's trajectory."""
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+_WORKER = os.path.join(os.path.dirname(__file__),
+                       "elastic_collective_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _read_log(path):
+    out = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass  # torn tail line from the kill
+    return out
+
+
+def test_collective_kill_detect_relaunch_resume(tmp_path):
+    from paddle_tpu.distributed.fleet.elastic import FileStore
+
+    ckpt_dir = str(tmp_path / "ckpt")
+    store_root = str(tmp_path / "store")
+    log_path = str(tmp_path / "train.log")
+    os.makedirs(ckpt_dir)
+    coord = f"127.0.0.1:{_free_port()}"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+
+    def spawn(rank, nproc, ndev):
+        return subprocess.Popen(
+            [sys.executable, _WORKER, str(rank), str(nproc), coord,
+             ckpt_dir, store_root, log_path, str(ndev)],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.PIPE, text=True)
+
+    # phase 1: 2-process world, 2 devices each (4 global)
+    procs = [spawn(0, 2, 2), spawn(1, 2, 2)]
+    store = FileStore(store_root, ttl=2.0)
+    try:
+        # wait until training made real progress (>= 4 completed steps)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            steps = [e for e in _read_log(log_path)
+                     if e["event"] == "step" and e["rank"] == 0]
+            if len(steps) >= 4:
+                break
+            if any(p.poll() not in (None, 0) for p in procs):
+                raise AssertionError(
+                    "worker died early:\n"
+                    + "\n".join(p.communicate()[1][-2000:]
+                                for p in procs if p.poll()))
+            time.sleep(0.2)
+        assert steps and len(steps) >= 4, "no training progress"
+
+        # the failure: SIGKILL rank 1 mid-training
+        procs[1].send_signal(signal.SIGKILL)
+        procs[1].wait()
+
+        # detection: the launcher observes the heartbeat expire
+        deadline = time.time() + 30
+        while "w1" in store.alive_nodes() and time.time() < deadline:
+            time.sleep(0.2)
+        assert "w1" not in store.alive_nodes(), \
+            "dead rank's heartbeat never expired"
+
+        # teardown: survivors would deadlock in their next collective
+        if procs[0].poll() is None:
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait()
+        orig = _read_log(log_path)
+        orig_losses = {e["step"]: e["loss"] for e in orig
+                       if e["event"] == "step" and e["rank"] == 0}
+        with open(os.path.join(ckpt_dir, "latest.txt")) as f:
+            resume_step = int(f.read().strip())
+        assert resume_step >= 1
+
+        # phase 2: relaunch as a 1-process world on HALF the devices —
+        # the sharded checkpoint written by the 4-device world restores
+        # onto the 2-device mesh (reshard path)
+        os.rename(log_path, log_path + ".phase1")
+        p = spawn(0, 1, 2)
+        procs = [p]
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            events = _read_log(log_path)
+            steps2 = [e for e in events if e["event"] == "step"]
+            if len(steps2) >= 3:
+                break
+            if p.poll() not in (None, 0):
+                raise AssertionError("relaunched worker died:\n"
+                                     + p.communicate()[1][-3000:])
+            time.sleep(0.2)
+        events = _read_log(log_path)
+        start = [e for e in events if e["event"] == "start"][0]
+        assert start["resumed_from"] == resume_step
+        assert start["world_devices"] == 2  # genuinely smaller world
+
+        # loss continuity: the resumed run's losses at overlapping steps
+        # match the original trajectory exactly (same global data, same
+        # restored params; dp4 vs dp2 is the same global computation)
+        steps2 = {e["step"]: e["loss"] for e in events
+                  if e["event"] == "step"}
+        overlap = sorted(set(steps2) & set(orig_losses))
+        assert overlap, (sorted(steps2), sorted(orig_losses))
+        for s in overlap:
+            np.testing.assert_allclose(steps2[s], orig_losses[s],
+                                       rtol=1e-5)
+        # and it progressed PAST the original run eventually or at least
+        # trained on
+        assert len(steps2) >= 3
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
