@@ -160,14 +160,20 @@ def bench_bert(batch=32, seq=128, steps=20):
                       "error": "all batch sizes OOMed"}), flush=True)
 
 
-def bench_gpt(batch=8, seq=1024, steps=20):
+def bench_gpt(batch=8, seq=1024, steps=20, amp_level=None):
     """GPT-2-small-scale (124M) causal-LM training on one chip: the
     flagship LLM path — Pallas flash attention fwd+bwd, AdamW, bf16.
     Reference flagship analogue: GPT pretraining under hybrid_parallel
-    (the single-chip slice of BASELINE.md config 5)."""
+    (the single-chip slice of BASELINE.md config 5).
+
+    Knobs (also see tools/gpt_mfu_sweep.py): batch/seq from argv,
+    GPT_AMP_LEVEL=O1|O2 (O2 = pure-bf16 compute, fp32 master weights in
+    the optimizer — halves the cast traffic), PADDLE_FLASH_BLOCK_* for
+    the attention kernel tile sweep."""
     import paddle_tpu as paddle
     from paddle_tpu.text.models import TransformerLMConfig, GPTForCausalLM
 
+    amp_level = amp_level or os.environ.get("GPT_AMP_LEVEL", "O1")
     paddle.seed(0)
     cfg = TransformerLMConfig(vocab_size=50304, hidden_size=768,
                               num_layers=12, num_heads=12,
@@ -180,7 +186,7 @@ def bench_gpt(batch=8, seq=1024, steps=20):
                                  weight_decay=0.01)
 
     def step_fn(ids, labels):
-        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        with paddle.amp.auto_cast(level=amp_level, dtype="bfloat16"):
             loss = model(ids, labels=labels)
         loss.backward()
         opt.step()
@@ -198,6 +204,8 @@ def bench_gpt(batch=8, seq=1024, steps=20):
     for _ in range(3):
         _sync(train_step(*small))
     for b in (batch, batch // 2, batch // 4):
+        if b < 1:
+            continue  # caller-chosen small batches: never "train" on b=0
         try:
             args = data(b)
             t0 = time.perf_counter()
@@ -211,13 +219,21 @@ def bench_gpt(batch=8, seq=1024, steps=20):
             dt = (time.perf_counter() - t0) / steps
             tokens_per_sec = b * seq / dt
             mfu = 6.0 * n_params * tokens_per_sec / 197e12
+            # true-FLOPs MFU as well: 6N ignores the attention
+            # quadratic. Causal fwd score+value matmuls are 2*s*d
+            # FLOPs/token/layer; fwd+bwd ~3x that -> 6*L*s*d extra,
+            # no longer negligible at seq >= 1024
+            attn_extra = 6.0 * cfg.num_layers * seq * cfg.hidden_size
+            mfu_true = ((6.0 * n_params + attn_extra)
+                        * tokens_per_sec / 197e12)
             print(json.dumps({
                 "config": 5, "model": "GPT-124M causal LM (flash attn)",
-                "batch": b, "seq": seq,
+                "batch": b, "seq": seq, "amp": amp_level,
                 "params_m": round(n_params / 1e6, 1),
                 "step_ms": round(dt * 1000, 2),
                 "tokens_per_sec": round(tokens_per_sec, 0),
                 "mfu_vs_v5e_peak_bf16": round(mfu, 3),
+                "mfu_incl_attention_flops": round(mfu_true, 3),
                 "final_loss": round(float(loss.numpy()), 4),
             }), flush=True)
             return
@@ -237,7 +253,12 @@ def main():
     if which in ("all", "bert"):
         bench_bert()
     if which in ("all", "gpt"):
-        bench_gpt()
+        kw = {}
+        if len(sys.argv) > 2:
+            kw["batch"] = int(sys.argv[2])
+        if len(sys.argv) > 3:
+            kw["seq"] = int(sys.argv[3])
+        bench_gpt(**kw)
 
 
 if __name__ == "__main__":

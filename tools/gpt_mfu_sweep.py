@@ -1,0 +1,99 @@
+"""GPT-124M MFU sweep (VERDICT r3 item 2: push 31.6% MFU toward 45%).
+
+Runs tools/baseline_bench.py's GPT config across the tuning axes that
+matter on one chip — AMP level (O1 per-op autocast vs O2 pure-bf16),
+flash-attention tile sizes (fwd and bwd independently), and the
+seq 2048/4096 extension points BASELINE.md names — each in a FRESH
+SUBPROCESS (a tunnel wedge dies with its attempt; JAX backend state
+never leaks between configs). Every result line is appended to a
+timestamped artifact in bench_artifacts/ for BASELINE.md citation.
+
+Usage:  python tools/gpt_mfu_sweep.py [quick|full]
+  quick: amp sweep + best-guess block sweep at seq 1024 (~6 configs)
+  full:  + seq 2048/4096 points and the full block grid
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ART = os.path.join(_ROOT, "bench_artifacts")
+
+
+def run_config(tag, batch, seq, env_extra, timeout=900):
+    env = dict(os.environ)
+    env.update(env_extra)
+    cmd = [sys.executable, os.path.join(_ROOT, "tools",
+                                        "baseline_bench.py"),
+           "gpt", str(batch), str(seq)]
+    t0 = time.time()
+    try:
+        res = subprocess.run(cmd, env=env, capture_output=True,
+                             text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"tag": tag, "error": f"hung >{timeout}s (tunnel wedge?)"}
+    line = None
+    for ln in res.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            line = ln
+    if line is None:
+        return {"tag": tag, "error": (res.stderr or "no output")[-400:],
+                "rc": res.returncode}
+    out = json.loads(line)
+    out["tag"] = tag
+    out["wall_s"] = round(time.time() - t0, 1)
+    return out
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "quick"
+    os.makedirs(_ART, exist_ok=True)
+    art = os.path.join(_ART, "gpt_mfu_sweep_" + time.strftime(
+        "%Y%m%dT%H%M%SZ", time.gmtime()) + ".jsonl")
+
+    configs = [
+        ("baseline_O1", 8, 1024, {"GPT_AMP_LEVEL": "O1"}),
+        ("O2_pure_bf16", 8, 1024, {"GPT_AMP_LEVEL": "O2"}),
+        ("O2_batch16", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
+        ("O2_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                    "PADDLE_FLASH_BLOCK_BWD": "256"}),
+        ("O2_blk1024", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                 "PADDLE_FLASH_BLOCK_Q": "1024",
+                                 "PADDLE_FLASH_BLOCK_K": "1024"}),
+        ("O2_blk1024_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                     "PADDLE_FLASH_BLOCK_BWD": "1024"}),
+    ]
+    if mode == "full":
+        configs += [
+            ("O1_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O1",
+                                        "PADDLE_FLASH_BLOCK_BWD": "256"}),
+            ("O2_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O2"}),
+            ("O2_seq4096", 2, 4096, {"GPT_AMP_LEVEL": "O2"}),
+            ("O1_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O1"}),
+        ]
+
+    best = None
+    with open(art, "a") as f:
+        for tag, batch, seq, env in configs:
+            print(f"# running {tag} (batch {batch} seq {seq}) ...",
+                  file=sys.stderr)
+            out = run_config(tag, batch, seq, env)
+            f.write(json.dumps(out) + "\n")
+            f.flush()
+            print(json.dumps(out), flush=True)
+            if "tokens_per_sec" in out and (
+                    best is None
+                    or out["tokens_per_sec"] > best["tokens_per_sec"]):
+                if out.get("seq") == 1024:
+                    best = out
+    if best:
+        print(json.dumps({"best_1024": best,
+                          "artifact": os.path.relpath(art, _ROOT)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
