@@ -26,6 +26,9 @@ WHITE_LIST = {
     # the fused linear op IS a matmul (reference white list has mul/fc);
     # without it every nn.Linear ran fp32 under O1
     "linear",
+    # the fused LM head accumulates in f32 internally; bf16 inputs keep
+    # its vocab matmul on the bf16 MXU
+    "fused_linear_cross_entropy",
 }
 # Ops numerically unsafe in low precision.
 BLACK_LIST = {

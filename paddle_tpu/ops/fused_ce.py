@@ -1,0 +1,290 @@
+"""Fused linear + softmax-cross-entropy Pallas kernel (the LM head).
+
+The GPT head computes logits = x @ W^T over a ~50k vocab and immediately
+reduces them to one scalar per token. Unfused, the [tokens, vocab]
+logits tensor (1.6 GB f32 at batch 8 x seq 1024) round-trips HBM several
+times (write logits, read for log-softmax, read again for d_logits,
+write d_logits, read twice for dx/dW) — pure bandwidth, no reuse. This
+kernel streams vocab TILES through VMEM with an online logsumexp
+(the flash-attention trick applied to the classifier), so the full
+logits tensor never exists in HBM in either direction. Backward splits
+into two pallas_calls (dx accumulates over the vocab grid dim, dW over
+the token grid dim — each accumulator needs ITS dim innermost), so each
+recomputes the logits tiles: TWO extra x@W matmul passes total. FLOPs
+are cheap here — the unfused path's MXU sits idle on the ~5 HBM passes
+over the logits tensor these kernels delete.
+
+Reference analogue: the reference fuses this pair as
+softmax_with_cross_entropy_op on the [T, V] logits its matmul wrote
+(paddle/fluid/operators/softmax_with_cross_entropy_op.cu) — on TPU the
+win is fusing the MATMUL too, which XLA will not do across a reduction.
+
+Weight layout is [V, H] (paddle embedding layout), so tied-embedding
+heads pass word_embeddings.weight with no transpose.
+"""
+import functools
+import math
+import os as _os
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op
+
+_BLOCK_T = int(_os.environ.get("PADDLE_FUSED_CE_BLOCK_T", "256"))
+_BLOCK_V = int(_os.environ.get("PADDLE_FUSED_CE_BLOCK_V", "1024"))
+_FORCE_INTERPRET = [False]
+
+
+def _interpret():
+    return _FORCE_INTERPRET[0]
+
+
+def _dot_f32(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _use_pallas(x, w_vh):
+    t, h = x.shape
+    v = w_vh.shape[0]
+    if x.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):
+        return False
+    ok = (t % 128 == 0 and h % 128 == 0 and v % 128 == 0
+          and t >= 128 and v >= 1024)
+    if _FORCE_INTERPRET[0]:
+        return ok
+    if jax.default_backend() == "cpu":
+        return False
+    return ok
+
+
+def _block_for(n, want):
+    b = 128
+    while b * 2 <= want and n % (b * 2) == 0:
+        b *= 2
+    return b if n % b == 0 else n
+
+
+# ---- forward: online logsumexp over vocab tiles ----------------------------
+
+def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
+                m_sc, s_sc, ll_sc, *, block_t, block_v, nv,
+                ignore_index):
+    from jax.experimental import pallas as pl
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, -1e30)
+        s_sc[...] = jnp.zeros_like(s_sc)
+        ll_sc[...] = jnp.zeros_like(ll_sc)
+
+    x = x_ref[...]                       # [bt, H]
+    w = w_ref[...]                       # [bv, H]
+    tile = _dot_f32(x, w, ((1,), (1,)))  # [bt, bv] logits tile
+
+    labels = lab_ref[...][0]             # [bt] int32
+    local = labels - vi * jnp.int32(block_v)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_t, block_v), 1)
+    hit = col == local[:, None]          # out-of-tile labels never match
+    ll_sc[...] += jnp.sum(jnp.where(hit, tile, 0.0),
+                          axis=1)[None, :]
+
+    m = m_sc[...][0]
+    new_m = jnp.maximum(m, jnp.max(tile, axis=1))
+    s_sc[...] = (s_sc[...][0] * jnp.exp(m - new_m)
+                 + jnp.sum(jnp.exp(tile - new_m[:, None]),
+                           axis=1))[None, :]
+    m_sc[...] = new_m[None, :]
+
+    @pl.when(vi == nv - 1)
+    def _store():
+        lse = m_sc[...][0] + jnp.log(s_sc[...][0])
+        valid = labels != jnp.int32(ignore_index)
+        loss_ref[...] = jnp.where(valid, lse - ll_sc[...][0],
+                                  0.0)[None, :]
+        lse_ref[...] = lse[None, :]
+
+
+def _pallas_fwd(x, w_vh, labels, ignore_index):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    t, h = x.shape
+    v = w_vh.shape[0]
+    bt = _block_for(t, _BLOCK_T)
+    bv = _block_for(v, _BLOCK_V)
+    nt, nv = t // bt, v // bv
+    lab2 = labels.astype(jnp.int32)[None, :]          # [1, T]
+    kernel = functools.partial(_fwd_kernel, block_t=bt, block_v=bv,
+                               nv=nv, ignore_index=ignore_index)
+    loss, lse = pl.pallas_call(
+        kernel,
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((bt, h), lambda ti, vi: (ti, 0)),
+            pl.BlockSpec((bv, h), lambda ti, vi: (vi, 0)),
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, t), jnp.float32),
+            jax.ShapeDtypeStruct((1, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((1, bt), jnp.float32),
+            pltpu.VMEM((1, bt), jnp.float32),
+            pltpu.VMEM((1, bt), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(x, w_vh, lab2)
+    return loss[0], lse[0]
+
+
+# ---- backward: recompute tiles, never materialize d_logits ------------------
+
+def _dtile(x, w, labels, lse, g, vi, block_t, block_v, ignore_index):
+    """d_logits tile = (softmax - onehot) * g, recomputed in VMEM."""
+    tile = _dot_f32(x, w, ((1,), (1,)))
+    p = jnp.exp(tile - lse[:, None])
+    local = labels - vi * jnp.int32(block_v)
+    col = jax.lax.broadcasted_iota(jnp.int32, (block_t, block_v), 1)
+    onehot = (col == local[:, None]).astype(jnp.float32)
+    valid = (labels != jnp.int32(ignore_index)).astype(jnp.float32)
+    return (p - onehot) * (g * valid)[:, None]
+
+
+def _bwd_dx_kernel(x_ref, w_ref, lab_ref, lse_ref, g_ref, dx_ref, *,
+                   block_t, block_v, ignore_index):
+    from jax.experimental import pallas as pl
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        dx_ref[...] = jnp.zeros_like(dx_ref)
+
+    d = _dtile(x_ref[...], w_ref[...], lab_ref[...][0], lse_ref[...][0],
+               g_ref[...][0], vi, block_t, block_v, ignore_index)
+    w = w_ref[...]
+    dx_ref[...] += _dot_f32(d.astype(w.dtype), w, ((1,), (0,)))
+
+
+def _bwd_dw_kernel(x_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, *,
+                   block_t, block_v, ignore_index):
+    from jax.experimental import pallas as pl
+    ti = pl.program_id(1)
+    vi = pl.program_id(0)
+
+    @pl.when(ti == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    x = x_ref[...]
+    d = _dtile(x, w_ref[...], lab_ref[...][0], lse_ref[...][0],
+               g_ref[...][0], vi, block_t, block_v, ignore_index)
+    dw_ref[...] += _dot_f32(d.astype(x.dtype), x, ((0,), (0,)))
+
+
+def _pallas_bwd(x, w_vh, labels, lse, g, ignore_index):
+    from jax.experimental import pallas as pl
+    t, h = x.shape
+    v = w_vh.shape[0]
+    bt = _block_for(t, _BLOCK_T)
+    bv = _block_for(v, _BLOCK_V)
+    nt, nv = t // bt, v // bv
+    lab2 = labels.astype(jnp.int32)[None, :]
+    lse2 = lse[None, :]
+    g2 = g.astype(jnp.float32)[None, :]
+
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, block_t=bt, block_v=bv,
+                          ignore_index=ignore_index),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((bt, h), lambda ti, vi: (ti, 0)),
+            pl.BlockSpec((bv, h), lambda ti, vi: (vi, 0)),
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+            pl.BlockSpec((1, bt), lambda ti, vi: (0, ti)),
+        ],
+        out_specs=pl.BlockSpec((bt, h), lambda ti, vi: (ti, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, h), jnp.float32),
+        interpret=_interpret(),
+    )(x, w_vh, lab2, lse2, g2)
+
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, block_t=bt, block_v=bv,
+                          ignore_index=ignore_index),
+        grid=(nv, nt),
+        in_specs=[
+            pl.BlockSpec((bt, h), lambda vi, ti: (ti, 0)),
+            pl.BlockSpec((bv, h), lambda vi, ti: (vi, 0)),
+            pl.BlockSpec((1, bt), lambda vi, ti: (0, ti)),
+            pl.BlockSpec((1, bt), lambda vi, ti: (0, ti)),
+            pl.BlockSpec((1, bt), lambda vi, ti: (0, ti)),
+        ],
+        out_specs=pl.BlockSpec((bv, h), lambda vi, ti: (vi, 0)),
+        out_shape=jax.ShapeDtypeStruct((v, h), jnp.float32),
+        interpret=_interpret(),
+    )(x, w_vh, lab2, lse2, g2)
+    return dx.astype(x.dtype), dw.astype(w_vh.dtype)
+
+
+# ---- reference composition + custom vjp ------------------------------------
+
+def _reference(x, w_vh, labels, ignore_index):
+    logits = _dot_f32(x, w_vh, ((1,), (1,)))
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logits, jnp.clip(labels, 0, w_vh.shape[0] - 1)[:, None].astype(
+            jnp.int32), axis=-1)[:, 0]
+    valid = labels != ignore_index
+    return jnp.where(valid, lse - ll, 0.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_core(x, w_vh, labels, ignore_index):
+    if _use_pallas(x, w_vh):
+        return _pallas_fwd(x, w_vh, labels, ignore_index)[0]
+    return _reference(x, w_vh, labels, ignore_index)
+
+
+def _fused_fwd(x, w_vh, labels, ignore_index):
+    if _use_pallas(x, w_vh):
+        loss, lse = _pallas_fwd(x, w_vh, labels, ignore_index)
+        return loss, (x, w_vh, labels, lse)
+    return (_reference(x, w_vh, labels, ignore_index),
+            (x, w_vh, labels, None))
+
+
+def _fused_bwd(ignore_index, res, g):
+    x, w_vh, labels, lse = res
+    if lse is None:  # reference path: differentiate the composition
+        _, vjp = jax.vjp(
+            lambda x_, w_: _reference(x_, w_, labels, ignore_index),
+            x, w_vh)
+        dx, dw = vjp(g)
+        return dx, dw, None
+    dx, dw = _pallas_bwd(x, w_vh, labels, lse, g, ignore_index)
+    return dx, dw, None
+
+
+_fused_core.defvjp(_fused_fwd, _fused_bwd)
+
+
+@register_op("fused_linear_cross_entropy")
+def _fused_op(x, w_vh, labels, *, ignore_index):
+    """Per-token loss [T] for logits = x @ w_vh.T, labels [T] int.
+    ignore_index rows contribute 0 loss and 0 gradient."""
+    return _fused_core(x, w_vh, labels, ignore_index)
+
+
+def fused_linear_cross_entropy(x, weight_vh, labels, ignore_index=-100):
+    """Public wrapper over Tensors: x [T, H], weight_vh [V, H] (paddle
+    embedding layout — tied heads pass the embedding table directly),
+    labels [T]. Returns per-token loss [T] (reduce outside)."""
+    return _fused_op(x, weight_vh, labels,
+                     ignore_index=int(ignore_index))

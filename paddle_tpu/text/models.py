@@ -187,6 +187,28 @@ class GPTForCausalLM(nn.Layer):
         return self._head_loss(h, labels)
 
     def _head_loss(self, h, labels=None):
+        mesh = topology.get_mesh()
+        mesh_trivial = mesh is None or all(
+            int(d) == 1 for d in mesh.shape.values())
+        if labels is not None and self.cfg.tie_embeddings \
+                and not self.cfg.use_mp and mesh_trivial:
+            # fused linear+CE streams vocab tiles through VMEM: the
+            # [tokens, vocab] logits tensor never exists in HBM in
+            # either direction (ops/fused_ce.py; falls back to the
+            # composition below on CPU / unsupported shapes). Sharded
+            # runs keep the composition: TP's vocab dim is mp-sharded
+            # (ParallelCrossEntropy territory) and a pallas_call under
+            # a dp/pp-sharded token dim would need manual partitioning.
+            from ..ops.fused_ce import fused_linear_cross_entropy
+            flat = manipulation.reshape(labels, (-1,))
+            per_tok = fused_linear_cross_entropy(
+                manipulation.reshape(h, (-1, self.cfg.hidden_size)),
+                self.gpt.word_embeddings.weight, flat)
+            # mean over NON-IGNORED tokens, matching cross_entropy's
+            # reduction='mean' (a plain mean would scale loss/grads by
+            # the valid fraction on padded batches)
+            valid = (flat != -100).astype("float32").sum()
+            return per_tok.sum() / valid.clip(min=1.0)
         if self.cfg.tie_embeddings:
             logits = math_ops.matmul(h, self.gpt.word_embeddings.weight,
                                      transpose_y=True)
