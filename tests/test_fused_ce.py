@@ -148,3 +148,28 @@ def test_gpt_head_ignore_index_mean_over_valid():
         manipulation.reshape(labels, (-1,)))
     np.testing.assert_allclose(float(loss_fused.numpy()),
                                float(loss_ref.numpy()), rtol=1e-5)
+
+
+def test_pallas_kernel_real_backend_parity():
+    """On a real accelerator backend this compiles the ACTUAL Mosaic
+    kernels (the interpret tests above can't see Mosaic lowering
+    issues); on CPU the gate routes to the reference path and the test
+    still checks the public wrapper end to end."""
+    import jax
+    rs = np.random.RandomState(3)
+    t, h, v = 256, 128, 1024
+    x = rs.randn(t, h).astype(np.float32) * 0.3
+    w = rs.randn(v, h).astype(np.float32) * 0.3
+    lab = rs.randint(0, v, (t,))
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    wt = paddle.to_tensor(w)
+    wt.stop_gradient = False
+    out = fused_ce.fused_linear_cross_entropy(
+        xt, wt, paddle.to_tensor(lab.astype(np.int64)))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               _reference_loss_np(x, w, lab),
+                               rtol=3e-5, atol=3e-5)
+    out.mean().backward()
+    assert xt.grad is not None and wt.grad is not None
+    assert np.isfinite(np.asarray(xt.grad.numpy())).all()
