@@ -1,0 +1,9 @@
+"""Self-contained ONNX protobuf bindings.
+
+The onnx python package is not available in this image; `onnx_pb2` is
+generated (protoc) from the bundled `onnx.proto`, a subset of the
+official schema with upstream field numbers/enums, so serialized models
+are valid ONNX files. Regenerate with:
+    protoc --python_out=. onnx.proto
+"""
+from . import onnx_pb2  # noqa: F401

@@ -1,0 +1,282 @@
+"""Real ONNX export (paddle_tpu/onnx.py + bundled protobuf schema).
+
+Validation strategy (no onnx/onnxruntime packages in this image): each
+exported file is parsed back through the generated official-schema
+bindings and EXECUTED by a small numpy interpreter over the emitted op
+set — proving the serialized graph computes the same function as the
+source layer, not merely that it round-trips."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+def _load(path):
+    from paddle_tpu.onnx_proto import onnx_pb2
+    m = onnx_pb2.ModelProto()
+    with open(path, "rb") as f:
+        m.ParseFromString(f.read())
+    return m
+
+
+_NP_DTYPE = {1: np.float32, 6: np.int32, 7: np.int64, 9: np.bool_,
+             10: np.float16, 11: np.float64, 2: np.uint8, 3: np.int8}
+
+
+def _tensor_value(t):
+    dt = _NP_DTYPE[t.data_type]
+    return np.frombuffer(t.raw_data, dt).reshape(list(t.dims)).copy()
+
+
+def _run_onnx(model, inputs):
+    """Numpy evaluator for the exported op subset."""
+    env = {t.name: _tensor_value(t) for t in model.graph.initializer}
+    for vi, x in zip(model.graph.input, inputs):
+        env[vi.name] = np.asarray(x)
+
+    def conv(x, w, attrs):
+        import jax.lax as lax
+        return np.asarray(lax.conv_general_dilated(
+            x.astype(np.float32), w.astype(np.float32),
+            window_strides=attrs.get("strides", [1, 1]),
+            padding=list(zip(attrs["pads"][:2], attrs["pads"][2:])),
+            rhs_dilation=attrs.get("dilations", [1, 1]),
+            feature_group_count=attrs.get("group", 1)))
+
+    def pool(x, attrs, mode):
+        import jax.lax as lax
+        k = [1, 1] + list(attrs["kernel_shape"])
+        s = [1, 1] + list(attrs.get("strides", attrs["kernel_shape"]))
+        pads = attrs.get("pads", [0] * 4)
+        pad = [(0, 0), (0, 0)] + list(zip(pads[:2], pads[2:]))
+        if mode == "max":
+            return np.asarray(lax.reduce_window(
+                x, -np.inf, lax.max, k, s, pad))
+        acc = np.asarray(lax.reduce_window(x, 0.0, lax.add, k, s, pad))
+        return acc / np.prod(attrs["kernel_shape"])
+
+    for node in model.graph.node:
+        a = {at.name: (list(at.ints) if at.ints else
+                       (at.i if at.type == 2 else
+                        (at.f if at.type == 1 else
+                         at.s.decode() if at.type == 3 else None)))
+             for at in node.attribute}
+        ins = [env[n] for n in node.input]
+        op = node.op_type
+        if op == "MatMul":
+            out = ins[0] @ ins[1]
+        elif op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Sub":
+            out = ins[0] - ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            out = ins[0] / ins[1]
+        elif op == "Max":
+            out = np.maximum(ins[0], ins[1])
+        elif op == "Min":
+            out = np.minimum(ins[0], ins[1])
+        elif op == "Neg":
+            out = -ins[0]
+        elif op == "Exp":
+            out = np.exp(ins[0])
+        elif op == "Log":
+            out = np.log(ins[0])
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-ins[0]))
+        elif op == "Sqrt":
+            out = np.sqrt(ins[0])
+        elif op == "Erf":
+            from scipy.special import erf as _erf  # pragma: no cover
+            out = _erf(ins[0])
+        elif op == "Pow":
+            out = ins[0] ** ins[1]
+        elif op == "Where":
+            out = np.where(ins[0], ins[1], ins[2])
+        elif op == "Cast":
+            out = ins[0].astype(_NP_DTYPE[a["to"]])
+        elif op == "Reshape":
+            out = ins[0].reshape([int(s) for s in ins[1]])
+        elif op == "Transpose":
+            out = np.transpose(ins[0], a["perm"])
+        elif op == "Expand":
+            out = np.broadcast_to(
+                ins[0], np.broadcast_shapes(tuple(int(s) for s in
+                                                  ins[1]),
+                                            ins[0].shape)).copy()
+        elif op == "Concat":
+            out = np.concatenate(ins, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (ins[1].astype(int),
+                                         ins[2].astype(int),
+                                         ins[3].astype(int),
+                                         ins[4].astype(int))
+            idx = [slice(None)] * ins[0].ndim
+            for st, en, ax, sp in zip(starts, ends, axes, steps):
+                idx[ax] = slice(st, en, sp)
+            out = ins[0][tuple(idx)]
+        elif op == "ReduceSum":
+            out = ins[0].sum(axis=tuple(int(x) for x in ins[1]))
+        elif op == "ReduceMax":
+            out = ins[0].max(axis=tuple(a["axes"]))
+        elif op == "ReduceMin":
+            out = ins[0].min(axis=tuple(a["axes"]))
+        elif op == "Conv":
+            out = conv(ins[0], ins[1], a)
+        elif op == "MaxPool":
+            out = pool(ins[0], a, "max")
+        elif op == "AveragePool":
+            out = pool(ins[0], a, "avg")
+        elif op == "Gather":
+            out = np.take(ins[0], ins[1].astype(int),
+                          axis=a.get("axis", 0))
+        elif op == "GatherND":
+            data, idx = ins[0], ins[1].astype(int)
+            k = idx.shape[-1]
+            flat = idx.reshape(-1, k)
+            picked = data[tuple(flat[:, i] for i in range(k))]
+            out = picked.reshape(idx.shape[:-1] + data.shape[k:])
+        elif op == "Identity":
+            out = ins[0]
+        elif op == "Less":
+            out = ins[0] < ins[1]
+        elif op == "LessOrEqual":
+            out = ins[0] <= ins[1]
+        elif op == "Greater":
+            out = ins[0] > ins[1]
+        elif op == "GreaterOrEqual":
+            out = ins[0] >= ins[1]
+        elif op == "Equal":
+            out = ins[0] == ins[1]
+        elif op == "Pad":
+            pads = ins[1].astype(int)
+            n = ins[0].ndim
+            out = np.pad(ins[0],
+                         list(zip(pads[:n], pads[n:])),
+                         constant_values=float(ins[2]))
+        else:
+            raise AssertionError(f"evaluator: unexpected op {op}")
+        env[node.output[0]] = out
+    return [env[o.name] for o in model.graph.output]
+
+
+def test_export_mlp_matches_layer(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4),
+                        nn.Softmax())
+    net.eval()
+    path = paddle.onnx.export(net, str(tmp_path / "mlp"),
+                              input_spec=[InputSpec([2, 8], "float32")])
+    assert path.endswith(".onnx")
+    model = _load(path)
+    assert model.ir_version == 8
+    assert model.opset_import[0].version == 13
+    ops = {n.op_type for n in model.graph.node}
+    assert "MatMul" in ops
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    got, = _run_onnx(model, [x])
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert got.sum() == pytest.approx(2.0, rel=1e-4)  # softmax rows
+
+
+def test_export_conv_net_matches_layer(tmp_path):
+    paddle.seed(1)
+    net = nn.Sequential(
+        nn.Conv2D(1, 4, 3, padding=1), nn.ReLU(),
+        nn.MaxPool2D(2, 2),
+        nn.Conv2D(4, 8, 3), nn.Sigmoid(),
+        nn.Flatten(), nn.Linear(8 * 12 * 12, 10))
+    net.eval()
+    path = paddle.onnx.export(
+        net, str(tmp_path / "conv"),
+        input_spec=[InputSpec([1, 1, 28, 28], "float32")])
+    model = _load(path)
+    ops = [n.op_type for n in model.graph.node]
+    # pooling exports as strided-window gathers + Max (the framework's
+    # differentiable slice+max pooling), not a MaxPool node
+    assert "Conv" in ops and "Max" in ops
+    x = np.random.RandomState(1).randn(1, 1, 28, 28).astype(np.float32)
+    got, = _run_onnx(model, [x])
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_export_embedding_model(tmp_path):
+    paddle.seed(2)
+
+    class Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(50, 8)
+            self.fc = nn.Linear(8, 3)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(axis=1))
+
+    net = Emb()
+    net.eval()
+    path = paddle.onnx.export(net, str(tmp_path / "emb"),
+                              input_spec=[InputSpec([2, 5], "int64")])
+    model = _load(path)
+    assert any(n.op_type == "Gather" for n in model.graph.node)
+    ids = np.random.RandomState(2).randint(0, 50, (2, 5)).astype(
+        np.int64)
+    got, = _run_onnx(model, [ids])
+    want = net(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_export_layernorm_mlp(tmp_path):
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(6, 12), nn.LayerNorm(12), nn.GELU(),
+                        nn.Linear(12, 2))
+    net.eval()
+    path = paddle.onnx.export(net, str(tmp_path / "ln"),
+                              input_spec=[InputSpec([3, 6], "float32")])
+    model = _load(path)
+    x = np.random.RandomState(3).randn(3, 6).astype(np.float32)
+    try:
+        got, = _run_onnx(model, [x])
+    except ImportError:
+        pytest.skip("scipy not available for Erf")
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_export_unsupported_primitive_raises_clearly(tmp_path):
+    class Sorty(nn.Layer):
+        def forward(self, x):
+            return paddle.sort(x, axis=-1)
+
+    net = Sorty()
+    net.eval()
+    with pytest.raises(NotImplementedError, match="primitive"):
+        paddle.onnx.export(net, str(tmp_path / "bad"),
+                           input_spec=[InputSpec([4, 4], "float32")])
+
+
+def test_initializers_carry_param_values(tmp_path):
+    """Weights land as initializers with the state_dict names (or are
+    folded into derived constants); no dangling node inputs."""
+    paddle.seed(4)
+    net = nn.Linear(5, 7)
+    net.eval()
+    path = paddle.onnx.export(net, str(tmp_path / "lin"),
+                              input_spec=[InputSpec([1, 5], "float32")])
+    model = _load(path)
+    inits = {t.name: _tensor_value(t) for t in model.graph.initializer}
+    produced = {o for n in model.graph.node for o in n.output}
+    avail = set(inits) | {vi.name for vi in model.graph.input} | produced
+    for n in model.graph.node:
+        for i in n.input:
+            assert i in avail, f"dangling input {i} of {n.op_type}"
+    # the weight value is present somewhere in the initializers
+    w = np.asarray(net.weight.numpy())
+    assert any(v.shape == w.shape and np.allclose(v, w)
+               for v in inits.values())
