@@ -280,3 +280,21 @@ def test_initializers_carry_param_values(tmp_path):
     w = np.asarray(net.weight.numpy())
     assert any(v.shape == w.shape and np.allclose(v, w)
                for v in inits.values())
+
+
+def test_export_transformer_encoder_layer(tmp_path):
+    """A full self-attention block (QKV projections, batched attention
+    matmuls, softmax, layernorm, FFN) exports and the emitted graph
+    reproduces the layer numerically."""
+    paddle.seed(5)
+    enc = nn.TransformerEncoderLayer(d_model=32, nhead=4,
+                                     dim_feedforward=64, dropout=0.0)
+    enc.eval()
+    path = paddle.onnx.export(
+        enc, str(tmp_path / "enc"),
+        input_spec=[InputSpec([2, 10, 32], "float32")])
+    model = _load(path)
+    x = np.random.RandomState(5).randn(2, 10, 32).astype(np.float32)
+    got, = _run_onnx(model, [x])
+    want = enc(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
