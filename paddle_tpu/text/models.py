@@ -21,7 +21,8 @@ class TransformerLMConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, intermediate_size=None, max_seq_len=1024,
                  dropout=0.1, use_mp=False, tie_embeddings=True,
-                 use_flash_attention=True, initializer_range=0.02):
+                 use_flash_attention=True, initializer_range=0.02,
+                 recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -33,6 +34,7 @@ class TransformerLMConfig:
         self.tie_embeddings = tie_embeddings
         self.use_flash_attention = use_flash_attention
         self.initializer_range = initializer_range
+        self.recompute = recompute
 
 
 def _mp_active():
@@ -159,8 +161,16 @@ class _TransformerCore(nn.Layer):
             x = math_ops.add(x, self.token_type_embeddings(token_type_ids))
         if self.cfg.dropout:
             x = nn_ops.dropout(x, p=self.cfg.dropout, training=self.training)
+        use_rc = (getattr(self.cfg, "recompute", False) and self.training
+                  and not x.stop_gradient)
+        if use_rc:
+            from ..distributed.utils_recompute import recompute as _rc
         for blk in self.blocks:
-            x = blk(x, attn_mask)
+            # per-block activation recompute (reference: fleet recompute
+            # over transformer layers) — trades one extra forward per
+            # block for O(layers) less live activation memory; the lever
+            # that fits seq-4096 training batches on one chip
+            x = _rc(blk, x, attn_mask) if use_rc else blk(x, attn_mask)
         if self.pre_norm:
             x = self.ln_f(x)
         return x

@@ -173,3 +173,32 @@ def test_pallas_kernel_real_backend_parity():
     out.mean().backward()
     assert xt.grad is not None and wt.grad is not None
     assert np.isfinite(np.asarray(xt.grad.numpy())).all()
+
+
+def test_gpt_recompute_matches_baseline():
+    """cfg.recompute=True (per-block activation recompute) must produce
+    the same training losses as the baseline up to XLA fusion
+    reassociation — it only changes WHEN activations are computed."""
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    def run(recompute):
+        paddle.seed(7)
+        cfg = TransformerLMConfig(vocab_size=64, hidden_size=32,
+                                  num_layers=2, num_heads=2,
+                                  max_seq_len=16, dropout=0.0,
+                                  recompute=recompute)
+        m = GPTForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=m.parameters())
+        rs = np.random.RandomState(0)
+        ids = paddle.to_tensor(rs.randint(0, 64, (2, 16)).astype(np.int64))
+        lab = paddle.to_tensor(rs.randint(0, 64, (2, 16)).astype(np.int64))
+        losses = []
+        for _ in range(3):
+            loss = m(ids, labels=lab)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)

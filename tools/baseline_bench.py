@@ -175,10 +175,11 @@ def bench_gpt(batch=8, seq=1024, steps=20, amp_level=None):
 
     amp_level = amp_level or os.environ.get("GPT_AMP_LEVEL", "O1")
     paddle.seed(0)
-    cfg = TransformerLMConfig(vocab_size=50304, hidden_size=768,
-                              num_layers=12, num_heads=12,
-                              max_seq_len=seq, dropout=0.0,
-                              use_flash_attention=True)
+    cfg = TransformerLMConfig(
+        vocab_size=50304, hidden_size=768,
+        num_layers=12, num_heads=12,
+        max_seq_len=seq, dropout=0.0, use_flash_attention=True,
+        recompute=os.environ.get("GPT_RECOMPUTE", "0") == "1")
     model = GPTForCausalLM(cfg)
     n_params = sum(int(np.prod(p.aval_shape()))
                    for p in model.parameters())

@@ -72,6 +72,8 @@ def main():
                                         "PADDLE_FLASH_BLOCK_BWD": "256"}),
             ("O2_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O2"}),
             ("O2_seq4096", 2, 4096, {"GPT_AMP_LEVEL": "O2"}),
+            ("O2_seq4096_rc_b4", 4, 4096, {"GPT_AMP_LEVEL": "O2",
+                                           "GPT_RECOMPUTE": "1"}),
             ("O1_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O1"}),
         ]
 
