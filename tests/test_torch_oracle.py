@@ -578,3 +578,37 @@ class TestTorchOracleRound3b:
         for p, t in zip(params, tps):
             _close(-np.asarray(p.numpy()), t.grad.numpy(),
                    rtol=1e-5, atol=1e-6)
+
+
+class TestFusedHeadOracle:
+    def test_fused_linear_cross_entropy_vs_torch(self):
+        """The fused LM-head op (r4 Pallas kernel; reference path on
+        CPU) against torch's linear + F.cross_entropy, including dx and
+        dW — an independent implementation of the same math."""
+        from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+        t, h, v = 12, 16, 40
+        x_np = _rs.randn(t, h).astype(np.float32) * 0.5
+        w_np = _rs.randn(v, h).astype(np.float32) * 0.5
+        lab_np = _rs.randint(0, v, (t,))
+        lab_np[4] = -100  # ignored row
+
+        xt = torch.tensor(x_np, requires_grad=True)
+        wt = torch.tensor(w_np, requires_grad=True)
+        loss_t = torch.nn.functional.cross_entropy(
+            xt @ wt.T, torch.tensor(lab_np), ignore_index=-100)
+        loss_t.backward()
+
+        xp = paddle.to_tensor(x_np)
+        xp.stop_gradient = False
+        wp = paddle.to_tensor(w_np)
+        wp.stop_gradient = False
+        per_tok = fused_linear_cross_entropy(
+            xp, wp, paddle.to_tensor(lab_np.astype(np.int64)))
+        valid = float((lab_np != -100).sum())
+        loss_p = per_tok.sum() / valid
+        loss_p.backward()
+
+        _close(float(loss_p.numpy()), float(loss_t.detach()))
+        _close(xp.grad.numpy(), xt.grad.numpy())
+        _close(wp.grad.numpy(), wt.grad.numpy())
