@@ -147,3 +147,64 @@ def test_descoped_constructs_point_to_parity(static_mode):
                  layers.reorder_lod_tensor_by_rank):
         with pytest.raises(UnimplementedError, match="PARITY.md"):
             ctor()
+
+
+def test_while_program_serialization_roundtrip(static_mode, tmp_path):
+    """Programs containing the new control-flow records (While sub-
+    blocks, aliases, consts) serialize and reload (reference:
+    save/load_inference_model over ProgramDesc sub-blocks)."""
+    from paddle_tpu.static.program import (_deserialize_program,
+                                           _serialize_program)
+
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [2], "float32")
+        i = layers.fill_constant([1], "int64", 0)
+        n = layers.fill_constant([1], "int64", 4)
+        acc = layers.fill_constant([2], "float32", 0.0)
+        cond = layers.less_than(i, n)
+        w = layers.While(cond)
+        with w.block():
+            layers.assign(acc + x, output=acc)
+            i = layers.increment(i, in_place=True)
+            layers.less_than(i, n, cond=cond)
+        out = acc * 2.0
+
+    exe = paddle.static.Executor()
+    xp = np.array([1.0, 3.0], np.float32)
+    want, = exe.run(main, feed={"x": xp}, fetch_list=[out])
+
+    blob = _serialize_program(main)
+    import pickle
+    prog2 = _deserialize_program(pickle.loads(pickle.dumps(blob)))
+    got, = exe.run(prog2, feed={"x": xp},
+                   fetch_list=[out.name])
+    np.testing.assert_allclose(got, want)
+    np.testing.assert_allclose(got, xp * 4 * 2)
+
+
+def test_static_rnn_serialization_roundtrip(static_mode):
+    from paddle_tpu.static.program import (_deserialize_program,
+                                           _serialize_program)
+
+    main = paddle.static.Program()
+    with paddle.static.program_guard(main):
+        x = paddle.static.data("x", [3, 2, 2], "float32")
+        rnn = layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            prev = rnn.memory(shape=[-1, 2], batch_ref=xt)
+            h = prev + xt
+            rnn.update_memory(prev, h)
+            rnn.step_output(h)
+        out = rnn()
+
+    exe = paddle.static.Executor()
+    xp = np.random.RandomState(0).randn(3, 2, 2).astype("float32")
+    want, = exe.run(main, feed={"x": xp}, fetch_list=[out])
+
+    import pickle
+    prog2 = _deserialize_program(
+        pickle.loads(pickle.dumps(_serialize_program(main))))
+    got, = exe.run(prog2, feed={"x": xp}, fetch_list=[out.name])
+    np.testing.assert_allclose(got, want)
