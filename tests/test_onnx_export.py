@@ -298,3 +298,22 @@ def test_export_transformer_encoder_layer(tmp_path):
     got, = _run_onnx(model, [x])
     want = enc(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_export_resnet18(tmp_path):
+    """Model-zoo scale: ResNet18 (conv/bn/relu/pool/residual-add/fc)
+    exports and the graph reproduces the network numerically."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    net = resnet18(num_classes=10)
+    net.eval()
+    path = paddle.onnx.export(
+        net, str(tmp_path / "r18"),
+        input_spec=[InputSpec([1, 3, 64, 64], "float32")])
+    model = _load(path)
+    assert sum(n.op_type == "Conv" for n in model.graph.node) >= 20
+    x = np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32)
+    got, = _run_onnx(model, [x])
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
