@@ -238,7 +238,9 @@ def _emit(g, env, eqn):
         return [g.node("Transpose", [nm(0)],
                        perm=list(p["permutation"]))]
     if prim in ("reshape", "squeeze", "expand_dims"):
-        if p.get("dimensions") is not None:
+        # NB: squeeze/expand_dims use "dimensions" for their AXES; only
+        # lax.reshape's dimensions= means permute-before-reshape
+        if prim == "reshape" and p.get("dimensions") is not None:
             raise NotImplementedError(
                 "onnx export: lax.reshape with dimensions= (permute-"
                 "before-reshape)")
@@ -255,6 +257,12 @@ def _emit(g, env, eqn):
         mid = g.node("Reshape", [nm(0), rs])
         tgt = g.add_init(np.asarray(shape, np.int64), "shape")
         return [g.node("Expand", [mid, tgt])]
+    if prim == "split":
+        sizes = list(p["sizes"])
+        sp = g.add_init(np.asarray(sizes, np.int64), "split")
+        outs = g.node("Split", [nm(0), sp], n_out=len(sizes),
+                      axis=int(p["axis"]))
+        return outs if isinstance(outs, list) else [outs]
     if prim == "concatenate":
         return [g.node("Concat", [nm(i) for i in range(len(ins))],
                        axis=int(p["dimension"]))]

@@ -159,9 +159,17 @@ def _run_onnx(model, inputs):
             out = np.pad(ins[0],
                          list(zip(pads[:n], pads[n:])),
                          constant_values=float(ins[2]))
+        elif op == "Split":
+            sizes = ins[1].astype(int)
+            out = np.split(ins[0], np.cumsum(sizes)[:-1],
+                           axis=a["axis"])
         else:
             raise AssertionError(f"evaluator: unexpected op {op}")
-        env[node.output[0]] = out
+        if isinstance(out, list):
+            for name, o in zip(node.output, out):
+                env[name] = o
+        else:
+            env[node.output[0]] = out
     return [env[o.name] for o in model.graph.output]
 
 
@@ -317,3 +325,26 @@ def test_export_resnet18(tmp_path):
     got, = _run_onnx(model, [x])
     want = net(paddle.to_tensor(x)).numpy()
     np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+
+def test_export_gpt_logits(tmp_path):
+    """A whole decoder-only LM (embeddings, causal attention with the
+    mask folded as a constant, QKV Split, tied-embedding logits head)
+    exports; graph reproduces teacher-forced logits."""
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    paddle.seed(0)
+    cfg = TransformerLMConfig(vocab_size=128, hidden_size=32,
+                              num_layers=2, num_heads=2, max_seq_len=16,
+                              dropout=0.0)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    path = paddle.onnx.export(net, str(tmp_path / "gpt"),
+                              input_spec=[InputSpec([1, 16], "int64")])
+    model = _load(path)
+    assert any(n.op_type == "Split" for n in model.graph.node)
+    ids = np.random.RandomState(0).randint(0, 128, (1, 16)).astype(
+        np.int64)
+    got, = _run_onnx(model, [ids])
+    want = net(paddle.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
