@@ -1,0 +1,41 @@
+"""Driver emission contract of bench.py (VERDICT r3 item 1): the
+cached artifact line prints FIRST at startup, the final line carries
+provenance, exit code is 0 even when no live measurement is possible
+(the axon tunnel is unreachable or wedged under pytest here; the worker
+never fakes a TPU number from another backend)."""
+import glob
+import json
+import os
+import subprocess
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WEDGE = os.path.join(_ROOT, "bench_artifacts", "wedge_report_*.json")
+
+
+def test_bench_emits_cached_first_final_last_rc0():
+    before = set(glob.glob(_WEDGE))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS",)}
+    env["BENCH_DEADLINE_SECS"] = "75"
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=170)
+    finally:
+        for f in set(glob.glob(_WEDGE)) - before:
+            os.unlink(f)  # this test's failed-attempt evidence is noise
+    assert res.returncode == 0, res.stderr[-500:]
+    lines = [json.loads(ln) for ln in res.stdout.splitlines()
+             if ln.strip().startswith("{")]
+    assert len(lines) >= 2, res.stdout
+    first, last = lines[0], lines[-1]
+    # provisional cached line first: nonzero, artifact-backed, marked
+    assert first["source"] == "cached" and first["value"] > 0
+    assert "note" in first and first["artifact"].startswith(
+        "bench_artifacts/")
+    # final line: same metric, explicit provenance for the failed live
+    # attempt (on a healthy tunnel this would be source="live")
+    assert last["metric"] == first["metric"]
+    assert last["source"] == "cached" and "error" in last
+    assert last["value"] > 0
