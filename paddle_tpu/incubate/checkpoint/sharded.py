@@ -12,7 +12,8 @@ next training steps.
 """
 import jax
 
-__all__ = ["save_sharded", "load_sharded", "AsyncShardedSaver"]
+__all__ = ["save_sharded", "load_sharded", "save_sharded_train_state",
+           "load_sharded_train_state", "AsyncShardedSaver"]
 
 
 def _checkpointer():
@@ -110,6 +111,120 @@ def load_sharded(path, target=None, shardings=None):
             if hasattr(t, "value"):
                 t.value = restored[k]
     return dict(restored)
+
+
+def save_sharded_train_state(model_state, optimizer, path):
+    """Persist the FULL training state — model parameters AND optimizer
+    accumulators (Adam moments, beta powers, ...) AND LR-scheduler
+    metadata — as one sharded checkpoint (the reference's
+    save_persistables semantics: fleet_base.py:732 persists optimizer
+    accumulator Variables alongside parameters; dist_sharding_save.py
+    asserts they round-trip).
+
+    Array state goes through orbax (each process writes only its own
+    shards — ZeRO-sharded moments stay sharded on disk); the
+    non-array LR/scheduler metadata goes to a process-0 JSON sidecar
+    `<path>_meta.json` (atomic rename, so a kill mid-write leaves no
+    torn sidecar).
+    """
+    import json
+    import os
+    opt_sd = dict(optimizer.state_dict())
+    meta = opt_sd.pop("LR_Scheduler", {})
+    tree = {"model": _to_arrays(model_state), "opt": _to_arrays(opt_sd)}
+    ckptr = _checkpointer()
+    apath = os.path.abspath(str(path))
+    ckptr.save(apath, tree, force=True)
+    ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        tmp = apath + "_meta.json.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"LR_Scheduler": meta}, f)
+        os.replace(tmp, apath + "_meta.json")
+
+
+def load_sharded_train_state(path, model_target, optimizer,
+                             sharding=None):
+    """Restore a checkpoint written by save_sharded_train_state:
+    parameters into `model_target` (a name -> Tensor state dict, values
+    replaced in place) and accumulators + LR metadata into `optimizer`
+    via set_state_dict — so a resumed Adam continues with its moments
+    instead of silently restarting them (the reference's resume:
+    fleet_base.py:732 + dist_sharding_save.py round-trip).
+
+    sharding: optional single jax.sharding.Sharding applied to EVERY
+    restored array — the reshard-onto-a-different-mesh (elastic) case.
+    None keeps each model param on its target's current placement and
+    the optimizer arrays on their saved layout.
+    """
+    import json
+    import os
+
+    import numpy as np
+    ckptr = _checkpointer()
+    apath = os.path.abspath(str(path))
+    tree = ckptr.metadata(apath).item_metadata.tree
+    if model_target is not None:
+        # validate BEFORE the restore reads anything from disk (same
+        # contract as load_sharded): a mismatch on a multi-GB
+        # checkpoint must not cost the full restore I/O or surface as
+        # a confusing downstream shape error
+        missing = [k for k in model_target if k not in tree["model"]]
+        if missing:
+            raise KeyError(
+                f"train-state checkpoint at {path} has no model entries "
+                f"for {sorted(missing)}")
+        for k, t in model_target.items():
+            m = tree["model"][k]
+            cur = getattr(t, "value", t)
+            cur_shape = tuple(getattr(cur, "shape", ()) or ())
+            if tuple(m.shape) != cur_shape:
+                raise ValueError(
+                    f"checkpoint parameter {k!r} has shape "
+                    f"{tuple(m.shape)} but the target expects "
+                    f"{cur_shape}")
+            if (hasattr(cur, "dtype")
+                    and np.dtype(m.dtype) != np.dtype(cur.dtype)):
+                raise ValueError(
+                    f"checkpoint parameter {k!r} has dtype {m.dtype} "
+                    f"but the target expects {cur.dtype}")
+    mpath = apath + "_meta.json"
+    if optimizer is not None and not os.path.exists(mpath):
+        # the orbax tree becomes durable before process 0 writes the
+        # sidecar; a kill in that window leaves a complete-looking
+        # checkpoint whose LR/param-order metadata is gone. Restoring
+        # it silently would resume at the wrong LR (and positional
+        # accumulator matching could not engage) — exactly the
+        # moment-less resume this API exists to prevent.
+        raise FileNotFoundError(
+            f"train-state checkpoint at {path} has no {mpath} sidecar "
+            f"(killed between the array save and the metadata write?) "
+            f"— treat this checkpoint as incomplete and resume from "
+            f"the previous one")
+    ref = {}
+    for sect, entries in tree.items():
+        ref[sect] = {}
+        for k, m in entries.items():
+            sh = sharding
+            if (sh is None and sect == "model"
+                    and model_target is not None and k in model_target):
+                v = getattr(model_target[k], "value", model_target[k])
+                sh = getattr(v, "sharding", None)
+            ref[sect][k] = jax.ShapeDtypeStruct(tuple(m.shape), m.dtype,
+                                                sharding=sh)
+    restored = ckptr.restore(apath, ref)
+    if model_target is not None:
+        for k, t in model_target.items():
+            if hasattr(t, "value"):
+                t.value = restored["model"][k]
+    if optimizer is not None:
+        with open(mpath) as f:
+            meta = json.load(f)
+        opt_sd = dict(restored["opt"])
+        opt_sd["LR_Scheduler"] = meta.get(
+            "LR_Scheduler", {"last_lr": optimizer.get_lr()})
+        optimizer.set_state_dict(opt_sd)
+    return restored
 
 
 class AsyncShardedSaver:

@@ -39,8 +39,8 @@ def main():
     from paddle_tpu.distributed import topology
     from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
                                                       FileStore)
-    from paddle_tpu.incubate.checkpoint.sharded import (load_sharded,
-                                                        save_sharded)
+    from paddle_tpu.incubate.checkpoint.sharded import (
+        load_sharded_train_state, save_sharded_train_state)
 
     paddle.set_flags({"FLAGS_compilation_cache_dir": ""})
     em = ElasticManager(node_id=f"w{rank}",
@@ -59,7 +59,9 @@ def main():
 
     paddle.seed(0)
     model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
-    opt = paddle.optimizer.SGD(0.05, parameters=model.parameters())
+    # Adam, NOT stateless SGD: the resume must carry the moments or the
+    # post-restore trajectory diverges from the original run's
+    opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
     loss_fn = nn.CrossEntropyLoss()
 
     start_step = 0
@@ -68,10 +70,12 @@ def main():
         with open(latest) as f:
             start_step = int(f.read().strip())
         sd = model.state_dict()
-        # restore ONTO this (possibly smaller) world's mesh: explicit
-        # shardings reshard the checkpoint written by the old topology
-        load_sharded(os.path.join(ckpt_dir, f"step_{start_step}"),
-                     target=sd, shardings={k: repl for k in sd})
+        # restore ONTO this (possibly smaller) world's mesh: the
+        # explicit sharding reshards the checkpoint written by the old
+        # topology; params AND Adam moments + LR metadata round-trip
+        load_sharded_train_state(
+            os.path.join(ckpt_dir, f"step_{start_step}"),
+            sd, opt, sharding=repl)
     log({"event": "start", "resumed_from": start_step,
          "world_devices": jax.device_count()})
 
@@ -90,13 +94,18 @@ def main():
         opt.clear_grad()
         log({"event": "step", "step": step,
              "loss": float(np.asarray(jax.device_get(loss.value)))})
-        # collective sharded save; the pointer advances only AFTER the
-        # save completed on every rank, so a kill mid-save leaves the
+        # collective sharded save of the FULL train state (params +
+        # Adam moments + LR); the pointer advances only AFTER the save
+        # completed on every rank, so a kill mid-save leaves the
         # previous complete checkpoint as latest
         sd = model.state_dict()
         for t in sd.values():  # global (replicated) arrays for orbax
             t._value = jax.device_put(jax.device_get(t.value), repl)
-        save_sharded(sd, os.path.join(ckpt_dir, f"step_{step + 1}"))
+        for store in opt._accumulators.values():
+            for t in store.values():
+                t._value = jax.device_put(jax.device_get(t.value), repl)
+        save_sharded_train_state(sd, opt,
+                                 os.path.join(ckpt_dir, f"step_{step + 1}"))
         if rank == 0:
             tmp = latest + ".tmp"
             with open(tmp, "w") as f:

