@@ -288,3 +288,182 @@ def fused_linear_cross_entropy(x, weight_vh, labels, ignore_index=-100):
     labels [T]. Returns per-token loss [T] (reduce outside)."""
     return _fused_op(x, weight_vh, labels,
                      ignore_index=int(ignore_index))
+
+
+# ---- tensor-parallel (vocab-sharded) variant --------------------------------
+#
+# The reference's TP loss IS a fused vocab-sharded kernel:
+# paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu:1
+# — each rank computes its local logits shard's max / sum-exp / label
+# hit, then combines with cross-rank allreduce(max) + allreduce(sum).
+# TPU-native translation: shard_map over the 'mp' mesh axis; each shard
+# runs the SAME single-chip Pallas streaming kernel on its local
+# [V/mp, H] vocab tile, then lax.pmax/psum over 'mp' combine the
+# per-shard logsumexp and label log-likelihood. The [tokens, vocab]
+# logits tensor never exists in HBM on ANY shard, in either direction.
+
+# out-of-vocab sentinel: never equals any (shifted) label, so the local
+# kernels treat every row as "valid" and validity is applied OUTSIDE
+# (ignore_index handling must be global, not per-shard: a shifted
+# ignore label could alias a real local id on shard 0 otherwise)
+_NEVER = -(2 ** 31 - 123)
+
+# mesh registry keyed by CONTENT (axis names + device ids + shape), not
+# id(): id-keyed entries pinned meshes forever and a recycled id could
+# have mapped a jit-cached mesh key onto the wrong mesh. Equal meshes
+# share one entry, so the registry is bounded by the number of distinct
+# topologies in the process.
+_TP_MESHES = {}
+
+
+def _register_mesh(mesh):
+    key = (tuple(mesh.axis_names),
+           tuple(int(d.id) for d in mesh.devices.flat),
+           tuple(mesh.devices.shape))
+    _TP_MESHES[key] = mesh
+    return key
+
+
+def _local_fwd(x_l, w_l, lab_local):
+    """(per-token local loss, local lse) for ONE vocab shard; labels
+    already shifted to local coords, out-of-shard labels miss (ll=0,
+    so local loss == local lse for them)."""
+    if _use_pallas(x_l, w_l):
+        return _pallas_fwd(x_l, w_l, lab_local, _NEVER)
+    logits = _dot_f32(x_l, w_l, ((1,), (1,)))
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    v_l = w_l.shape[0]
+    hit = (lab_local >= 0) & (lab_local < v_l)
+    ll = jnp.where(
+        hit,
+        jnp.take_along_axis(
+            logits, jnp.clip(lab_local, 0, v_l - 1)[:, None].astype(
+                jnp.int32), axis=-1)[:, 0],
+        0.0)
+    return lse - ll, lse
+
+
+def _tp_specs(mesh, P):
+    tok = "dp" if "dp" in mesh.axis_names else None
+    return P(tok, None), P("mp", None), P(tok)
+
+
+def _tp_fwd_impl(x, w_vh, labels, mesh_id, ignore_index):
+    from jax.sharding import PartitionSpec as P
+    mesh = _TP_MESHES[mesh_id]
+    v_local = w_vh.shape[0] // mesh.shape["mp"]
+    x_spec, w_spec, t_spec = _tp_specs(mesh, P)
+
+    def body(x_l, w_l, lab_l):
+        lab = lab_l.astype(jnp.int32)
+        valid = lab != jnp.int32(ignore_index)
+        shifted = (jnp.where(valid, lab, jnp.int32(_NEVER))
+                   - jax.lax.axis_index("mp") * jnp.int32(v_local))
+        loss_l, lse_l = _local_fwd(x_l, w_l, shifted)
+        ll_l = lse_l - loss_l           # local label log-likelihood
+        # distributed logsumexp: allreduce(max) + allreduce(sum), the
+        # c_softmax_with_cross_entropy combine, on ICI via GSPMD
+        m = jax.lax.pmax(lse_l, "mp")
+        lse_g = m + jnp.log(jax.lax.psum(jnp.exp(lse_l - m), "mp"))
+        ll_g = jax.lax.psum(ll_l, "mp")
+        loss = jnp.where(valid, lse_g - ll_g, 0.0)
+        return loss, lse_g
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(x_spec, w_spec, t_spec),
+        out_specs=(t_spec, t_spec), check_vma=False)(x, w_vh, labels)
+
+
+def _tp_bwd_impl(x, w_vh, labels, lse_g, g, mesh_id, ignore_index):
+    from jax.sharding import PartitionSpec as P
+    mesh = _TP_MESHES[mesh_id]
+    v_local = w_vh.shape[0] // mesh.shape["mp"]
+    x_spec, w_spec, t_spec = _tp_specs(mesh, P)
+
+    def body(x_l, w_l, lab_l, lse_l, g_l):
+        lab = lab_l.astype(jnp.int32)
+        valid = lab != jnp.int32(ignore_index)
+        shifted = (jnp.where(valid, lab, jnp.int32(_NEVER))
+                   - jax.lax.axis_index("mp") * jnp.int32(v_local))
+        # validity zeroes the cotangent (the kernels' sentinel
+        # ignore_index treats every row as valid)
+        g_eff = g_l * valid.astype(g_l.dtype)
+        if _use_pallas(x_l, w_l):
+            # global lse → each shard's recomputed tile exponentiates
+            # to the GLOBAL softmax slice; dx partial-sums over shards
+            dx_l, dw_l = _pallas_bwd(x_l, w_l, shifted, lse_l, g_eff,
+                                     _NEVER)
+        else:
+            logits = _dot_f32(x_l, w_l, ((1,), (1,)))
+            p = jnp.exp(logits - lse_l[:, None])
+            col = jax.lax.broadcasted_iota(
+                jnp.int32, logits.shape, 1)
+            onehot = (col == shifted[:, None]).astype(jnp.float32)
+            d = (p - onehot) * g_eff.astype(jnp.float32)[:, None]
+            dx_l = _dot_f32(d.astype(w_l.dtype), w_l, ((1,), (0,)))
+            dw_l = _dot_f32(d.astype(x_l.dtype), x_l, ((0,), (0,)))
+        # dx partial-sums over the vocab ('mp') shards; dw over the
+        # token ('dp') shards — each axis reduces the dim it splits
+        dx = jax.lax.psum(dx_l.astype(x_l.dtype), "mp")
+        dw = dw_l.astype(w_l.dtype)
+        if "dp" in mesh.axis_names:
+            dw = jax.lax.psum(dw, "dp")
+        return dx, dw
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(x_spec, w_spec, t_spec, t_spec, t_spec),
+        out_specs=(x_spec, w_spec), check_vma=False)(
+            x, w_vh, labels, lse_g, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_tp_core(x, w_vh, labels, mesh_id, ignore_index):
+    return _tp_fwd_impl(x, w_vh, labels, mesh_id, ignore_index)[0]
+
+
+def _fused_tp_fwd(x, w_vh, labels, mesh_id, ignore_index):
+    loss, lse_g = _tp_fwd_impl(x, w_vh, labels, mesh_id, ignore_index)
+    return loss, (x, w_vh, labels, lse_g)
+
+
+def _fused_tp_bwd(mesh_id, ignore_index, res, g):
+    x, w_vh, labels, lse_g = res
+    dx, dw = _tp_bwd_impl(x, w_vh, labels, lse_g, g, mesh_id,
+                          ignore_index)
+    return dx, dw, None
+
+
+_fused_tp_core.defvjp(_fused_tp_fwd, _fused_tp_bwd)
+
+
+@register_op("fused_linear_cross_entropy_tp")
+def _fused_tp_op(x, w_vh, labels, *, mesh_id, ignore_index):
+    return _fused_tp_core(x, w_vh, labels, mesh_id, ignore_index)
+
+
+def tp_fused_applicable(mesh, t, h, v):
+    """The fused TP head handles meshes whose parallel axes are
+    dp/mp/sharding (pp stages slice the program before the head; the
+    pipelined loss keeps the composition) with the vocab and token dims
+    dividing evenly over their axes."""
+    if mesh is None or "mp" not in mesh.axis_names:
+        return False
+    mp = int(mesh.shape["mp"])
+    if mp <= 1 or v % mp != 0:
+        return False
+    if int(mesh.shape.get("pp", 1)) != 1:
+        return False
+    dp = int(mesh.shape.get("dp", 1))
+    return t % max(dp, 1) == 0
+
+
+def fused_linear_cross_entropy_tp(x, weight_vh, labels, mesh,
+                                  ignore_index=-100):
+    """Vocab-sharded fused linear+CE: weight_vh [V, H] sharded over the
+    'mp' mesh axis, x [T, H] (tokens dp-sharded when the mesh has a dp
+    axis), labels [T]. Per-token loss [T]. Reference:
+    c_softmax_with_cross_entropy_op.cu (allreduce-max/sum combine)."""
+    return _fused_tp_op(x, weight_vh, labels,
+                        mesh_id=_register_mesh(mesh),
+                        ignore_index=int(ignore_index))

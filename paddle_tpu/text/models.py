@@ -205,10 +205,7 @@ class GPTForCausalLM(nn.Layer):
             # fused linear+CE streams vocab tiles through VMEM: the
             # [tokens, vocab] logits tensor never exists in HBM in
             # either direction (ops/fused_ce.py; falls back to the
-            # composition below on CPU / unsupported shapes). Sharded
-            # runs keep the composition: TP's vocab dim is mp-sharded
-            # (ParallelCrossEntropy territory) and a pallas_call under
-            # a dp/pp-sharded token dim would need manual partitioning.
+            # composition below on CPU / unsupported shapes).
             from ..ops.fused_ce import fused_linear_cross_entropy
             flat = manipulation.reshape(labels, (-1,))
             per_tok = fused_linear_cross_entropy(
@@ -219,6 +216,27 @@ class GPTForCausalLM(nn.Layer):
             # the valid fraction on padded batches)
             valid = (flat != -100).astype("float32").sum()
             return per_tok.sum() / valid.clip(min=1.0)
+        if labels is not None and self.cfg.tie_embeddings \
+                and self.cfg.use_mp and mesh is not None:
+            # TP: the vocab-sharded fused kernel — each mp shard
+            # streams its LOCAL vocab tile through VMEM, then
+            # pmax/psum combine the per-shard logsumexp (the
+            # c_softmax_with_cross_entropy_op.cu scheme; pp>1 keeps
+            # the composition — stages slice the program before the
+            # head)
+            from ..ops.fused_ce import (fused_linear_cross_entropy_tp,
+                                        tp_fused_applicable)
+            t = 1
+            for d in h.shape[:-1]:
+                t *= int(d)
+            if tp_fused_applicable(mesh, t, self.cfg.hidden_size,
+                                   self.cfg.vocab_size):
+                flat = manipulation.reshape(labels, (-1,))
+                per_tok = fused_linear_cross_entropy_tp(
+                    manipulation.reshape(h, (-1, self.cfg.hidden_size)),
+                    self.gpt.word_embeddings.weight, flat, mesh)
+                valid = (flat != -100).astype("float32").sum()
+                return per_tok.sum() / valid.clip(min=1.0)
         if self.cfg.tie_embeddings:
             logits = math_ops.matmul(h, self.gpt.word_embeddings.weight,
                                      transpose_y=True)
