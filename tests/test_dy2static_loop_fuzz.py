@@ -101,6 +101,65 @@ def f(x, n):
     return src, bound, tensor_bound
 
 
+def _gen_return_program(rs):
+    """Random early-return program over float vectors x and y: guard
+    clauses / if-else returns / elif-style chains on Tensor predicates,
+    optionally interleaved with reassignments and a trailing return
+    (the ReturnTransformer grammar — reference
+    return_transformer.py:136)."""
+    exprs = ["x * 2.0", "x + y", "x - y", "y * 0.5", "(x + y) * 1.5"]
+    lines = ["import paddle_tpu as paddle", "", "", "def f(x, y):"]
+    n_guards = int(rs.randint(1, 4))
+    for _ in range(n_guards):
+        thr = round(float(rs.uniform(-2, 2)), 2)
+        pred = rs.choice([f"x.sum() > {thr}", f"y.mean() > {thr}",
+                          f"(x + y).max() > {thr}"])
+        if rs.randint(2):
+            lines.append(f"    if {pred}:")
+            lines.append(f"        return {rs.choice(exprs)}")
+        else:  # if/else both return: terminates the function
+            lines.append(f"    if {pred}:")
+            lines.append(f"        return {rs.choice(exprs)}")
+            lines.append("    else:")
+            lines.append(f"        return {rs.choice(exprs)}")
+            return "\n".join(lines) + "\n"
+        if rs.randint(2):
+            c = round(float(rs.uniform(0.1, 1.0)), 2)
+            lines.append(f"    x = x + {c}")
+    lines.append(f"    return {rs.choice(exprs)}")
+    return "\n".join(lines) + "\n"
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_return_program_three_leg_parity(seed):
+    """Early returns three-legged: plain python truth, converted eager,
+    converted compiled — exact agreement on shared random inputs."""
+    import warnings
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    rs = np.random.RandomState(7000 + seed)
+    src = _gen_return_program(rs)
+    f = _make_fn(src, "f")
+    xp = rs.randn(3).astype(np.float32)
+    yp = rs.randn(3).astype(np.float32)
+
+    want = f(paddle.to_tensor(xp), paddle.to_tensor(yp)).numpy()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # conversion must not fall back
+        g = convert_to_static(f)
+        got_eager = g(paddle.to_tensor(xp),
+                      paddle.to_tensor(yp)).numpy()
+    np.testing.assert_allclose(got_eager, want, rtol=1e-6, err_msg=src)
+
+    h = paddle.jit.to_static(f)
+    for _ in range(3):
+        got_c = h(paddle.to_tensor(xp), paddle.to_tensor(yp))
+    np.testing.assert_allclose(got_c.numpy(), want, rtol=1e-6,
+                               err_msg=src)
+
+
 @pytest.mark.parametrize("seed", range(30))
 def test_loop_program_three_leg_parity(seed):
     from paddle_tpu.jit.dy2static import convert_to_static

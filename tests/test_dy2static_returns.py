@@ -1,0 +1,212 @@
+"""dy2static early-return conversion (reference:
+return_transformer.py:136 ReturnTransformer). This repo rewrites by
+ELSE-PUSHING — `if p: return a; <rest>` -> `if p: ret = a else:
+<rest>` with one final return — so Tensor-predicate returns lower to
+nested lax.cond inside ONE compiled program (no flag carries)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _entries(fn):
+    return len(fn.entries)
+
+
+def test_guard_clause_tensor_pred_single_program():
+    """The canonical early return: a guard clause on a Tensor predicate
+    must compile INTO the program (lax.cond), with both data paths
+    served by the same executable — no retrace, no fallback warning."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2.0
+        return x - 1.0
+
+    pos = np.ones((3,), np.float32)
+    neg = -np.ones((3,), np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any fallback warning -> fail
+        for _ in range(3):
+            out = f(paddle.to_tensor(pos))
+    np.testing.assert_allclose(out.numpy(), pos * 2.0)
+    n = _entries(f)
+    out = f(paddle.to_tensor(neg))  # other branch, SAME program
+    np.testing.assert_allclose(out.numpy(), neg - 1.0)
+    assert _entries(f) == n, "branch flip retraced: cond not in-program"
+
+
+def test_if_else_both_return():
+    @paddle.jit.to_static
+    def f(x):
+        if x.mean() > 1.0:
+            return x / 2.0
+        else:
+            return x + 3.0
+
+    big = np.full((4,), 4.0, np.float32)
+    small = np.zeros((4,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(big))
+    np.testing.assert_allclose(out.numpy(), big / 2.0)
+    np.testing.assert_allclose(f(paddle.to_tensor(small)).numpy(),
+                               small + 3.0)
+
+
+def test_elif_chain_returns():
+    @paddle.jit.to_static
+    def f(x):
+        s = x.sum()
+        if s > 10.0:
+            return x * 10.0
+        elif s > 0.0:
+            return x * 1.0
+        else:
+            return x * -1.0
+
+    for mul, arr in ((10.0, np.full((3,), 5.0, np.float32)),
+                     (1.0, np.full((3,), 0.5, np.float32)),
+                     (-1.0, np.full((3,), -2.0, np.float32))):
+        for _ in range(3):
+            out = f(paddle.to_tensor(arr))
+        np.testing.assert_allclose(out.numpy(), arr * mul)
+
+
+def test_early_return_then_trailing_code():
+    """(A, N) shape: the remainder after the guard must execute exactly
+    when the guard does not return (else-push), including later
+    tensor-pred conversions in that remainder."""
+    @paddle.jit.to_static
+    def f(x, y):
+        if x.max() > 100.0:
+            return x
+        z = x + y
+        if z.sum() > 0:
+            z = z * 2.0
+        return z
+
+    x = np.ones((2,), np.float32)
+    y = np.ones((2,), np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(out.numpy(), (x + y) * 2.0)
+    big = np.full((2,), 200.0, np.float32)
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(big), paddle.to_tensor(y)).numpy(), big)
+
+
+def test_nested_all_paths_return():
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            if x.max() > 2.0:
+                return x * 4.0
+            return x * 2.0
+        return x * -1.0
+
+    for mul, arr in ((4.0, np.full((3,), 3.0, np.float32)),
+                     (2.0, np.full((3,), 0.5, np.float32)),
+                     (-1.0, np.full((3,), -1.0, np.float32))):
+        for _ in range(3):
+            out = f(paddle.to_tensor(arr))
+        np.testing.assert_allclose(out.numpy(), arr * mul)
+
+
+def test_python_pred_early_return_keeps_python_semantics():
+    """A python-bool guard dispatches at run time to the plain branch —
+    no cond in the program, branch decided per trace."""
+    @paddle.jit.to_static
+    def f(x, flag):
+        if flag:
+            return x * 2.0
+        return x + 1.0
+
+    xp = np.ones((2,), np.float32)
+    for _ in range(3):
+        a = f(paddle.to_tensor(xp), True)
+    np.testing.assert_allclose(a.numpy(), xp * 2.0)
+    b = f(paddle.to_tensor(xp), False)
+    np.testing.assert_allclose(b.numpy(), xp + 1.0)
+
+
+def test_fallthrough_returns_none_python_pred():
+    """No tail return: a python-pred guard that does not fire falls
+    through and the function returns None (python semantics kept by
+    the rewrite's None-initialized return slot)."""
+    def f(x):
+        if x > 3:  # python int comparison
+            return x * 2
+        x + 1  # no return
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+    g = convert_to_static(f)
+    assert getattr(g, "__wrapped_dy2static__", False)
+    assert g(5) == 10
+    assert g(1) is None
+
+
+def test_return_inside_loop_falls_back_with_warning():
+    """Conditional return under a loop genuinely needs run-time flags;
+    the converter must warn and keep python semantics (the reference
+    would convert via its interpreter-executed flag scheme)."""
+    def f(x, n):
+        for i in range(n):
+            if i == 2:
+                return x * float(i)
+            x = x + 1.0
+        return x
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+    with pytest.warns(UserWarning, match="early-return conversion"):
+        g = convert_to_static(f)
+    xp = np.ones((2,), np.float32)
+    out = g(paddle.to_tensor(xp), 5)
+    np.testing.assert_allclose(out.numpy(), (xp + 2.0) * 2.0)
+
+
+def test_returns_compose_with_converted_loops():
+    """A guard-clause return above a Tensor-bounded loop: the rewrite
+    must leave the loop conversion intact (remainder pushed into the
+    else leg still goes through the loop transformer)."""
+    @paddle.jit.to_static
+    def f(x, n):
+        if x.min() > 50.0:
+            return x
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+        return s
+
+    xp = np.full((3,), 2.0, np.float32)
+    for _ in range(3):
+        out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(4)))
+    np.testing.assert_allclose(out.numpy(), xp * 4)
+    n_entries = _entries(f)
+    out = f(paddle.to_tensor(xp), paddle.to_tensor(np.int64(7)))
+    np.testing.assert_allclose(out.numpy(), xp * 7)
+    assert _entries(f) == n_entries
+
+
+def test_return_differential_vs_eager():
+    """Differential check: converted vs undecorated eager execution on
+    a grid of inputs crossing every branch."""
+    def body(x, y):
+        if x.sum() > 4.0:
+            return x - y
+        if y.sum() > 4.0:
+            return x + y
+        z = x * y
+        if z.mean() > 0:
+            return z * 3.0
+        return z
+
+    conv = paddle.jit.to_static(body)
+    rs = np.random.RandomState(0)
+    for _ in range(8):
+        xp = rs.randn(3).astype(np.float32) * 3
+        yp = rs.randn(3).astype(np.float32) * 3
+        want = body(paddle.to_tensor(xp), paddle.to_tensor(yp)).numpy()
+        got = conv(paddle.to_tensor(xp), paddle.to_tensor(yp)).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)
