@@ -1,10 +1,15 @@
 """High-level Model API.
 
 Reference parity: python/paddle/hapi/model.py:878 Model (fit:1523,
-evaluate:1753, predict:1855, train_batch/eval_batch). Single adapter: the
-dygraph path with to_static compilation of the train step — the reference's
-Dynamic/StaticGraphAdapter split collapses because trace-capture IS the
-static mode here.
+evaluate:1753, predict:1855, train_batch/eval_batch) with BOTH adapters:
+the dygraph path runs ops eagerly; with paddle.enable_static() active,
+train/eval/predict batches run through a to_static-COMPILED whole step —
+the TPU-native equivalent of the reference's StaticGraphAdapter
+(model.py:249: builds a static Program per mode and runs it in the
+executor; here the captured trace IS that program, compiled by XLA).
+Both adapters share the callback/metric/loop plumbing, and metrics stay
+eager over the step's returned outputs exactly like the reference
+adapter feeds fetched outputs to Metric.update.
 """
 import numpy as np
 
@@ -15,6 +20,11 @@ from ..ops import math as math_ops
 from . import callbacks as cb_mod
 
 
+def _in_static_mode():
+    from ..static import _static_mode
+    return bool(_static_mode[0])
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -22,11 +32,68 @@ class Model:
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        # static-adapter compiled steps, built lazily per mode (the
+        # train/eval distinction must be baked into separate programs:
+        # dropout/BN behave differently)
+        self._static_steps = {}
+
+    # ---- static adapter (reference: hapi/model.py:249
+    # StaticGraphAdapter) --------------------------------------------------
+    def _static_step(self, mode):
+        step = self._static_steps.get(mode)
+        if step is not None:
+            return step
+        from ..jit import to_static
+        model = self
+
+        if mode == "train":
+            def raw(ins, labs, update):
+                outputs = model.network(*ins)
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                losses = model._loss(*(outs + [l for l in labs
+                                               if l is not None]))
+                loss_list = losses if isinstance(losses, (list, tuple)) \
+                    else [losses]
+                total = loss_list[0]
+                for l in loss_list[1:]:
+                    total = math_ops.add(total, l)
+                total.backward()
+                if update:
+                    model._optimizer.step()
+                    model._optimizer.clear_grad()
+                return list(loss_list), list(outs)
+        elif mode == "eval":
+            def raw(ins, labs):
+                outputs = model.network(*ins)
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                if model._loss is None:
+                    return [], list(outs)
+                losses = model._loss(*(outs + [l for l in labs
+                                               if l is not None]))
+                loss_list = losses if isinstance(losses, (list, tuple)) \
+                    else [losses]
+                return list(loss_list), list(outs)
+        else:
+            def raw(ins):
+                outputs = model.network(*ins)
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                return list(outs)
+
+        step = to_static(raw)
+        self._static_steps[mode] = step
+        return step
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
+        # compiled static steps close over loss/optimizer at trace
+        # time: a re-prepare must invalidate them or the old pair
+        # stays baked into the XLA program
+        self._static_steps = {}
         if metrics is None:
             self._metrics = []
         elif isinstance(metrics, (list, tuple)):
@@ -43,17 +110,24 @@ class Model:
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
         labs = [y if isinstance(y, Tensor) or y is None
                 else Tensor(np.asarray(y)) for y in labs]
-        outputs = self.network(*ins)
-        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
-        losses = self._loss(*(outs + [l for l in labs if l is not None]))
-        loss_list = losses if isinstance(losses, (list, tuple)) else [losses]
-        total = loss_list[0]
-        for l in loss_list[1:]:
-            total = math_ops.add(total, l)
-        total.backward()
-        if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+        if _in_static_mode():
+            loss_list, outs = self._static_step("train")(
+                ins, labs, bool(update))
+        else:
+            outputs = self.network(*ins)
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            losses = self._loss(*(outs
+                                  + [l for l in labs if l is not None]))
+            loss_list = losses if isinstance(losses, (list, tuple)) \
+                else [losses]
+            total = loss_list[0]
+            for l in loss_list[1:]:
+                total = math_ops.add(total, l)
+            total.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
         metrics = []
         for m in self._metrics:
             metrics.append(m.update(m.compute(*(outs + [l for l in labs
@@ -70,15 +144,23 @@ class Model:
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
         labs = [y if isinstance(y, Tensor) or y is None
                 else Tensor(np.asarray(y)) for y in labs]
-        outputs = self.network(*ins)
-        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if _in_static_mode():
+            loss_list, outs = self._static_step("eval")(ins, labs)
+        else:
+            outputs = self.network(*ins)
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
+            loss_list = None
+            if self._loss is not None:
+                losses = self._loss(*(outs + [l for l in labs
+                                              if l is not None]))
+                loss_list = losses if isinstance(losses, (list, tuple)) \
+                    else [losses]
         metrics = []
         for m in self._metrics:
             metrics.append(m.update(m.compute(*(outs + [l for l in labs
                                                         if l is not None]))))
-        if self._loss is not None:
-            losses = self._loss(*(outs + [l for l in labs if l is not None]))
-            loss_list = losses if isinstance(losses, (list, tuple)) else [losses]
+        if self._loss is not None and loss_list is not None:
             vals = [float(l.numpy()) for l in loss_list]
             return (vals, metrics) if metrics else vals
         return ([], metrics)
@@ -89,8 +171,12 @@ class Model:
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
                for x in ins]
-        outputs = self.network(*ins)
-        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        if _in_static_mode():
+            outs = self._static_step("predict")(ins)
+        else:
+            outputs = self.network(*ins)
+            outs = outputs if isinstance(outputs, (list, tuple)) \
+                else [outputs]
         return [o.numpy() for o in outs]
 
     # ---- loops -----------------------------------------------------------
