@@ -241,7 +241,16 @@ def assign(input, output=None):
                 hint="assign")
         if output is not None:
             return prog.alias(src, output)
-        return src
+        # assign MAKES A COPY: record a fresh variable aliased from src
+        # at THIS program position, so a later in-place alias onto src
+        # (increment(in_place=True), less_than(cond=...)) is not
+        # visible through the returned value — returning src itself
+        # would silently share it (fluid assign-copy semantics inside
+        # While bodies depend on this)
+        name = prog._new_name("assign")
+        v = _SVar(name, tuple(src._shape), src._dtype, prog)
+        prog.vars[name] = v
+        return prog.alias(src, v)
     t = Tensor(np.asarray(input)) if not isinstance(input, Tensor) \
         else input.clone()
     if output is not None:
@@ -1698,6 +1707,7 @@ class _WhileBlockGuard:
 
     def __enter__(self):
         self._start = len(self._w._prog.ops)
+        self._pre_vars = set(self._w._prog.vars)
         return self
 
     def __exit__(self, et, ev, tb):
@@ -1731,8 +1741,12 @@ class _WhileBlockGuard:
                     collect(r.body)
 
         collect(body)
+        # an alias dst FIRST CREATED inside the block (assign's copy
+        # variable) is a per-iteration temporary, not loop state — only
+        # pre-existing variables can be carried
         carry = [self._w._cond.name] + [n for n in writes
                                         if n not in produced
+                                        and n in self._pre_vars
                                         and n != self._w._cond.name]
         prog.ops.append(WhileRecord(self._w._cond.name, body, carry))
         return False

@@ -316,6 +316,30 @@ def _emit_dot(g, env, eqn):
             or tuple(rb) != tuple(range(len(rb))):
         raise NotImplementedError(
             "onnx export: non-leading batch dims in dot_general")
+    # ONNX MatMul uses numpy semantics (all-but-last-two dims are
+    # batch); dot_general's free-dim ordering diverges once either side
+    # keeps >=2 free dims next to a batched counterpart — emitting
+    # MatMul there would compute a DIFFERENT function. Supported exactly
+    # when the two agree: <=1 free dim per side, or a rank-2 unbatched
+    # rhs (numpy broadcasts it across all lhs free dims).
+    lhs_free = ar - len(lb) - 1
+    rhs_free = br - len(rb) - 1
+    if lb and (ar < len(lb) + 2 or br < len(rb) + 2):
+        # a batched side with no free dim (e.g. lhs [B, K] @ rhs
+        # [B, K, N]): numpy/ONNX MatMul would rank-promote the rank-2
+        # side to a broadcast matrix, computing [B, B, N] instead of
+        # dot_general's [B, N]
+        raise NotImplementedError(
+            "onnx export: batched dot_general with a vector (no free "
+            "dim) side does not map to ONNX MatMul; reshape to give "
+            "each batched side a free dim before export")
+    if not ((lhs_free <= 1 and rhs_free <= 1)
+            or (br == 2 and not rb)):
+        raise NotImplementedError(
+            "onnx export: dot_general with >=2 free dims on a side "
+            f"(lhs_free={lhs_free}, rhs_free={rhs_free}) does not map "
+            "to ONNX MatMul's numpy batching; reshape to a single free "
+            "dim per side before export")
     an = env.name(a, "a")
     bn = env.name(b, "b")
     lc0, rc0 = lc[0], rc[0]
@@ -501,9 +525,6 @@ def _convert(closed, param_names, param_values, input_names,
     opset.version = _OPSET
     graph = model.graph
     graph.name = graph_name
-    graph.node.extend(g.nodes)
-    for t in g.initializers.values():
-        graph.initializer.add().CopyFrom(t)
 
     def vinfo(name, aval):
         vi = pb.ValueInfoProto()
@@ -514,11 +535,23 @@ def _convert(closed, param_names, param_values, input_names,
             tt.shape.dim.add().dim_value = int(s)
         return vi
 
+    # resolve outputs BEFORE copying nodes/initializers: resolving a
+    # fully-folded output can CREATE an initializer, and the Identity
+    # wrapper below appends a node — both must land in the graph
     for v, n in zip(xvars, input_names):
         graph.input.add().CopyFrom(vinfo(n, v.aval))
     for out in jaxpr.outvars:
-        graph.output.add().CopyFrom(
-            vinfo(env.name(out, "output"), out.aval))
+        name = env.name(out, "output")
+        if name in g.initializers or name in set(input_names):
+            # ONNX requires graph outputs to be produced by nodes: a
+            # fully constant-folded output (resolves to an initializer)
+            # or an input passthrough must go through an Identity or
+            # strict checkers/runtimes reject the model
+            name = g.node("Identity", [name])
+        graph.output.add().CopyFrom(vinfo(name, out.aval))
+    graph.node.extend(g.nodes)
+    for t in g.initializers.values():
+        graph.initializer.add().CopyFrom(t)
     return model, g
 
 

@@ -437,17 +437,34 @@ class Program:
             # gradient boundary on — and strip state write-backs (e.g.
             # BatchNorm running stats) while KEEPING those forward ops'
             # outputs for downstream consumers.
+            def strip(recs):
+                out = []
+                for r in recs:
+                    if getattr(r, "writebacks", None):
+                        out.append(OpRecord(r.op, r.in_refs, r.out_names,
+                                            r.attrs, cast=r.cast))
+                    elif isinstance(r, WhileRecord):
+                        # writebacks can hide INSIDE sub-block bodies
+                        # (e.g. batch-norm running stats updated in a
+                        # StaticRNN step): a test-mode clone must not
+                        # mutate persistent state from nested ops either
+                        out.append(WhileRecord(r.cond_name,
+                                               strip(r.body),
+                                               r.carry_names))
+                    elif isinstance(r, ScanRecord):
+                        out.append(ScanRecord(strip(r.body),
+                                              r.seq_inputs, r.mems,
+                                              r.out_pairs))
+                    else:
+                        out.append(r)
+                return out
+
             fwd = []
             for r in c.ops:
                 if isinstance(r, GradRecord):
                     break
-                if getattr(r, "writebacks", None):
-                    r2 = OpRecord(r.op, r.in_refs, r.out_names, r.attrs,
-                                  cast=r.cast)
-                    fwd.append(r2)
-                else:
-                    fwd.append(r)
-            c.ops = fwd
+                fwd.append(r)
+            c.ops = strip(fwd)
         return c
 
     def to_string(self, throw_on_error=False, with_details=False):

@@ -1,0 +1,144 @@
+"""Round-5 fixes for the round-4 advisor findings (ADVICE.md):
+ONNX dot_general/MatMul semantics mismatch, proto descriptor-pool
+rename, Identity-wrapped constant graph outputs, clone(for_test)
+nested-writeback stripping, fluid assign copy semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_onnx_dot_general_rejects_numpy_batch_mismatch():
+    """ADVICE #1 (medium): a dot_general whose free dims diverge from
+    ONNX MatMul's all-but-last-two batching must refuse at export time
+    instead of silently emitting a graph that computes a different
+    function."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from paddle_tpu import onnx as onnx_mod
+
+    def bad(a, b):  # lhs_free=2 beside a batched rhs -> not MatMul
+        return lax.dot_general(a, b, (((3,), (1,)), ((0,), (0,))))
+
+    a = jnp.zeros((2, 3, 4, 5), jnp.float32)
+    b = jnp.zeros((2, 5, 6), jnp.float32)
+    closed = jax.make_jaxpr(bad)(a, b)
+    with pytest.raises(NotImplementedError, match="free dims"):
+        onnx_mod._convert(closed, [], [], ["a", "b"], "g")
+
+    def ok(a, b):  # rank-2 unbatched rhs: numpy broadcast matches
+        return lax.dot_general(a, b, (((2,), (0,)), ((), ())))
+
+    a2 = jnp.zeros((2, 3, 4), jnp.float32)
+    b2 = jnp.zeros((4, 6), jnp.float32)
+    model, _ = onnx_mod._convert(jax.make_jaxpr(ok)(a2, b2), [], [],
+                                 ["a", "b"], "g")
+    assert any(n.op_type == "MatMul" for n in model.graph.node)
+
+
+def test_onnx_proto_registered_under_renamed_package():
+    """ADVICE #2: the bundled bindings must NOT register 'onnx.proto'
+    into protobuf's default pool (collides with the real onnx package
+    when both are imported); the emitted bytes stay valid regardless
+    because the wire format only depends on field numbers."""
+    from paddle_tpu.onnx_proto import onnx_pb2
+
+    d = onnx_pb2.DESCRIPTOR
+    assert d.name == "paddle_tpu_onnx.proto"
+    assert d.package == "paddle_tpu_onnx"
+    m = onnx_pb2.ModelProto()
+    m.ir_version = 8
+    m2 = onnx_pb2.ModelProto()
+    m2.ParseFromString(m.SerializeToString())
+    assert m2.ir_version == 8
+
+
+def test_onnx_constant_output_wrapped_in_identity(tmp_path):
+    """ADVICE #3: a graph output that fully constant-folds (depends
+    only on parameters) must be produced by a node (Identity over the
+    initializer) — ONNX requires node-produced outputs."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.onnx_proto import onnx_pb2
+    from paddle_tpu.static import InputSpec
+
+    class ConstOut(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter((3,))
+
+        def forward(self, x):
+            return self.w * 2.0  # ignores x: folds to a constant
+
+    net = ConstOut()
+    net.eval()
+    path = paddle.onnx.export(net, str(tmp_path / "c"),
+                              input_spec=[InputSpec([2], "float32")])
+    model = onnx_pb2.ModelProto()
+    with open(path, "rb") as f:
+        model.ParseFromString(f.read())
+    produced = {o for n in model.graph.node for o in n.output}
+    for out in model.graph.output:
+        assert out.name in produced, (
+            f"graph output {out.name} is not produced by any node")
+    init_names = {t.name for t in model.graph.initializer}
+    id_nodes = [n for n in model.graph.node if n.op_type == "Identity"]
+    assert any(n.input[0] in init_names for n in id_nodes)
+
+
+def test_clone_for_test_strips_writebacks_inside_subblocks():
+    """ADVICE #4: clone(for_test=True) must strip writebacks from
+    OpRecords nested inside While/Scan bodies too (running-stat
+    updates inside a StaticRNN step would otherwise mutate persistent
+    state in test-mode clones)."""
+    from paddle_tpu.static.program import (OpRecord, Program, ScanRecord,
+                                           WhileRecord)
+
+    class _FakeOp:
+        name = "fake"
+
+    inner = OpRecord(_FakeOp(), [], ["o1"], {})
+    inner.writebacks = {0: object()}
+    inner2 = OpRecord(_FakeOp(), [], ["o2"], {})
+    inner2.writebacks = {0: object()}
+    prog = Program()
+    prog.ops.append(WhileRecord("c", [inner], ["c"]))
+    prog.ops.append(ScanRecord([inner2], [], [], []))
+
+    test_prog = prog.clone(for_test=True)
+    w, s = test_prog.ops
+    assert not w.body[0].writebacks
+    assert not s.body[0].writebacks
+    # the original program keeps its writebacks
+    assert prog.ops[0].body[0].writebacks
+
+
+def test_fluid_assign_copies_in_static_while():
+    """ADVICE #5: assign(x) with no output must record a COPY — a later
+    in-place increment of x must not be visible through the assigned
+    value (fluid's assign-makes-a-copy contract inside While bodies)."""
+    from paddle_tpu.fluid import layers
+
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            i = layers.fill_constant([1], "int64", 0)
+            n = layers.fill_constant([1], "int64", 3)
+            snap = layers.fill_constant([1], "int64", -1)
+            cond = layers.less_than(i, n)
+            w = layers.While(cond)
+            with w.block():
+                copy = layers.assign(i)       # snapshot BEFORE increment
+                layers.assign(copy, output=snap)
+                i2 = layers.increment(i, in_place=True)
+                layers.less_than(i2, n, cond=cond)
+
+        exe = paddle.static.Executor()
+        res, = exe.run(main, feed={}, fetch_list=[snap])
+        # last iteration runs with i == 2: the snapshot must be the
+        # PRE-increment value, not the post-increment 3
+        np.testing.assert_array_equal(np.asarray(res), [2])
+    finally:
+        paddle.disable_static()
