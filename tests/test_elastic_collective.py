@@ -1,15 +1,20 @@
-"""Collective-mode elastic recovery (VERDICT r3 item 7; reference:
-fleet/elastic.py:101 — membership watch + relaunch covers COLLECTIVE
-jobs, not just the PS path tested in test_aux_systems).
+"""Collective-mode elastic recovery (reference: fleet/elastic.py:101 —
+membership watch BOTH ways + relaunch covers COLLECTIVE jobs, not just
+the PS path tested in test_aux_systems).
 
-Flow proven end-to-end: a 2-process jax.distributed training job
-checkpoints (orbax sharded) every step and heartbeats into the shared
-FileStore; the launcher SIGKILLs one rank, DETECTS the death via
-heartbeat expiry, tears down the survivors (they would deadlock in the
-next collective), relaunches a 1-process world on HALF the devices, and
-the new world resumes from the latest complete sharded checkpoint —
-restored onto the smaller mesh — with loss continuity against the
-original run's trajectory."""
+Flow proven end-to-end, shrink AND grow:
+  phase 1: a 2-process jax.distributed training job (Adam) checkpoints
+    the FULL train state (params + moments + LR) every step and
+    heartbeats into the shared FileStore;
+  shrink: the launcher SIGKILLs one rank, DETECTS the death via
+    heartbeat expiry, tears down the survivors (they would deadlock in
+    the next collective), relaunches a 1-process world on HALF the
+    devices; resume restores params AND Adam moments onto the smaller
+    mesh, with loss continuity against the original trajectory;
+  grow (reference elastic.py:173-206 watches joins too): a NEW node
+    registers in the store, the launcher detects the join, tears down
+    the small world and relaunches the 2-process world; resume reshards
+    back onto the full device set and the trajectory still matches."""
 import json
 import os
 import signal
@@ -55,10 +60,11 @@ def test_collective_kill_detect_relaunch_resume(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
 
-    def spawn(rank, nproc, ndev):
+    def spawn(rank, nproc, ndev, coord_addr=None):
         return subprocess.Popen(
-            [sys.executable, _WORKER, str(rank), str(nproc), coord,
-             ckpt_dir, store_root, log_path, str(ndev)],
+            [sys.executable, _WORKER, str(rank), str(nproc),
+             coord_addr or coord, ckpt_dir, store_root, log_path,
+             str(ndev)],
             env=env, stdout=subprocess.DEVNULL,
             stderr=subprocess.PIPE, text=True)
 
@@ -126,7 +132,8 @@ def test_collective_kill_detect_relaunch_resume(tmp_path):
 
         # loss continuity: the resumed run's losses at overlapping steps
         # match the original trajectory exactly (same global data, same
-        # restored params; dp4 vs dp2 is the same global computation)
+        # restored params AND Adam moments; dp4 vs dp2 is the same
+        # global computation)
         steps2 = {e["step"]: e["loss"] for e in events
                   if e["event"] == "step"}
         overlap = sorted(set(steps2) & set(orig_losses))
@@ -137,6 +144,74 @@ def test_collective_kill_detect_relaunch_resume(tmp_path):
         # and it progressed PAST the original run eventually or at least
         # trained on
         assert len(steps2) >= 3
+
+        # ---- phase 3: SCALE-OUT (reference elastic.py:173-206 watches
+        # joins too). A new node registers; the launcher detects the
+        # join, tears down the small world, re-grows to 2 processes on
+        # the full device set; resume reshards back up and the
+        # trajectory still matches.
+        store.register("w-joiner")
+        deadline = time.time() + 10
+        while "w-joiner" not in store.alive_nodes() \
+                and time.time() < deadline:
+            time.sleep(0.1)
+        assert "w-joiner" in store.alive_nodes(), \
+            "new node's registration never became visible"
+        # kill while the last LOGGED step is at/past the checkpoint
+        # pointer, so the re-grown world's steps overlap the small
+        # world's logged trajectory (the pointer advances only after
+        # the slow collective save — the window is wide)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            logged = [e["step"] for e in _read_log(log_path)
+                      if e["event"] == "step"]
+            with open(os.path.join(ckpt_dir, "latest.txt")) as f:
+                pointer = int(f.read().strip())
+            if logged and max(logged) >= pointer:
+                break
+            time.sleep(0.05)
+        if procs[0].poll() is None:
+            procs[0].send_signal(signal.SIGKILL)
+            procs[0].wait()
+        # re-read AFTER the kill: the small world kept stepping during
+        # the join-visibility and kill-window polls above — a stale
+        # snapshot would miss those steps and break the overlap below
+        steps2 = {e["step"]: e["loss"]
+                  for e in _read_log(log_path) if e["event"] == "step"}
+        all_losses = dict(orig_losses)
+        all_losses.update(steps2)
+        with open(os.path.join(ckpt_dir, "latest.txt")) as f:
+            resume2 = int(f.read().strip())
+        assert resume2 > resume_step, "small world made no progress"
+
+        os.rename(log_path, log_path + ".phase2")
+        coord2 = f"127.0.0.1:{_free_port()}"
+        procs = [spawn(0, 2, 2, coord2), spawn(1, 2, 2, coord2)]
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            events = _read_log(log_path)
+            steps3 = [e for e in events
+                      if e["event"] == "step" and e["rank"] == 0]
+            if len(steps3) >= 3:
+                break
+            if any(p.poll() not in (None, 0) for p in procs):
+                raise AssertionError(
+                    "re-grown worker died:\n"
+                    + "\n".join(p.communicate()[1][-3000:]
+                                for p in procs if p.poll()))
+            time.sleep(0.2)
+        events = _read_log(log_path)
+        start3 = [e for e in events if e["event"] == "start"
+                  and e["rank"] == 0][0]
+        assert start3["resumed_from"] == resume2
+        assert start3["world_devices"] == 4  # genuinely re-grown
+        steps3 = {e["step"]: e["loss"] for e in events
+                  if e["event"] == "step" and e["rank"] == 0}
+        overlap3 = sorted(set(steps3) & set(all_losses))
+        assert overlap3, (sorted(steps3), sorted(all_losses))
+        for s in overlap3:
+            np.testing.assert_allclose(steps3[s], all_losses[s],
+                                       rtol=1e-5)
     finally:
         for p in procs:
             if p.poll() is None:
