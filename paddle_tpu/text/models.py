@@ -22,7 +22,7 @@ class TransformerLMConfig:
                  num_heads=12, intermediate_size=None, max_seq_len=1024,
                  dropout=0.1, use_mp=False, tie_embeddings=True,
                  use_flash_attention=True, initializer_range=0.02,
-                 recompute=False):
+                 recompute=False, use_sp=False, sp_mode="ring"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -35,11 +35,24 @@ class TransformerLMConfig:
         self.use_flash_attention = use_flash_attention
         self.initializer_range = initializer_range
         self.recompute = recompute
+        # sequence/context parallelism over the 'sp' mesh axis:
+        # attention runs ring (K/V stream the ICI ring, O(S/sp) HBM per
+        # chip) or ulysses (head all-to-all) and activations are
+        # sequence-sharded — the lever that trains long contexts the
+        # chip's HBM cannot hold whole
+        self.use_sp = use_sp
+        assert sp_mode in ("ring", "ulysses")
+        self.sp_mode = sp_mode
 
 
 def _mp_active():
     mesh = topology.get_mesh()
     return mesh is not None and int(mesh.shape.get("mp", 1)) > 1
+
+
+def _sp_active():
+    mesh = topology.get_mesh()
+    return mesh is not None and int(mesh.shape.get("sp", 1)) > 1
 
 
 class SelfAttention(nn.Layer):
@@ -54,6 +67,8 @@ class SelfAttention(nn.Layer):
         self.causal = causal
         self.dropout = cfg.dropout
         self.use_flash = cfg.use_flash_attention
+        self.use_sp = getattr(cfg, "use_sp", False)
+        self.sp_mode = getattr(cfg, "sp_mode", "ring")
         use_mp = cfg.use_mp and _mp_active()
         if use_mp:
             self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
@@ -69,9 +84,19 @@ class SelfAttention(nn.Layer):
                                          self.head_dim))
         qkv = manipulation.transpose(qkv, (2, 0, 3, 1, 4))
         q, k, v = manipulation.unbind(qkv, axis=0)
-        from ..ops import attention as attn_ops
-        o = attn_ops.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=self.causal)
+        if self.use_sp and attn_mask is None and _sp_active():
+            # sequence-parallel kernel over the 'sp' mesh axis (falls
+            # back to dense/flash when the mesh has no sp axis); custom
+            # masks need the gathered scores and keep the dense path
+            from ..distributed.fleet.meta_parallel.sequence_parallel \
+                import ring_attention, ulysses_attention
+            sp_fn = (ring_attention if self.sp_mode == "ring"
+                     else ulysses_attention)
+            o = sp_fn(q, k, v, causal=self.causal)
+        else:
+            from ..ops import attention as attn_ops
+            o = attn_ops.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=self.causal)
         o = manipulation.transpose(o, (0, 2, 1, 3))
         o = manipulation.reshape(o, (b, s, h))
         o = self.out(o)
@@ -161,6 +186,16 @@ class _TransformerCore(nn.Layer):
             x = math_ops.add(x, self.token_type_embeddings(token_type_ids))
         if self.cfg.dropout:
             x = nn_ops.dropout(x, p=self.cfg.dropout, training=self.training)
+        if getattr(self.cfg, "use_sp", False) and _sp_active():
+            # sequence-shard the activations: every elementwise op /
+            # LayerNorm / MLP between attentions holds only S/sp of the
+            # sequence per chip (GSPMD propagates the layout; the
+            # attention kernel reshards to its ring/all-to-all form)
+            from ..distributed.fleet.meta_parallel.mp_layers import \
+                shard_constraint
+            mesh = topology.get_mesh()
+            bspec = "dp" if "dp" in mesh.axis_names else None
+            x = shard_constraint(x, (bspec, "sp", None))
         use_rc = (getattr(self.cfg, "recompute", False) and self.training
                   and not x.stop_gradient)
         if use_rc:

@@ -68,6 +68,60 @@ def test_ring_attention_grads_flow(sp_mesh):
                                atol=1e-4)
 
 
+def test_gpt_trains_with_sequence_parallelism():
+    """Long-context first-class: the FLAGSHIP model trains end-to-end
+    with sequence parallelism — cfg.use_sp routes attention through
+    the ring kernel over the 'sp' mesh axis and sequence-shards the
+    activations; the training trajectory matches the dense-attention
+    run (same seed/data) and a compiled step serves it."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet, topology
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        TransformerLMConfig)
+
+    def run(use_sp):
+        topology._HYBRID = None
+        if use_sp:
+            strategy = DistributedStrategy()
+            strategy.hybrid_configs = {"dp_degree": 2, "sp_degree": 4}
+            fleet.init(is_collective=True, strategy=strategy)
+        try:
+            paddle.seed(3)
+            cfg = TransformerLMConfig(vocab_size=128, hidden_size=64,
+                                      num_layers=2, num_heads=4,
+                                      max_seq_len=32, dropout=0.0,
+                                      use_sp=use_sp)
+            model = GPTForCausalLM(cfg)
+            if use_sp:
+                model = fleet.distributed_model(model)
+            opt = paddle.optimizer.AdamW(
+                1e-3, parameters=model.parameters())
+
+            @paddle.jit.to_static
+            def step(ids, labels):
+                loss = model(ids, labels)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            rs = np.random.RandomState(0)
+            ids = rs.randint(0, 128, (4, 32)).astype("int64")
+            return [float(step(paddle.to_tensor(ids),
+                               paddle.to_tensor(ids)).numpy())
+                    for _ in range(5)]
+        finally:
+            topology._HYBRID = None
+
+    dense = run(False)
+    sp = run(True)
+    assert np.isfinite(sp).all() and sp[-1] < sp[0]
+    # ring attention is the same math as dense attention: the sp run's
+    # trajectory tracks the dense run within kernel-numerics tolerance
+    np.testing.assert_allclose(sp, dense, rtol=5e-3, atol=5e-4)
+
+
 def test_sp_layer_api_dispatch(sp_mesh):
     from paddle_tpu.distributed.fleet.meta_parallel.sequence_parallel import (
         ring_attention as ring_t)
