@@ -63,6 +63,33 @@ class Model:
                     model._optimizer.step()
                     model._optimizer.clear_grad()
                 return list(loss_list), list(outs)
+        elif mode == "train_window":
+            # gradient accumulation, static style: the WINDOW is the
+            # compiled unit — k micro-batch backwards accumulate grads
+            # in-trace, then one optimizer step. Splitting update/no-
+            # update into separate compiled programs would break the
+            # grad dataflow between them (compiled programs capture
+            # tensors by identity at record time), and one program per
+            # window is the better XLA program anyway (the fleet
+            # GradientMerge meta-optimizer compiles the same shape).
+            def raw(ins_seq, labs_seq):
+                per = []
+                for ins, labs in zip(ins_seq, labs_seq):
+                    outputs = model.network(*ins)
+                    outs = list(outputs) if isinstance(
+                        outputs, (list, tuple)) else [outputs]
+                    losses = model._loss(*(outs + [l for l in labs
+                                                   if l is not None]))
+                    loss_list = list(losses) if isinstance(
+                        losses, (list, tuple)) else [losses]
+                    total = loss_list[0]
+                    for l in loss_list[1:]:
+                        total = math_ops.add(total, l)
+                    total.backward()
+                    per.append((loss_list, outs))
+                model._optimizer.step()
+                model._optimizer.clear_grad()
+                return per
         elif mode == "eval":
             def raw(ins, labs):
                 outputs = model.network(*ins)
@@ -207,16 +234,50 @@ class Model:
             for m in self._metrics:
                 m.reset()
             train_logs = {}
+            n_steps = _safe_len(train_loader)
+            k = max(1, int(accumulate_grad_batches))
+            # static mode compiles the whole accumulation window as ONE
+            # program (see _static_step "train_window")
+            use_window = _in_static_mode() and k > 1
+            window = []
+            pending = False
             for step, batch in enumerate(train_loader):
-                cbks.on_batch_begin("train", step, {})
                 ins, labs = _split_batch(batch)
-                res = self.train_batch(ins, labs)
-                train_logs = self._pack_logs(res, batch_size)
-                cbks.on_batch_end("train", step, train_logs)
+                if use_window:
+                    window.append((step, ins, labs))
+                    if len(window) == k or (n_steps is not None
+                                            and step + 1 == n_steps):
+                        train_logs = self._run_static_window(
+                            window, cbks, batch_size)
+                        window = []
+                else:
+                    cbks.on_batch_begin("train", step, {})
+                    # gradient accumulation (reference model.py:2059):
+                    # the optimizer steps every k batches (and on the
+                    # final batch); grads sum across the in-between
+                    # backwards since clear_grad only runs on update
+                    update = ((step + 1) % k == 0
+                              or (n_steps is not None
+                                  and step + 1 == n_steps))
+                    res = self.train_batch(ins, labs, update=update)
+                    pending = not update
+                    train_logs = self._pack_logs(res, batch_size)
+                    cbks.on_batch_end("train", step, train_logs)
                 it_count += 1
                 if (num_iters is not None and it_count >= num_iters) or \
                         self.stop_training:
                     break
+            if window:
+                # tail window (unknown-length loader / early break)
+                train_logs = self._run_static_window(window, cbks,
+                                                     batch_size)
+            if pending:
+                # unknown-length loader tail: the last batches ran with
+                # update=False — apply their accumulated grads instead
+                # of dropping them (or leaking them into the next
+                # epoch's first step)
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_res = self.evaluate(eval_loader, verbose=0)
                 for k, v in eval_res.items():
@@ -226,6 +287,33 @@ class Model:
                                       and it_count >= num_iters):
                 break
         cbks.on_end("train", {})
+
+    def _run_static_window(self, window, cbks, batch_size):
+        """Execute one static-mode accumulation window (compiled as a
+        single program) and fire the per-batch callbacks/metrics/logs
+        in order."""
+        self.network.train()
+
+        def coerce(xs):
+            return [x if isinstance(x, Tensor) or x is None
+                    else Tensor(np.asarray(x)) for x in xs]
+
+        ins_seq = [coerce(ins) for _, ins, _ in window]
+        labs_seq = [coerce(labs) for _, _, labs in window]
+        results = self._static_step("train_window")(ins_seq, labs_seq)
+        logs = {}
+        for (step, _, _), labs, (loss_list, outs) in zip(window, labs_seq,
+                                                         results):
+            cbks.on_batch_begin("train", step, {})
+            metrics = []
+            for m in self._metrics:
+                metrics.append(m.update(m.compute(
+                    *(outs + [l for l in labs if l is not None]))))
+            vals = [float(l.numpy()) for l in loss_list]
+            res = (vals, metrics) if metrics else vals
+            logs = self._pack_logs(res, batch_size)
+            cbks.on_batch_end("train", step, logs)
+        return logs
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

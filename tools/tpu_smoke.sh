@@ -34,7 +34,9 @@ sys.exit(0 if d[0].platform == 'tpu' else 1)  # CPU fallback is NOT evidence
 FILES="tests/test_tensor.py tests/test_autograd.py tests/test_ops.py \
 tests/test_nn_layers.py tests/test_optimizer.py tests/test_amp.py \
 tests/test_flash_backward.py tests/test_generation.py \
-tests/test_fused_ce.py tests/test_dy2static_loops.py"
+tests/test_fused_ce.py tests/test_dy2static_loops.py \
+tests/test_dy2static_returns.py tests/test_advice_round5.py \
+tests/test_checkpoint.py"
 
 PADDLE_TPU_TEST_BACKEND=tpu timeout 5400 \
     python -m pytest $FILES -q -p no:cacheprovider \
