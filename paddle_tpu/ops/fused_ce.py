@@ -46,6 +46,8 @@ def _dot_f32(a, b, dims):
 
 
 def _use_pallas(x, w_vh):
+    if _os.environ.get("PADDLE_FUSED_CE_DISABLE") == "1":
+        return False  # perf-ablation knob (tools/gpt_mfu_sweep.py)
     t, h = x.shape
     v = w_vh.shape[0]
     if x.dtype not in (jnp.float32, jnp.bfloat16, jnp.float16):

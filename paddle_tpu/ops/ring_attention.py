@@ -70,9 +70,26 @@ def _ring_attention_sharded(q, k, v, *, axis_name, sp, scale, causal):
     return (acc / l[..., None]).astype(v.dtype)
 
 
+def _bh_specs(mesh, q, heads_groups=1):
+    """Batch/head placements for the sp shard_map: keep the batch on
+    'dp' and the heads on 'mp' when the mesh has those axes (Megatron-SP
+    composition — attention is head- and batch-independent, so each
+    dp x mp shard runs its own ring on its slice; an unmentioned axis
+    would force an all-gather instead). heads_groups: extra divisibility
+    the body needs on the per-mp-shard head count (Ulysses sp groups)."""
+    b, h = q.shape[0], q.shape[1]
+    bspec = "dp" if ("dp" in mesh.axis_names
+                     and b % int(mesh.shape["dp"]) == 0) else None
+    mp = int(mesh.shape.get("mp", 1))
+    hspec = "mp" if (mp > 1 and h % mp == 0
+                     and (h // mp) % heads_groups == 0) else None
+    return bspec, hspec
+
+
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
     """q/k/v: GLOBAL [B, H, S, D] arrays (sharded or not) — runs the ring
-    over mesh[axis_name], sequence dimension sharded sp-ways."""
+    over mesh[axis_name], sequence dimension sharded sp-ways; batch and
+    heads stay dp-/mp-sharded when those axes exist."""
     sp = int(mesh.shape[axis_name])
     sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if sp == 1:
@@ -80,7 +97,8 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
         return _flash_attention_core(q, k, v, sc, causal)
     body = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                              sp=sp, scale=sc, causal=causal)
-    spec = P(None, None, axis_name, None)
+    bspec, hspec = _bh_specs(mesh, q)
+    spec = P(bspec, hspec, axis_name, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)
@@ -120,7 +138,8 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
     assert q.shape[1] % sp == 0, "num_heads must divide sp for Ulysses"
     body = functools.partial(_ulysses_sharded, axis_name=axis_name, sp=sp,
                              scale=sc, causal=causal)
-    spec = P(None, None, axis_name, None)
+    bspec, hspec = _bh_specs(mesh, q, heads_groups=sp)
+    spec = P(bspec, hspec, axis_name, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

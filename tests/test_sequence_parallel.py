@@ -68,58 +68,86 @@ def test_ring_attention_grads_flow(sp_mesh):
                                atol=1e-4)
 
 
+def _train_gpt(hybrid_configs, seed, data_seed, steps=5, **cfg_kwargs):
+    """Shared harness: (optionally) fleet.init a hybrid mesh, build a
+    GPT from cfg_kwargs, run `steps` compiled train steps on seeded
+    data, return the loss trajectory. hybrid_configs=None runs the
+    plain single-mesh (dense) twin."""
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    topology._HYBRID = None
+    if hybrid_configs is not None:
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = dict(hybrid_configs)
+        fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(seed)
+        cfg = TransformerLMConfig(dropout=0.0, **cfg_kwargs)
+        model = GPTForCausalLM(cfg)
+        if hybrid_configs is not None:
+            model = fleet.distributed_model(model)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+        @paddle.jit.to_static
+        def step(ids, labels):
+            loss = model(ids, labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        rs = np.random.RandomState(data_seed)
+        ids = rs.randint(0, cfg.vocab_size, (4, 32)).astype("int64")
+        return [float(step(paddle.to_tensor(ids),
+                           paddle.to_tensor(ids)).numpy())
+                for _ in range(steps)]
+    finally:
+        topology._HYBRID = None
+
+
 def test_gpt_trains_with_sequence_parallelism():
     """Long-context first-class: the FLAGSHIP model trains end-to-end
     with sequence parallelism — cfg.use_sp routes attention through
     the ring kernel over the 'sp' mesh axis and sequence-shards the
     activations; the training trajectory matches the dense-attention
     run (same seed/data) and a compiled step serves it."""
-    import paddle_tpu as paddle
-    from paddle_tpu.distributed import fleet, topology
-    from paddle_tpu.distributed.fleet import DistributedStrategy
-    from paddle_tpu.text.models import (GPTForCausalLM,
-                                        TransformerLMConfig)
-
-    def run(use_sp):
-        topology._HYBRID = None
-        if use_sp:
-            strategy = DistributedStrategy()
-            strategy.hybrid_configs = {"dp_degree": 2, "sp_degree": 4}
-            fleet.init(is_collective=True, strategy=strategy)
-        try:
-            paddle.seed(3)
-            cfg = TransformerLMConfig(vocab_size=128, hidden_size=64,
-                                      num_layers=2, num_heads=4,
-                                      max_seq_len=32, dropout=0.0,
-                                      use_sp=use_sp)
-            model = GPTForCausalLM(cfg)
-            if use_sp:
-                model = fleet.distributed_model(model)
-            opt = paddle.optimizer.AdamW(
-                1e-3, parameters=model.parameters())
-
-            @paddle.jit.to_static
-            def step(ids, labels):
-                loss = model(ids, labels)
-                loss.backward()
-                opt.step()
-                opt.clear_grad()
-                return loss
-
-            rs = np.random.RandomState(0)
-            ids = rs.randint(0, 128, (4, 32)).astype("int64")
-            return [float(step(paddle.to_tensor(ids),
-                               paddle.to_tensor(ids)).numpy())
-                    for _ in range(5)]
-        finally:
-            topology._HYBRID = None
-
-    dense = run(False)
-    sp = run(True)
+    kw = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=32)
+    dense = _train_gpt(None, 3, 0, **kw)
+    sp = _train_gpt({"dp_degree": 2, "sp_degree": 4}, 3, 0, use_sp=True,
+                    **kw)
     assert np.isfinite(sp).all() and sp[-1] < sp[0]
     # ring attention is the same math as dense attention: the sp run's
     # trajectory tracks the dense run within kernel-numerics tolerance
     np.testing.assert_allclose(sp, dense, rtol=5e-3, atol=5e-4)
+
+
+def test_gpt_trains_with_tp_and_sp_combined():
+    """Megatron-SP composition: TP (heads/vocab over 'mp') and sequence
+    parallelism ('sp') in ONE mesh — the ring runs per dp x mp shard on
+    its head slice (specs keep batch on dp and heads on mp instead of
+    forcing an all-gather). Trajectory tracks the unsharded run."""
+    kw = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+              max_seq_len=32)
+    dense = _train_gpt(None, 9, 1, **kw)
+    tp_sp = _train_gpt({"dp_degree": 2, "mp_degree": 2, "sp_degree": 2},
+                       9, 1, use_mp=True, use_sp=True, **kw)
+    assert np.isfinite(tp_sp).all() and tp_sp[-1] < tp_sp[0]
+    np.testing.assert_allclose(tp_sp, dense, rtol=5e-3, atol=5e-4)
+
+
+def test_gpt_sp_with_recompute_matches_no_recompute():
+    """The realistic long-context config: sequence parallelism +
+    per-block activation recompute together (recompute trades FLOPs
+    for the memory that long sequences actually exhaust).
+    jax.checkpoint must compose with the shard_map ring kernel —
+    same trajectory either way."""
+    kw = dict(vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+              max_seq_len=32, use_sp=True)
+    mesh_cfg = {"dp_degree": 2, "sp_degree": 4}
+    with_rc = _train_gpt(mesh_cfg, 4, 2, steps=4, recompute=True, **kw)
+    without = _train_gpt(mesh_cfg, 4, 2, steps=4, recompute=False, **kw)
+    np.testing.assert_allclose(with_rc, without, rtol=1e-5)
 
 
 def test_sp_layer_api_dispatch(sp_mesh):

@@ -58,6 +58,10 @@ def main():
         ("baseline_O1", 8, 1024, {"GPT_AMP_LEVEL": "O1"}),
         ("O2_pure_bf16", 8, 1024, {"GPT_AMP_LEVEL": "O2"}),
         ("O2_batch16", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
+        # ablation: the fused linear+CE head OFF (logits round-trip
+        # HBM) — the delta vs O2_pure_bf16 is the fused-CE win
+        ("O2_unfused_ce", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                    "PADDLE_FUSED_CE_DISABLE": "1"}),
         ("O2_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
                                     "PADDLE_FLASH_BLOCK_BWD": "256"}),
         ("O2_blk1024", 8, 1024, {"GPT_AMP_LEVEL": "O2",
