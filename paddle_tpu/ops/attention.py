@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import register_op
+from .pallas_compat import trace_32bit as _trace_32bit
 
 # tests flip this to run the Pallas kernels in interpret mode on CPU
 _FORCE_INTERPRET = [False]
@@ -118,12 +119,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
 
 def _pallas_flash_fwd(q, k, v, scale, causal):
-    from jax.experimental import pallas as pl
-    # the framework enables jax_enable_x64 globally (paddle int64/float64
-    # dtypes); inside the kernels python literals would become i64/f64,
-    # which Mosaic cannot lower — trace the kernels in 32-bit mode
-    with jax.enable_x64(False):
-        return _pallas_flash_fwd_32(q, k, v, scale, causal)
+    # x64 guard shared by every Pallas entry point (pallas_compat)
+    return _trace_32bit(_pallas_flash_fwd_32)(q, k, v, scale, causal)
 
 
 import os as _os
@@ -277,8 +274,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal):
-    with jax.enable_x64(False):
-        return _pallas_flash_bwd_32(q, k, v, out, lse, g, scale, causal)
+    return _trace_32bit(_pallas_flash_bwd_32)(q, k, v, out, lse, g,
+                                              scale, causal)
 
 
 def _pallas_flash_bwd_32(q, k, v, out, lse, g, scale, causal):

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import register_op
+from .pallas_compat import trace_32bit as _trace_32bit
 
 _BLOCK_T = int(_os.environ.get("PADDLE_FUSED_CE_BLOCK_T", "256"))
 _BLOCK_V = int(_os.environ.get("PADDLE_FUSED_CE_BLOCK_V", "1024"))
@@ -109,6 +110,7 @@ def _fwd_kernel(x_ref, w_ref, lab_ref, loss_ref, lse_ref,
         lse_ref[...] = lse[None, :]
 
 
+@_trace_32bit
 def _pallas_fwd(x, w_vh, labels, ignore_index):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -190,6 +192,7 @@ def _bwd_dw_kernel(x_ref, w_ref, lab_ref, lse_ref, g_ref, dw_ref, *,
     dw_ref[...] += _dot_f32(d.astype(x.dtype), x, ((0,), (0,)))
 
 
+@_trace_32bit
 def _pallas_bwd(x, w_vh, labels, lse, g, ignore_index):
     from jax.experimental import pallas as pl
     t, h = x.shape

@@ -70,18 +70,20 @@ def _ring_attention_sharded(q, k, v, *, axis_name, sp, scale, causal):
     return (acc / l[..., None]).astype(v.dtype)
 
 
-def _bh_specs(mesh, q, heads_groups=1):
+def _bh_specs(mesh, q, axis_name, heads_groups=1):
     """Batch/head placements for the sp shard_map: keep the batch on
     'dp' and the heads on 'mp' when the mesh has those axes (Megatron-SP
     composition — attention is head- and batch-independent, so each
     dp x mp shard runs its own ring on its slice; an unmentioned axis
     would force an all-gather instead). heads_groups: extra divisibility
-    the body needs on the per-mp-shard head count (Ulysses sp groups)."""
+    the body needs on the per-mp-shard head count (Ulysses sp groups).
+    axis_name (the ring/a2a axis) must not repeat in the spec, so a ring
+    run over 'dp' or 'mp' itself keeps that dim replicated as before."""
     b, h = q.shape[0], q.shape[1]
-    bspec = "dp" if ("dp" in mesh.axis_names
+    bspec = "dp" if ("dp" in mesh.axis_names and axis_name != "dp"
                      and b % int(mesh.shape["dp"]) == 0) else None
     mp = int(mesh.shape.get("mp", 1))
-    hspec = "mp" if (mp > 1 and h % mp == 0
+    hspec = "mp" if (mp > 1 and axis_name != "mp" and h % mp == 0
                      and (h // mp) % heads_groups == 0) else None
     return bspec, hspec
 
@@ -97,7 +99,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
         return _flash_attention_core(q, k, v, sc, causal)
     body = functools.partial(_ring_attention_sharded, axis_name=axis_name,
                              sp=sp, scale=sc, causal=causal)
-    bspec, hspec = _bh_specs(mesh, q)
+    bspec, hspec = _bh_specs(mesh, q, axis_name)
     spec = P(bspec, hspec, axis_name, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
@@ -138,7 +140,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=True, scale=None):
     assert q.shape[1] % sp == 0, "num_heads must divide sp for Ulysses"
     body = functools.partial(_ulysses_sharded, axis_name=axis_name, sp=sp,
                              scale=sc, causal=causal)
-    bspec, hspec = _bh_specs(mesh, q, heads_groups=sp)
+    bspec, hspec = _bh_specs(mesh, q, axis_name, heads_groups=sp)
     spec = P(bspec, hspec, axis_name, None)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)

@@ -43,7 +43,7 @@ def pytest_runtest_setup(item):
     except (OSError, TypeError, AttributeError):
         src = ""
     if ("Mesh" in src or "shard_map" in src or "device_count" in src
-            or "mesh" in src):
+            or "mesh" in src or "hybrid_configs" in src):
         pytest.skip("needs the 8-device virtual mesh")
 
 

@@ -160,3 +160,28 @@ def test_sp_layer_api_dispatch(sp_mesh):
     ref = _reference_attention(q, k, v, None, 1.0 / np.sqrt(8), True)
     np.testing.assert_allclose(out.numpy(), np.asarray(ref), rtol=2e-4,
                                atol=2e-5)
+
+
+def test_ring_attention_over_dp_axis_no_spec_collision():
+    """ring/ulysses with axis_name='dp' or 'mp' must not emit that axis
+    twice in the shard_map spec (the _bh_specs dp/mp placement has to
+    yield to the ring axis); parity vs dense on a mesh whose ring axis
+    IS 'dp'."""
+    from paddle_tpu.ops.ring_attention import (ring_attention,
+                                               ulysses_attention)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        mesh = fleet.get_hybrid_communicate_group().mesh
+        q, k, v = _qkv(b=4, h=4, s=32, d=8)
+        ref = _reference_attention(q, k, v, None, 1.0 / np.sqrt(8), True)
+        out = ring_attention(q, k, v, mesh, axis_name="dp", causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        out2 = ulysses_attention(q, k, v, mesh, axis_name="mp",
+                                 causal=True)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        topology._HYBRID = None

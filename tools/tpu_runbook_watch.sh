@@ -1,0 +1,65 @@
+#!/bin/bash
+# Round-5 armed runbook (VERDICT r4 "Next round" item 1).
+#
+# Probes the tunneled TPU every PROBE_INTERVAL seconds; each time the
+# tunnel is healthy it advances through the runbook stages IN ORDER,
+# one stage per healthy window, re-probing between stages (a wedge
+# kills only the stage in flight, never the watcher):
+#   1. smoke  : bash tools/tpu_smoke.sh        (green on-hardware sweep)
+#   2. bench  : python bench.py               (live driver-contract line)
+#   3. mfu    : python tools/gpt_mfu_sweep.py full
+# Completed stages are recorded in bench_artifacts/runbook_r05_state
+# so a restarted watcher resumes where it left off. All tunnel use in
+# the round goes through this script — concurrent tunnel processes
+# corrupt each other's timings (BASELINE.md measurement notes).
+set -u
+cd "$(dirname "$0")/.."
+ART=bench_artifacts
+STATE="$ART/runbook_r05_state"
+PROBE_LOG="$ART/probe_log_r05.txt"
+PROBE_INTERVAL=${PROBE_INTERVAL:-240}
+touch "$STATE"
+
+probe() {
+    timeout 90 python -c "
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d[0].platform == 'tpu', d
+x = jnp.ones((256, 256))
+print(float((x @ x).sum()))
+" >/dev/null 2>&1
+}
+
+stage_done() { grep -qx "$1" "$STATE"; }
+mark_done()  { echo "$1" >> "$STATE"; }
+
+run_stage() {
+    local name=$1 cap=$2; shift 2
+    local ts=$(date -u +%Y%m%dT%H%M%SZ)
+    echo "[$ts] stage $name: starting (cap ${cap}s)" | tee -a "$PROBE_LOG"
+    timeout "$cap" "$@" > "$ART/runbook_${name}_${ts}.log" 2>&1
+    local rc=$?
+    echo "[$(date -u +%Y%m%dT%H%M%SZ)] stage $name: rc=$rc" | tee -a "$PROBE_LOG"
+    if [ $rc -eq 0 ]; then mark_done "$name"; return 0; fi
+    return 1
+}
+
+while true; do
+    if stage_done smoke && stage_done bench && stage_done mfu; then
+        echo "[$(date -u +%Y%m%dT%H%M%SZ)] runbook complete" | tee -a "$PROBE_LOG"
+        exit 0
+    fi
+    if probe; then
+        echo "[$(date -u +%Y%m%dT%H%M%SZ)] probe OK" >> "$PROBE_LOG"
+        if ! stage_done smoke; then
+            run_stage smoke 3600 bash tools/tpu_smoke.sh
+        elif ! stage_done bench; then
+            run_stage bench 1500 python bench.py
+        else
+            run_stage mfu 5400 python tools/gpt_mfu_sweep.py full
+        fi
+    else
+        echo "[$(date -u +%Y%m%dT%H%M%SZ)] probe FAIL (wedged)" >> "$PROBE_LOG"
+    fi
+    sleep "$PROBE_INTERVAL"
+done
