@@ -5,8 +5,10 @@
 # tunnel is healthy it advances through the runbook stages IN ORDER,
 # one stage per healthy window, re-probing between stages (a wedge
 # kills only the stage in flight, never the watcher):
-#   1. smoke  : bash tools/tpu_smoke.sh        (green on-hardware sweep)
-#   2. bench  : python bench.py               (live driver-contract line)
+#   1. bench  : python bench.py               (live driver-contract line
+#               — FIRST: healthy windows have been as short as ~20 min,
+#               and the live bench line is the round's #1 artifact)
+#   2. smoke  : bash tools/tpu_smoke.sh        (green on-hardware sweep)
 #   3. mfu    : python tools/gpt_mfu_sweep.py full
 # Completed stages are recorded in bench_artifacts/runbook_r05_state
 # so a restarted watcher resumes where it left off. All tunnel use in
@@ -51,10 +53,10 @@ while true; do
     fi
     if probe; then
         echo "[$(date -u +%Y%m%dT%H%M%SZ)] probe OK" >> "$PROBE_LOG"
-        if ! stage_done smoke; then
-            run_stage smoke 3600 bash tools/tpu_smoke.sh
-        elif ! stage_done bench; then
+        if ! stage_done bench; then
             run_stage bench 1500 python bench.py
+        elif ! stage_done smoke; then
+            run_stage smoke 3600 bash tools/tpu_smoke.sh
         else
             run_stage mfu 5400 python tools/gpt_mfu_sweep.py full
         fi
