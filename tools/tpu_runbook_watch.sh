@@ -10,6 +10,8 @@
 #               and the live bench line is the round's #1 artifact)
 #   2. smoke  : bash tools/tpu_smoke.sh        (green on-hardware sweep)
 #   3. mfu    : python tools/gpt_mfu_sweep.py full
+#   4. baseline: python tools/baseline_bench.py all  (refresh BASELINE
+#               rows 1 and 3 — LeNet lazy-engine + BERT — live this round)
 # Completed stages are recorded in bench_artifacts/runbook_r05_state
 # so a restarted watcher resumes where it left off. All tunnel use in
 # the round goes through this script — concurrent tunnel processes
@@ -46,19 +48,48 @@ run_stage() {
     return 1
 }
 
+# hard deadline: stand down WELL before the driver's own end-of-round
+# bench run — concurrent tunnel users corrupt each other's timings and
+# can wedge each other (BASELINE.md measurement notes). Anchored to the
+# FIRST launch's wall clock (persisted), so a restarted watcher does not
+# get a fresh window; a stage whose cap would overrun the deadline is
+# not started at all.
+DEADLINE_S=${DEADLINE_S:-32400}   # 9 h from first launch
+EPOCH_FILE="$ART/runbook_r05_epoch"
+[ -f "$EPOCH_FILE" ] || date +%s > "$EPOCH_FILE"
+T0=$(cat "$EPOCH_FILE")
+DEADLINE_AT=$((T0 + DEADLINE_S))
+
+past_deadline() {   # $1 = seconds of headroom needed
+    [ $(( $(date +%s) + ${1:-0} )) -ge "$DEADLINE_AT" ]
+}
+
 while true; do
-    if stage_done smoke && stage_done bench && stage_done mfu; then
+    if past_deadline 0; then
+        echo "[$(date -u +%Y%m%dT%H%M%SZ)] watcher deadline reached;" \
+             "standing down for the driver's end-of-round run" \
+             | tee -a "$PROBE_LOG"
+        exit 0
+    fi
+    if stage_done smoke && stage_done bench && stage_done mfu \
+            && stage_done baseline; then
         echo "[$(date -u +%Y%m%dT%H%M%SZ)] runbook complete" | tee -a "$PROBE_LOG"
         exit 0
     fi
     if probe; then
         echo "[$(date -u +%Y%m%dT%H%M%SZ)] probe OK" >> "$PROBE_LOG"
         if ! stage_done bench; then
-            run_stage bench 1500 python bench.py
+            past_deadline 1500 || run_stage bench 1500 python bench.py
         elif ! stage_done smoke; then
-            run_stage smoke 3600 bash tools/tpu_smoke.sh
+            past_deadline 3600 || run_stage smoke 3600 bash tools/tpu_smoke.sh
+        elif ! stage_done mfu; then
+            past_deadline 5400 || run_stage mfu 5400 \
+                python tools/gpt_mfu_sweep.py full
         else
-            run_stage mfu 5400 python tools/gpt_mfu_sweep.py full
+            # rows 1+3 only — 'all' would re-run the GPT config the mfu
+            # stage just measured
+            past_deadline 2400 || run_stage baseline 2400 bash -c \
+                "python tools/baseline_bench.py lenet && python tools/baseline_bench.py bert"
         fi
     else
         echo "[$(date -u +%Y%m%dT%H%M%SZ)] probe FAIL (wedged)" >> "$PROBE_LOG"
