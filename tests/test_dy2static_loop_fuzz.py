@@ -186,3 +186,63 @@ def test_loop_program_three_leg_parity(seed):
         got_c = h(paddle.to_tensor(xp), n_t)
     np.testing.assert_allclose(got_c.numpy(), want, rtol=1e-6,
                                err_msg=src)
+
+
+def _gen_loop_return_program(rs):
+    """Random `return <name>` inside a loop (the round-5 flag+break
+    conversion): for-range or while over a carried vector s, a guarded
+    `return s` at a random position, random trailing tail expression."""
+    bound = int(rs.randint(3, 8))
+    thr = round(float(rs.uniform(1.0, 8.0)), 2)
+    tails = ["s * 10.0", "s - 1.0", "s + x"]
+    pre = bool(rs.randint(2))   # return-guard before or after the step
+    step = "        s = s + x"
+    guard = [f"        if s.sum() > {thr}:",
+             "            return s"]
+    body = (guard + [step]) if pre else ([step] + guard)
+    kind = rs.choice(["for", "while"])
+    if kind == "for":
+        loop = [f"    for _i in range(n):"]
+    else:
+        # bounded: the while leg needs a terminating cond; bound via n
+        loop = [f"    _c = n * 1",
+                f"    while _c > 0:"]
+        body = body + ["        _c = _c - 1"]
+    lines = (["import paddle_tpu as paddle", "", "", "def f(x, n):",
+              "    s = x * 1.0"] + loop + body
+             + [f"    return {rs.choice(tails)}"])
+    return "\n".join(lines) + "\n", bound
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_loop_return_program_three_leg_parity(seed):
+    """Returns inside loops three-legged (python truth / converted
+    eager / compiled), python AND tensor bounds on shared inputs."""
+    import warnings
+
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    rs = np.random.RandomState(8000 + seed)
+    src, bound = _gen_loop_return_program(rs)
+    f = _make_fn(src, "f")
+    xp = np.abs(rs.randn(3)).astype(np.float32)
+
+    want = f(paddle.to_tensor(xp), bound).numpy()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # conversion must not fall back
+        g = convert_to_static(f)
+        got_eager = g(paddle.to_tensor(xp), bound).numpy()
+        np.testing.assert_allclose(got_eager, want, rtol=1e-6,
+                                   err_msg=src)
+        # tensor bound: the loop must run as ONE compiled while_loop
+        got_t = g(paddle.to_tensor(xp),
+                  paddle.to_tensor(np.int64(bound))).numpy()
+        np.testing.assert_allclose(got_t, want, rtol=1e-6, err_msg=src)
+
+    h = paddle.jit.to_static(f)
+    for _ in range(3):
+        got_c = h(paddle.to_tensor(xp),
+                  paddle.to_tensor(np.int64(bound)))
+    np.testing.assert_allclose(got_c.numpy(), want, rtol=1e-6,
+                               err_msg=src)

@@ -210,3 +210,124 @@ def test_return_differential_vs_eager():
         want = body(paddle.to_tensor(xp), paddle.to_tensor(yp)).numpy()
         got = conv(paddle.to_tensor(xp), paddle.to_tensor(yp)).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# ---- returns INSIDE loops (round-5: flag+break via the loop carry) ----
+
+def test_return_inside_tensor_bound_loop():
+    """`return x` under a Tensor-bound loop compiles: the return rides
+    the break-flag carry through ONE lax.while_loop and the post-loop
+    guarded return merges via else-push — both exit paths served by the
+    same program, no fallback warning."""
+    @paddle.jit.to_static
+    def f(n, x):
+        for _i in range(n):
+            x = x + 1.0
+            if x.sum() > 6.0:
+                return x
+        return x * 10.0
+
+    def ref(n, x):
+        for _i in range(n):
+            x = x + 1.0
+            if x.sum() > 6.0:
+                return x
+        return x * 10.0
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        # early-exit case (sum crosses 6 at iteration 2) and
+        # run-to-completion case, same compiled entry
+        for n0, x0 in ((paddle.to_tensor(5), np.ones((3,), np.float32)),
+                       (paddle.to_tensor(1), np.zeros((3,), np.float32))):
+            out = f(n0, paddle.to_tensor(x0))
+            np.testing.assert_allclose(out.numpy(), ref(5 if x0[0] else 1,
+                                                        x0.copy()),
+                                       rtol=1e-6)
+
+
+def test_return_inside_while_loop_tensor_pred():
+    @paddle.jit.to_static
+    def f(x):
+        while x.sum() < 100.0:
+            x = x * 2.0
+            if x.max() > 40.0:
+                return x
+        return x + 0.5
+
+    def ref(x):
+        while x.sum() < 100.0:
+            x = x * 2.0
+            if x.max() > 40.0:
+                return x
+        return x + 0.5
+
+    for start in (1.0, 30.0, 200.0):
+        x0 = np.full((3,), start, np.float32)
+        out = f(paddle.to_tensor(x0))
+        np.testing.assert_allclose(out.numpy(), ref(x0.copy()), rtol=1e-6)
+
+
+def test_return_inside_nested_loops_cascades():
+    """Inner-loop return cascades: the inner conversion's post-loop
+    guarded return is the outer loop's direct return."""
+    @paddle.jit.to_static
+    def f(n, x):
+        for _i in range(n):
+            for _j in range(n):
+                x = x + 1.0
+                if x.sum() > 9.0:
+                    return x
+        return x - 100.0
+
+    def ref(n, x):
+        for _i in range(n):
+            for _j in range(n):
+                x = x + 1.0
+                if x.sum() > 9.0:
+                    return x
+        return x - 100.0
+
+    for n0, x0 in ((3, np.ones((2,), np.float32)),
+                   (1, np.zeros((2,), np.float32))):
+        out = f(paddle.to_tensor(n0), paddle.to_tensor(x0))
+        np.testing.assert_allclose(out.numpy(), ref(n0, x0.copy()),
+                                   rtol=1e-6)
+
+
+def test_return_expr_in_loop_falls_back_with_warning():
+    """`return <expr>` (not a bare name) inside a loop has no
+    type-stable carry — keeps python semantics with the documented
+    warning, and still computes correctly."""
+    def g(n, x):
+        for _i in range(n):
+            x = x + 1.0
+            if x.sum() > 2.0:
+                return x * 7.0
+        return x
+
+    with pytest.warns(UserWarning, match="early-return conversion"):
+        f = paddle.jit.to_static(g)
+        # python bound: the fallback keeps python `for` semantics
+        out = f(4, paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(),
+                               np.full((2,), 14.0, np.float32))
+
+
+def test_return_loop_local_name_falls_back():
+    """`return t` where t is first assigned INSIDE the loop has no
+    pre-loop carry init — must keep the python fallback (warned), not
+    convert into an unbound post-loop read."""
+    def g(x):
+        while x.sum() < 100.0:
+            t = x * 3.0
+            if t.max() > 40.0:
+                return t
+            x = x + 5.0
+        return x + 0.5
+
+    with pytest.warns(UserWarning, match="early-return conversion"):
+        f = paddle.jit.to_static(g)
+        out = f(paddle.to_tensor(np.full((3,), 1.0, np.float32)))
+    np.testing.assert_allclose(out.numpy(),
+                               np.full((3,), 48.0, np.float32))
