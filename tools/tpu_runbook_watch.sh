@@ -40,9 +40,16 @@ mark_done()  { echo "$1" >> "$STATE"; }
 run_stage() {
     local name=$1 cap=$2; shift 2
     local ts=$(date -u +%Y%m%dT%H%M%SZ)
+    local log="$ART/runbook_${name}_${ts}.log"
     echo "[$ts] stage $name: starting (cap ${cap}s)" | tee -a "$PROBE_LOG"
-    timeout "$cap" "$@" > "$ART/runbook_${name}_${ts}.log" 2>&1
+    timeout "$cap" "$@" > "$log" 2>&1
     local rc=$?
+    # bench.py exits 0 even when it could only emit the CACHED line
+    # (driver contract); the stage is only done once a LIVE line exists
+    if [ "$name" = bench ] && [ $rc -eq 0 ] \
+            && ! grep -q '"source": "live"' "$log"; then
+        rc=99
+    fi
     echo "[$(date -u +%Y%m%dT%H%M%SZ)] stage $name: rc=$rc" | tee -a "$PROBE_LOG"
     if [ $rc -eq 0 ]; then mark_done "$name"; return 0; fi
     return 1
