@@ -250,7 +250,10 @@ def bench_gpt(batch=8, seq=1024, steps=20, amp_level=None):
                 # a failed capture must not sink the measurement above
                 try:
                     import jax
-                    with jax.profiler.trace(prof_dir):
+                    # perfetto trace = gzipped JSON, parseable without
+                    # the TF profiler stack (XPlane .pb is not)
+                    with jax.profiler.trace(prof_dir,
+                                            create_perfetto_trace=True):
                         for _ in range(5):
                             loss = train_step(*args)
                         _sync(loss)
