@@ -331,3 +331,60 @@ def test_return_loop_local_name_falls_back():
         out = f(paddle.to_tensor(np.full((3,), 1.0, np.float32)))
     np.testing.assert_allclose(out.numpy(),
                                np.full((3,), 48.0, np.float32))
+
+
+def test_return_and_break_in_same_loop():
+    """Pre-existing break and a converted return coexist: break exits
+    with the return flag False (tail runs), return exits with it True."""
+    @paddle.jit.to_static
+    def f(n, x):
+        for _i in range(n):
+            x = x + 1.0
+            if x.mean() > 10.0:
+                break
+            if x.sum() > 12.0:
+                return x
+        return x * 100.0
+
+    def ref(n, x):
+        for _i in range(n):
+            x = x + 1.0
+            if x.mean() > 10.0:
+                break
+            if x.sum() > 12.0:
+                return x
+        return x * 100.0
+
+    for n0, x0 in ((20, np.full((4,), 0.0, np.float32)),   # break wins
+                   (20, np.full((2,), 5.0, np.float32)),   # return wins
+                   (2, np.zeros((3,), np.float32))):       # neither
+        out = f(paddle.to_tensor(n0), paddle.to_tensor(x0))
+        np.testing.assert_allclose(out.numpy(), ref(n0, x0.copy()),
+                                   rtol=1e-6)
+
+
+def test_return_in_loop_with_continue():
+    @paddle.jit.to_static
+    def f(n, x):
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            x = x + 2.0
+            if x.sum() > 10.0:
+                return x
+        return x - 0.5
+
+    def ref(n, x):
+        for i in range(n):
+            if i % 2 == 0:
+                continue
+            x = x + 2.0
+            if x.sum() > 10.0:
+                return x
+        return x - 0.5
+
+    for n0 in (9, 2):
+        x0 = np.ones((2,), np.float32)
+        out = f(n0, paddle.to_tensor(x0))  # python bound + continue
+        np.testing.assert_allclose(out.numpy(), ref(n0, x0.copy()),
+                                   rtol=1e-6)
