@@ -265,6 +265,27 @@ def _fused_fwd(x, w_vh, labels, ignore_index):
             (x, w_vh, labels, None))
 
 
+def _xla_bwd(x, w_vh, labels, lse, g, ignore_index):
+    """Backward as plain XLA ops from the saved lse: ONE logits
+    recompute at XLA matmul efficiency, d_logits = (softmax-onehot)*g
+    fused into its consumers by XLA, dx/dW as two MXU matmuls. Trades
+    the Pallas bwd's zero-materialization for d_logits round-tripping
+    HBM once in bf16 — but deletes the second logits recompute and runs
+    every matmul at XLA's MXU scheduling, not a hand-rolled kernel's.
+    Selected by PADDLE_FUSED_CE_BWD=xla (perf sweep axis)."""
+    logits = _dot_f32(x, w_vh, ((1,), (1,)))
+    p = jnp.exp(logits - lse[:, None])
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    onehot = (col == labels.astype(jnp.int32)[:, None]).astype(
+        jnp.float32)
+    valid = (labels != ignore_index).astype(jnp.float32)
+    d = ((p - onehot) * (g.astype(jnp.float32) * valid)[:, None]
+         ).astype(x.dtype)
+    dx = _dot_f32(d, w_vh, ((1,), (0,))).astype(x.dtype)
+    dw = _dot_f32(d, x, ((0,), (0,))).astype(w_vh.dtype)
+    return dx, dw
+
+
 def _fused_bwd(ignore_index, res, g):
     x, w_vh, labels, lse = res
     if lse is None:  # reference path: differentiate the composition
@@ -272,6 +293,9 @@ def _fused_bwd(ignore_index, res, g):
             lambda x_, w_: _reference(x_, w_, labels, ignore_index),
             x, w_vh)
         dx, dw = vjp(g)
+        return dx, dw, None
+    if _os.environ.get("PADDLE_FUSED_CE_BWD") == "xla":
+        dx, dw = _xla_bwd(x, w_vh, labels, lse, g, ignore_index)
         return dx, dw, None
     dx, dw = _pallas_bwd(x, w_vh, labels, lse, g, ignore_index)
     return dx, dw, None

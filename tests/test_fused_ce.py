@@ -85,6 +85,33 @@ def test_pallas_kernel_grads_interpret(interpret_kernels):
                                rtol=2e-4, atol=2e-6)
 
 
+def test_xla_bwd_variant_grads_match(interpret_kernels, monkeypatch):
+    """PADDLE_FUSED_CE_BWD=xla (Pallas fused fwd + XLA-composed bwd
+    from the saved lse) matches jax.grad of the unfused composition —
+    the hybrid the perf sweep measures against the all-Pallas bwd."""
+    import jax
+    import jax.numpy as jnp
+    monkeypatch.setenv("PADDLE_FUSED_CE_BWD", "xla")
+    rs = np.random.RandomState(6)
+    t, h, v = 128, 128, 1024
+    x = jnp.asarray(rs.randn(t, h).astype(np.float32) * 0.3)
+    w = jnp.asarray(rs.randn(v, h).astype(np.float32) * 0.3)
+    lab_np = rs.randint(0, v, (t,))
+    lab_np[3] = -100
+    lab = jnp.asarray(lab_np.astype(np.int32))
+
+    gx_f, gw_f = jax.grad(
+        lambda x_, w_: fused_ce._fused_core(x_, w_, lab, -100).mean(),
+        argnums=(0, 1))(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x_, w_: fused_ce._reference(x_, w_, lab, -100).mean(),
+        argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_f), np.asarray(gx_r),
+                               rtol=2e-4, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_r),
+                               rtol=2e-4, atol=2e-6)
+
+
 def test_gpt_head_uses_fused_and_trains():
     """GPT with a tied head routes through the fused op and the loss
     matches the unfused composition; one train step decreases it."""

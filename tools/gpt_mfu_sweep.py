@@ -90,11 +90,18 @@ def main():
     configs = [
         ("baseline_O1", 8, 1024, {"GPT_AMP_LEVEL": "O1"}),
         ("O2_pure_bf16", 8, 1024, {"GPT_AMP_LEVEL": "O2"}),
-        ("O2_batch16", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
         # ablation: the fused linear+CE head OFF (logits round-trip
         # HBM) — the delta vs O2_pure_bf16 is the fused-CE win
         ("O2_unfused_ce", 8, 1024, {"GPT_AMP_LEVEL": "O2",
                                     "PADDLE_FUSED_CE_DISABLE": "1"}),
+        # hybrid: Pallas fused fwd (no logits in HBM) + XLA-composed
+        # bwd (one recompute at XLA matmul efficiency instead of the
+        # Pallas bwd's two hand-rolled ones)
+        ("O2_ce_bwd_xla", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                    "PADDLE_FUSED_CE_BWD": "xla"}),
+        # bigger token tile: halves the per-token-block W streaming
+        ("O2_ce_bt512", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                  "PADDLE_FUSED_CE_BLOCK_T": "512"}),
         ("O2_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
                                     "PADDLE_FLASH_BLOCK_BWD": "256"}),
         ("O2_blk1024", 8, 1024, {"GPT_AMP_LEVEL": "O2",
@@ -102,6 +109,10 @@ def main():
                                  "PADDLE_FLASH_BLOCK_K": "1024"}),
         ("O2_blk1024_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
                                      "PADDLE_FLASH_BLOCK_BWD": "1024"}),
+        # LAST in the quick list: hung >900s in the 2026-08-02 window
+        # (wedge or compile churn) — must not block the ablation configs
+        # on a short healthy window
+        ("O2_batch16", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
     ]
     if mode == "full":
         configs += [
