@@ -102,33 +102,48 @@ def main():
         # bigger token tile: halves the per-token-block W streaming
         ("O2_ce_bt512", 8, 1024, {"GPT_AMP_LEVEL": "O2",
                                   "PADDLE_FUSED_CE_BLOCK_T": "512"}),
-        ("O2_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
-                                    "PADDLE_FLASH_BLOCK_BWD": "256"}),
-        ("O2_blk1024", 8, 1024, {"GPT_AMP_LEVEL": "O2",
-                                 "PADDLE_FLASH_BLOCK_Q": "1024",
-                                 "PADDLE_FLASH_BLOCK_K": "1024"}),
-        ("O2_blk1024_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
-                                     "PADDLE_FLASH_BLOCK_BWD": "1024"}),
+        # attention-axis configs run UNFUSED (nf): the 2026-08-02 window
+        # showed the fused head costs ~46 ms/step, which would drown the
+        # flash-tile deltas these configs exist to measure
+        ("O2_nf_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                       "PADDLE_FUSED_CE_DISABLE": "1",
+                                       "PADDLE_FLASH_BLOCK_BWD": "256"}),
+        ("O2_nf_blk1024", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                    "PADDLE_FUSED_CE_DISABLE": "1",
+                                    "PADDLE_FLASH_BLOCK_Q": "1024",
+                                    "PADDLE_FLASH_BLOCK_K": "1024"}),
+        ("O2_nf_blk1024_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                        "PADDLE_FUSED_CE_DISABLE": "1",
+                                        "PADDLE_FLASH_BLOCK_BWD": "1024"}),
         # LAST in the quick list: hung >900s in the 2026-08-02 window
         # (wedge or compile churn) — must not block the ablation configs
-        # on a short healthy window
-        ("O2_batch16", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
+        # on a short healthy window; unfused so the batch-scaling axis
+        # is clean of the head question
+        ("O2_nf_batch16", 16, 1024, {"GPT_AMP_LEVEL": "O2",
+                                     "PADDLE_FUSED_CE_DISABLE": "1"}),
     ]
     if mode == "full":
         configs += [
             # the profiled headline config runs BEFORE the long seq
             # points — it feeds the ceiling analysis and must not be
             # the first config a capped/wedged sweep drops
-            ("O2_profiled", 8, 1024,
+            ("O2_nf_profiled", 8, 1024,
              {"GPT_AMP_LEVEL": "O2",
+              "PADDLE_FUSED_CE_DISABLE": "1",
               "GPT_PROFILE_DIR": os.path.join(_ART, "gpt_profile_r05")}),
-            ("O1_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O1",
-                                        "PADDLE_FLASH_BLOCK_BWD": "256"}),
-            ("O2_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O2"}),
-            ("O2_seq4096", 2, 4096, {"GPT_AMP_LEVEL": "O2"}),
-            ("O2_seq4096_rc_b4", 4, 4096, {"GPT_AMP_LEVEL": "O2",
-                                           "GPT_RECOMPUTE": "1"}),
-            ("O1_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O1"}),
+            ("O1_nf_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O1",
+                                           "PADDLE_FUSED_CE_DISABLE": "1",
+                                           "PADDLE_FLASH_BLOCK_BWD": "256"}),
+            ("O2_nf_seq2048", 4, 2048, {"GPT_AMP_LEVEL": "O2",
+                                        "PADDLE_FUSED_CE_DISABLE": "1"}),
+            ("O2_nf_seq4096", 2, 4096, {"GPT_AMP_LEVEL": "O2",
+                                        "PADDLE_FUSED_CE_DISABLE": "1"}),
+            # fused head at seq 4096: the memory-bound config where
+            # not materializing [T, V] logits should actually matter
+            ("O2_seq4096_fused", 2, 4096, {"GPT_AMP_LEVEL": "O2"}),
+            ("O2_nf_seq4096_rc_b4", 4, 4096, {"GPT_AMP_LEVEL": "O2",
+                                              "PADDLE_FUSED_CE_DISABLE": "1",
+                                              "GPT_RECOMPUTE": "1"}),
         ]
 
     best = prior_best
