@@ -307,51 +307,61 @@ def _emit(g, env, eqn):
 
 
 def _emit_dot(g, env, eqn):
+    """dot_general -> ONNX. Fast path: the cases whose free-dim layout
+    already agrees with MatMul's numpy batching emit one MatMul (plus a
+    contraction-axis Transpose when needed). Everything else — >=2 free
+    dims beside a batched side, multi-dim contraction, non-leading or
+    vector-side batch dims — canonicalizes: Transpose each side to
+    [batch..., free..., contract...], Reshape to 3D-style
+    [B..., prod(free), prod(K)] / [B..., prod(K), prod(free)], MatMul,
+    Reshape to dot_general's exact output shape (batch, lhs free, rhs
+    free — the layout the jaxpr's out aval records)."""
     (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
     a, b = eqn.invars
     ar, br = len(a.aval.shape), len(b.aval.shape)
-    if len(lc) != 1 or len(rc) != 1:
-        raise NotImplementedError("onnx export: multi-dim contraction")
-    if tuple(lb) != tuple(range(len(lb))) \
-            or tuple(rb) != tuple(range(len(rb))):
-        raise NotImplementedError(
-            "onnx export: non-leading batch dims in dot_general")
-    # ONNX MatMul uses numpy semantics (all-but-last-two dims are
-    # batch); dot_general's free-dim ordering diverges once either side
-    # keeps >=2 free dims next to a batched counterpart — emitting
-    # MatMul there would compute a DIFFERENT function. Supported exactly
-    # when the two agree: <=1 free dim per side, or a rank-2 unbatched
-    # rhs (numpy broadcasts it across all lhs free dims).
-    lhs_free = ar - len(lb) - 1
-    rhs_free = br - len(rb) - 1
-    if lb and (ar < len(lb) + 2 or br < len(rb) + 2):
-        # a batched side with no free dim (e.g. lhs [B, K] @ rhs
-        # [B, K, N]): numpy/ONNX MatMul would rank-promote the rank-2
-        # side to a broadcast matrix, computing [B, B, N] instead of
-        # dot_general's [B, N]
-        raise NotImplementedError(
-            "onnx export: batched dot_general with a vector (no free "
-            "dim) side does not map to ONNX MatMul; reshape to give "
-            "each batched side a free dim before export")
-    if not ((lhs_free <= 1 and rhs_free <= 1)
-            or (br == 2 and not rb)):
-        raise NotImplementedError(
-            "onnx export: dot_general with >=2 free dims on a side "
-            f"(lhs_free={lhs_free}, rhs_free={rhs_free}) does not map "
-            "to ONNX MatMul's numpy batching; reshape to a single free "
-            "dim per side before export")
+    lhs_free = ar - len(lb) - len(lc)
+    rhs_free = br - len(rb) - len(rc)
+    fast = (len(lc) == 1 and len(rc) == 1
+            and tuple(lb) == tuple(range(len(lb)))
+            and tuple(rb) == tuple(range(len(rb)))
+            and not (lb and (ar < len(lb) + 2 or br < len(rb) + 2))
+            and ((lhs_free <= 1 and rhs_free <= 1)
+                 or (br == 2 and not rb)))
     an = env.name(a, "a")
     bn = env.name(b, "b")
-    lc0, rc0 = lc[0], rc[0]
-    if lc0 != ar - 1:  # lhs contraction must be the last axis
-        perm = [i for i in range(ar) if i != lc0] + [lc0]
-        an = g.node("Transpose", [an], perm=perm)
-    want = len(rb)     # rhs contraction right after the batch dims
-    if rc0 != want:
-        perm = list(range(want)) + [rc0] + \
-            [i for i in range(br) if i >= want and i != rc0]
-        bn = g.node("Transpose", [bn], perm=perm)
-    return g.node("MatMul", [an, bn])
+    if fast:
+        lc0, rc0 = lc[0], rc[0]
+        if lc0 != ar - 1:  # lhs contraction must be the last axis
+            perm = [i for i in range(ar) if i != lc0] + [lc0]
+            an = g.node("Transpose", [an], perm=perm)
+        want = len(rb)     # rhs contraction right after the batch dims
+        if rc0 != want:
+            perm = list(range(want)) + [rc0] + \
+                [i for i in range(br) if i >= want and i != rc0]
+            bn = g.node("Transpose", [bn], perm=perm)
+        return g.node("MatMul", [an, bn])
+
+    ash, bsh = a.aval.shape, b.aval.shape
+    fl = [i for i in range(ar) if i not in lb and i not in lc]
+    fr = [i for i in range(br) if i not in rb and i not in rc]
+    perm_l = list(lb) + fl + list(lc)
+    perm_r = list(rb) + list(rc) + fr
+    if perm_l != list(range(ar)):
+        an = g.node("Transpose", [an], perm=perm_l)
+    if perm_r != list(range(br)):
+        bn = g.node("Transpose", [bn], perm=perm_r)
+    bshape = [int(ash[i]) for i in lb]
+    k = int(np.prod([ash[i] for i in lc], dtype=np.int64))
+    m = int(np.prod([ash[i] for i in fl], dtype=np.int64))
+    n = int(np.prod([bsh[i] for i in fr], dtype=np.int64))
+    an = g.node("Reshape", [an, g.add_init(
+        np.asarray(bshape + [m, k], np.int64), "shape")])
+    bn = g.node("Reshape", [bn, g.add_init(
+        np.asarray(bshape + [k, n], np.int64), "shape")])
+    mm = g.node("MatMul", [an, bn])
+    out_shape = [int(d) for d in eqn.outvars[0].aval.shape]
+    return g.node("Reshape", [mm, g.add_init(
+        np.asarray(out_shape, np.int64), "shape")])
 
 
 def _emit_conv(g, env, eqn):

@@ -257,6 +257,49 @@ def test_export_layernorm_mlp(tmp_path):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+def test_export_general_dot_general_canonicalized(tmp_path):
+    """dot_generals outside MatMul's numpy batching (>=2 free dims on a
+    batched side, multi-dim contraction, non-leading batch dims, vector
+    sides) export via the Transpose/Reshape/MatMul/Reshape
+    canonicalization (r4 advisor finding: these used to raise) and match
+    numpy.einsum numerically."""
+    from paddle_tpu.ops.math import einsum
+
+    class Net(nn.Layer):
+        def __init__(self, eq):
+            super().__init__()
+            self._eq = eq
+
+        def forward(self, x, y):
+            return einsum(self._eq, x, y)
+
+    cases = [
+        ("bijh,bhk->bijk", (2, 3, 4, 5), (2, 5, 6)),  # 2 lhs free dims
+        ("bxy,bxy->b", (2, 3, 4), (2, 3, 4)),   # multi-dim contraction
+        ("ibh,bhk->bik", (3, 2, 5), (2, 5, 4)),  # non-leading batch
+        ("bh,bhk->bk", (2, 5), (2, 5, 4)),       # vector (no-free) lhs
+    ]
+    for i, (eqn, sa, sb) in enumerate(cases):
+        net = Net(eqn)
+        net.eval()
+        path = paddle.onnx.export(
+            net, str(tmp_path / f"dg{i}"),
+            input_spec=[InputSpec(list(sa), "float32"),
+                        InputSpec(list(sb), "float32")])
+        model = _load(path)
+        rs = np.random.RandomState(i)
+        x = rs.randn(*sa).astype(np.float32)
+        y = rs.randn(*sb).astype(np.float32)
+        got, = _run_onnx(model, [x, y])
+        np.testing.assert_allclose(got, np.einsum(eqn, x, y),
+                                   rtol=1e-4, atol=1e-5, err_msg=eqn)
+    # the >=2-free-dims case must have gone through the canonicalization
+    # (Reshape around MatMul), not the fast path
+    ops = [n.op_type for n in _load(
+        str(tmp_path / "dg0.onnx")).graph.node]
+    assert "Reshape" in ops and "MatMul" in ops
+
+
 def test_export_unsupported_primitive_raises_clearly(tmp_path):
     class Sorty(nn.Layer):
         def forward(self, x):
