@@ -102,6 +102,14 @@ def main():
         # bigger token tile: halves the per-token-block W streaming
         ("O2_ce_bt512", 8, 1024, {"GPT_AMP_LEVEL": "O2",
                                   "PADDLE_FUSED_CE_BLOCK_T": "512"}),
+        # the ceiling-analysis capture runs right after the head
+        # decision configs — it is the "45% MFU or a profile-backed
+        # ceiling analysis" deliverable and must not sit behind the
+        # block sweep on a short window
+        ("O2_nf_profiled", 8, 1024,
+         {"GPT_AMP_LEVEL": "O2",
+          "PADDLE_FUSED_CE_DISABLE": "1",
+          "GPT_PROFILE_DIR": os.path.join(_ART, "gpt_profile_r05")}),
         # attention-axis configs run UNFUSED (nf): the 2026-08-02 window
         # showed the fused head costs ~46 ms/step, which would drown the
         # flash-tile deltas these configs exist to measure
@@ -124,13 +132,6 @@ def main():
     ]
     if mode == "full":
         configs += [
-            # the profiled headline config runs BEFORE the long seq
-            # points — it feeds the ceiling analysis and must not be
-            # the first config a capped/wedged sweep drops
-            ("O2_nf_profiled", 8, 1024,
-             {"GPT_AMP_LEVEL": "O2",
-              "PADDLE_FUSED_CE_DISABLE": "1",
-              "GPT_PROFILE_DIR": os.path.join(_ART, "gpt_profile_r05")}),
             ("O1_nf_blk256_bwd", 8, 1024, {"GPT_AMP_LEVEL": "O1",
                                            "PADDLE_FUSED_CE_DISABLE": "1",
                                            "PADDLE_FLASH_BLOCK_BWD": "256"}),
