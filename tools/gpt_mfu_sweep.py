@@ -145,6 +145,10 @@ def main():
             ("O2_nf_seq4096_rc_b4", 4, 4096, {"GPT_AMP_LEVEL": "O2",
                                               "PADDLE_FUSED_CE_DISABLE": "1",
                                               "GPT_RECOMPUTE": "1"}),
+            # fused head at batch 16: if nf_batch16 OOMs back to batch
+            # 8, this measures whether the no-logits-in-HBM head buys
+            # the batch the unfused one can't fit
+            ("O2_batch16_fused", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
         ]
 
     best = prior_best

@@ -1,7 +1,7 @@
 """MFU ceiling analysis from a perfetto trace + sweep artifact.
 
 Digests the XPlane/perfetto capture that `GPT_PROFILE_DIR` (see
-tools/baseline_bench.py, emitted by the O2_profiled config of
+tools/baseline_bench.py, emitted by the O2_nf_profiled config of
 tools/gpt_mfu_sweep.py) writes, into the per-step device-time breakdown
 the round-5 deliverable asks for ("profile-backed ceiling analysis"):
 which fraction of the step is MXU matmul work vs Pallas kernels vs
