@@ -1,0 +1,79 @@
+"""Analytical roofline budget for the GPT-124M single-chip train step.
+
+Computes, from first principles, where the step time HAS to go on a
+v5e-class chip (197 TFLOP/s bf16 MXU, ~819 GB/s HBM): dense matmul
+FLOPs, attention FLOPs (causal-halved), LM-head cost (fused vs
+unfused), optimizer + parameter HBM traffic, and activation traffic.
+Pairs with tools/mfu_analysis.py's measured perfetto breakdown: the
+measured bucket that most exceeds its roofline line is the next lever.
+
+Usage: python tools/gpt_roofline.py [batch seq] (default 8 1024)
+"""
+import json
+import sys
+
+PEAK_FLOPS = 197e12        # v5e bf16
+HBM_BPS = 819e9            # v5e HBM bandwidth
+
+# GPT-124M
+L, H, V, HEADS = 12, 768, 50304, 12
+
+
+def budget(batch, seq, mxu_eff=1.0, hbm_eff=1.0):
+    t = batch * seq
+    # dense body matmuls: qkv+proj (4H^2/layer) + mlp (8H^2/layer),
+    # fwd + 2x bwd
+    body_params = L * 12 * H * H
+    body_flops = 6.0 * body_params * t
+    # attention score+value matmuls: 2 matmuls x 2*T*seq*H per layer
+    # fwd, 2x that bwd; causal -> half the blocks are skipped
+    attn_flops = 0.5 * 3 * 2 * 2 * t * seq * H * L
+    # LM head (tied embedding): fwd logits + bwd dx + bwd dW
+    head_flops = 3 * 2.0 * t * H * V
+    head_flops_fused_pallas = 5 * 2.0 * t * H * V  # +2 recomputes
+    # optimizer/params HBM (O2: bf16 weights, f32 master+moments):
+    # fwd read Wbf16, bwd read Wbf16 + write Gbf16, opt reads
+    # G+m+v+master, writes m+v+master+Wbf16
+    n_params = body_params + V * H + seq * H
+    opt_bytes = n_params * (2 + 2 + 2 + 4 * 4 + 4 * 3 + 2)
+    # activation traffic: ~10 layer-intermediate [T, H] bf16 tensors
+    # per layer written fwd + read bwd
+    act_bytes = 2 * 10 * L * t * H * 2
+    # unfused head logits traffic: write [T, V] bf16 + read in
+    # softmax-CE fwd, dlogits write + 2 reads bwd
+    logits_bytes = 5 * t * V * 2
+
+    ms = lambda fl, by: round(max(fl / (PEAK_FLOPS * mxu_eff),
+                                  by / (HBM_BPS * hbm_eff)) * 1e3, 2)
+    rows = {
+        "body_matmuls": ms(body_flops, 0),
+        "attention(causal)": ms(attn_flops, 0),
+        "head_unfused": ms(head_flops, logits_bytes),
+        "head_fused_pallas(2 recomputes)": ms(head_flops_fused_pallas, 0),
+        "optimizer+params_hbm": ms(0, opt_bytes),
+        "activations_hbm": ms(0, act_bytes),
+    }
+    floor_unfused = (rows["body_matmuls"] + rows["attention(causal)"]
+                     + rows["head_unfused"]
+                     + rows["optimizer+params_hbm"])
+    model_flops = 6.0 * (n_params) * t + attn_flops
+    return {
+        "config": {"batch": batch, "seq": seq,
+                   "mxu_eff": mxu_eff, "hbm_eff": hbm_eff},
+        "per_component_ms": rows,
+        "step_floor_ms_unfused_head": round(floor_unfused, 2),
+        "mfu_at_floor": round(
+            model_flops / (floor_unfused / 1e3) / PEAK_FLOPS, 3),
+    }
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    seq = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    # ideal floor and a realistic-efficiency scenario
+    for mxu, hbm in ((1.0, 1.0), (0.6, 0.7)):
+        print(json.dumps(budget(batch, seq, mxu, hbm)))
+
+
+if __name__ == "__main__":
+    main()
