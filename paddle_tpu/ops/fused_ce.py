@@ -59,7 +59,15 @@ def _use_pallas(x, w_vh):
         return ok
     if jax.default_backend() == "cpu":
         return False
-    return ok
+    # Default OFF on real hardware since the 2026-08-02 on-chip sweep:
+    # the Pallas kernels cost ~46 ms/step on GPT-124M vs the XLA
+    # composition (the bwd recomputes the 633-GFLOP head matmul in both
+    # dx and dw kernels at below-XLA MXU efficiency; tools/
+    # gpt_roofline.py shows fused cannot beat unfused on speed even at
+    # equal kernel efficiency — its win is logits-tensor MEMORY, which
+    # matters for big-batch/long-seq configs). PADDLE_FUSED_CE=1 opts
+    # in; the vocab-sharded TP path keeps its own gating.
+    return ok and _os.environ.get("PADDLE_FUSED_CE") == "1"
 
 
 def _block_for(n, want):

@@ -98,9 +98,11 @@ def main():
         # bwd (one recompute at XLA matmul efficiency instead of the
         # Pallas bwd's two hand-rolled ones)
         ("O2_ce_bwd_xla", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                    "PADDLE_FUSED_CE": "1",
                                     "PADDLE_FUSED_CE_BWD": "xla"}),
         # bigger token tile: halves the per-token-block W streaming
         ("O2_ce_bt512", 8, 1024, {"GPT_AMP_LEVEL": "O2",
+                                  "PADDLE_FUSED_CE": "1",
                                   "PADDLE_FUSED_CE_BLOCK_T": "512"}),
         # the ceiling-analysis capture runs right after the head
         # decision configs — it is the "45% MFU or a profile-backed
@@ -141,14 +143,16 @@ def main():
                                         "PADDLE_FUSED_CE_DISABLE": "1"}),
             # fused head at seq 4096: the memory-bound config where
             # not materializing [T, V] logits should actually matter
-            ("O2_seq4096_fused", 2, 4096, {"GPT_AMP_LEVEL": "O2"}),
+            ("O2_seq4096_fused", 2, 4096, {"GPT_AMP_LEVEL": "O2",
+                                           "PADDLE_FUSED_CE": "1"}),
             ("O2_nf_seq4096_rc_b4", 4, 4096, {"GPT_AMP_LEVEL": "O2",
                                               "PADDLE_FUSED_CE_DISABLE": "1",
                                               "GPT_RECOMPUTE": "1"}),
             # fused head at batch 16: if nf_batch16 OOMs back to batch
             # 8, this measures whether the no-logits-in-HBM head buys
             # the batch the unfused one can't fit
-            ("O2_batch16_fused", 16, 1024, {"GPT_AMP_LEVEL": "O2"}),
+            ("O2_batch16_fused", 16, 1024, {"GPT_AMP_LEVEL": "O2",
+                                            "PADDLE_FUSED_CE": "1"}),
         ]
 
     best = prior_best
