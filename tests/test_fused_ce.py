@@ -112,6 +112,25 @@ def test_xla_bwd_variant_grads_match(interpret_kernels, monkeypatch):
                                rtol=2e-4, atol=2e-6)
 
 
+def test_fused_head_hardware_optin_policy(monkeypatch):
+    """Policy pin (2026-08-02 perf finding): on a real accelerator the
+    Pallas head is OPT-IN (PADDLE_FUSED_CE=1) — the XLA composition is
+    the measured-fast default — and PADDLE_FUSED_CE_DISABLE=1 always
+    wins. Interpret-forced tests are unaffected by the policy."""
+    import jax
+    import jax.numpy as jnp
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    x = jnp.zeros((256, 128), jnp.float32)
+    w = jnp.zeros((1024, 128), jnp.float32)
+    monkeypatch.delenv("PADDLE_FUSED_CE", raising=False)
+    monkeypatch.delenv("PADDLE_FUSED_CE_DISABLE", raising=False)
+    assert not fused_ce._use_pallas(x, w)
+    monkeypatch.setenv("PADDLE_FUSED_CE", "1")
+    assert fused_ce._use_pallas(x, w)
+    monkeypatch.setenv("PADDLE_FUSED_CE_DISABLE", "1")
+    assert not fused_ce._use_pallas(x, w)
+
+
 def test_gpt_head_uses_fused_and_trains():
     """GPT with a tied head routes through the fused op and the loss
     matches the unfused composition; one train step decreases it."""
