@@ -1,0 +1,39 @@
+"""Version-compat wrappers for jax APIs that moved between releases.
+
+The SPMD modules were written against the promoted ``jax.shard_map``
+(with ``check_vma`` / ``axis_names``); older releases only ship
+``jax.experimental.shard_map.shard_map`` (with ``check_rep`` /
+``auto``). One adapter here keeps every call site on the modern
+spelling.
+"""
+import jax
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+              check_vma=None, axis_names=None):
+    """jax.shard_map when available, else the experimental fallback.
+
+    check_vma maps to the old check_rep (both toggle the replication
+    checker); axis_names={a, ...} maps to auto = mesh axes NOT named
+    (manual over the named axes only).
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    kw = {}
+    if check_vma is not None:
+        kw["check_rep"] = check_vma
+    # axis_names (manual-over-these, automatic elsewhere) maps to the
+    # old auto= parameter, but partial-auto lowering is unreliable in
+    # the experimental versions (PartitionId UNIMPLEMENTED under CPU
+    # SPMD) — run full-manual instead: unmentioned axes just see
+    # replicated data, which is semantically identical and only costs
+    # redundant compute on those axes.
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kw)

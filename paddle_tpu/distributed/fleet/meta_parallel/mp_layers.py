@@ -25,10 +25,44 @@ from ....ops import nn_ops
 from ... import topology
 
 
+def _axis_is_manual(name):
+    """True when `name` is currently a bound (manual) axis — i.e. we
+    are tracing inside a shard_map/pmap body over it. A GSPMD sharding
+    constraint over a manual axis is invalid (the data is already
+    per-device there), so callers skip the hint."""
+    try:
+        from jax._src.core import axis_frame
+    except ImportError:
+        return False
+    try:
+        axis_frame(name)
+        return True
+    except NameError:
+        return False
+
+
 @register_op("sharding_constraint")
 def _constraint(x, *, spec, mesh_id):
     mesh = _MESH_REGISTRY[mesh_id]
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+    axes = []
+    for ax in spec:  # spec entries: name | tuple of names | None
+        if isinstance(ax, str):
+            axes.append(ax)
+        elif isinstance(ax, (tuple, list)):
+            axes.extend(a for a in ax if isinstance(a, str))
+    if any(_axis_is_manual(ax) for ax in axes):
+        # full-manual shard_map (older jax without partial-auto
+        # axis_names): data is per-device; the hint is meaningless —
+        # and with_sharding_constraint would reject the spec at
+        # lowering time with an opaque manual_axes ValueError
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec)))
+    except ValueError as e:
+        if "manual" in str(e):
+            return x
+        raise
 
 
 _MESH_REGISTRY = {}

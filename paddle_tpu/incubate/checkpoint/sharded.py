@@ -65,7 +65,11 @@ def load_sharded(path, target=None, shardings=None):
     if target is not None or shardings is not None:
         ref = {}
         src = target if target is not None else {}
-        tree = ckptr.metadata(apath).item_metadata.tree
+        md = ckptr.metadata(apath)
+        # newer orbax wraps the tree in CheckpointMetadata.item_metadata;
+        # older releases return the metadata tree directly
+        tree = md.item_metadata.tree if hasattr(md, "item_metadata") \
+            else md
         if target is not None:
             # validate BEFORE the restore reads anything from disk: a
             # mismatch on a multi-GB checkpoint must not cost the full
@@ -163,7 +167,8 @@ def load_sharded_train_state(path, model_target, optimizer,
     import numpy as np
     ckptr = _checkpointer()
     apath = os.path.abspath(str(path))
-    tree = ckptr.metadata(apath).item_metadata.tree
+    md = ckptr.metadata(apath)
+    tree = md.item_metadata.tree if hasattr(md, "item_metadata") else md
     if model_target is not None:
         # validate BEFORE the restore reads anything from disk (same
         # contract as load_sharded): a mismatch on a multi-GB

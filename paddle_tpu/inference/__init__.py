@@ -174,6 +174,18 @@ def get_version():
     return f"paddle_tpu inference {__version__}"
 
 
+def create_serving_engine(model, **kwargs):
+    """Continuous-batching serving entry point — the multi-request
+    analogue of create_predictor for autoregressive decode. Takes a
+    live GPTForCausalLM (weights snapshotted now) and the
+    paddle_tpu.serving knobs (num_slots, max_len, buckets, bucket_min,
+    eos_id); returns a paddle_tpu.serving.ServingEngine whose
+    add_request/step/run loop serves concurrent generations from a
+    slot-pooled KV cache with zero steady-state recompiles."""
+    from ..serving import ServingEngine
+    return ServingEngine(model, **kwargs)
+
+
 class PredictorPool:
     """Reference: paddle_infer.PredictorPool — N predictors sharing one
     config (thread-per-predictor serving). Programs are jit-compiled

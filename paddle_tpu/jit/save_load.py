@@ -13,6 +13,7 @@ import pickle
 
 import numpy as np
 import jax
+import jax.export  # binds the jax.export attribute on older releases
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor

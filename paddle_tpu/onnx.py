@@ -342,6 +342,16 @@ def _emit_dot(g, env, eqn):
         return g.node("MatMul", [an, bn])
 
     ash, bsh = a.aval.shape, b.aval.shape
+    out_aval_shape = eqn.outvars[0].aval.shape
+    if not all(isinstance(d, (int, np.integer))
+               for d in (*ash, *bsh, *out_aval_shape)):
+        # shape-polymorphic tracing (jax.export symbolic dims) reaches
+        # here with _DimExpr dims; the int() bakes below would raise a
+        # bare TypeError — fail with the exporter's standard signal
+        raise NotImplementedError(
+            "onnx export: dynamic dims in dot_general canonicalization "
+            "(the general path bakes static Reshape targets; export "
+            "with concrete shapes)")
     fl = [i for i in range(ar) if i not in lb and i not in lc]
     fr = [i for i in range(br) if i not in rb and i not in rc]
     perm_l = list(lb) + fl + list(lc)
@@ -359,7 +369,7 @@ def _emit_dot(g, env, eqn):
     bn = g.node("Reshape", [bn, g.add_init(
         np.asarray(bshape + [k, n], np.int64), "shape")])
     mm = g.node("MatMul", [an, bn])
-    out_shape = [int(d) for d in eqn.outvars[0].aval.shape]
+    out_shape = [int(d) for d in out_aval_shape]
     return g.node("Reshape", [mm, g.add_init(
         np.asarray(out_shape, np.int64), "shape")])
 

@@ -374,6 +374,33 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                      causal=bool(is_causal))
 
 
+def cached_slot_attention(q, k_cache, v_cache, lengths):
+    """Single-token decode attention over a slot-pooled static cache
+    with per-slot cache-length masking (the serving decode step,
+    text.models.GPTForCausalLM.build_serving_fns).
+
+    q [S, nh, hd] — one new-token query per slot;
+    k_cache/v_cache [S, nh, C, hd] — each slot's full static cache;
+    lengths [S] int — live prefix length per slot (prompt + generated
+    so far, INCLUDING the row just written for this step).
+
+    Key positions >= lengths[s] get -1e30 before the f32 softmax, so
+    stale K/V from a slot's previous occupant (and prefill pad rows)
+    carry exactly-zero weight — a recycled slot is bit-identical to a
+    fresh one. Same score scale / mask value / softmax as the causal
+    decode in generate(): for lengths = pos + 1 this IS its mask,
+    vectorized over slots."""
+    hd = q.shape[-1]
+    cache_len = k_cache.shape[2]
+    s = jnp.einsum("shd,shkd->shk", q, k_cache) / jnp.sqrt(
+        jnp.float32(hd))
+    kpos = jnp.arange(cache_len)[None, None, :]
+    s = jnp.where(kpos < lengths[:, None, None], s,
+                  jnp.float32(-1e30))
+    return jnp.einsum("shk,shkd->shd", jax.nn.softmax(s, axis=-1),
+                      v_cache)
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     out = scaled_dot_product_attention(query, key, value, is_causal=causal)

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import register_op
+from ..core.jax_compat import shard_map as _shard_map
 from .pallas_compat import trace_32bit as _trace_32bit
 
 _BLOCK_T = int(_os.environ.get("PADDLE_FUSED_CE_BLOCK_T", "256"))
@@ -46,7 +47,7 @@ def _dot_f32(a, b, dims):
                                preferred_element_type=jnp.float32)
 
 
-def _use_pallas(x, w_vh):
+def _use_pallas(x, w_vh, tp=False):
     if _os.environ.get("PADDLE_FUSED_CE_DISABLE") == "1":
         return False  # perf-ablation knob (tools/gpt_mfu_sweep.py)
     t, h = x.shape
@@ -59,6 +60,15 @@ def _use_pallas(x, w_vh):
         return ok
     if jax.default_backend() == "cpu":
         return False
+    if tp:
+        # Vocab-sharded TP path: Pallas ON by default (ADVICE r5). The
+        # single-chip opt-in below exists because the 2026-08-02 sweep
+        # showed XLA wins on SPEED there — but the TP kernel's point is
+        # that the per-shard [T, V/mp] logits tensor never exists in
+        # HBM, the memory property the path is chosen for, so it keeps
+        # its own gate: PADDLE_FUSED_CE_TP=0 opts out (the global
+        # PADDLE_FUSED_CE_DISABLE kill switch above still wins).
+        return ok and _os.environ.get("PADDLE_FUSED_CE_TP", "1") != "0"
     # Default OFF on real hardware since the 2026-08-02 on-chip sweep:
     # the Pallas kernels cost ~46 ms/step on GPT-124M vs the XLA
     # composition (the bwd recomputes the 633-GFLOP head matmul in both
@@ -66,7 +76,8 @@ def _use_pallas(x, w_vh):
     # gpt_roofline.py shows fused cannot beat unfused on speed even at
     # equal kernel efficiency — its win is logits-tensor MEMORY, which
     # matters for big-batch/long-seq configs). PADDLE_FUSED_CE=1 opts
-    # in; the vocab-sharded TP path keeps its own gating.
+    # in; the vocab-sharded TP path has its own default-on gate above
+    # (PADDLE_FUSED_CE_TP).
     return ok and _os.environ.get("PADDLE_FUSED_CE") == "1"
 
 
@@ -287,8 +298,12 @@ def _xla_bwd(x, w_vh, labels, lse, g, ignore_index):
     onehot = (col == labels.astype(jnp.int32)[:, None]).astype(
         jnp.float32)
     valid = (labels != ignore_index).astype(jnp.float32)
-    d = ((p - onehot) * (g.astype(jnp.float32) * valid)[:, None]
-         ).astype(x.dtype)
+    # d_logits stays f32 through BOTH matmuls (ADVICE r5): casting to
+    # bf16 first would quantize the gradient signal the Pallas backward
+    # keeps at f32 tile precision; only the final outputs narrow.
+    # dot_general accepts the mixed f32/bf16 operands and accumulates
+    # f32 (preferred_element_type in _dot_f32).
+    d = (p - onehot) * (g.astype(jnp.float32) * valid)[:, None]
     dx = _dot_f32(d, w_vh, ((1,), (0,))).astype(x.dtype)
     dw = _dot_f32(d, x, ((0,), (0,))).astype(w_vh.dtype)
     return dx, dw
@@ -365,7 +380,7 @@ def _local_fwd(x_l, w_l, lab_local):
     """(per-token local loss, local lse) for ONE vocab shard; labels
     already shifted to local coords, out-of-shard labels miss (ll=0,
     so local loss == local lse for them)."""
-    if _use_pallas(x_l, w_l):
+    if _use_pallas(x_l, w_l, tp=True):
         return _pallas_fwd(x_l, w_l, lab_local, _NEVER)
     logits = _dot_f32(x_l, w_l, ((1,), (1,)))
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -406,7 +421,7 @@ def _tp_fwd_impl(x, w_vh, labels, mesh_id, ignore_index):
         loss = jnp.where(valid, lse_g - ll_g, 0.0)
         return loss, lse_g
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh, in_specs=(x_spec, w_spec, t_spec),
         out_specs=(t_spec, t_spec), check_vma=False)(x, w_vh, labels)
 
@@ -425,7 +440,7 @@ def _tp_bwd_impl(x, w_vh, labels, lse_g, g, mesh_id, ignore_index):
         # validity zeroes the cotangent (the kernels' sentinel
         # ignore_index treats every row as valid)
         g_eff = g_l * valid.astype(g_l.dtype)
-        if _use_pallas(x_l, w_l):
+        if _use_pallas(x_l, w_l, tp=True):
             # global lse → each shard's recomputed tile exponentiates
             # to the GLOBAL softmax slice; dx partial-sums over shards
             dx_l, dw_l = _pallas_bwd(x_l, w_l, shifted, lse_l, g_eff,
@@ -447,7 +462,7 @@ def _tp_bwd_impl(x, w_vh, labels, lse_g, g, mesh_id, ignore_index):
             dw = jax.lax.psum(dw, "dp")
         return dx, dw
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(x_spec, w_spec, t_spec, t_spec, t_spec),
         out_specs=(x_spec, w_spec), check_vma=False)(

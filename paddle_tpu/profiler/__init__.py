@@ -37,6 +37,21 @@ class RecordEvent:
         self.__exit__()
 
 
+@contextlib.contextmanager
+def record_scope(name, sink=None):
+    """RecordEvent + wall-clock measurement in one scope: annotates the
+    XLA trace (TraceAnnotation + named_scope, visible in a live XPlane
+    capture) AND reports elapsed seconds to ``sink(name, dt)``. The
+    hook the serving metrics (paddle_tpu.serving.metrics) hang their
+    prefill/decode/compile accounting on — one instrumentation point
+    feeds both the device timeline and the throughput counters."""
+    t0 = time.perf_counter()
+    with RecordEvent(name):
+        yield
+    if sink is not None:
+        sink(name, time.perf_counter() - t0)
+
+
 class ProfilerState:
     """Reference: paddle.profiler.ProfilerState."""
     CLOSED = 0

@@ -1,0 +1,52 @@
+"""Continuous-batching inference serving (the Orca/vLLM pattern,
+TPU-native).
+
+``generate()`` is batch-synchronous: every request in a batch waits for
+the slowest, and every new (batch, prompt_len, new_tokens) signature
+compiles a fresh XLA executable. This package turns the same decode
+math into a multi-tenant server:
+
+  * **slot-pooled static-shape KV cache** (kv_pool.SlotKVPool) — one
+    ``[layers, num_slots, heads, max_len, head_dim]`` pair; finished
+    sequences free their slot and waiting requests claim it mid-flight,
+    so the jitted decode step keeps ONE shape forever;
+  * **prefill/decode split with bucketed prefill** — prompts pad to a
+    small geometric bucket set, so prompt-length variety costs at most
+    ``len(buckets)`` compiles;
+  * **step scheduler** (scheduler.StepScheduler) — FIFO queue,
+    admission on free slots, per-slot EOS/max-token stops, streaming
+    token callbacks;
+  * **metrics** (metrics.ServingMetrics) — tokens/sec, TTFT, queue
+    depth, slot occupancy and an exact compile counter, with every
+    timed span routed through paddle_tpu.profiler;
+  * zero-recompile steady state BY CONSTRUCTION: all device work runs
+    ahead-of-time compiled executables (engine.ServingEngine).
+
+Tuning knobs
+------------
+``num_slots``   decode batch width and cache pool size. Throughput
+                rises with concurrency until the pooled cache
+                (``SlotKVPool.nbytes()``) or the decode step's matmul
+                width saturates the chip; 8-32 is a sensible range.
+``max_len``     per-slot capacity (prompt + generated), default the
+                model's max_seq_len. The cache is num_slots*max_len
+                tokens — size it to the traffic's real tail, not the
+                model maximum.
+``buckets`` / ``bucket_min``
+                prefill pad lengths, default geometric doubling
+                ``[bucket_min, 2x, ..., max_len]``. More buckets = less
+                pad waste per prefill but more compiles; the doubling
+                set bounds pad waste at <2x and compiles at
+                O(log(max_len/bucket_min)).
+``eos_id``      default stop token (per-request override on
+                add_request).
+
+Quick start: ``bench_serving.py --smoke``; correctness + throughput
+contracts live in tests/test_serving.py.
+"""
+from .engine import (  # noqa: F401
+    ServingConfig, ServingEngine, default_buckets,
+)
+from .kv_pool import SlotKVPool  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .scheduler import Request, StepScheduler  # noqa: F401

@@ -1,0 +1,190 @@
+"""Continuous-batching inference engine.
+
+One engine step = (admission + bucketed prefill of newly admitted
+requests) + ONE pooled decode step advancing every live slot by one
+token. All device work goes through ahead-of-time compiled executables
+(jax.jit(...).lower(...).compile()), so steady state is zero-recompile
+BY CONSTRUCTION: an executable either exists in the table (cache hit,
+no jit dispatch at all) or is built exactly once and counted in
+``metrics.compiles`` — a shape drifting from its compiled signature is
+a hard error at the call, never a silent recompile.
+
+Compiled program inventory for a whole serving lifetime:
+  * one decode step at the fixed pooled-cache shape, and
+  * at most ``len(buckets)`` prefill programs (prompts pad up to a
+    small geometric bucket set),
+so prompt-length variety is O(len(buckets)) compiles, not one per
+length — the generate() LRU problem this engine exists to delete.
+"""
+import numpy as np
+
+from .kv_pool import SlotKVPool
+from .metrics import ServingMetrics
+from .scheduler import Request, StepScheduler
+
+
+def default_buckets(cache_len, bucket_min=32):
+    """Geometric prefill bucket set: bucket_min, 2x, 4x, ... capped at
+    cache_len (the per-slot capacity) which is always included so any
+    admissible prompt has a bucket."""
+    if bucket_min < 1:
+        raise ValueError(f"bucket_min must be >= 1, got {bucket_min}")
+    buckets = []
+    b = int(bucket_min)
+    while b < cache_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(cache_len))
+    return buckets
+
+
+class ServingConfig:
+    """Knobs (see package docstring): num_slots sizes the decode batch
+    and the pooled cache; max_len is the per-slot capacity (default:
+    the model's max_seq_len); buckets/bucket_min shape the prefill
+    compile set; eos_id is the default stop token."""
+
+    def __init__(self, num_slots=8, max_len=None, buckets=None,
+                 bucket_min=32, eos_id=None):
+        self.num_slots = int(num_slots)
+        self.max_len = max_len
+        self.buckets = buckets
+        self.bucket_min = int(bucket_min)
+        self.eos_id = eos_id
+
+
+class ServingEngine:
+    """Continuous-batching engine over a GPTForCausalLM.
+
+    Weights are snapshotted at construction (export_decode_params);
+    greedy decoding only — sampling is a ROADMAP open item. Typical
+    use::
+
+        eng = ServingEngine(model, num_slots=8)
+        reqs = [eng.add_request(p, max_new_tokens=64) for p in prompts]
+        eng.run()                 # or eng.step() in a service loop
+        reqs[0].output_ids        # prompt + generated, as generate()
+    """
+
+    def __init__(self, model, config=None, **kwargs):
+        if config is None:
+            config = ServingConfig(**kwargs)
+        elif kwargs:
+            raise TypeError("pass either config= or knob kwargs, not both")
+        self.config = config
+        cfg = model.cfg
+        cache_len = int(config.max_len or cfg.max_seq_len)
+        if cache_len > cfg.max_seq_len:
+            raise ValueError(
+                f"max_len {cache_len} exceeds the model's position "
+                f"table max_seq_len {cfg.max_seq_len}")
+        buckets = config.buckets or default_buckets(cache_len,
+                                                    config.bucket_min)
+        if max(buckets) > cache_len:
+            raise ValueError("prefill buckets cannot exceed max_len")
+        self.cache_len = cache_len
+        self.params = model.export_decode_params()
+        self._prefill_fn, self._decode_fn = model.build_serving_fns(
+            config.num_slots, cache_len)
+        self.pool = SlotKVPool(
+            config.num_slots, cfg.num_layers, cfg.num_heads, cache_len,
+            cfg.hidden_size // cfg.num_heads)
+        self.scheduler = StepScheduler(buckets, cache_len)
+        self.metrics = ServingMetrics()
+        self._exec = {}  # (kind, bucket?) -> compiled XLA executable
+
+    # ---------------------------------------------------------- requests
+
+    def add_request(self, prompt, max_new_tokens, eos_id=None,
+                    on_token=None):
+        """Enqueue a prompt; returns the Request handle immediately.
+        Tokens stream through on_token(request, token) as steps run."""
+        req = Request(prompt, max_new_tokens,
+                      eos_id=self.config.eos_id if eos_id is None
+                      else eos_id,
+                      on_token=on_token)
+        return self.scheduler.submit(req)
+
+    @property
+    def pending(self):
+        return self.scheduler.pending
+
+    # ------------------------------------------------------- compilation
+
+    def _compiled(self, key, fn, args):
+        """AOT compile-once table. The ONLY place executables are
+        built; metrics.compiles is therefore an exact compile counter
+        for the whole engine."""
+        ex = self._exec.get(key)
+        if ex is None:
+            import jax
+            with self.metrics.span("serving/compile"):
+                ex = jax.jit(fn).lower(*args).compile()
+            self._exec[key] = ex
+            self.metrics.compiles += 1
+        return ex
+
+    # -------------------------------------------------------------- step
+
+    def _emit(self, req, token):
+        """Account one generated token; retire the request on stop."""
+        first = not req.generated
+        req.generated.append(token)
+        self.metrics.tokens_generated += 1
+        if first:
+            self.metrics.record_first_token(req)
+        if req.on_token is not None:
+            req.on_token(req, token)
+        if self.scheduler.should_stop(req, token):
+            self.scheduler.finish(req, self.pool)
+            self.metrics.record_completion(req)
+
+    def step(self):
+        """One engine iteration: admit+prefill, then one pooled decode
+        step. Returns True while work remains."""
+        sch, pool, M = self.scheduler, self.pool, self.metrics
+
+        for req, slot in sch.admit(pool):
+            M.requests_admitted += 1
+            n = len(req.prompt)
+            bucket = sch.bucket_for(n)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.prompt
+            args = (self.params, padded, np.int32(n), np.int32(slot),
+                    pool.kc, pool.vc)
+            ex = self._compiled(("prefill", bucket), self._prefill_fn,
+                                args)
+            with M.span("serving/prefill"):
+                tok, pool.kc, pool.vc = ex(*args)
+                tok = int(tok)
+            M.prefills += 1
+            self._emit(req, tok)
+
+        if sch.active:
+            S = pool.num_slots
+            toks = np.zeros((S,), np.int32)
+            pos = np.zeros((S,), np.int32)
+            for slot, req in sch.active.items():
+                toks[slot] = req.generated[-1]
+                pos[slot] = req.write_pos
+            args = (self.params, toks, pos, pool.kc, pool.vc)
+            ex = self._compiled(("decode",), self._decode_fn, args)
+            with M.span("serving/decode"):
+                nxt, pool.kc, pool.vc = ex(*args)
+                nxt = np.asarray(nxt)
+            M.decode_steps += 1
+            for slot, req in list(sch.active.items()):
+                self._emit(req, int(nxt[slot]))
+
+        M.queue_depth = len(sch.queue)
+        M.slot_occupancy = pool.occupancy
+        return sch.pending
+
+    def run(self):
+        """Drain the queue: step until every submitted request is done.
+        Returns the completed requests (submission order preserved by
+        the FIFO scheduler for equal-length runs; use the returned
+        handles' rid to correlate)."""
+        while self.step():
+            pass
+        return self.scheduler.completed

@@ -1,0 +1,76 @@
+"""Slot-pooled static-shape KV cache.
+
+The pool owns ONE pair of cache arrays shaped
+``[layers, num_slots, heads, max_len, head_dim]`` for K and V. Slots are
+the unit of admission: a request claims a slot at prefill, decodes in
+place, and frees the slot the step it finishes — a waiting request then
+claims it mid-flight. Because the arrays never change shape, the jitted
+decode step runs at ONE fixed signature forever (vLLM's slot/paged
+insight collapsed to slot granularity: no paging, one contiguous region
+per slot, which is the right trade for XLA's static-shape world).
+
+Slot recycling never needs a cache wipe: prefill overwrites positions
+``0..bucket-1`` of the claimed slot and the per-slot length mask
+(ops/attention.cached_slot_attention) hides every position beyond the
+request's live prefix, so a recycled slot is indistinguishable from a
+fresh one (tests/test_serving.py pins this).
+"""
+import jax.numpy as jnp
+
+
+class SlotKVPool:
+    """Free-list allocator over the pooled cache arrays.
+
+    ``kc``/``vc`` are rebound by the engine after every compiled call
+    (functional update: the executables return the new arrays); the pool
+    only tracks WHICH slots are live and hands out the lowest free index
+    (deterministic allocation keeps runs reproducible).
+    """
+
+    def __init__(self, num_slots, num_layers, num_heads, max_len,
+                 head_dim, dtype=jnp.float32):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        shape = (int(num_layers), self.num_slots, int(num_heads),
+                 self.max_len, int(head_dim))
+        self.kc = jnp.zeros(shape, dtype)
+        self.vc = jnp.zeros(shape, dtype)
+        self._free = list(range(self.num_slots))  # sorted: lowest first
+        self._owner = {}                          # slot -> request id
+        self.reuse_count = 0   # acquisitions of a previously-used slot
+        self._ever_used = set()
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def occupancy(self):
+        """Fraction of slots currently owned by live requests."""
+        return 1.0 - len(self._free) / self.num_slots
+
+    def acquire(self, owner):
+        """Claim the lowest free slot for ``owner``; None when full."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._owner[slot] = owner
+        if slot in self._ever_used:
+            self.reuse_count += 1
+        self._ever_used.add(slot)
+        return slot
+
+    def release(self, slot):
+        if slot not in self._owner:
+            raise ValueError(f"slot {slot} is not live")
+        del self._owner[slot]
+        self._free.append(slot)
+        self._free.sort()
+
+    def owner_of(self, slot):
+        return self._owner.get(slot)
+
+    def nbytes(self):
+        return int(self.kc.nbytes + self.vc.nbytes)

@@ -1,0 +1,73 @@
+"""Serving metrics: throughput, TTFT, queue depth, slot occupancy,
+compile counter.
+
+Timed sections route through paddle_tpu.profiler.record_scope, so every
+prefill / decode / compile span is simultaneously (a) accumulated here
+for the snapshot() numbers and (b) annotated into the XLA trace when a
+jax.profiler capture is active — one instrumentation point feeds both
+the serving dashboard and the device timeline.
+"""
+import time
+
+from .. import profiler as _profiler
+
+
+class ServingMetrics:
+    def __init__(self):
+        self.compiles = 0            # XLA executables built (ever)
+        self.prefills = 0
+        self.decode_steps = 0
+        self.tokens_generated = 0
+        self.requests_admitted = 0
+        self.requests_completed = 0
+        self.queue_depth = 0         # gauge: updated each engine step
+        self.slot_occupancy = 0.0    # gauge: live slots / num_slots
+        self.ttft_s = []             # per request: arrival -> 1st token
+        self.request_latency_s = []  # per request: arrival -> done
+        self.span_s = {}             # section name -> accumulated secs
+        self._t_first_work = None
+        self._t_last_work = None
+
+    def span(self, name):
+        """Context manager: profiler trace annotation + wall accrual."""
+        return _profiler.record_scope(name, sink=self._accrue)
+
+    def _accrue(self, name, dt):
+        self.span_s[name] = self.span_s.get(name, 0.0) + dt
+        now = time.perf_counter()
+        if self._t_first_work is None:
+            self._t_first_work = now - dt
+        self._t_last_work = now
+
+    def record_first_token(self, request):
+        request.t_first_token = time.perf_counter()
+        self.ttft_s.append(request.t_first_token - request.t_arrival)
+
+    def record_completion(self, request):
+        self.requests_completed += 1
+        self.request_latency_s.append(request.t_done - request.t_arrival)
+
+    def tokens_per_sec(self):
+        """Generated tokens over the busy window (first to last timed
+        span) — the serving throughput headline."""
+        if self._t_first_work is None or self._t_last_work is None:
+            return 0.0
+        dt = self._t_last_work - self._t_first_work
+        return self.tokens_generated / dt if dt > 0 else 0.0
+
+    def snapshot(self):
+        n_ttft = len(self.ttft_s)
+        return {
+            "tokens_generated": self.tokens_generated,
+            "tokens_per_sec": round(self.tokens_per_sec(), 2),
+            "ttft_avg_ms": round(
+                sum(self.ttft_s) / n_ttft * 1000.0, 3) if n_ttft else None,
+            "queue_depth": self.queue_depth,
+            "slot_occupancy": round(self.slot_occupancy, 4),
+            "prefills": self.prefills,
+            "decode_steps": self.decode_steps,
+            "compiles": self.compiles,
+            "requests_admitted": self.requests_admitted,
+            "requests_completed": self.requests_completed,
+            "span_s": {k: round(v, 4) for k, v in self.span_s.items()},
+        }

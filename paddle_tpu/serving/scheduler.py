@@ -1,0 +1,126 @@
+"""Step scheduler: request queue, slot admission, stop conditions.
+
+Continuous batching (Orca's iteration-level scheduling): admission
+happens every engine step, not per batch — the moment a slot frees, the
+head of the FIFO queue claims it and prefills, while the other slots
+keep decoding. Per-slot stop conditions (EOS / max-new-tokens) retire
+requests individually, so nobody waits for the slowest member of an
+arrival batch.
+"""
+import collections
+import itertools
+import time
+
+import numpy as np
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+
+_rid = itertools.count()
+
+
+class Request:
+    """One in-flight generation request.
+
+    ``on_token(request, token)`` streams tokens as they are produced
+    (the first call is the TTFT moment); ``output_ids`` is the full
+    prompt+generation sequence once ``done``.
+    """
+
+    def __init__(self, prompt, max_new_tokens, eos_id=None,
+                 on_token=None):
+        self.rid = next(_rid)
+        self.prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
+        if self.prompt.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_id = eos_id
+        self.on_token = on_token
+        self.state = QUEUED
+        self.slot = None
+        self.generated = []
+        self.t_arrival = time.perf_counter()
+        self.t_first_token = None
+        self.t_done = None
+
+    @property
+    def done(self):
+        return self.state == DONE
+
+    @property
+    def output_ids(self):
+        """Prompt + generated tokens, the shape generate() returns."""
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int64)])
+
+    @property
+    def write_pos(self):
+        """Cache position the NEXT decode step writes at: the last
+        emitted token goes in at prompt_len + len(generated) - 1."""
+        return len(self.prompt) + len(self.generated) - 1
+
+
+class StepScheduler:
+    """FIFO queue + slot table + per-slot stop conditions."""
+
+    def __init__(self, buckets, cache_len):
+        self.buckets = sorted(int(b) for b in buckets)
+        self.cache_len = int(cache_len)
+        if not self.buckets:
+            raise ValueError("need at least one prefill bucket")
+        self.queue = collections.deque()
+        self.active = {}       # slot -> Request
+        self.completed = []
+
+    def bucket_for(self, prompt_len):
+        """Smallest bucket that holds the prompt — prompt-length variety
+        costs at most len(buckets) prefill compiles."""
+        for b in self.buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.buckets[-1]}")
+
+    def submit(self, request):
+        n = len(request.prompt)
+        self.bucket_for(n)  # raises on oversized prompts
+        if n + request.max_new_tokens > self.cache_len:
+            raise ValueError(
+                f"prompt {n} + max_new_tokens {request.max_new_tokens} "
+                f"exceeds the per-slot cache capacity {self.cache_len}")
+        self.queue.append(request)
+        return request
+
+    def admit(self, pool):
+        """Claim free slots for queued requests (FIFO). Returns the
+        newly admitted [(request, slot), ...] to prefill this step."""
+        admitted = []
+        while self.queue and pool.free_count:
+            req = self.queue.popleft()
+            slot = pool.acquire(req.rid)
+            req.slot = slot
+            req.state = RUNNING
+            self.active[slot] = req
+            admitted.append((req, slot))
+        return admitted
+
+    def should_stop(self, request, token):
+        if request.eos_id is not None and token == request.eos_id:
+            return True
+        return len(request.generated) >= request.max_new_tokens
+
+    def finish(self, request, pool):
+        """Retire a request: free its slot for the next admission."""
+        pool.release(request.slot)
+        del self.active[request.slot]
+        request.state = DONE
+        request.t_done = time.perf_counter()
+        self.completed.append(request)
+
+    @property
+    def pending(self):
+        return bool(self.queue or self.active)

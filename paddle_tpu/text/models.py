@@ -218,6 +218,73 @@ class GPTModel(_TransformerCore):
         super().__init__(cfg, causal=True, pre_norm=True)
 
 
+def _decode_forward_builder(num_heads, head_dim, hidden_size):
+    """Pure-jax KV-cache decode math shared by generate() AND the
+    serving engine (paddle_tpu.serving) — one definition, so the
+    continuous-batching engine's greedy tokens match generate() by
+    construction. Returns (ln, forward_t):
+
+      forward_t(params, tok [bb, t], pos, kc, vc) -> (logits, kc, vc)
+
+    with kc/vc [L, bb, nh, total, hd]; writes the new K/V at
+    pos..pos+t and attends causally over the cache (positions beyond
+    the live prefix are masked to exact-zero softmax weight, so stale
+    slot contents are invisible)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    nh, hd = num_heads, head_dim
+
+    def ln(x, w, bias):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) / jnp.sqrt(var + 1e-5) * w + bias
+
+    def block(x, p, kc, vc, pos):
+        # x [bb, t, h]; kc/vc [bb, nh, total, hd]; writes at
+        # pos..pos+t (bb = batch OR batch*beams OR one pool slot)
+        bb, t = x.shape[0], x.shape[1]
+        total = kc.shape[2]
+        h_ = ln(x, p["ln1_w"], p["ln1_b"])
+        qkv = h_ @ p["qkv_w"] + p["qkv_b"]
+        qkv = qkv.reshape(bb, t, 3, nh, hd).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        z = jnp.int32(0)  # index dtypes must all match under x64
+        kc = lax.dynamic_update_slice(kc, k, (z, z, pos, z))
+        vc = lax.dynamic_update_slice(vc, v, (z, z, pos, z))
+        s = jnp.einsum("bhtd,bhsd->bhts", q, kc) / jnp.sqrt(
+            jnp.float32(hd))
+        kpos = jnp.arange(total)[None, None, None, :]
+        qpos = pos + jnp.arange(t)[None, None, :, None]
+        s = jnp.where(kpos <= qpos, s, jnp.float32(-1e30))
+        o = jnp.einsum("bhts,bhsd->bhtd",
+                       jax.nn.softmax(s, axis=-1), vc)
+        o = o.transpose(0, 2, 1, 3).reshape(bb, t, hidden_size)
+        x = x + (o @ p["out_w"] + p["out_b"])
+        h2 = ln(x, p["ln2_w"], p["ln2_b"])
+        m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"],
+                        approximate=True)
+        return x + (m @ p["fc2_w"] + p["fc2_b"]), kc, vc
+
+    def forward_t(pr, tok, pos, kc, vc):
+        # tok [bb, t] int32; kc/vc [L, bb, nh, total, hd]
+        t = tok.shape[1]
+        x = pr["wemb"][tok] + pr["pemb"][pos + jnp.arange(t)]
+
+        def body(carry, inp):
+            x = carry
+            p, kcl, vcl = inp
+            x, kcl, vcl = block(x, p, kcl, vcl, pos)
+            return x, (kcl, vcl)
+
+        x, (kc, vc) = lax.scan(body, x, (pr["stacked"], kc, vc))
+        logits = ln(x, pr["lnf_w"], pr["lnf_b"]) @ pr["head"]
+        return logits, kc, vc
+
+    return ln, forward_t
+
+
 class GPTForCausalLM(nn.Layer):
     def __init__(self, cfg):
         super().__init__()
@@ -284,6 +351,127 @@ class GPTForCausalLM(nn.Layer):
             manipulation.reshape(labels, (-1,)))
         return loss
 
+    def export_decode_params(self):
+        """Weights as the stacked pytree the jitted decode programs
+        consume (generate() and the serving engine): per-layer tensors
+        stacked on a leading layer axis for lax.scan, plus embeddings
+        and the (tied or separate) head. Values are concrete jax
+        arrays snapshotted NOW — serving engines built from this see
+        the weights as of this call."""
+        import jax.numpy as jnp
+
+        from ..core.lazy import concrete
+
+        cfg = self.cfg
+
+        def W(t):
+            return concrete(t.value)
+
+        stacked = {}
+        per_layer = []
+        for blk in self.gpt.blocks:
+            per_layer.append({
+                "ln1_w": W(blk.ln1.weight), "ln1_b": W(blk.ln1.bias),
+                "qkv_w": W(blk.attn.qkv.weight),
+                "qkv_b": W(blk.attn.qkv.bias),
+                "out_w": W(blk.attn.out.weight),
+                "out_b": W(blk.attn.out.bias),
+                "ln2_w": W(blk.ln2.weight), "ln2_b": W(blk.ln2.bias),
+                "fc1_w": W(blk.mlp.fc1.weight),
+                "fc1_b": W(blk.mlp.fc1.bias),
+                "fc2_w": W(blk.mlp.fc2.weight),
+                "fc2_b": W(blk.mlp.fc2.bias)})
+        for k in per_layer[0]:
+            stacked[k] = jnp.stack([p[k] for p in per_layer])
+        wemb = W(self.gpt.word_embeddings.weight)
+        pemb = W(self.gpt.position_embeddings.weight)
+        head = wemb.T if cfg.tie_embeddings else W(self.lm_head.weight)
+        return {"stacked": stacked, "wemb": wemb, "pemb": pemb,
+                "lnf_w": W(self.gpt.ln_f.weight),
+                "lnf_b": W(self.gpt.ln_f.bias), "head": head}
+
+    def build_serving_fns(self, num_slots, cache_len):
+        """Slot-indexed cache programs for the continuous-batching
+        engine (paddle_tpu.serving), over a pooled cache
+        kc/vc [L, num_slots, nh, cache_len, hd]:
+
+          prefill(params, tokens [1, bucket], length, slot, kc, vc)
+              -> (first greedy token, kc, vc)
+              runs the shared forward_t on slot's cache slice; the
+              prompt is right-padded to the bucket (causal masking
+              makes pad rows invisible to real rows, and decode's
+              length mask hides their stale K/V afterwards);
+
+          decode_step(params, toks [S], pos [S], kc, vc)
+              -> (next greedy tokens [S], kc, vc)
+              ONE fused program advancing every slot a token: per-slot
+              K/V writes at each slot's own position, attention under
+              the per-slot cache-length mask
+              (ops.attention.cached_slot_attention).
+
+        Both are pure and shape-stable; the engine AOT-compiles them
+        (decode once, prefill once per bucket)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..ops import attention as attn_ops
+
+        cfg = self.cfg
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        hidden = cfg.hidden_size
+        ln, forward_t = _decode_forward_builder(nh, hd, hidden)
+
+        def prefill(params, tokens, length, slot, kc, vc):
+            kcs = lax.dynamic_slice_in_dim(kc, slot, 1, axis=1)
+            vcs = lax.dynamic_slice_in_dim(vc, slot, 1, axis=1)
+            logits, kcs, vcs = forward_t(params, tokens, jnp.int32(0),
+                                         kcs, vcs)
+            kc = lax.dynamic_update_slice_in_dim(kc, kcs, slot, axis=1)
+            vc = lax.dynamic_update_slice_in_dim(vc, vcs, slot, axis=1)
+            last = lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            axis=0, keepdims=False)
+            return jnp.argmax(last, -1).astype(jnp.int32), kc, vc
+
+        def write_slot(cache_l, new, pos):
+            # cache_l [S, nh, C, hd], new [S, nh, hd]: each slot writes
+            # its own row at its own position
+            return jax.vmap(
+                lambda c, n, p: lax.dynamic_update_slice(
+                    c, n[:, None], (jnp.int32(0), p, jnp.int32(0))))(
+                    cache_l, new, pos)
+
+        def decode_step(params, toks, pos, kc, vc):
+            S = toks.shape[0]
+            x = params["wemb"][toks] + params["pemb"][pos]  # [S, h]
+
+            def body(carry, inp):
+                x = carry
+                p, kcl, vcl = inp
+                h_ = ln(x, p["ln1_w"], p["ln1_b"])
+                qkv = h_ @ p["qkv_w"] + p["qkv_b"]
+                qkv = qkv.reshape(S, 3, nh, hd).transpose(1, 0, 2, 3)
+                q, k, v = qkv[0], qkv[1], qkv[2]      # [S, nh, hd]
+                kcl = write_slot(kcl, k, pos)
+                vcl = write_slot(vcl, v, pos)
+                o = attn_ops.cached_slot_attention(q, kcl, vcl,
+                                                   pos + 1)
+                o = o.reshape(S, hidden)              # concat heads
+                x = x + (o @ p["out_w"] + p["out_b"])
+                h2 = ln(x, p["ln2_w"], p["ln2_b"])
+                m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"],
+                                approximate=True)
+                return x + (m @ p["fc2_w"] + p["fc2_b"]), (kcl, vcl)
+
+            x, (kc, vc) = lax.scan(body, x,
+                                   (params["stacked"], kc, vc))
+            logits = ln(x, params["lnf_w"], params["lnf_b"]) \
+                @ params["head"]                      # [S, vocab]
+            return jnp.argmax(logits, -1).astype(jnp.int32), kc, vc
+
+        return prefill, decode_step
+
     _DECODE_CACHE_MAX = 16
 
     @staticmethod
@@ -332,32 +520,7 @@ class GPTForCausalLM(nn.Layer):
         nh = cfg.num_heads
         hd = cfg.hidden_size // nh
 
-        def W(t):
-            return concrete(t.value)
-
-        stacked = {}
-        per_layer = []
-        for blk in self.gpt.blocks:
-            per_layer.append({
-                "ln1_w": W(blk.ln1.weight), "ln1_b": W(blk.ln1.bias),
-                "qkv_w": W(blk.attn.qkv.weight),
-                "qkv_b": W(blk.attn.qkv.bias),
-                "out_w": W(blk.attn.out.weight),
-                "out_b": W(blk.attn.out.bias),
-                "ln2_w": W(blk.ln2.weight), "ln2_b": W(blk.ln2.bias),
-                "fc1_w": W(blk.mlp.fc1.weight),
-                "fc1_b": W(blk.mlp.fc1.bias),
-                "fc2_w": W(blk.mlp.fc2.weight),
-                "fc2_b": W(blk.mlp.fc2.bias)})
-        for k in per_layer[0]:
-            stacked[k] = jnp.stack([p[k] for p in per_layer])
-        wemb = W(self.gpt.word_embeddings.weight)
-        pemb = W(self.gpt.position_embeddings.weight)
-        lnf_w, lnf_b = W(self.gpt.ln_f.weight), W(self.gpt.ln_f.bias)
-        head = wemb.T if cfg.tie_embeddings else W(self.lm_head.weight)
-
-        params = {"stacked": stacked, "wemb": wemb, "pemb": pemb,
-                  "lnf_w": lnf_w, "lnf_b": lnf_b, "head": head}
+        params = self.export_decode_params()
         ids = jnp.asarray(
             concrete(getattr(input_ids, "value", input_ids)), jnp.int32)
         b, s0 = ids.shape
@@ -373,50 +536,10 @@ class GPTForCausalLM(nn.Layer):
         greedy = temperature <= 0 or top_k == 1
         kk = min(int(top_k), cfg.vocab_size)  # top_k > vocab = full vocab
 
-        def ln(x, w, bias):
-            mu = x.mean(-1, keepdims=True)
-            var = ((x - mu) ** 2).mean(-1, keepdims=True)
-            return (x - mu) / jnp.sqrt(var + 1e-5) * w + bias
-
-        def block(x, p, kc, vc, pos):
-            # x [bb, t, h]; kc/vc [bb, nh, total, hd]; writes at
-            # pos..pos+t (bb = batch OR batch*beams)
-            bb, t = x.shape[0], x.shape[1]
-            h_ = ln(x, p["ln1_w"], p["ln1_b"])
-            qkv = h_ @ p["qkv_w"] + p["qkv_b"]
-            qkv = qkv.reshape(bb, t, 3, nh, hd).transpose(2, 0, 3, 1, 4)
-            q, k, v = qkv[0], qkv[1], qkv[2]
-            z = jnp.int32(0)  # index dtypes must all match under x64
-            kc = lax.dynamic_update_slice(kc, k, (z, z, pos, z))
-            vc = lax.dynamic_update_slice(vc, v, (z, z, pos, z))
-            s = jnp.einsum("bhtd,bhsd->bhts", q, kc) / jnp.sqrt(
-                jnp.float32(hd))
-            kpos = jnp.arange(total)[None, None, None, :]
-            qpos = pos + jnp.arange(t)[None, None, :, None]
-            s = jnp.where(kpos <= qpos, s, jnp.float32(-1e30))
-            o = jnp.einsum("bhts,bhsd->bhtd",
-                           jax.nn.softmax(s, axis=-1), vc)
-            o = o.transpose(0, 2, 1, 3).reshape(bb, t, cfg.hidden_size)
-            x = x + (o @ p["out_w"] + p["out_b"])
-            h2 = ln(x, p["ln2_w"], p["ln2_b"])
-            m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"],
-                            approximate=True)
-            return x + (m @ p["fc2_w"] + p["fc2_b"]), kc, vc
-
-        def forward_t(pr, tok, pos, kc, vc):
-            # tok [b, t] int32; kc/vc [L, b, nh, total, hd]
-            t = tok.shape[1]
-            x = pr["wemb"][tok] + pr["pemb"][pos + jnp.arange(t)]
-
-            def body(carry, inp):
-                x = carry
-                p, kcl, vcl = inp
-                x, kcl, vcl = block(x, p, kcl, vcl, pos)
-                return x, (kcl, vcl)
-
-            x, (kc, vc) = lax.scan(body, x, (pr["stacked"], kc, vc))
-            logits = ln(x, pr["lnf_w"], pr["lnf_b"]) @ pr["head"]
-            return logits, kc, vc
+        # decode math shared with the serving engine — ONE definition
+        # (parity between generate() and continuous batching holds by
+        # construction, not by testing alone)
+        _, forward_t = _decode_forward_builder(nh, hd, cfg.hidden_size)
 
         def pick(logits, key, temp):
             # logits [b, vocab]

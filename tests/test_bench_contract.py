@@ -2,7 +2,8 @@
 cached artifact line prints FIRST at startup, the final line carries
 provenance, exit code is 0 even when no live measurement is possible
 (the axon tunnel is unreachable or wedged under pytest here; the worker
-never fakes a TPU number from another backend)."""
+never fakes a TPU number from another backend). Same contract for
+bench_serving.py --smoke (the serving engine line)."""
 import glob
 import json
 import os
@@ -39,3 +40,41 @@ def test_bench_emits_cached_first_final_last_rc0():
     assert last["metric"] == first["metric"]
     assert last["source"] == "cached" and "error" in last
     assert last["value"] > 0
+
+
+def test_bench_serving_smoke_emits_contract_line_rc0():
+    """bench_serving.py --smoke: a live CPU measurement in seconds,
+    emitting the serving_decode_tokens_per_sec JSON line in bench.py's
+    artifact-backed format (value > 0, vs_baseline = engine over
+    sequential generate, artifact path on disk), rc 0."""
+    smoke_glob = os.path.join(_ROOT, "bench_artifacts",
+                              "serving_smoke_*.json")
+    before = set(glob.glob(smoke_glob))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_DEADLINE_SECS"] = "150"
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "bench_serving.py"),
+             "--smoke"],
+            env=env, capture_output=True, text=True, timeout=200)
+        assert res.returncode == 0, res.stderr[-500:]
+        lines = [json.loads(ln) for ln in res.stdout.splitlines()
+                 if ln.strip().startswith("{")]
+        assert lines, res.stdout
+        last = lines[-1]
+        assert last["metric"] == "serving_decode_tokens_per_sec"
+        assert last["unit"] == "tokens/sec" and last["value"] > 0
+        assert last["source"] == "live-smoke"
+        assert last["vs_baseline"] > 0
+        art = os.path.join(_ROOT, last["artifact"])
+        with open(art) as fh:
+            evidence = json.load(fh)
+        assert evidence["tokens_per_sec"] == last["value"]
+        assert evidence["workload"]["tokens"] > 0
+        # any earlier lines are provisional cached ones, marked so
+        for ln in lines[:-1]:
+            assert ln["source"] == "cached" and "note" in ln
+    finally:
+        for f in set(glob.glob(smoke_glob)) - before:
+            os.unlink(f)  # this test's artifact is noise in git

@@ -391,3 +391,27 @@ def test_export_gpt_logits(tmp_path):
     got, = _run_onnx(model, [ids])
     want = net(paddle.to_tensor(ids)).numpy()
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_general_dot_general_symbolic_dims_raise_clearly():
+    """ADVICE r5 (low): shape-polymorphic dims reaching the general
+    dot_general canonicalization must raise the exporter's standard
+    NotImplementedError (the int() shape bakes would otherwise surface
+    a bare TypeError)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jax_export
+    from jax import lax
+
+    from paddle_tpu import onnx as onnx_mod
+
+    (b,) = jax_export.symbolic_shape("b")
+
+    def f(a, c):  # 2 lhs free dims beside a batched rhs: general path
+        return lax.dot_general(a, c, (((3,), (1,)), ((0,), (0,))))
+
+    closed = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((2, 3, 4, b), jnp.float32),
+        jax.ShapeDtypeStruct((2, b, 6), jnp.float32))
+    with pytest.raises(NotImplementedError, match="dynamic dims"):
+        onnx_mod._convert(closed, [], [], ["a", "c"], "g")
