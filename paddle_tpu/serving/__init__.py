@@ -9,18 +9,33 @@ math into a multi-tenant server:
   * **slot-pooled static-shape KV cache** (kv_pool.SlotKVPool) — one
     ``[layers, num_slots, heads, max_len, head_dim]`` pair; finished
     sequences free their slot and waiting requests claim it mid-flight,
-    so the jitted decode step keeps ONE shape forever;
-  * **prefill/decode split with bucketed prefill** — prompts pad to a
-    small geometric bucket set, so prompt-length variety costs at most
-    ``len(buckets)`` compiles;
+    so the jitted decode step keeps ONE shape forever. The pooled
+    kc/vc (and the position vector) are DONATED into every serving
+    executable, so on TPU/GPU the cache updates in place instead of
+    double-buffering ~2x its footprint per call;
+  * **grouped bucketed prefill** — prompts pad to a small geometric
+    bucket set and same-bucket admissions batch into geometric group
+    sizes (1, 2, 4, ... capped at num_slots), so a deep queue prefills
+    in one ``[G, bucket]`` dispatch per group and prompt-length AND
+    queue-depth variety costs at most
+    ``len(buckets) * len(group_sizes)`` prefill compiles;
+  * **one-step-deep async decode pipeline** — step N's tokens are read
+    back only after step N+1's decode is dispatched (token/position
+    state chains device-side), so host bookkeeping overlaps device
+    compute; a just-stopped request's speculative in-flight token is
+    masked at harvest, keeping exact greedy generate() parity
+    (``async_depth=0`` restores the synchronous schedule);
   * **step scheduler** (scheduler.StepScheduler) — FIFO queue,
-    admission on free slots, per-slot EOS/max-token stops, streaming
-    token callbacks;
+    same-bucket group admission on free slots, per-slot EOS/max-token
+    stops, streaming token callbacks;
   * **metrics** (metrics.ServingMetrics) — tokens/sec, TTFT, queue
-    depth, slot occupancy and an exact compile counter, with every
-    timed span routed through paddle_tpu.profiler;
+    depth, slot occupancy, prefill-group histogram, KV-donation
+    status, dispatch-vs-sync wall split and an exact compile counter,
+    with every timed span routed through paddle_tpu.profiler;
   * zero-recompile steady state BY CONSTRUCTION: all device work runs
-    ahead-of-time compiled executables (engine.ServingEngine).
+    ahead-of-time compiled executables (engine.ServingEngine), and the
+    whole-lifetime compiled-program inventory is bounded by
+    ``len(buckets) * len(group_sizes) + 1``.
 
 Tuning knobs
 ------------
@@ -38,6 +53,17 @@ Tuning knobs
                 pad waste per prefill but more compiles; the doubling
                 set bounds pad waste at <2x and compiles at
                 O(log(max_len/bucket_min)).
+``prefill_group_sizes``
+                admission group sizes for grouped prefill, default
+                geometric ``[1, 2, 4, ..., <= num_slots]``. ``(1,)``
+                restores one-prefill-per-request.
+``async_depth`` 1 (default) = one-step-deep decode pipelining; 0 =
+                fully synchronous per-step host reads (can win on
+                churn-heavy tiny-model CPU workloads where every step
+                prefills).
+``donate_buffers``
+                None (default) = donate kc/vc/pos where the backend
+                aliases donated buffers (TPU/GPU); True/False forces.
 ``eos_id``      default stop token (per-request override on
                 add_request).
 
@@ -45,7 +71,7 @@ Quick start: ``bench_serving.py --smoke``; correctness + throughput
 contracts live in tests/test_serving.py.
 """
 from .engine import (  # noqa: F401
-    ServingConfig, ServingEngine, default_buckets,
+    ServingConfig, ServingEngine, default_buckets, default_group_sizes,
 )
 from .kv_pool import SlotKVPool  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401

@@ -1,26 +1,63 @@
 """Continuous-batching inference engine.
 
-One engine step = (admission + bucketed prefill of newly admitted
-requests) + ONE pooled decode step advancing every live slot by one
-token. All device work goes through ahead-of-time compiled executables
+One engine step = (dispatch of ONE pooled decode step) + (harvest of
+the PREVIOUS step's dispatched results) + (admission + grouped
+bucketed prefill of newly admitted requests). All device work goes
+through ahead-of-time compiled executables
 (jax.jit(...).lower(...).compile()), so steady state is zero-recompile
 BY CONSTRUCTION: an executable either exists in the table (cache hit,
 no jit dispatch at all) or is built exactly once and counted in
 ``metrics.compiles`` — a shape drifting from its compiled signature is
 a hard error at the call, never a silent recompile.
 
+Three hot-path properties keep the device saturated between scheduler
+ticks:
+
+  * **grouped prefill** — same-bucket admissions prefill in one
+    ``[G, bucket]`` dispatch, G drawn from a small geometric group-size
+    set, so a deep queue costs one dispatch per group, not per request;
+  * **donated KV buffers** — prefill/decode executables are built with
+    the pooled kc/vc (and the position vector) donated, so on donating
+    backends (TPU/GPU) the cache updates in place instead of
+    double-buffering ~2x its footprint per call (CPU ignores donation;
+    ``metrics.kv_donation`` reports both facts);
+  * **one-step-deep async decode pipelining** — step N's token values
+    are read back only AFTER step N+1's decode has been dispatched
+    (tokens and write positions chain device-side through the
+    executables), so host bookkeeping overlaps device compute via JAX
+    async dispatch. Retirement is therefore deferred one step and the
+    speculative extra token a just-stopped request's in-flight step
+    produced is masked at harvest — greedy parity with ``generate()``
+    is exact. Max-token stops are PREDICTABLE at dispatch time, so
+    those slots prerelease before the next decode goes out and pay no
+    retirement lag at all; only EOS stops (unknowable until the token
+    value is read) cost one masked speculative token.
+    ``async_depth=0`` restores the fully synchronous schedule — on
+    CPU's serial device queue it can win on churn-heavy tiny-model
+    workloads (every step prefilling), while the pipeline pays off
+    when decode dominates the step.
+
 Compiled program inventory for a whole serving lifetime:
   * one decode step at the fixed pooled-cache shape, and
-  * at most ``len(buckets)`` prefill programs (prompts pad up to a
-    small geometric bucket set),
-so prompt-length variety is O(len(buckets)) compiles, not one per
-length — the generate() LRU problem this engine exists to delete.
+  * at most ``len(buckets) * len(group_sizes)`` prefill programs
+    (prompts pad up to a small geometric bucket set, admission groups
+    up to a small geometric size set),
+so prompt-length AND queue-depth variety is O(buckets x group_sizes)
+compiles — the generate() LRU problem this engine exists to delete.
 """
+import warnings
+
 import numpy as np
 
 from .kv_pool import SlotKVPool
 from .metrics import ServingMetrics
-from .scheduler import Request, StepScheduler
+from .scheduler import RUNNING, Request, StepScheduler
+
+# kc/vc/pos are donated into every serving executable; backends without
+# donation support (CPU) warn once per compiled program — expected, not
+# actionable (see ROADMAP "Cache-buffer donation").
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
 
 
 def default_buckets(cache_len, bucket_min=32):
@@ -38,19 +75,52 @@ def default_buckets(cache_len, bucket_min=32):
     return buckets
 
 
+def default_group_sizes(num_slots):
+    """Geometric prefill group-size set: 1, 2, 4, ... capped at
+    num_slots. Any admission burst splits into groups from this set
+    (largest first), so deep-queue admission costs O(log burst)
+    dispatches while the compile inventory stays
+    O(len(buckets) * len(group_sizes))."""
+    if num_slots < 1:
+        raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+    sizes = []
+    g = 1
+    while g <= num_slots:
+        sizes.append(g)
+        g *= 2
+    return sizes
+
+
 class ServingConfig:
     """Knobs (see package docstring): num_slots sizes the decode batch
     and the pooled cache; max_len is the per-slot capacity (default:
     the model's max_seq_len); buckets/bucket_min shape the prefill
-    compile set; eos_id is the default stop token."""
+    compile set; prefill_group_sizes the admission-group compile set
+    (default: geometric up to num_slots); async_depth selects the
+    decode pipeline depth (1 = read step N's tokens after dispatching
+    step N+1, 0 = synchronous); eos_id is the default stop token."""
 
     def __init__(self, num_slots=8, max_len=None, buckets=None,
-                 bucket_min=32, eos_id=None):
+                 bucket_min=32, eos_id=None, prefill_group_sizes=None,
+                 async_depth=1, donate_buffers=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
         self.bucket_min = int(bucket_min)
         self.eos_id = eos_id
+        self.prefill_group_sizes = prefill_group_sizes
+        self.async_depth = int(async_depth)
+        if self.async_depth not in (0, 1):
+            raise ValueError(
+                f"async_depth must be 0 (synchronous) or 1 (one-step-"
+                f"deep pipeline), got {async_depth}")
+        # None = auto: donate kc/vc/pos where the backend aliases
+        # donated buffers (TPU/GPU). On CPU donation never aliases but
+        # JAX still enforces the input invalidation AND charges ~40us
+        # of buffer bookkeeping per dispatch — pure loss, so auto
+        # turns it off there. Force True to exercise the donation
+        # discipline (rebind correctness) on any backend.
+        self.donate_buffers = donate_buffers
 
 
 class ServingEngine:
@@ -82,6 +152,15 @@ class ServingEngine:
                                                     config.bucket_min)
         if max(buckets) > cache_len:
             raise ValueError("prefill buckets cannot exceed max_len")
+        sizes = (config.prefill_group_sizes
+                 or default_group_sizes(config.num_slots))
+        self.group_sizes = sorted(int(g) for g in sizes)
+        if self.group_sizes[0] != 1:
+            raise ValueError("prefill_group_sizes must include 1")
+        if self.group_sizes[-1] > config.num_slots:
+            raise ValueError(
+                f"prefill group size {self.group_sizes[-1]} exceeds "
+                f"num_slots {config.num_slots}")
         self.cache_len = cache_len
         self.params = model.export_decode_params()
         self._prefill_fn, self._decode_fn = model.build_serving_fns(
@@ -91,14 +170,34 @@ class ServingEngine:
             cfg.hidden_size // cfg.num_heads)
         self.scheduler = StepScheduler(buckets, cache_len)
         self.metrics = ServingMetrics()
-        self._exec = {}  # (kind, bucket?) -> compiled XLA executable
+        self._exec = {}  # (kind, bucket?, group?) -> XLA executable
+
+        import jax
+        import jax.numpy as jnp
+        # rolling device state: last token and next write position per
+        # slot. Prefill/decode scatter their results in, so step N+1's
+        # inputs never depend on step N's values reaching the host.
+        self._toks = jnp.zeros((config.num_slots,), jnp.int32)
+        self._pos = jnp.zeros((config.num_slots,), jnp.int32)
+        self._pending = []  # dispatched, not-yet-read device results
+        effective = jax.devices()[0].platform != "cpu"
+        self._donate = (effective if config.donate_buffers is None
+                        else bool(config.donate_buffers))
+        self.metrics.kv_donation = {
+            "enabled": self._donate,
+            # in-place aliasing actually happens (donation is enforced
+            # but never aliases on CPU)
+            "effective": self._donate and effective,
+        }
 
     # ---------------------------------------------------------- requests
 
     def add_request(self, prompt, max_new_tokens, eos_id=None,
                     on_token=None):
         """Enqueue a prompt; returns the Request handle immediately.
-        Tokens stream through on_token(request, token) as steps run."""
+        Tokens stream through on_token(request, token) as steps run
+        (with async_depth=1 a token surfaces one engine step after the
+        decode that produced it was dispatched)."""
         req = Request(prompt, max_new_tokens,
                       eos_id=self.config.eos_id if eos_id is None
                       else eos_id,
@@ -107,19 +206,23 @@ class ServingEngine:
 
     @property
     def pending(self):
-        return self.scheduler.pending
+        return self.scheduler.pending or bool(self._pending)
 
     # ------------------------------------------------------- compilation
 
-    def _compiled(self, key, fn, args):
+    def _compiled(self, key, fn, args, donate=()):
         """AOT compile-once table. The ONLY place executables are
         built; metrics.compiles is therefore an exact compile counter
-        for the whole engine."""
+        for the whole engine. ``donate`` argnums are recorded in the
+        lowered program (in-place cache updates on TPU/GPU)."""
         ex = self._exec.get(key)
         if ex is None:
             import jax
+            if not self._donate:
+                donate = ()
             with self.metrics.span("serving/compile"):
-                ex = jax.jit(fn).lower(*args).compile()
+                ex = jax.jit(fn, donate_argnums=donate) \
+                    .lower(*args).compile()
             self._exec[key] = ex
             self.metrics.compiles += 1
         return ex
@@ -139,52 +242,117 @@ class ServingEngine:
             self.scheduler.finish(req, self.pool)
             self.metrics.record_completion(req)
 
+    def _harvest(self, pending):
+        """Read back dispatched results (at most one step's worth: the
+        prefill groups and the decode of the previous step, in
+        dispatch order) and run the host bookkeeping on the token
+        values. np.asarray here is the engine's ONLY device->host
+        sync; with async_depth=1 the current step's prefill/decode are
+        already executing when it blocks, so stop checks, streaming
+        callbacks and retirement overlap device compute."""
+        M = self.metrics
+        for entry in pending:
+            with M.span("serving/sync"):
+                vals = np.asarray(entry[1])
+            if entry[0] == "prefill":
+                for (req, slot), tok in zip(entry[2], vals):
+                    req.inflight -= 1
+                    self._emit(req, int(tok))
+            else:
+                for slot, req in entry[2].items():
+                    if req.state != RUNNING:
+                        # the request hit an (unpredictable) EOS stop
+                        # after this decode was dispatched: the extra
+                        # token is speculative — masked, preserving
+                        # exact greedy parity with generate()
+                        M.speculative_masked += 1
+                        continue
+                    req.inflight -= 1
+                    self._emit(req, int(vals[slot]))
+
     def step(self):
-        """One engine iteration: admit+prefill, then one pooled decode
-        step. Returns True while work remains."""
+        """One engine iteration of the pipelined hot path:
+
+        1. prerelease: slots whose request's max-token stop is already
+           determined by in-flight tokens free NOW (predictable stops
+           pay no retirement lag; EOS stops mask one speculative
+           token);
+        2. admission + grouped prefill dispatch into free slots;
+        3. dispatch ONE pooled decode advancing every token-wanting
+           slot (freshly prefilled slots included — the device runs
+           prefill then decode back to back);
+        4. harvest the PREVIOUS step's results — the only host sync,
+           overlapped with 2/3's device compute.
+
+        Returns True while work remains. With async_depth=0 every
+        dispatch is harvested immediately (the synchronous PR-1
+        schedule)."""
         sch, pool, M = self.scheduler, self.pool, self.metrics
+        sync = self.config.async_depth == 0
+        prev, self._pending = self._pending, []
 
-        for req, slot in sch.admit(pool):
-            M.requests_admitted += 1
-            n = len(req.prompt)
-            bucket = sch.bucket_for(n)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :n] = req.prompt
-            args = (self.params, padded, np.int32(n), np.int32(slot),
-                    pool.kc, pool.vc)
-            ex = self._compiled(("prefill", bucket), self._prefill_fn,
-                                args)
-            with M.span("serving/prefill"):
-                tok, pool.kc, pool.vc = ex(*args)
-                tok = int(tok)
+        for req in [r for r in sch.active.values() if sch.saturated(r)]:
+            sch.prerelease(req, pool)
+
+        for group in sch.admit(pool, self.group_sizes):
+            G = len(group)
+            M.requests_admitted += G
+            bucket = sch.bucket_for(len(group[0][0].prompt))
+            tokens = np.zeros((G, bucket), np.int32)
+            lengths = np.zeros((G,), np.int32)
+            slots = np.zeros((G,), np.int32)
+            for g, (req, slot) in enumerate(group):
+                n = len(req.prompt)
+                tokens[g, :n] = req.prompt
+                lengths[g] = n
+                slots[g] = slot
+                req.inflight += 1
+            args = (self.params, tokens, lengths, slots, self._toks,
+                    self._pos, pool.kc, pool.vc)
+            ex = self._compiled(("prefill", bucket, G),
+                                self._prefill_fn, args,
+                                donate=(5, 6, 7))
+            with M.span("serving/prefill_dispatch"):
+                first, self._toks, self._pos, kc, vc = ex(*args)
+            pool.rebind(kc, vc)
             M.prefills += 1
-            self._emit(req, tok)
+            M.prefill_requests += G
+            M.prefill_group_hist[G] = \
+                M.prefill_group_hist.get(G, 0) + 1
+            if sync:
+                self._harvest([("prefill", first, group)])
+            else:
+                self._pending.append(("prefill", first, group))
 
-        if sch.active:
-            S = pool.num_slots
-            toks = np.zeros((S,), np.int32)
-            pos = np.zeros((S,), np.int32)
-            for slot, req in sch.active.items():
-                toks[slot] = req.generated[-1]
-                pos[slot] = req.write_pos
-            args = (self.params, toks, pos, pool.kc, pool.vc)
-            ex = self._compiled(("decode",), self._decode_fn, args)
-            with M.span("serving/decode"):
-                nxt, pool.kc, pool.vc = ex(*args)
-                nxt = np.asarray(nxt)
+        snapshot = {slot: req for slot, req in sch.active.items()
+                    if not sch.saturated(req)}
+        if snapshot:
+            for req in snapshot.values():
+                req.inflight += 1
+            args = (self.params, self._toks, self._pos, pool.kc,
+                    pool.vc)
+            ex = self._compiled(("decode",), self._decode_fn, args,
+                                donate=(2, 3, 4))
+            with M.span("serving/decode_dispatch"):
+                nxt, self._pos, kc, vc = ex(*args)
+            pool.rebind(kc, vc)
+            self._toks = nxt
             M.decode_steps += 1
-            for slot, req in list(sch.active.items()):
-                self._emit(req, int(nxt[slot]))
+            if sync:
+                self._harvest([("decode", nxt, snapshot)])
+            else:
+                self._pending.append(("decode", nxt, snapshot))
+
+        self._harvest(prev)
 
         M.queue_depth = len(sch.queue)
         M.slot_occupancy = pool.occupancy
-        return sch.pending
+        return sch.pending or bool(self._pending)
 
     def run(self):
         """Drain the queue: step until every submitted request is done.
-        Returns the completed requests (submission order preserved by
-        the FIFO scheduler for equal-length runs; use the returned
-        handles' rid to correlate)."""
+        Returns the completed requests in SUBMISSION order (sorted by
+        rid — the scheduler's own completed list is finish-ordered)."""
         while self.step():
             pass
-        return self.scheduler.completed
+        return sorted(self.scheduler.completed, key=lambda r: r.rid)

@@ -15,15 +15,20 @@ Slot recycling never needs a cache wipe: prefill overwrites positions
 request's live prefix, so a recycled slot is indistinguishable from a
 fresh one (tests/test_serving.py pins this).
 """
+import heapq
+
 import jax.numpy as jnp
 
 
 class SlotKVPool:
     """Free-list allocator over the pooled cache arrays.
 
-    ``kc``/``vc`` are rebound by the engine after every compiled call
-    (functional update: the executables return the new arrays); the pool
-    only tracks WHICH slots are live and hands out the lowest free index
+    ``kc``/``vc`` are rebound (``rebind``) by the engine after every
+    compiled call: the executables return the new arrays, and on
+    donating backends (TPU/GPU) the INPUT buffers were consumed in
+    place — routing the swap through the pool keeps it the single
+    owner of the live buffers. The pool itself only tracks WHICH slots
+    are live and hands out the lowest free index via a heap
     (deterministic allocation keeps runs reproducible).
     """
 
@@ -37,7 +42,7 @@ class SlotKVPool:
                  self.max_len, int(head_dim))
         self.kc = jnp.zeros(shape, dtype)
         self.vc = jnp.zeros(shape, dtype)
-        self._free = list(range(self.num_slots))  # sorted: lowest first
+        self._free = list(range(self.num_slots))  # heap: lowest first
         self._owner = {}                          # slot -> request id
         self.reuse_count = 0   # acquisitions of a previously-used slot
         self._ever_used = set()
@@ -55,7 +60,7 @@ class SlotKVPool:
         """Claim the lowest free slot for ``owner``; None when full."""
         if not self._free:
             return None
-        slot = self._free.pop(0)
+        slot = heapq.heappop(self._free)
         self._owner[slot] = owner
         if slot in self._ever_used:
             self.reuse_count += 1
@@ -66,11 +71,25 @@ class SlotKVPool:
         if slot not in self._owner:
             raise ValueError(f"slot {slot} is not live")
         del self._owner[slot]
-        self._free.append(slot)
-        self._free.sort()
+        heapq.heappush(self._free, slot)
 
     def owner_of(self, slot):
         return self._owner.get(slot)
+
+    def rebind(self, kc, vc):
+        """Swap in the cache arrays a compiled call returned. With
+        buffer donation the previous arrays are already invalid, so
+        every shape/dtype drift must be caught HERE, before a stale or
+        mismatched buffer reaches the next AOT executable."""
+        if kc.shape != self.kc.shape or vc.shape != self.vc.shape:
+            raise ValueError(
+                f"rebind shape drift: got {kc.shape}/{vc.shape}, pool "
+                f"owns {self.kc.shape}")
+        if kc.dtype != self.kc.dtype or vc.dtype != self.vc.dtype:
+            raise ValueError(
+                f"rebind dtype drift: got {kc.dtype}/{vc.dtype}, pool "
+                f"owns {self.kc.dtype}")
+        self.kc, self.vc = kc, vc
 
     def nbytes(self):
         return int(self.kc.nbytes + self.vc.nbytes)

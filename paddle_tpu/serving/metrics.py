@@ -15,9 +15,15 @@ from .. import profiler as _profiler
 class ServingMetrics:
     def __init__(self):
         self.compiles = 0            # XLA executables built (ever)
-        self.prefills = 0
+        self.prefills = 0            # prefill dispatches (one per group)
+        self.prefill_requests = 0    # requests prefilled (sum of G)
+        self.prefill_group_hist = {} # group size G -> dispatch count
         self.decode_steps = 0
         self.tokens_generated = 0
+        self.speculative_masked = 0  # pipelined tokens discarded at
+                                     # harvest (request stopped while
+                                     # its next step was in flight)
+        self.kv_donation = {"enabled": False, "effective": False}
         self.requests_admitted = 0
         self.requests_completed = 0
         self.queue_depth = 0         # gauge: updated each engine step
@@ -55,8 +61,18 @@ class ServingMetrics:
         dt = self._t_last_work - self._t_first_work
         return self.tokens_generated / dt if dt > 0 else 0.0
 
+    def dispatch_sync_split(self):
+        """(dispatch_s, sync_s): wall time spent ISSUING device work vs
+        BLOCKED on device->host reads. The pipelined hot path's whole
+        point is pushing time out of sync and letting it overlap the
+        dispatch column."""
+        dispatch = sum(v for k, v in self.span_s.items()
+                       if k.endswith("_dispatch"))
+        return dispatch, self.span_s.get("serving/sync", 0.0)
+
     def snapshot(self):
         n_ttft = len(self.ttft_s)
+        dispatch_s, sync_s = self.dispatch_sync_split()
         return {
             "tokens_generated": self.tokens_generated,
             "tokens_per_sec": round(self.tokens_per_sec(), 2),
@@ -65,9 +81,16 @@ class ServingMetrics:
             "queue_depth": self.queue_depth,
             "slot_occupancy": round(self.slot_occupancy, 4),
             "prefills": self.prefills,
+            "prefill_requests": self.prefill_requests,
+            "prefill_groups": {str(k): v for k, v in
+                               sorted(self.prefill_group_hist.items())},
             "decode_steps": self.decode_steps,
+            "speculative_masked": self.speculative_masked,
+            "kv_donation": dict(self.kv_donation),
             "compiles": self.compiles,
             "requests_admitted": self.requests_admitted,
             "requests_completed": self.requests_completed,
+            "dispatch_s": round(dispatch_s, 4),
+            "sync_s": round(sync_s, 4),
             "span_s": {k: round(v, 4) for k, v in self.span_s.items()},
         }

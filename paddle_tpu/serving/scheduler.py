@@ -42,6 +42,7 @@ class Request:
         self.state = QUEUED
         self.slot = None
         self.generated = []
+        self.inflight = 0   # tokens dispatched on device, not yet read
         self.t_arrival = time.perf_counter()
         self.t_first_token = None
         self.t_done = None
@@ -95,28 +96,68 @@ class StepScheduler:
         self.queue.append(request)
         return request
 
-    def admit(self, pool):
-        """Claim free slots for queued requests (FIFO). Returns the
-        newly admitted [(request, slot), ...] to prefill this step."""
-        admitted = []
+    def admit(self, pool, group_sizes=(1,)):
+        """Claim free slots for queued requests (FIFO) and return the
+        admissions as SAME-BUCKET prefill groups: a list of
+        [(request, slot), ...] lists, every member of a group sharing
+        one prefill bucket and group lengths drawn from ``group_sizes``
+        (largest fitting size first), so a deep queue costs one prefill
+        dispatch per group instead of one per request. Groups keep FIFO
+        order: buckets appear in first-arrival order, members in
+        arrival order within each bucket."""
+        sizes = sorted(int(g) for g in group_sizes)
+        if not sizes or sizes[0] != 1:
+            raise ValueError(f"group_sizes must include 1, got "
+                             f"{group_sizes}")
+        by_bucket = {}
         while self.queue and pool.free_count:
             req = self.queue.popleft()
             slot = pool.acquire(req.rid)
             req.slot = slot
             req.state = RUNNING
             self.active[slot] = req
-            admitted.append((req, slot))
-        return admitted
+            by_bucket.setdefault(self.bucket_for(len(req.prompt)),
+                                 []).append((req, slot))
+        groups = []
+        for members in by_bucket.values():
+            i = 0
+            while i < len(members):
+                take = max(g for g in sizes if g <= len(members) - i)
+                groups.append(members[i:i + take])
+                i += take
+        return groups
 
     def should_stop(self, request, token):
         if request.eos_id is not None and token == request.eos_id:
             return True
         return len(request.generated) >= request.max_new_tokens
 
-    def finish(self, request, pool):
-        """Retire a request: free its slot for the next admission."""
+    def saturated(self, request):
+        """True when the tokens already read plus the tokens still in
+        flight on device reach max_new_tokens: the request needs no
+        further decode dispatches. Max-token stops are predictable at
+        DISPATCH time — the pipelined engine releases these slots
+        before the next decode goes out, so a waiting request claims
+        the slot without the one-step retirement lag an EOS stop
+        (unpredictable until the token value is read) must pay."""
+        return (len(request.generated) + request.inflight
+                >= request.max_new_tokens)
+
+    def prerelease(self, request, pool):
+        """Free a saturated request's slot ahead of its final token's
+        harvest. The request stays RUNNING (its last token is still in
+        flight); finish() completes it when that token is emitted."""
         pool.release(request.slot)
         del self.active[request.slot]
+        request.slot = None
+
+    def finish(self, request, pool):
+        """Retire a request: free its slot (unless prereleased) for
+        the next admission."""
+        if request.slot is not None:
+            pool.release(request.slot)
+            del self.active[request.slot]
+            request.slot = None
         request.state = DONE
         request.t_done = time.perf_counter()
         self.completed.append(request)

@@ -393,24 +393,37 @@ class GPTForCausalLM(nn.Layer):
     def build_serving_fns(self, num_slots, cache_len):
         """Slot-indexed cache programs for the continuous-batching
         engine (paddle_tpu.serving), over a pooled cache
-        kc/vc [L, num_slots, nh, cache_len, hd]:
+        kc/vc [L, num_slots, nh, cache_len, hd]. Both programs thread
+        the engine's rolling device state (toks/pos [S]) through, so
+        consecutive steps chain entirely on device — the engine reads
+        token values back only AFTER dispatching the next step, and
+        the executables are built with kc/vc (and pos) donated so the
+        pooled cache updates in place on donating backends:
 
-          prefill(params, tokens [1, bucket], length, slot, kc, vc)
-              -> (first greedy token, kc, vc)
-              runs the shared forward_t on slot's cache slice; the
-              prompt is right-padded to the bucket (causal masking
+          prefill(params, tokens [G, bucket], lengths [G], slots [G],
+                  toks [S], pos [S], kc, vc)
+              -> (first greedy tokens [G], toks', pos', kc, vc)
+              ONE dispatch prefills a whole same-bucket admission
+              group: the G claimed slot caches are gathered, the
+              shared forward_t runs batched over the group, and the
+              updated slices scatter back. The first tokens and next
+              write positions also scatter into toks/pos so the next
+              decode step consumes them with no host round-trip.
+              Prompts are right-padded to the bucket (causal masking
               makes pad rows invisible to real rows, and decode's
               length mask hides their stale K/V afterwards);
 
           decode_step(params, toks [S], pos [S], kc, vc)
-              -> (next greedy tokens [S], kc, vc)
+              -> (next greedy tokens [S], pos + 1, kc, vc)
               ONE fused program advancing every slot a token: per-slot
               K/V writes at each slot's own position, attention under
               the per-slot cache-length mask
-              (ops.attention.cached_slot_attention).
+              (ops.attention.cached_slot_attention). Positions come
+              back incremented so decode chains into the next decode
+              device-side.
 
         Both are pure and shape-stable; the engine AOT-compiles them
-        (decode once, prefill once per bucket)."""
+        (decode once, prefill once per (bucket, group size))."""
         import jax
         import jax.numpy as jnp
         from jax import lax
@@ -423,16 +436,22 @@ class GPTForCausalLM(nn.Layer):
         hidden = cfg.hidden_size
         ln, forward_t = _decode_forward_builder(nh, hd, hidden)
 
-        def prefill(params, tokens, length, slot, kc, vc):
-            kcs = lax.dynamic_slice_in_dim(kc, slot, 1, axis=1)
-            vcs = lax.dynamic_slice_in_dim(vc, slot, 1, axis=1)
+        def prefill(params, tokens, lengths, slots, toks, pos, kc, vc):
+            # tokens [G, bucket]; lengths/slots [G]; toks/pos [S]
+            kcs = jnp.take(kc, slots, axis=1)   # [L, G, nh, C, hd]
+            vcs = jnp.take(vc, slots, axis=1)
             logits, kcs, vcs = forward_t(params, tokens, jnp.int32(0),
                                          kcs, vcs)
-            kc = lax.dynamic_update_slice_in_dim(kc, kcs, slot, axis=1)
-            vc = lax.dynamic_update_slice_in_dim(vc, vcs, slot, axis=1)
-            last = lax.dynamic_index_in_dim(logits[0], length - 1,
-                                            axis=0, keepdims=False)
-            return jnp.argmax(last, -1).astype(jnp.int32), kc, vc
+            kc = kc.at[:, slots].set(kcs)
+            vc = vc.at[:, slots].set(vcs)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            first = jnp.argmax(last, -1).astype(jnp.int32)   # [G]
+            toks = toks.at[slots].set(first)
+            # the next decode writes each group member at position
+            # lengths[g] (its first generated token's cache row)
+            pos = pos.at[slots].set(lengths)
+            return first, toks, pos, kc, vc
 
         def write_slot(cache_l, new, pos):
             # cache_l [S, nh, C, hd], new [S, nh, hd]: each slot writes
@@ -468,7 +487,8 @@ class GPTForCausalLM(nn.Layer):
                                    (params["stacked"], kc, vc))
             logits = ln(x, params["lnf_w"], params["lnf_b"]) \
                 @ params["head"]                      # [S, vocab]
-            return jnp.argmax(logits, -1).astype(jnp.int32), kc, vc
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            return nxt, pos + jnp.int32(1), kc, vc
 
         return prefill, decode_step
 

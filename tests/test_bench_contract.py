@@ -72,6 +72,21 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
             evidence = json.load(fh)
         assert evidence["tokens_per_sec"] == last["value"]
         assert evidence["workload"]["tokens"] > 0
+        # serving hot-path observability (PR 2): grouped prefill,
+        # KV-donation status, dispatch-vs-sync wall split — in the
+        # engine snapshot AND the deep-queue scenario section
+        snap = evidence["serving_metrics"]
+        assert set(snap["kv_donation"]) == {"enabled", "effective"}
+        assert snap["dispatch_s"] >= 0 and snap["sync_s"] >= 0
+        assert snap["prefill_requests"] >= snap["prefills"] > 0
+        dq = evidence["deep_queue"]
+        assert dq["group_sizes_used"] and \
+            max(dq["group_sizes_used"]) > 1   # grouped prefill fired
+        assert set(dq["kv_donation"]) == {"enabled", "effective"}
+        assert dq["dispatch_s"] >= 0 and dq["sync_s"] >= 0
+        assert dq["vs_pr1_engine"] > 0
+        assert dq["steady_state_new_compiles"] == 0
+        assert last["deep_queue_vs_pr1"] == dq["vs_pr1_engine"]
         # any earlier lines are provisional cached ones, marked so
         for ln in lines[:-1]:
             assert ln["source"] == "cached" and "note" in ln

@@ -9,7 +9,8 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
-from paddle_tpu.serving import ServingEngine, default_buckets
+from paddle_tpu.serving import (ServingEngine, SlotKVPool, StepScheduler,
+                                default_buckets, default_group_sizes)
 from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
 
 
@@ -38,6 +39,79 @@ def test_default_buckets_geometric():
     assert default_buckets(64, 8) == [8, 16, 32, 64]
     assert default_buckets(48, 32) == [32, 48]  # cap always included
     assert default_buckets(32, 32) == [32]
+
+
+def test_default_buckets_edge_cases():
+    """bucket_min at/above cache_len collapses to [cache_len];
+    non-power-of-two cache_len keeps the doubling run plus the cap."""
+    assert default_buckets(64, 64) == [64]
+    assert default_buckets(64, 100) == [64]   # bucket_min > capacity
+    assert default_buckets(48, 8) == [8, 16, 32, 48]
+    assert default_buckets(100, 16) == [16, 32, 64, 100]
+    with pytest.raises(ValueError):
+        default_buckets(64, 0)
+
+
+def test_default_group_sizes_geometric():
+    assert default_group_sizes(1) == [1]
+    assert default_group_sizes(6) == [1, 2, 4]   # capped at num_slots
+    assert default_group_sizes(8) == [1, 2, 4, 8]
+    with pytest.raises(ValueError):
+        default_group_sizes(0)
+
+
+def test_bucket_for_boundaries():
+    """Prompt exactly at a bucket boundary stays in that bucket; one
+    past it moves up; past the largest bucket raises."""
+    sch = StepScheduler([8, 16, 32], 32)
+    assert sch.bucket_for(1) == 8
+    assert sch.bucket_for(8) == 8
+    assert sch.bucket_for(9) == 16
+    assert sch.bucket_for(32) == 32
+    with pytest.raises(ValueError):
+        sch.bucket_for(33)
+
+
+def test_pool_heap_is_lowest_slot_first():
+    """Free-list determinism: whatever the release order, acquisition
+    always hands out the lowest free slot."""
+    pool = SlotKVPool(4, 1, 1, 8, 4)
+    slots = [pool.acquire(i) for i in range(4)]
+    assert slots == [0, 1, 2, 3] and pool.acquire(99) is None
+    for s in (3, 1, 2):
+        pool.release(s)
+    assert [pool.acquire(10), pool.acquire(11), pool.acquire(12)] \
+        == [1, 2, 3]
+    assert pool.reuse_count == 3
+
+
+def test_pool_acquire_release_fuzz():
+    """Admit-when-full churn fuzz: across random acquire/release
+    traffic the free set and the owned set always partition the pool,
+    acquisition is always the minimum free slot, acquire on a full
+    pool is None, and double-release raises."""
+    pool = SlotKVPool(4, 1, 1, 8, 4)
+    rs = np.random.RandomState(9)
+    live = set()
+    for i in range(300):
+        if live and (pool.free_count == 0 or rs.rand() < 0.45):
+            slot = int(rs.choice(sorted(live)))
+            pool.release(slot)
+            live.discard(slot)
+            with pytest.raises(ValueError):
+                pool.release(slot)
+        else:
+            free_before = set(pool._free)
+            slot = pool.acquire(i)
+            assert slot == min(free_before)
+            assert pool.owner_of(slot) == i
+            live.add(slot)
+        assert set(pool._free) | live == {0, 1, 2, 3}
+        assert set(pool._free) & live == set()
+        assert pool.free_count + len(live) == 4
+        if pool.free_count == 0:
+            assert pool.acquire(-1) is None
+    assert pool.reuse_count >= 50
 
 
 def test_engine_matches_generate_staggered_mixed_lengths():
@@ -107,28 +181,155 @@ def test_eos_stops_slot_early_and_frees_it():
 
 
 def test_zero_steady_state_recompiles():
-    """After warmup (one decode compile + one per touched prefill
-    bucket) NEW prompt lengths, slot churn, and arbitrary traffic must
-    add ZERO compiles: all device work is AOT executables at fixed
-    shapes (metrics.compiles counts every executable ever built)."""
+    """After a warmup wave covers the workload's (bucket, group-size)
+    signatures, identical traffic adds ZERO compiles: all device work
+    is AOT executables at fixed shapes (metrics.compiles counts every
+    executable ever built), and the whole inventory respects the hard
+    bound len(buckets) * len(group_sizes) + 1."""
     m = _model()
     eng = ServingEngine(m, num_slots=2, bucket_min=8)
     rs = np.random.RandomState(2)
-    for n, k in [(3, 5), (7, 5), (10, 4), (14, 6)]:
+    wave = [(3, 5), (7, 5), (10, 4), (14, 6)]
+    for n, k in wave:
         eng.add_request(rs.randint(0, 97, (n,)).astype(np.int64), k)
     eng.run()
     warm = eng.metrics.compiles
-    # buckets touched: 8 (3,7), 16 (10,14) -> 2 prefill + 1 decode
+    # both admission bursts pair up: (8, G=2), (16, G=2) + 1 decode
     assert warm == 3
-    # steady state: different lengths, same buckets; heavy slot churn
-    for n, k in [(4, 7), (6, 3), (9, 8), (12, 2), (15, 6), (5, 9)]:
+    assert warm <= len(eng.scheduler.buckets) * len(eng.group_sizes) + 1
+    # steady state: the same traffic pattern again — zero new compiles
+    for n, k in wave:
         eng.add_request(rs.randint(0, 97, (n,)).astype(np.int64), k)
     eng.run()
-    assert eng.metrics.compiles == warm, "steady-state decode recompiled"
-    # a NEW bucket is exactly one more compile
+    assert eng.metrics.compiles == warm, "steady-state recompiled"
+    # a NEW (bucket, group) signature is exactly one more compile
     eng.add_request(rs.randint(0, 97, (20,)).astype(np.int64), 4)
     eng.run()
     assert eng.metrics.compiles == warm + 1
+
+
+def test_compile_inventory_bound_mixed_lengths():
+    """Tier-1 guard for the grouped-prefill compile inventory: a mixed
+    prompt-length workload with arbitrary admission bursts never
+    builds more than len(buckets) * len(group_sizes) + 1 executables."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=4, bucket_min=8)
+    rs = np.random.RandomState(11)
+    specs = [(int(n), int(k)) for n, k in zip(
+        rs.randint(2, 30, 20), rs.randint(2, 10, 20))]
+    for p, (_, k) in zip(_prompts(rs, [n for n, _ in specs]), specs):
+        eng.add_request(p, max_new_tokens=k)
+    eng.run()
+    bound = len(eng.scheduler.buckets) * len(eng.group_sizes) + 1
+    assert eng.metrics.compiles <= bound
+
+
+def test_run_returns_submission_order():
+    """run()'s contract: completed requests come back sorted by rid
+    (submission order) even when they FINISH out of order; the
+    scheduler's own completed list keeps finish order."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    rs = np.random.RandomState(7)
+    prompts = _prompts(rs, [5, 6, 4])
+    r0 = eng.add_request(prompts[0], max_new_tokens=12)
+    r1 = eng.add_request(prompts[1], max_new_tokens=2)
+    r2 = eng.add_request(prompts[2], max_new_tokens=2)
+    done = eng.run()
+    assert all(r.done for r in (r0, r1, r2))
+    assert [r.rid for r in done] == [r0.rid, r1.rid, r2.rid]
+    # the long request finished last, so finish order differs
+    assert eng.scheduler.completed[-1] is r0
+    assert eng.scheduler.completed != done
+
+
+def test_grouped_prefill_deep_queue_parity():
+    """Queue much deeper than the slot pool with same-bucket bursts:
+    multi-request prefill groups fire (one dispatch covers several
+    admissions) and every request still matches its own batch-1
+    generate() exactly."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=4, bucket_min=8)
+    rs = np.random.RandomState(8)
+    specs = [(5, 4), (7, 5), (3, 6), (6, 4), (11, 5), (13, 4),
+             (9, 6), (14, 5), (4, 4), (8, 5), (12, 4), (10, 6)]
+    prompts = _prompts(rs, [n for n, _ in specs])
+    reqs = [eng.add_request(p, max_new_tokens=k)
+            for p, (_, k) in zip(prompts, specs)]
+    eng.run()
+    hist = eng.metrics.prefill_group_hist
+    assert any(g > 1 for g in hist), f"no grouped prefill fired: {hist}"
+    assert eng.metrics.prefill_requests == len(specs)
+    assert sum(g * c for g, c in hist.items()) == len(specs)
+    assert eng.metrics.prefills < len(specs)  # fewer dispatches
+    for r, p, (_, k) in zip(reqs, prompts, specs):
+        np.testing.assert_array_equal(r.output_ids, _ref(m, p, k))
+
+
+def test_sync_mode_matches_pipelined_engine():
+    """async_depth=0 + singleton prefill (the PR-1 synchronous
+    schedule) and the pipelined grouped default produce identical
+    tokens — the overhaul changes the schedule, never the math."""
+    m = _model()
+    rs = np.random.RandomState(10)
+    specs = [(3, 6), (11, 4), (7, 9), (20, 5), (5, 7), (13, 3)]
+    prompts = _prompts(rs, [n for n, _ in specs])
+    eng_a = ServingEngine(m, num_slots=3, bucket_min=8)
+    eng_b = ServingEngine(m, num_slots=3, bucket_min=8,
+                          prefill_group_sizes=(1,), async_depth=0)
+    ra = [eng_a.add_request(p, max_new_tokens=k)
+          for p, (_, k) in zip(prompts, specs)]
+    rb = [eng_b.add_request(p, max_new_tokens=k)
+          for p, (_, k) in zip(prompts, specs)]
+    eng_a.run()
+    eng_b.run()
+    for a, b in zip(ra, rb):
+        np.testing.assert_array_equal(a.output_ids, b.output_ids)
+    # sync mode never leaves tokens in flight, so it never masks
+    assert eng_b.metrics.speculative_masked == 0
+
+
+def test_forced_donation_parity_on_cpu():
+    """donate_buffers=True: JAX enforces donation semantics (the input
+    buffers are invalidated after the call) even on backends that
+    don't alias them — the engine's rebind discipline must survive
+    with identical tokens, and snapshot() must surface the status."""
+    import jax
+
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8,
+                        donate_buffers=True)
+    rs = np.random.RandomState(12)
+    prompts = _prompts(rs, [4, 9, 6, 12])
+    reqs = [eng.add_request(p, max_new_tokens=5) for p in prompts]
+    eng.run()
+    for r, p in zip(reqs, prompts):
+        np.testing.assert_array_equal(r.output_ids, _ref(m, p, 5))
+    snap = eng.metrics.snapshot()
+    assert snap["kv_donation"]["enabled"] is True
+    on_cpu = jax.devices()[0].platform == "cpu"
+    assert snap["kv_donation"]["effective"] == (not on_cpu)
+    # auto mode: donation only where it aliases
+    eng2 = ServingEngine(m, num_slots=2, bucket_min=8)
+    assert eng2.metrics.kv_donation["enabled"] == (not on_cpu)
+
+
+def test_snapshot_surfaces_pipeline_metrics():
+    """snapshot() carries the hot-path observability the bench artifact
+    asserts on: prefill group histogram, KV donation status, and the
+    dispatch-vs-sync wall split."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    rs = np.random.RandomState(13)
+    for p in _prompts(rs, [5, 9, 7]):
+        eng.add_request(p, max_new_tokens=4)
+    eng.run()
+    snap = eng.metrics.snapshot()
+    assert snap["prefill_requests"] == 3
+    assert sum(int(g) * c for g, c in snap["prefill_groups"].items()) == 3
+    assert set(snap["kv_donation"]) == {"enabled", "effective"}
+    assert snap["dispatch_s"] > 0 and snap["sync_s"] >= 0
+    assert snap["speculative_masked"] >= 0
 
 
 def test_admission_validation():
@@ -212,33 +413,35 @@ def test_throughput_vs_sequential_generate():
 def test_serving_soak_slot_churn():
     """Soak (slow tier): 24 mixed requests through 4 slots in three
     arrival waves — full parity, heavy recycling, and the compile
-    count frozen after the first wave's bucket coverage."""
+    inventory bound len(buckets) * len(group_sizes) + 1 holding across
+    the whole soak (admission-burst variety may touch new group sizes
+    per wave; the BOUND is the contract). A fourth wave repeating the
+    first three's arrival pattern must add zero compiles."""
     m = _model(max_seq_len=64, num_layers=3)
     eng = ServingEngine(m, num_slots=4, bucket_min=8)
     rs = np.random.RandomState(6)
     specs = [(int(n), int(k)) for n, k in zip(
         rs.randint(2, 30, 24), rs.randint(2, 14, 24))]
-    # wave 0 must touch every bucket the workload uses, so the later
-    # waves assert zero NEW compiles: move one representative of each
-    # bucket to the front
-    seen, front, rest = set(), [], []
-    for spec in specs:
-        b = eng.scheduler.bucket_for(spec[0])
-        (front if b not in seen else rest).append(spec)
-        seen.add(b)
-    specs = front + rest
     prompts = _prompts(rs, [n for n, _ in specs])
     reqs = []
     for wave in range(3):
         for p, (_, k) in list(zip(prompts, specs))[wave * 8:
                                                    (wave + 1) * 8]:
             reqs.append(eng.add_request(p, max_new_tokens=k))
-        if wave == 0:
-            eng.run()
-            warm = eng.metrics.compiles
-        else:
-            eng.run()
-    assert eng.metrics.compiles == warm
+        eng.run()
+    bound = len(eng.scheduler.buckets) * len(eng.group_sizes) + 1
+    assert eng.metrics.compiles <= bound
     assert eng.pool.reuse_count >= 20
     for r, p, (_, k) in zip(reqs, prompts, specs):
         np.testing.assert_array_equal(r.output_ids, _ref(m, p, k))
+    # repeat the identical three-wave pattern: fully warm, zero new
+    warm = eng.metrics.compiles
+    reqs2 = []
+    for wave in range(3):
+        for p, (_, k) in list(zip(prompts, specs))[wave * 8:
+                                                   (wave + 1) * 8]:
+            reqs2.append(eng.add_request(p, max_new_tokens=k))
+        eng.run()
+    assert eng.metrics.compiles == warm
+    for r, r2 in zip(reqs, reqs2):
+        np.testing.assert_array_equal(r.output_ids, r2.output_ids)
