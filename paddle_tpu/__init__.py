@@ -95,6 +95,7 @@ from . import distributed  # noqa: F401
 from . import vision  # noqa: F401
 from . import text  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import tensor  # noqa: F401
 from . import utils  # noqa: F401
 from . import distribution  # noqa: F401

@@ -13,6 +13,7 @@ adapter feeds fetched outputs to Metric.update.
 """
 import numpy as np
 
+from .. import profiler as _profiler
 from ..core.tensor import Tensor
 from ..core.dispatch import no_grad
 from ..io import DataLoader
@@ -137,24 +138,30 @@ class Model:
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
         labs = [y if isinstance(y, Tensor) or y is None
                 else Tensor(np.asarray(y)) for y in labs]
-        if _in_static_mode():
-            loss_list, outs = self._static_step("train")(
-                ins, labs, bool(update))
-        else:
-            outputs = self.network(*ins)
-            outs = outputs if isinstance(outputs, (list, tuple)) \
-                else [outputs]
-            losses = self._loss(*(outs
-                                  + [l for l in labs if l is not None]))
-            loss_list = losses if isinstance(losses, (list, tuple)) \
-                else [losses]
-            total = loss_list[0]
-            for l in loss_list[1:]:
-                total = math_ops.add(total, l)
-            total.backward()
-            if update:
-                self._optimizer.step()
-                self._optimizer.clear_grad()
+        # the train-step scope feeds the XLA trace, the chrome host
+        # timeline and the registry span counters in one shot (see
+        # paddle_tpu.observability) — same discipline as the serving
+        # engine's serving/* scopes
+        with _profiler.record_scope("hapi/train_batch"):
+            if _in_static_mode():
+                loss_list, outs = self._static_step("train")(
+                    ins, labs, bool(update))
+            else:
+                outputs = self.network(*ins)
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                losses = self._loss(*(outs
+                                      + [l for l in labs
+                                         if l is not None]))
+                loss_list = losses if isinstance(losses, (list, tuple)) \
+                    else [losses]
+                total = loss_list[0]
+                for l in loss_list[1:]:
+                    total = math_ops.add(total, l)
+                total.backward()
+                if update:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
         metrics = []
         for m in self._metrics:
             metrics.append(m.update(m.compute(*(outs + [l for l in labs
@@ -171,18 +178,19 @@ class Model:
         labs = labels if isinstance(labels, (list, tuple)) else [labels]
         labs = [y if isinstance(y, Tensor) or y is None
                 else Tensor(np.asarray(y)) for y in labs]
-        if _in_static_mode():
-            loss_list, outs = self._static_step("eval")(ins, labs)
-        else:
-            outputs = self.network(*ins)
-            outs = outputs if isinstance(outputs, (list, tuple)) \
-                else [outputs]
-            loss_list = None
-            if self._loss is not None:
-                losses = self._loss(*(outs + [l for l in labs
-                                              if l is not None]))
-                loss_list = losses if isinstance(losses, (list, tuple)) \
-                    else [losses]
+        with _profiler.record_scope("hapi/eval_batch"):
+            if _in_static_mode():
+                loss_list, outs = self._static_step("eval")(ins, labs)
+            else:
+                outputs = self.network(*ins)
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
+                loss_list = None
+                if self._loss is not None:
+                    losses = self._loss(*(outs + [l for l in labs
+                                                  if l is not None]))
+                    loss_list = losses \
+                        if isinstance(losses, (list, tuple)) else [losses]
         metrics = []
         for m in self._metrics:
             metrics.append(m.update(m.compute(*(outs + [l for l in labs
@@ -198,12 +206,13 @@ class Model:
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
                for x in ins]
-        if _in_static_mode():
-            outs = self._static_step("predict")(ins)
-        else:
-            outputs = self.network(*ins)
-            outs = outputs if isinstance(outputs, (list, tuple)) \
-                else [outputs]
+        with _profiler.record_scope("hapi/predict_batch"):
+            if _in_static_mode():
+                outs = self._static_step("predict")(ins)
+            else:
+                outputs = self.network(*ins)
+                outs = outputs if isinstance(outputs, (list, tuple)) \
+                    else [outputs]
         return [o.numpy() for o in outs]
 
     # ---- loops -----------------------------------------------------------
@@ -300,7 +309,9 @@ class Model:
 
         ins_seq = [coerce(ins) for _, ins, _ in window]
         labs_seq = [coerce(labs) for _, _, labs in window]
-        results = self._static_step("train_window")(ins_seq, labs_seq)
+        with _profiler.record_scope("hapi/train_window"):
+            results = self._static_step("train_window")(ins_seq,
+                                                        labs_seq)
         logs = {}
         for (step, _, _), labs, (loss_list, outs) in zip(window, labs_seq,
                                                          results):

@@ -1,0 +1,49 @@
+"""Unified observability layer: metrics registry, host-span chrome
+tracing, compile watchdog.
+
+The reference stack treats observability as a platform subsystem
+(profiler.h RecordEvent/EnableProfiler, the CUPTI DeviceTracer
+timeline, tools/timeline.py). This package is its operational,
+TPU-native generalization, built around ONE instrumentation point:
+``paddle_tpu.profiler.record_scope(name)`` feeds three sinks at once —
+
+  1. the **XLA trace**  (TraceAnnotation + named_scope: op metadata in
+     a live XPlane capture, as before);
+  2. the **host timeline** (tracing.HostSpanRecorder: a bounded ring
+     buffer dumpable as chrome://tracing / Perfetto JSON, no capture
+     session needed);
+  3. the **dashboard** (registry.default_registry(): per-scope
+     seconds + call counters, scrapeable as Prometheus text).
+
+The serving engine and the hapi training loop both instrument through
+it, so `serving/*`, `hapi/*` and `optimizer/*` scopes land in all
+three views. The third pillar, watchdog.CompileWatchdog, turns the
+serving engine's exact compile counter into an ATTRIBUTED invariant:
+every compile logs its key + abstract-shape signature + call-site,
+and any compile after ``declare_warmup_complete()`` is flagged (or
+raised) with that attribution.
+
+Quick start::
+
+    from paddle_tpu import observability as obs
+
+    reg = obs.MetricsRegistry()
+    reqs = reg.counter("requests_total", "requests served")
+    reqs.inc()
+    print(reg.prometheus_text())          # scrape format
+    server = obs.start_metrics_server(reg)  # GET /metrics, /metrics.json
+
+    obs.default_recorder().dump_chrome_trace("host_trace.json")
+    # -> open in chrome://tracing or ui.perfetto.dev
+"""
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
+    DEFAULT_TIME_BUCKETS, default_registry, start_metrics_server,
+)
+from .tracing import (  # noqa: F401
+    HostSpan, HostSpanRecorder, default_recorder, span_timer,
+)
+from .watchdog import (  # noqa: F401
+    CompileAfterWarmupError, CompileWatchdog, abstract_signature,
+    watch_jax_lowering,
+)

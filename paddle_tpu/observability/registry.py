@@ -1,0 +1,453 @@
+"""Thread-safe metrics registry: counters, gauges, fixed-bucket
+histograms — with labels, a stable JSON snapshot, and Prometheus text
+exposition.
+
+Reference parity: the platform layer's profiler counters
+(paddle/fluid/platform/profiler.h EnableProfiler aggregates event
+totals) generalized into the operational form a serving fleet actually
+scrapes. Pure stdlib: no prometheus_client dependency — the text
+format (HELP/TYPE lines, label escaping, cumulative ``le`` histogram
+buckets) is emitted directly and pinned by tests/test_observability.py.
+
+Three metric kinds, one family model:
+
+  * ``Counter``  — monotone float total, ``inc(n)``;
+  * ``Gauge``    — settable float, ``set(v)`` / ``inc`` / ``dec``;
+  * ``Histogram``— fixed upper-bound buckets declared at registration
+                   (never resized: bounded memory under sustained
+                   traffic — the reason ServingMetrics' unbounded
+                   latency lists moved here), ``observe(v)`` with
+                   cumulative bucket counts + sum + count exposition.
+
+A family declared with ``labelnames`` hands out per-label-value
+children via ``labels(...)``; without labelnames the family IS its
+single child (``counter.inc()`` just works). ``MetricsRegistry`` is
+fully lock-protected; one global ``default_registry()`` backs the
+framework-wide span accounting (profiler.record_scope's third sink).
+
+``start_metrics_server(registry)`` serves ``/metrics`` (Prometheus
+text) and ``/metrics.json`` (the snapshot) from a stdlib
+ThreadingHTTPServer daemon thread — the serving engine exposes it as
+``ServingEngine.serve_metrics()``.
+"""
+import json
+import random
+import threading
+
+# prometheus-style latency buckets (seconds): sub-ms to tens of seconds
+DEFAULT_TIME_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+_NAME_OK = set("abcdefghijklmnopqrstuvwxyz"
+               "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:")
+
+
+def _check_name(name):
+    if not name or name[0].isdigit() or not set(name) <= _NAME_OK:
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
+
+def _escape_label(value):
+    """Prometheus label-value escaping: backslash, double-quote, LF."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text):
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v):
+    """Sample-value formatting: integers without a trailing .0."""
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class _Child:
+    """One (labelvalues) series of a counter/gauge family."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._value = 0.0
+
+    @property
+    def value(self):
+        return self._value
+
+    def inc(self, amount=1.0):
+        if amount < 0:
+            raise ValueError("counters only go up; use a gauge")
+        with self._lock:
+            self._value += amount
+
+    def set_to(self, value):
+        """Absolute set — the facade hook for code that keeps a python
+        attribute in sync (ServingMetrics' ``metrics.compiles += 1``
+        property pattern)."""
+        with self._lock:
+            self._value = float(value)
+
+
+class _GaugeChild(_Child):
+    __slots__ = ()
+
+    def inc(self, amount=1.0):
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+    def set(self, value):
+        with self._lock:
+            self._value = float(value)
+
+
+class _HistogramChild:
+    """Fixed-bucket histogram series: bucket counts stay per-bucket
+    internally and cumulate only at exposition/snapshot time."""
+
+    __slots__ = ("_lock", "_bounds", "_counts", "_sum", "_count")
+
+    def __init__(self, lock, bounds):
+        self._lock = lock
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value):
+        v = float(value)
+        with self._lock:
+            i = 0
+            for i, b in enumerate(self._bounds):
+                if v <= b:
+                    break
+            else:
+                i = len(self._bounds)
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def count(self):
+        return self._count
+
+    def cumulative_buckets(self):
+        """[(upper_bound_label, cumulative_count), ...] ending at +Inf."""
+        out, acc = [], 0
+        with self._lock:
+            counts = list(self._counts)
+        for b, c in zip(self._bounds, counts):
+            acc += c
+            out.append((format(b, "g"), acc))
+        out.append(("+Inf", acc + counts[-1]))
+        return out
+
+
+class _Family:
+    """A named metric family: help text, label names, children."""
+
+    kind = None
+
+    def __init__(self, registry, name, help_text, labelnames):
+        self.name = _check_name(name)
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        for ln in self.labelnames:
+            _check_name(ln)
+        self._lock = registry._lock
+        self._children = {}
+        if not self.labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kwvalues):
+        if kwvalues:
+            if values:
+                raise ValueError("pass label values positionally OR by "
+                                 "name, not both")
+            values = tuple(kwvalues[ln] for ln in self.labelnames)
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects labels {self.labelnames}, got "
+                f"{values}")
+        with self._lock:
+            child = self._children.get(values)
+            if child is None:
+                child = self._children[values] = self._make_child()
+        return child
+
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}; call "
+                f".labels(...) first")
+        return self._children[()]
+
+    def series(self):
+        """Stable-ordered [(labelvalues, child)] view."""
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class Counter(_Family):
+    kind = "counter"
+
+    def _make_child(self):
+        return _Child(self._lock)
+
+    def inc(self, amount=1.0):
+        self._default().inc(amount)
+
+    def set_to(self, value):
+        self._default().set_to(value)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class Gauge(_Family):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value):
+        self._default().set(value)
+
+    def inc(self, amount=1.0):
+        self._default().inc(amount)
+
+    def dec(self, amount=1.0):
+        self._default().dec(amount)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class Histogram(_Family):
+    kind = "histogram"
+
+    def __init__(self, registry, name, help_text, labelnames, buckets):
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket")
+        self.buckets = bounds
+        super().__init__(registry, name, help_text, labelnames)
+
+    def _make_child(self):
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, value):
+        self._default().observe(value)
+
+    @property
+    def sum(self):
+        return self._default().sum
+
+    @property
+    def count(self):
+        return self._default().count
+
+
+class Reservoir:
+    """Fixed-size uniform sample of an unbounded observation stream
+    (Vitter's Algorithm R) — exact percentiles over a bounded memory
+    footprint. Deterministically seeded so snapshots are reproducible
+    under test."""
+
+    def __init__(self, capacity=1024, seed=0x5EED):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._rng = random.Random(seed)
+        self._samples = []
+        self._seen = 0
+        self._lock = threading.Lock()
+
+    def add(self, value):
+        v = float(value)
+        with self._lock:
+            self._seen += 1
+            if len(self._samples) < self.capacity:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self._seen)
+                if j < self.capacity:
+                    self._samples[j] = v
+
+    @property
+    def seen(self):
+        return self._seen
+
+    def samples(self):
+        with self._lock:
+            return tuple(self._samples)
+
+    def percentile(self, q):
+        """Linear-interpolated percentile over the current sample,
+        q in [0, 100]; None when empty."""
+        with self._lock:
+            xs = sorted(self._samples)
+        if not xs:
+            return None
+        if len(xs) == 1:
+            return xs[0]
+        pos = (float(q) / 100.0) * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        frac = pos - lo
+        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+
+class MetricsRegistry:
+    """Named families, one namespace; snapshot() and prometheus_text()
+    are the two exposition surfaces (JSON artifact / scrape)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families = {}
+
+    def _register(self, cls, name, help_text, labelnames, **kw):
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != cls.kind or \
+                        fam.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric {name!r} re-registered as a different "
+                        f"kind/labelset")
+                return fam
+            fam = cls(self, name, help_text, labelnames, **kw)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name, help_text="", labelnames=()):
+        return self._register(Counter, name, help_text, labelnames)
+
+    def gauge(self, name, help_text="", labelnames=()):
+        return self._register(Gauge, name, help_text, labelnames)
+
+    def histogram(self, name, help_text="", labelnames=(),
+                  buckets=DEFAULT_TIME_BUCKETS):
+        return self._register(Histogram, name, help_text, labelnames,
+                              buckets=buckets)
+
+    def get(self, name):
+        with self._lock:
+            return self._families.get(name)
+
+    def families(self):
+        with self._lock:
+            return [self._families[k] for k in sorted(self._families)]
+
+    # ---------------------------------------------------- exposition
+    def snapshot(self):
+        """Stable, JSON-serializable view: family name -> {type, help,
+        values} with label series keyed 'k=v,k=v' ('' for unlabeled)."""
+        out = {}
+        for fam in self.families():
+            values = {}
+            for labelvalues, child in fam.series():
+                key = ",".join(f"{k}={v}" for k, v in
+                               zip(fam.labelnames, labelvalues))
+                if fam.kind == "histogram":
+                    values[key] = {
+                        "count": child.count,
+                        "sum": round(child.sum, 6),
+                        "buckets": dict(child.cumulative_buckets()),
+                    }
+                else:
+                    values[key] = child.value
+            out[fam.name] = {"type": fam.kind, "help": fam.help,
+                             "values": values}
+        return out
+
+    def snapshot_json(self):
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def prometheus_text(self):
+        """Prometheus text exposition format 0.0.4: HELP/TYPE lines,
+        escaped label values, cumulative histogram buckets with the
+        canonical _bucket/_sum/_count triple."""
+        lines = []
+        for fam in self.families():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for labelvalues, child in fam.series():
+                pairs = [f'{k}="{_escape_label(v)}"' for k, v in
+                         zip(fam.labelnames, labelvalues)]
+                base = "{" + ",".join(pairs) + "}" if pairs else ""
+                if fam.kind == "histogram":
+                    for le, cum in child.cumulative_buckets():
+                        bpairs = pairs + [f'le="{le}"']
+                        lines.append(f"{fam.name}_bucket{{"
+                                     + ",".join(bpairs) + f"}} {cum}")
+                    lines.append(f"{fam.name}_sum{base} "
+                                 f"{_fmt(child.sum)}")
+                    lines.append(f"{fam.name}_count{base} "
+                                 f"{child.count}")
+                else:
+                    lines.append(f"{fam.name}{base} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry():
+    """The process-global registry profiler.record_scope accrues into
+    (span seconds + span count per scope name)."""
+    return _default_registry
+
+
+def start_metrics_server(registry=None, port=0, addr="127.0.0.1"):
+    """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` (the
+    snapshot) on a stdlib HTTP server in a daemon thread. Returns the
+    live server; ``server.server_address[1]`` is the bound port
+    (``port=0`` picks a free one) and ``server.shutdown()`` stops it."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry if registry is not None else default_registry()
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+            if path == "/metrics":
+                body = reg.prometheus_text().encode("utf-8")
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/metrics.json":
+                body = reg.snapshot_json().encode("utf-8")
+                ctype = "application/json"
+            else:
+                self.send_error(404)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-request stderr spam
+            pass
+
+    server = ThreadingHTTPServer((addr, port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="paddle-tpu-metrics")
+    thread.start()
+    return server

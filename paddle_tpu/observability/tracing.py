@@ -1,0 +1,154 @@
+"""Bounded host-span ring buffer + chrome://tracing export.
+
+The device timeline already exists (profiler.Profiler's XPlane capture,
+the reference DeviceTracer analogue) — but it needs a live
+jax.profiler session and TensorBoard/XProf to read. This recorder is
+the HOST half: every ``profiler.record_scope`` feeds it (alongside the
+XPlane annotation and the metrics-registry accrual — one scope, three
+sinks), so the serving engine's step anatomy (admission → grouped
+prefill → decode dispatch → harvest → retirement) and the training
+loop's step/optimizer scopes are inspectable after the fact with zero
+capture setup: ``dump_chrome_trace()`` writes a JSON Trace Event file
+that chrome://tracing and https://ui.perfetto.dev open directly
+(reference parity: tools/timeline.py building a chrome trace from the
+profiler proto).
+
+The buffer is a fixed-capacity ring (collections.deque maxlen):
+sustained traffic overwrites the oldest spans instead of growing —
+recording is always-on and O(1) per span with a single lock.
+"""
+import collections
+import json
+import os
+import threading
+import time
+
+
+class HostSpan:
+    """One completed host scope: [t0, t0+dur) seconds on thread tid."""
+
+    __slots__ = ("name", "t0", "dur", "tid", "args")
+
+    def __init__(self, name, t0, dur, tid, args=None):
+        self.name = name
+        self.t0 = float(t0)
+        self.dur = float(dur)
+        self.tid = int(tid)
+        self.args = args
+
+    @property
+    def t1(self):
+        return self.t0 + self.dur
+
+
+class HostSpanRecorder:
+    """Thread-safe bounded recorder of completed host spans.
+
+    Spans arrive at scope EXIT (record_scope knows its duration only
+    then), so within one thread children are recorded before their
+    parent — the chrome export doesn't care: complete ("X") events
+    carry absolute ts+dur and nest by containment in the viewer.
+    """
+
+    def __init__(self, capacity=65536):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._buf = collections.deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._dropped = 0
+        self._pid = os.getpid()
+
+    def record(self, name, t0, dur, args=None):
+        span = HostSpan(name, t0, dur, threading.get_ident(), args)
+        with self._lock:
+            if len(self._buf) == self.capacity:
+                self._dropped += 1
+            self._buf.append(span)
+        return span
+
+    def __len__(self):
+        with self._lock:
+            return len(self._buf)
+
+    @property
+    def dropped(self):
+        """Spans overwritten by the ring since the last clear()."""
+        return self._dropped
+
+    def spans(self):
+        with self._lock:
+            return list(self._buf)
+
+    def clear(self):
+        with self._lock:
+            self._buf.clear()
+            self._dropped = 0
+
+    # ---------------------------------------------------------- export
+    def chrome_trace(self, process_name="paddle_tpu"):
+        """The trace as a dict in Chrome Trace Event JSON format:
+        complete ("X") events in microseconds with stable pid/tid,
+        plus process/thread-name metadata events. Load with
+        chrome://tracing or ui.perfetto.dev."""
+        spans = self.spans()
+        pid = self._pid
+        events = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": process_name},
+        }]
+        for tid in sorted({s.tid for s in spans}):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": tid, "args": {"name": f"host-{tid}"},
+            })
+        for s in spans:
+            ev = {
+                "name": s.name, "ph": "X", "cat": "host",
+                "ts": round(s.t0 * 1e6, 3),
+                "dur": round(s.dur * 1e6, 3),
+                "pid": pid, "tid": s.tid,
+            }
+            if s.args:
+                ev["args"] = dict(s.args)
+            events.append(ev)
+        # deterministic viewer order: by start time, metadata first
+        events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"recorder": "paddle_tpu.observability",
+                              "dropped_spans": self._dropped}}
+
+    def dump_chrome_trace(self, path, process_name="paddle_tpu"):
+        """Write the chrome trace JSON to ``path``; returns the path."""
+        with open(path, "w") as fh:
+            json.dump(self.chrome_trace(process_name), fh)
+        return path
+
+
+_default_recorder = HostSpanRecorder()
+
+
+def default_recorder():
+    """The process-global recorder profiler.record_scope feeds."""
+    return _default_recorder
+
+
+class span_timer:
+    """Context manager recording one span into a recorder — the
+    non-profiler entry point (record_scope is the instrumented path;
+    this is for host-only phases that must not touch jax)."""
+
+    def __init__(self, name, recorder=None, args=None):
+        self.name = name
+        self.recorder = recorder or _default_recorder
+        self.args = args
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.recorder.record(self.name, self._t0,
+                             time.perf_counter() - self._t0, self.args)
+        return False

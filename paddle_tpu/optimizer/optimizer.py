@@ -13,6 +13,7 @@ tensor outside the trace.
 """
 import jax.numpy as jnp
 
+from .. import profiler as _profiler
 from ..core.tensor import Tensor
 from ..core.dispatch import no_grad
 from .lr import LRScheduler
@@ -89,6 +90,14 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        # the optimizer/step scope shows up in the XLA trace, the
+        # chrome host timeline and the registry span counters (see
+        # paddle_tpu.observability) — the training-loop counterpart of
+        # the serving engine's serving/* scopes
+        with _profiler.record_scope("optimizer/step"):
+            self._step_impl()
+
+    def _step_impl(self):
         params_grads = [(p, p._grad) for p in self._parameter_list()
                         if p._grad is not None and p.trainable]
         if self._grad_clip is not None:

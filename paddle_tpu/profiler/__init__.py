@@ -6,11 +6,31 @@ jax.profiler (XPlane) captures real device timelines viewable in
 TensorBoard / Perfetto; RecordEvent lowers to jax.profiler.TraceAnnotation
 + jax.named_scope so op metadata reaches the XLA trace, the analogue of
 the reference's NVTX/CUPTI annotations.
+
+record_scope is the framework's single instrumentation point with
+THREE sinks (see paddle_tpu.observability): the XPlane annotation
+above, the bounded host-span ring buffer (chrome://tracing dump), and
+the process metrics registry (per-scope seconds/calls, Prometheus
+text) — so a scope placed once in the serving engine or the hapi
+training loop shows up in the device timeline, the host timeline, and
+the dashboard.
 """
 import contextlib
 import time
 
 import jax
+
+from ..observability import registry as _obs_registry
+from ..observability import tracing as _obs_tracing
+
+# framework-wide per-scope accrual (the "dashboard" sink): seconds and
+# call count per scope name, in the process-global registry
+_span_seconds = _obs_registry.default_registry().counter(
+    "host_span_seconds_total",
+    "wall seconds accrued per record_scope name", labelnames=("span",))
+_span_calls = _obs_registry.default_registry().counter(
+    "host_span_calls_total",
+    "record_scope completions per scope name", labelnames=("span",))
 
 
 class RecordEvent:
@@ -39,17 +59,25 @@ class RecordEvent:
 
 @contextlib.contextmanager
 def record_scope(name, sink=None):
-    """RecordEvent + wall-clock measurement in one scope: annotates the
-    XLA trace (TraceAnnotation + named_scope, visible in a live XPlane
-    capture) AND reports elapsed seconds to ``sink(name, dt)``. The
-    hook the serving metrics (paddle_tpu.serving.metrics) hang their
-    prefill/decode/compile accounting on — one instrumentation point
-    feeds both the device timeline and the throughput counters."""
+    """One scope, three sinks. Entering annotates the XLA trace
+    (TraceAnnotation + named_scope, visible in a live XPlane capture);
+    exiting records the span into the bounded host-span ring buffer
+    (observability.default_recorder(), dumpable as a chrome://tracing
+    timeline) and accrues seconds + a call count into the process
+    metrics registry (observability.default_registry(), scrapeable as
+    Prometheus text). An optional ``sink(name, dt)`` callback receives
+    the same elapsed seconds — the hook the serving metrics
+    (paddle_tpu.serving.metrics) hang their per-engine prefill/decode/
+    compile accounting on."""
     t0 = time.perf_counter()
     with RecordEvent(name):
         yield
+    dt = time.perf_counter() - t0
+    _obs_tracing.default_recorder().record(name, t0, dt)
+    _span_seconds.labels(name).inc(dt)
+    _span_calls.labels(name).inc()
     if sink is not None:
-        sink(name, time.perf_counter() - t0)
+        sink(name, dt)
 
 
 class ProfilerState:
@@ -171,12 +199,19 @@ class Profiler:
             self._sync_trace()
 
     def step_info(self, unit=None):
+        """Step-time summary string; ``unit`` selects milliseconds
+        ("ms", default) or seconds ("s")."""
+        unit = "ms" if unit is None else str(unit).lower()
+        if unit not in ("ms", "s"):
+            raise ValueError(f"unit must be 'ms' or 's', got {unit!r}")
         if not self._step_times:
             return "no steps recorded"
         import numpy as np
         arr = np.asarray(self._step_times[1:] or self._step_times)
-        return (f"avg step {arr.mean() * 1000:.3f} ms, "
-                f"min {arr.min() * 1000:.3f} ms, max {arr.max() * 1000:.3f} ms")
+        scale = 1000.0 if unit == "ms" else 1.0
+        return (f"avg step {arr.mean() * scale:.3f} {unit}, "
+                f"min {arr.min() * scale:.3f} {unit}, "
+                f"max {arr.max() * scale:.3f} {unit}")
 
     def __enter__(self):
         self.start()

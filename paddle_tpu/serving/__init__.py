@@ -28,14 +28,35 @@ math into a multi-tenant server:
   * **step scheduler** (scheduler.StepScheduler) — FIFO queue,
     same-bucket group admission on free slots, per-slot EOS/max-token
     stops, streaming token callbacks;
-  * **metrics** (metrics.ServingMetrics) — tokens/sec, TTFT, queue
-    depth, slot occupancy, prefill-group histogram, KV-donation
-    status, dispatch-vs-sync wall split and an exact compile counter,
-    with every timed span routed through paddle_tpu.profiler;
-  * zero-recompile steady state BY CONSTRUCTION: all device work runs
-    ahead-of-time compiled executables (engine.ServingEngine), and the
-    whole-lifetime compiled-program inventory is bounded by
-    ``len(buckets) * len(group_sizes) + 1``.
+  * **metrics** (metrics.ServingMetrics) — a thin facade over a
+    paddle_tpu.observability MetricsRegistry: tokens/sec, TTFT /
+    request-latency / queue-wait percentiles (bounded histograms +
+    fixed-size reservoirs — no unbounded lists under sustained
+    traffic), queue depth, slot occupancy, prefill-group histogram,
+    KV-donation status, dispatch-vs-sync wall split and an exact
+    compile counter. Every timed section uses the ONE-SCOPE-THREE-
+    SINKS discipline (paddle_tpu.profiler.record_scope): the same
+    ``serving/*`` scope is (1) annotated into the XLA trace for live
+    XPlane captures, (2) recorded into the bounded host-span ring
+    buffer — dump the engine-step anatomy (retirement → admission →
+    grouped prefill → decode dispatch → harvest) as a chrome://tracing
+    / Perfetto timeline via
+    ``observability.default_recorder().dump_chrome_trace(path)`` —
+    and (3) accrued into the registry for the snapshot()/Prometheus
+    numbers. Scrape with ``server = engine.serve_metrics()`` then
+    ``GET http://127.0.0.1:<port>/metrics`` (Prometheus text) or
+    ``/metrics.json`` (the snapshot schema);
+  * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
+    (engine.ServingEngine): all device work runs ahead-of-time
+    compiled executables, the whole-lifetime compiled-program
+    inventory is bounded by ``len(buckets) * len(group_sizes) + 1``,
+    and every build is logged in a compile watchdog
+    (``engine.watchdog``) with its abstract-shape signature and
+    dispatch call-site. After ``engine.declare_warmup()`` any further
+    compile is flagged in ``watchdog.report()`` — or raised
+    immediately with ``ServingConfig(watchdog_mode="raise")`` — so a
+    production recompile is an attributed alarm, not a silent counter
+    drift.
 
 Tuning knobs
 ------------
@@ -64,6 +85,10 @@ Tuning knobs
 ``donate_buffers``
                 None (default) = donate kc/vc/pos where the backend
                 aliases donated buffers (TPU/GPU); True/False forces.
+``watchdog_mode``
+                "flag" (default) records post-warmup compiles in
+                ``engine.watchdog.report()``; "raise" turns them into
+                CompileAfterWarmupError at the offending dispatch.
 ``eos_id``      default stop token (per-request override on
                 add_request).
 

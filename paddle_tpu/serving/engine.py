@@ -49,6 +49,7 @@ import warnings
 
 import numpy as np
 
+from ..observability import CompileWatchdog, abstract_signature
 from .kv_pool import SlotKVPool
 from .metrics import ServingMetrics
 from .scheduler import RUNNING, Request, StepScheduler
@@ -102,7 +103,8 @@ class ServingConfig:
 
     def __init__(self, num_slots=8, max_len=None, buckets=None,
                  bucket_min=32, eos_id=None, prefill_group_sizes=None,
-                 async_depth=1, donate_buffers=None):
+                 async_depth=1, donate_buffers=None,
+                 watchdog_mode="flag"):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -121,6 +123,10 @@ class ServingConfig:
         # turns it off there. Force True to exercise the donation
         # discipline (rebind correctness) on any backend.
         self.donate_buffers = donate_buffers
+        # compile-watchdog behavior once declare_warmup() has been
+        # called: "flag" records steady-state compiles in the report,
+        # "raise" hard-fails at the offending compile (tests/canaries)
+        self.watchdog_mode = watchdog_mode
 
 
 class ServingEngine:
@@ -170,6 +176,7 @@ class ServingEngine:
             cfg.hidden_size // cfg.num_heads)
         self.scheduler = StepScheduler(buckets, cache_len)
         self.metrics = ServingMetrics()
+        self.watchdog = CompileWatchdog(mode=config.watchdog_mode)
         self._exec = {}  # (kind, bucket?, group?) -> XLA executable
 
         import jax
@@ -213,11 +220,16 @@ class ServingEngine:
     def _compiled(self, key, fn, args, donate=()):
         """AOT compile-once table. The ONLY place executables are
         built; metrics.compiles is therefore an exact compile counter
-        for the whole engine. ``donate`` argnums are recorded in the
+        for the whole engine, and every build is logged in the compile
+        watchdog with its abstract-shape signature and the dispatch
+        call-site that triggered it (skip=1 walks past this helper) —
+        after declare_warmup() a build here is a flagged/raised
+        steady-state violation. ``donate`` argnums are recorded in the
         lowered program (in-place cache updates on TPU/GPU)."""
         ex = self._exec.get(key)
         if ex is None:
             import jax
+            self.watchdog.record(key, abstract_signature(args), skip=1)
             if not self._donate:
                 donate = ()
             with self.metrics.span("serving/compile"):
@@ -226,6 +238,22 @@ class ServingEngine:
             self._exec[key] = ex
             self.metrics.compiles += 1
         return ex
+
+    def declare_warmup(self):
+        """Declare warmup complete: the compiled-executable inventory
+        is final, and any further compile is an attributed steady-state
+        violation (flagged in ``watchdog.report()``, or raised when
+        the engine was built with watchdog_mode="raise")."""
+        self.watchdog.declare_warmup_complete()
+
+    def serve_metrics(self, port=0, addr="127.0.0.1"):
+        """Expose this engine's metrics registry over HTTP: GET
+        /metrics (Prometheus text) and /metrics.json (the snapshot
+        schema). Returns the stdlib server; ``server_address[1]`` is
+        the bound port, ``shutdown()`` stops it."""
+        from ..observability import start_metrics_server
+        return start_metrics_server(self.metrics.registry, port=port,
+                                    addr=addr)
 
     # -------------------------------------------------------------- step
 
@@ -286,15 +314,34 @@ class ServingEngine:
 
         Returns True while work remains. With async_depth=0 every
         dispatch is harvested immediately (the synchronous PR-1
-        schedule)."""
+        schedule).
+
+        Each phase runs in its own ``serving/*`` scope nested under
+        ``serving/step``, so the step anatomy (retirement → admission
+        → grouped prefill → decode dispatch → harvest) is readable in
+        the chrome host timeline
+        (observability.default_recorder().dump_chrome_trace()) as well
+        as the XPlane capture and the span counters."""
+        with self.metrics.span("serving/step"):
+            return self._step_inner()
+
+    def _step_inner(self):
         sch, pool, M = self.scheduler, self.pool, self.metrics
         sync = self.config.async_depth == 0
         prev, self._pending = self._pending, []
 
-        for req in [r for r in sch.active.values() if sch.saturated(r)]:
-            sch.prerelease(req, pool)
+        with M.span("serving/retirement"):
+            for req in [r for r in sch.active.values()
+                        if sch.saturated(r)]:
+                sch.prerelease(req, pool)
 
-        for group in sch.admit(pool, self.group_sizes):
+        with M.span("serving/admit"):
+            groups = sch.admit(pool, self.group_sizes)
+            for group in groups:
+                for req, _slot in group:
+                    M.record_admission(req)
+
+        for group in groups:
             G = len(group)
             M.requests_admitted += G
             bucket = sch.bucket_for(len(group[0][0].prompt))
@@ -317,8 +364,7 @@ class ServingEngine:
             pool.rebind(kc, vc)
             M.prefills += 1
             M.prefill_requests += G
-            M.prefill_group_hist[G] = \
-                M.prefill_group_hist.get(G, 0) + 1
+            M.record_prefill_group(G)
             if sync:
                 self._harvest([("prefill", first, group)])
             else:
@@ -343,7 +389,8 @@ class ServingEngine:
             else:
                 self._pending.append(("decode", nxt, snapshot))
 
-        self._harvest(prev)
+        with M.span("serving/harvest"):
+            self._harvest(prev)
 
         M.queue_depth = len(sch.queue)
         M.slot_occupancy = pool.occupancy

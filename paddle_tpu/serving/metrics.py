@@ -1,58 +1,195 @@
 """Serving metrics: throughput, TTFT, queue depth, slot occupancy,
-compile counter.
+compile counter — a thin facade over an observability MetricsRegistry.
 
-Timed sections route through paddle_tpu.profiler.record_scope, so every
-prefill / decode / compile span is simultaneously (a) accumulated here
-for the snapshot() numbers and (b) annotated into the XLA trace when a
-jax.profiler capture is active — one instrumentation point feeds both
-the serving dashboard and the device timeline.
+Every number lives in a per-engine paddle_tpu.observability registry
+(counters / gauges / fixed-bucket histograms), so one accounting point
+feeds BOTH the stable ``snapshot()`` dict the bench artifacts pin AND
+Prometheus text exposition (``prometheus_text()``, served over HTTP by
+``ServingEngine.serve_metrics()``). The legacy attribute surface
+(``metrics.compiles += 1`` etc.) is preserved via properties so the
+engine's hot path reads exactly as before.
+
+Latency series are BOUNDED: TTFT / request latency / queue wait each
+record into a fixed-bucket histogram (Prometheus view, exact avg)
+plus a fixed-size uniform reservoir (exact p50/p90/p99 over a sampled
+window) — replacing the unbounded Python lists that leaked memory
+under sustained traffic. ``snapshot()["latency_percentiles"]`` carries
+the percentiles.
+
+Timed sections route through paddle_tpu.profiler.record_scope, so
+every span is simultaneously (a) accrued here for snapshot(), (b)
+annotated into the XLA trace when an XPlane capture is live, and (c)
+recorded into the host-span ring buffer for the chrome://tracing
+timeline — one scope, three sinks.
 """
 import time
 
 from .. import profiler as _profiler
+from ..observability import MetricsRegistry, Reservoir
+
+# serving latencies are sub-ms (CPU smoke) to tens of seconds (deep
+# queues on big models) — the default time buckets cover that span
+_PCTS = ((50, "p50_ms"), (90, "p90_ms"), (99, "p99_ms"))
+
+
+def _counter_property(attr):
+    def get(self):
+        v = getattr(self, attr).value
+        return int(v) if float(v).is_integer() else v
+
+    def set_(self, value):
+        getattr(self, attr).set_to(value)
+
+    return property(get, set_)
 
 
 class ServingMetrics:
-    def __init__(self):
-        self.compiles = 0            # XLA executables built (ever)
-        self.prefills = 0            # prefill dispatches (one per group)
-        self.prefill_requests = 0    # requests prefilled (sum of G)
-        self.prefill_group_hist = {} # group size G -> dispatch count
-        self.decode_steps = 0
-        self.tokens_generated = 0
-        self.speculative_masked = 0  # pipelined tokens discarded at
-                                     # harvest (request stopped while
-                                     # its next step was in flight)
+    """Engine-scoped metrics facade. ``registry`` defaults to a fresh
+    MetricsRegistry per engine (pass a shared one to aggregate several
+    engines into a single /metrics endpoint)."""
+
+    RESERVOIR_SIZE = 1024
+
+    def __init__(self, registry=None):
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        r = self.registry
+        self._c_compiles = r.counter(
+            "serving_compiles_total", "XLA executables built (ever)")
+        self._c_prefills = r.counter(
+            "serving_prefill_dispatches_total",
+            "prefill dispatches (one per group)")
+        self._c_prefill_requests = r.counter(
+            "serving_prefill_requests_total",
+            "requests prefilled (sum of group sizes)")
+        self._c_decode_steps = r.counter(
+            "serving_decode_steps_total", "pooled decode dispatches")
+        self._c_tokens = r.counter(
+            "serving_tokens_generated_total", "tokens emitted")
+        self._c_spec_masked = r.counter(
+            "serving_speculative_masked_total",
+            "pipelined tokens discarded at harvest (request stopped "
+            "while its next step was in flight)")
+        self._c_admitted = r.counter(
+            "serving_requests_admitted_total", "requests admitted")
+        self._c_completed = r.counter(
+            "serving_requests_completed_total", "requests completed")
+        self._g_queue_depth = r.gauge(
+            "serving_queue_depth", "queued requests (per engine step)")
+        self._g_occupancy = r.gauge(
+            "serving_slot_occupancy", "live slots / num_slots")
+        self._c_groups = r.counter(
+            "serving_prefill_groups_total",
+            "prefill dispatches by group size",
+            labelnames=("group_size",))
+        self._c_span = r.counter(
+            "serving_span_seconds_total",
+            "wall seconds accrued per engine scope",
+            labelnames=("span",))
+        self._h_ttft = r.histogram(
+            "serving_ttft_seconds", "arrival -> first token")
+        self._h_latency = r.histogram(
+            "serving_request_latency_seconds", "arrival -> done")
+        self._h_queue_wait = r.histogram(
+            "serving_queue_wait_seconds", "arrival -> slot admission")
+        self._res = {
+            "ttft": Reservoir(self.RESERVOIR_SIZE),
+            "request_latency": Reservoir(self.RESERVOIR_SIZE),
+            "queue_wait": Reservoir(self.RESERVOIR_SIZE),
+        }
         self.kv_donation = {"enabled": False, "effective": False}
-        self.requests_admitted = 0
-        self.requests_completed = 0
-        self.queue_depth = 0         # gauge: updated each engine step
-        self.slot_occupancy = 0.0    # gauge: live slots / num_slots
-        self.ttft_s = []             # per request: arrival -> 1st token
-        self.request_latency_s = []  # per request: arrival -> done
-        self.span_s = {}             # section name -> accumulated secs
         self._t_first_work = None
         self._t_last_work = None
 
+    # ------------------------------------------- legacy attribute facade
+    compiles = _counter_property("_c_compiles")
+    prefills = _counter_property("_c_prefills")
+    prefill_requests = _counter_property("_c_prefill_requests")
+    decode_steps = _counter_property("_c_decode_steps")
+    tokens_generated = _counter_property("_c_tokens")
+    speculative_masked = _counter_property("_c_spec_masked")
+    requests_admitted = _counter_property("_c_admitted")
+    requests_completed = _counter_property("_c_completed")
+
+    @property
+    def queue_depth(self):
+        return int(self._g_queue_depth.value)
+
+    @queue_depth.setter
+    def queue_depth(self, value):
+        self._g_queue_depth.set(value)
+
+    @property
+    def slot_occupancy(self):
+        return self._g_occupancy.value
+
+    @slot_occupancy.setter
+    def slot_occupancy(self, value):
+        self._g_occupancy.set(value)
+
+    @property
+    def prefill_group_hist(self):
+        """group size G -> dispatch count (read-only view of the
+        labeled counter; mutate via record_prefill_group)."""
+        fam = self._c_groups
+        return {int(labels[0]): int(child.value)
+                for labels, child in fam.series()}
+
+    @property
+    def span_s(self):
+        """section name -> accumulated seconds (read-only view)."""
+        return {labels[0]: child.value
+                for labels, child in self._c_span.series()}
+
+    @property
+    def ttft_s(self):
+        """BOUNDED reservoir view of per-request TTFT seconds (the
+        unbounded list this replaced leaked under sustained traffic);
+        exact totals live in the serving_ttft_seconds histogram."""
+        return list(self._res["ttft"].samples())
+
+    @property
+    def request_latency_s(self):
+        return list(self._res["request_latency"].samples())
+
+    # ------------------------------------------------------- accounting
     def span(self, name):
-        """Context manager: profiler trace annotation + wall accrual."""
+        """Context manager: XPlane annotation + chrome host span +
+        registry accrual (via profiler.record_scope's three sinks) +
+        this engine's own span counter."""
         return _profiler.record_scope(name, sink=self._accrue)
 
     def _accrue(self, name, dt):
-        self.span_s[name] = self.span_s.get(name, 0.0) + dt
+        self._c_span.labels(name).inc(dt)
         now = time.perf_counter()
         if self._t_first_work is None:
             self._t_first_work = now - dt
         self._t_last_work = now
 
+    def record_prefill_group(self, group_size):
+        self._c_groups.labels(str(int(group_size))).inc()
+
+    def record_admission(self, request):
+        """Queue-wait accounting at slot-claim time (the scheduler
+        stamps request.t_admitted in admit())."""
+        if request.t_admitted is not None:
+            wait = request.t_admitted - request.t_arrival
+            self._h_queue_wait.observe(wait)
+            self._res["queue_wait"].add(wait)
+
     def record_first_token(self, request):
         request.t_first_token = time.perf_counter()
-        self.ttft_s.append(request.t_first_token - request.t_arrival)
+        ttft = request.t_first_token - request.t_arrival
+        self._h_ttft.observe(ttft)
+        self._res["ttft"].add(ttft)
 
     def record_completion(self, request):
-        self.requests_completed += 1
-        self.request_latency_s.append(request.t_done - request.t_arrival)
+        self._c_completed.inc()
+        latency = request.t_done - request.t_arrival
+        self._h_latency.observe(latency)
+        self._res["request_latency"].add(latency)
 
+    # --------------------------------------------------------- derived
     def tokens_per_sec(self):
         """Generated tokens over the busy window (first to last timed
         span) — the serving throughput headline."""
@@ -66,18 +203,40 @@ class ServingMetrics:
         BLOCKED on device->host reads. The pipelined hot path's whole
         point is pushing time out of sync and letting it overlap the
         dispatch column."""
-        dispatch = sum(v for k, v in self.span_s.items()
+        spans = self.span_s
+        dispatch = sum(v for k, v in spans.items()
                        if k.endswith("_dispatch"))
-        return dispatch, self.span_s.get("serving/sync", 0.0)
+        return dispatch, spans.get("serving/sync", 0.0)
+
+    def latency_percentiles(self):
+        """{"ttft": {...}, "request_latency": {...}, "queue_wait":
+        {...}} — count + p50/p90/p99 in ms from the bounded
+        reservoirs (None when the series is empty)."""
+        out = {}
+        for name, res in self._res.items():
+            entry = {"count": res.seen}
+            for q, key in _PCTS:
+                p = res.percentile(q)
+                entry[key] = None if p is None else round(p * 1000.0, 3)
+            out[name] = entry
+        return out
+
+    def prometheus_text(self):
+        """This engine's registry in Prometheus text exposition format
+        (also served over HTTP by ServingEngine.serve_metrics())."""
+        return self.registry.prometheus_text()
 
     def snapshot(self):
-        n_ttft = len(self.ttft_s)
+        """The stable dict the bench artifacts embed. Schema is a
+        CONTRACT (tests/test_observability.py pins the key set): keys
+        only get added, never renamed/removed within a PR sequence."""
+        n_ttft = self._h_ttft.count
         dispatch_s, sync_s = self.dispatch_sync_split()
         return {
             "tokens_generated": self.tokens_generated,
             "tokens_per_sec": round(self.tokens_per_sec(), 2),
             "ttft_avg_ms": round(
-                sum(self.ttft_s) / n_ttft * 1000.0, 3) if n_ttft else None,
+                self._h_ttft.sum / n_ttft * 1000.0, 3) if n_ttft else None,
             "queue_depth": self.queue_depth,
             "slot_occupancy": round(self.slot_occupancy, 4),
             "prefills": self.prefills,
@@ -93,4 +252,5 @@ class ServingMetrics:
             "dispatch_s": round(dispatch_s, 4),
             "sync_s": round(sync_s, 4),
             "span_s": {k: round(v, 4) for k, v in self.span_s.items()},
+            "latency_percentiles": self.latency_percentiles(),
         }

@@ -43,7 +43,11 @@ class Request:
         self.slot = None
         self.generated = []
         self.inflight = 0   # tokens dispatched on device, not yet read
+        # lifecycle timestamps (perf_counter clock): arrival ->
+        # admission (slot claimed) -> first token -> done. The deltas
+        # feed ServingMetrics' queue-wait / TTFT / latency histograms.
         self.t_arrival = time.perf_counter()
+        self.t_admitted = None
         self.t_first_token = None
         self.t_done = None
 
@@ -115,6 +119,7 @@ class StepScheduler:
             slot = pool.acquire(req.rid)
             req.slot = slot
             req.state = RUNNING
+            req.t_admitted = time.perf_counter()
             self.active[slot] = req
             by_bucket.setdefault(self.bucket_for(len(req.prompt)),
                                  []).append((req, slot))

@@ -79,6 +79,18 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         assert set(snap["kv_donation"]) == {"enabled", "effective"}
         assert snap["dispatch_s"] >= 0 and snap["sync_s"] >= 0
         assert snap["prefill_requests"] >= snap["prefills"] > 0
+        # PR 3 observability sections: latency percentiles from the
+        # bounded reservoirs, and the attributed compile log
+        lp = evidence["latency_percentiles"]
+        assert set(lp) == {"ttft", "request_latency", "queue_wait"}
+        for entry in lp.values():
+            assert set(entry) == {"count", "p50_ms", "p90_ms", "p99_ms"}
+            assert entry["count"] > 0
+            assert entry["p50_ms"] <= entry["p90_ms"] <= entry["p99_ms"]
+        wd = evidence["watchdog"]
+        assert wd["compiles_total"] == snap["compiles"] > 0
+        assert all(e["call_site"] and e["signature"]
+                   for e in wd["events"])   # every compile attributed
         dq = evidence["deep_queue"]
         assert dq["group_sizes_used"] and \
             max(dq["group_sizes_used"]) > 1   # grouped prefill fired
@@ -87,6 +99,12 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         assert dq["vs_pr1_engine"] > 0
         assert dq["steady_state_new_compiles"] == 0
         assert last["deep_queue_vs_pr1"] == dq["vs_pr1_engine"]
+        # the deep-queue engine declared warmup after its first drain,
+        # so its watchdog section IS the zero-recompile invariant
+        dq_wd = dq["watchdog"]
+        assert dq_wd["warmed"] is True
+        assert dq_wd["steady_state_compiles"] == 0
+        assert dq["latency_percentiles"]["ttft"]["count"] > 0
         # any earlier lines are provisional cached ones, marked so
         for ln in lines[:-1]:
             assert ln["source"] == "cached" and "note" in ln

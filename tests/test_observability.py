@@ -1,0 +1,474 @@
+"""Unified observability layer (paddle_tpu.observability): metrics
+registry + Prometheus exposition, bounded host-span chrome tracing,
+and the compile watchdog — including the serving-engine integration
+(snapshot schema contract, zero steady-state recompiles as an
+ATTRIBUTED invariant, induced shape drift flagged with its call-site).
+
+Acceptance criteria pinned here: the emitted chrome trace is valid
+JSON with nesting spans and stable pid/tids; Prometheus text parses
+(TYPE/HELP lines, label escaping); every engine compile is attributed.
+"""
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler as prof_mod
+from paddle_tpu.observability import (
+    CompileAfterWarmupError, CompileWatchdog, HostSpanRecorder,
+    MetricsRegistry, Reservoir, abstract_signature, start_metrics_server,
+    watch_jax_lowering,
+)
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _drive(eng, rs, specs):
+    for n, k in specs:
+        eng.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                        max_new_tokens=k)
+    eng.run()
+
+
+# --------------------------------------------------------------- registry
+
+def test_counter_gauge_histogram_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)            # counters are monotone
+    g = reg.gauge("depth", "queue depth")
+    g.set(7)
+    g.dec(2)
+    assert g.value == 5
+    h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 2.0):
+        h.observe(v)
+    assert h.count == 3 and h.sum == pytest.approx(2.55)
+    # re-registration returns the same family; kind mismatch raises
+    assert reg.counter("reqs_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("reqs_total")
+
+
+def test_labeled_families_and_snapshot_stability():
+    reg = MetricsRegistry()
+    c = reg.counter("rpc_total", "calls", labelnames=("route", "code"))
+    c.labels("generate", "200").inc(3)
+    c.labels(route="health", code="500").inc()
+    with pytest.raises(ValueError):
+        c.inc()              # labeled family needs .labels(...)
+    with pytest.raises(ValueError):
+        c.labels("only-one")
+    snap = reg.snapshot()
+    assert snap["rpc_total"]["type"] == "counter"
+    assert snap["rpc_total"]["values"]["route=generate,code=200"] == 3
+    # snapshot is stable JSON: serializable and key-sorted reproducible
+    assert json.loads(reg.snapshot_json()) == json.loads(
+        reg.snapshot_json())
+
+
+def test_registry_thread_safety():
+    reg = MetricsRegistry()
+    c = reg.counter("n_total")
+    h = reg.histogram("v_seconds", buckets=(0.5,))
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.25)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_prometheus(text):
+    """Minimal format-0.0.4 parser: returns (types, samples)."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+        elif line.startswith("# HELP "):
+            assert line.split(" ", 3)[2]  # named help line
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            labels = dict(
+                (k, v) for k, v in _LABEL_RE.findall(m.group(3) or ""))
+            samples.append((m.group(1), labels, float(m.group(4))))
+    return types, samples
+
+
+def test_prometheus_text_parses_with_label_escaping():
+    reg = MetricsRegistry()
+    c = reg.counter("odd_total", "weird labels", labelnames=("k",))
+    nasty = 'a"b\\c\nd'
+    c.labels(nasty).inc(2)
+    reg.gauge("g", "a gauge").set(1.5)
+    reg.histogram("h_seconds", "hist", buckets=(0.01, 1.0)).observe(0.5)
+    types, samples = _parse_prometheus(reg.prometheus_text())
+    assert types == {"odd_total": "counter", "g": "gauge",
+                     "h_seconds": "histogram"}
+    # the escaped label value round-trips through the parser
+    (name, labels, value), = [s for s in samples if s[0] == "odd_total"]
+    unescaped = (labels["k"].replace("\\\\", "\0").replace('\\"', '"')
+                 .replace("\\n", "\n").replace("\0", "\\"))
+    assert unescaped == nasty and value == 2
+    # histogram exposition: cumulative le buckets ending at +Inf, with
+    # the _sum/_count pair
+    hb = [(s[1]["le"], s[2]) for s in samples if s[0] == "h_seconds_bucket"]
+    assert [b for b, _ in hb] == ["0.01", "1", "+Inf"]
+    assert [c for _, c in hb] == [0.0, 1.0, 1.0]  # cumulative
+    assert ("h_seconds_count", {}, 1.0) in samples
+    # every sample belongs to a TYPEd family
+    for name, _, _ in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or base in types
+
+
+def test_metric_name_validation():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError):
+        reg.counter("9starts_with_digit")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", labelnames=("bad-label",))
+
+
+def test_reservoir_bounded_and_percentiles():
+    res = Reservoir(capacity=100)
+    for v in range(10000):
+        res.add(float(v))
+    assert len(res.samples()) == 100       # bounded under 100x overflow
+    assert res.seen == 10000
+    # uniform sample of 0..9999: median lands near 5000
+    assert 2500 < res.percentile(50) < 7500
+    assert res.percentile(0) >= 0 and res.percentile(100) <= 9999
+    empty = Reservoir(4)
+    assert empty.percentile(50) is None
+
+
+def test_http_metrics_endpoint():
+    reg = MetricsRegistry()
+    reg.counter("served_total", "hits").inc(5)
+    server = start_metrics_server(reg, port=0)
+    try:
+        port = server.server_address[1]
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        types, samples = _parse_prometheus(text)
+        assert ("served_total", {}, 5.0) in samples
+        js = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=10).read())
+        assert js["served_total"]["values"][""] == 5
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------- host tracing
+
+def test_ring_buffer_is_bounded():
+    rec = HostSpanRecorder(capacity=4)
+    for i in range(10):
+        rec.record(f"s{i}", t0=float(i), dur=0.5)
+    assert len(rec) == 4
+    assert [s.name for s in rec.spans()] == ["s6", "s7", "s8", "s9"]
+    assert rec.dropped == 6
+    rec.clear()
+    assert len(rec) == 0 and rec.dropped == 0
+
+
+def test_record_scope_feeds_three_sinks():
+    """One record_scope: XPlane annotation (not assertable without a
+    live capture — covered by test_profiler), host span ring buffer,
+    and the default-registry span counters."""
+    rec = obs.default_recorder()
+    reg = obs.default_registry()
+    rec.clear()
+    calls_before = reg.get("host_span_calls_total") \
+        .labels("obs_test/scope").value
+    with prof_mod.record_scope("obs_test/scope"):
+        with prof_mod.record_scope("obs_test/inner"):
+            pass
+    names = [s.name for s in rec.spans()]
+    assert "obs_test/scope" in names and "obs_test/inner" in names
+    assert reg.get("host_span_calls_total") \
+        .labels("obs_test/scope").value == calls_before + 1
+    assert reg.get("host_span_seconds_total") \
+        .labels("obs_test/scope").value > 0
+
+
+def _overlap_partially(a, b):
+    """True if events a and b overlap without containment."""
+    a0, a1 = a["ts"], a["ts"] + a["dur"]
+    b0, b1 = b["ts"], b["ts"] + b["dur"]
+    if a1 <= b0 or b1 <= a0:
+        return False                       # disjoint
+    eps = 0.5                              # us rounding slack
+    contained = (a0 >= b0 - eps and a1 <= b1 + eps) or \
+        (b0 >= a0 - eps and b1 <= a1 + eps)
+    return not contained
+
+
+def test_chrome_trace_valid_nesting_stable_pids(tmp_path):
+    """Acceptance: the engine's chrome trace is valid JSON, every X
+    event carries name/ts/dur/pid/tid, pid is stable, and spans on a
+    thread either nest or are disjoint — with real serving/step >
+    serving/harvest > serving/sync containment present."""
+    rec = obs.default_recorder()
+    rec.clear()
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    _drive(eng, np.random.RandomState(0), [(5, 4), (9, 5), (12, 3)])
+    path = str(tmp_path / "host_trace.json")
+    eng_trace = rec.dump_chrome_trace(path)
+    with open(eng_trace) as fh:
+        trace = json.load(fh)              # valid JSON
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert xs, "no spans captured"
+    for e in xs:
+        assert e["name"] and e["dur"] >= 0 and e["ts"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    assert len({e["pid"] for e in xs}) == 1          # stable pid
+    # metadata names the process/threads (Perfetto track labels)
+    metas = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    # spans nest: no partial overlap on any thread
+    by_tid = {}
+    for e in xs:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid_events in by_tid.values():
+        tid_events.sort(key=lambda e: e["ts"])
+        for i, a in enumerate(tid_events):
+            for b in tid_events[i + 1:]:
+                if b["ts"] >= a["ts"] + a["dur"]:
+                    break
+                assert not _overlap_partially(a, b), (a, b)
+    # real containment: a serving/sync span inside a serving/step span
+    steps = [e for e in xs if e["name"] == "serving/step"]
+    syncs = [e for e in xs if e["name"] == "serving/sync"]
+    assert steps and syncs
+    assert any(s["ts"] >= t["ts"] and
+               s["ts"] + s["dur"] <= t["ts"] + t["dur"] + 0.5
+               for s in syncs for t in steps), "sync never nested in step"
+
+
+# --------------------------------------------------------------- watchdog
+
+def test_watchdog_flags_after_warmup_with_attribution():
+    wd = CompileWatchdog()
+    wd.record("k1", "f32[8]")
+    assert not wd.report()["steady_state_compiles"]
+    wd.declare_warmup_complete()
+    ev = wd.record("k2", "f32[16]")
+    assert ev["steady_state"]
+    rep = wd.report()
+    assert rep["compiles_total"] == 2
+    assert rep["warmup_compiles"] == 1
+    assert rep["steady_state_compiles"] == 1
+    viol = rep["steady_state_events"][0]
+    assert viol["key"] == "k2" and viol["signature"] == "f32[16]"
+    # default call-site attribution: this test file, this function
+    assert "test_observability.py" in viol["call_site"]
+    assert "test_watchdog_flags_after_warmup" in viol["call_site"]
+
+
+def test_watchdog_raise_mode():
+    wd = CompileWatchdog(mode="raise")
+    wd.record("k", "sig")
+    wd.declare_warmup_complete()
+    with pytest.raises(CompileAfterWarmupError) as ei:
+        wd.record("k2", "f32[4,4]")
+    msg = str(ei.value)
+    assert "k2" in msg and "f32[4,4]" in msg and \
+        "test_observability.py" in msg
+    with pytest.raises(ValueError):
+        CompileWatchdog(mode="explode")
+
+
+def test_abstract_signature_distinguishes_shapes():
+    import jax.numpy as jnp
+    a = (jnp.zeros((4, 8), jnp.float32), jnp.zeros((3,), jnp.int32))
+    b = (jnp.zeros((4, 9), jnp.float32), jnp.zeros((3,), jnp.int32))
+    sa, sb = abstract_signature(a), abstract_signature(b)
+    assert sa != sb
+    assert sa == abstract_signature(
+        (jnp.ones((4, 8), jnp.float32), jnp.ones((3,), jnp.int32)))
+    assert "float32[4,8]" in sa and "int32[3]" in sa
+
+
+def test_watch_jax_lowering_records_generic_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    wd = CompileWatchdog()
+    with watch_jax_lowering(wd):
+        jax.jit(lambda x: x * 2).lower(jnp.ones((5,))).compile()
+    assert wd.compiles == 1
+    ev = wd.events()[0]
+    assert ev["key"] == "jax.Lowered.compile"
+    assert "test_observability.py" in ev["call_site"]
+    # the patch is gone after the block
+    import jax.stages
+    assert jax.stages.Lowered.compile.__qualname__.startswith("Lowered")
+
+
+# ------------------------------------------------- serving integration
+
+# ServingMetrics.snapshot() schema contract: bench artifacts and the
+# driver tail-parse these keys across PRs — additions are fine,
+# renames/removals break parseability and fail here.
+_SNAPSHOT_KEYS = {
+    "tokens_generated", "tokens_per_sec", "ttft_avg_ms", "queue_depth",
+    "slot_occupancy", "prefills", "prefill_requests", "prefill_groups",
+    "decode_steps", "speculative_masked", "kv_donation", "compiles",
+    "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
+    "span_s", "latency_percentiles",
+}
+_PCT_KEYS = {"count", "p50_ms", "p90_ms", "p99_ms"}
+
+
+def test_serving_snapshot_schema_contract():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    _drive(eng, np.random.RandomState(1), [(4, 3), (9, 4), (6, 3)])
+    snap = eng.metrics.snapshot()
+    assert set(snap) == _SNAPSHOT_KEYS
+    json.dumps(snap)                       # artifact-embeddable
+    pcts = snap["latency_percentiles"]
+    assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
+    for entry in pcts.values():
+        assert set(entry) == _PCT_KEYS
+        assert entry["count"] == 3
+        assert entry["p50_ms"] <= entry["p90_ms"] <= entry["p99_ms"]
+    # ttft <= full request latency, always
+    assert pcts["ttft"]["p50_ms"] <= pcts["request_latency"]["p50_ms"]
+
+
+def test_serving_latency_series_bounded():
+    """The unbounded ttft/request-latency lists are gone: sustained
+    traffic keeps the reservoir at its fixed capacity while the
+    histogram keeps exact totals."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    eng.metrics._res["ttft"] = Reservoir(8)    # tiny cap to see it bind
+    rs = np.random.RandomState(2)
+    _drive(eng, rs, [(int(n), 2) for n in rs.randint(2, 12, 20)])
+    assert len(eng.metrics.ttft_s) == 8
+    assert eng.metrics._res["ttft"].seen == 20
+    assert eng.metrics._h_ttft.count == 20     # exact count kept
+    assert eng.metrics.snapshot()["latency_percentiles"]["ttft"][
+        "count"] == 20
+
+
+def test_serving_prometheus_exposition():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    _drive(eng, np.random.RandomState(3), [(5, 3), (11, 4)])
+    types, samples = _parse_prometheus(eng.metrics.prometheus_text())
+    assert types["serving_compiles_total"] == "counter"
+    assert types["serving_ttft_seconds"] == "histogram"
+    assert types["serving_queue_depth"] == "gauge"
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["serving_tokens_generated_total"][0][1] == 7
+    # per-scope span counters carry the engine step anatomy
+    span_labels = {lb["span"] for lb, _ in
+                   by_name["serving_span_seconds_total"]}
+    assert {"serving/step", "serving/admit", "serving/harvest",
+            "serving/retirement"} <= span_labels
+
+
+def test_engine_watchdog_zero_steady_state_and_induced_drift():
+    """Tier-1 invariant: past warmup, identical traffic compiles
+    NOTHING (watchdog-attributed, not just counter equality) — and an
+    induced shape drift (a never-warmed bucket) is flagged with the
+    engine dispatch call-site and its abstract-shape signature."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    rs = np.random.RandomState(4)
+    wave = [(3, 4), (7, 4), (12, 3), (14, 4)]
+    _drive(eng, rs, wave)
+    warm = eng.metrics.compiles
+    assert eng.watchdog.report()["compiles_total"] == warm
+    eng.declare_warmup()
+    _drive(eng, rs, wave)                  # steady state: same traffic
+    rep = eng.watchdog.report()
+    assert rep["warmed"] and rep["steady_state_compiles"] == 0
+    # induced drift: a prompt in a (bucket, group) never compiled
+    _drive(eng, rs, [(20, 3)])
+    rep = eng.watchdog.report()
+    assert rep["steady_state_compiles"] == 1
+    viol = rep["steady_state_events"][0]
+    assert "engine.py" in viol["call_site"]        # attributed
+    assert viol["key"].startswith("('prefill'")
+    assert "#" in viol["signature"]                # shape digest present
+    assert eng.metrics.compiles == warm + 1        # counter agrees
+
+
+def test_engine_watchdog_raise_mode_hard_fails():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8,
+                        watchdog_mode="raise")
+    rs = np.random.RandomState(5)
+    _drive(eng, rs, [(4, 3), (9, 3)])
+    eng.declare_warmup()
+    _drive(eng, rs, [(4, 3), (9, 3)])      # warm traffic is fine
+    eng.add_request(rs.randint(0, 97, (25,)).astype(np.int64),
+                    max_new_tokens=2)
+    with pytest.raises(CompileAfterWarmupError) as ei:
+        eng.run()
+    assert "engine.py" in str(ei.value)
+
+
+def test_engine_serve_metrics_http():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    _drive(eng, np.random.RandomState(6), [(5, 3)])
+    server = eng.serve_metrics()
+    try:
+        port = server.server_address[1]
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        types, samples = _parse_prometheus(text)
+        assert "serving_tokens_generated_total" in types
+        assert ("serving_tokens_generated_total", {}, 3.0) in samples
+    finally:
+        server.shutdown()
