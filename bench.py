@@ -151,10 +151,25 @@ def _worker(batch, steps, out_path):
     """One full measurement attempt in THIS process; writes evidence JSON
     to out_path on success. Runs in a subprocess of the orchestrator so a
     tunnel wedge (hung backend init / hung compile) cannot poison retries.
+    A heartbeat line on stderr every $BENCH_HEARTBEAT_SECS (default 15)
+    seconds names the CURRENT phase, so a hung attempt is attributable
+    ("wedged in backend-init for 840s") instead of an opaque timeout —
+    the r5 postmortem's ">900s tunnel wedge" gap.
     """
     import numpy as np
 
     t_start = time.time()
+    phase = {"phase": "backend-init"}
+    hb_interval = float(os.environ.get("BENCH_HEARTBEAT_SECS", "15"))
+    if hb_interval > 0:
+        def _beat():
+            while True:
+                time.sleep(hb_interval)
+                print(f"# heartbeat +{time.time() - t_start:.0f}s "
+                      f"phase={phase['phase']}", file=sys.stderr,
+                      flush=True)
+        threading.Thread(target=_beat, daemon=True,
+                         name="bench-heartbeat").start()
     import jax
     devs = jax.devices()
     if devs[0].platform == "cpu":
@@ -210,13 +225,15 @@ def _worker(batch, steps, out_path):
     # eager and record passes keep every intermediate live). Larger
     # batches then reuse the compiled closure shape-polymorphically.
     xs, ys = data(8)
-    for phase in ("eager", "record", "compile"):
+    for warm_phase in ("eager", "record", "compile"):
+        phase["phase"] = f"warmup-{warm_phase}"
         t_p = time.perf_counter()
         loss = train_step(xs, ys)
         float(loss.numpy())
         dt = time.perf_counter() - t_p
-        evidence["warmup"][phase] = round(dt, 2)
-        print(f"# warmup {phase} (batch 8): {dt:.1f}s", file=sys.stderr)
+        evidence["warmup"][warm_phase] = round(dt, 2)
+        print(f"# warmup {warm_phase} (batch 8): {dt:.1f}s",
+              file=sys.stderr)
 
     # host snapshot of all step-mutated state: an OOM mid-execution can
     # consume donated buffers, so restore before retrying smaller
@@ -232,6 +249,7 @@ def _worker(batch, steps, out_path):
     for b in candidates:
         try:
             x, y = data(b)
+            phase["phase"] = f"compile-batch-{b}"
             t_p = time.perf_counter()
             loss = train_step(x, y)  # compile at this batch
             float(loss.numpy())
@@ -240,6 +258,7 @@ def _worker(batch, steps, out_path):
             # three independent timed runs for auditability; headline is
             # the median
             for run in range(3):
+                phase["phase"] = f"timed-run-{run}-batch-{b}"
                 t0 = time.perf_counter()
                 for _ in range(steps):
                     loss = train_step(x, y)

@@ -30,7 +30,20 @@ TTFT / request latency / queue wait from ServingMetrics' bounded
 reservoirs) and ``watchdog`` (the attributed compile log — every
 executable with abstract-shape signature + call-site; the deep_queue
 run declares warmup after its first drain, so its watchdog section is
-the zero-steady-state-recompile invariant as measured).
+the zero-steady-state-recompile invariant as measured) — and, since
+PR 4, the request-level sections: ``slo`` (SLO attainment / goodput
+tokens / sliding-window percentiles under the configured TTFT/TPOT
+targets), ``cost_model`` (per-executable cost_analysis flops/bytes,
+estimated MFU, device memory — graceful nulls where the backend
+doesn't report) and ``request_traces`` (a sample of flight-recorder
+lifecycle traces: enqueued → admitted → prefill → first token →
+retired, with ms-relative timestamps).
+
+A heartbeat line (``# heartbeat +<secs>s phase=<phase>``) prints to
+stderr every $BENCH_HEARTBEAT_SECS (default 15) seconds so a hung run
+is attributable to its phase — BENCH_r05 recorded a live-measurement
+failure as an opaque ">900s tunnel wedge" precisely because nothing
+marked WHERE it wedged.
 
 ``--smoke`` runs a seconds-scale CPU configuration and emits the same
 line shape (source: "live-smoke") — the emission-format contract test
@@ -47,6 +60,32 @@ _ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bench_artifacts")
 _print_lock = threading.Lock()
 _final_printed = False
+
+# heartbeat state: the beat thread reads the CURRENT phase, so stderr
+# shows where a wedged run is stuck, not just that it is stuck
+_PHASE = {"phase": "startup", "t0": time.time()}
+
+
+def _set_phase(phase):
+    _PHASE["phase"] = phase
+    print(f"# phase={phase} +{time.time() - _PHASE['t0']:.0f}s",
+          file=sys.stderr, flush=True)
+
+
+def _start_heartbeat():
+    interval = float(os.environ.get("BENCH_HEARTBEAT_SECS", "15"))
+    if interval <= 0:
+        return
+
+    def beat():
+        while True:
+            time.sleep(interval)
+            print(f"# heartbeat +{time.time() - _PHASE['t0']:.0f}s "
+                  f"phase={_PHASE['phase']}", file=sys.stderr,
+                  flush=True)
+
+    threading.Thread(target=beat, daemon=True,
+                     name="bench-heartbeat").start()
 
 
 def _emit(payload, final=True):
@@ -94,7 +133,7 @@ def _cached_payload():
 
 
 def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
-             specs, deep, seed=7):
+             specs, deep, slo, seed=7):
     """One cold engine-vs-sequential measurement; returns evidence."""
     import numpy as np
 
@@ -116,8 +155,11 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     prompts = [rs.randint(0, vocab, (n,)).astype(np.int64)
                for n, _ in specs]
 
+    _set_phase("build-model")
     m_eng = build()
-    eng = ServingEngine(m_eng, num_slots=num_slots, bucket_min=8)
+    eng = ServingEngine(m_eng, num_slots=num_slots, bucket_min=8,
+                        **slo)
+    _set_phase("engine-wave")
     t0 = time.perf_counter()
     for i, (p, (_, k)) in enumerate(zip(prompts, specs)):
         eng.add_request(p, max_new_tokens=k)
@@ -128,6 +170,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     t_engine = time.perf_counter() - t0
     n_tokens = eng.metrics.tokens_generated
 
+    _set_phase("sequential-wave")
     m_seq = build()                # fresh decode LRU: cold sequential
     t0 = time.perf_counter()
     for p, (_, k) in zip(prompts, specs):
@@ -141,6 +184,9 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     dev = jax.devices()[0]
     tps = n_tokens / t_engine
     snap = eng.metrics.snapshot()
+    # a sample of flight-recorder lifecycle traces: enough to follow
+    # real requests through the artifact without dumping the whole ring
+    traces = [t.as_dict() for t in eng.flight.completed()[:4]]
     return {
         "metric": _METRIC,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -164,6 +210,13 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         # alarm is exercised by the deep_queue section below)
         "latency_percentiles": snap["latency_percentiles"],
         "watchdog": eng.watchdog.report(),
+        # PR 4 request-level sections: SLO attainment / goodput under
+        # the configured targets, the device cost model (flops/bytes
+        # per executable, estimated MFU, memory — nulls where the
+        # backend doesn't report), and sampled lifecycle traces
+        "slo": snap["slo"],
+        "cost_model": eng.cost_model(),
+        "request_traces": traces,
         "deep_queue": deep_queue,
     }
 
@@ -188,7 +241,8 @@ def _measure_deep_queue(model, num_slots, dq):
     prompts = [rs.randint(0, model.cfg.vocab_size, (n,)).astype(np.int64)
                for n, _ in specs]
 
-    def drain(**kw):
+    def drain(phase, **kw):
+        _set_phase(f"deep-queue-{phase}-warmup")
         eng = ServingEngine(model, num_slots=num_slots, bucket_min=8,
                             **kw)
         for p, (_, k) in zip(prompts, specs):
@@ -197,6 +251,7 @@ def _measure_deep_queue(model, num_slots, dq):
         warm = eng.metrics.compiles
         # from here on any compile is an attributed watchdog violation
         eng.declare_warmup()
+        _set_phase(f"deep-queue-{phase}-timed")
         ts = []
         for _ in range(reps):
             t0 = _time.perf_counter()
@@ -206,8 +261,9 @@ def _measure_deep_queue(model, num_slots, dq):
             ts.append(_time.perf_counter() - t0)
         return eng, sorted(ts)[len(ts) // 2], warm
 
-    eng_new, t_new, warm_new = drain()
-    eng_pr1, t_pr1, _ = drain(prefill_group_sizes=(1,), async_depth=0)
+    eng_new, t_new, warm_new = drain("grouped")
+    eng_pr1, t_pr1, _ = drain("pr1", prefill_group_sizes=(1,),
+                              async_depth=0)
     tokens = sum(k for _, k in specs)
     snap = eng_new.metrics.snapshot()
     return {
@@ -249,12 +305,17 @@ _DEEP_FULL = dict(reps=5, num_slots=8, specs=[
 
 _SMOKE = dict(hidden=32, layers=2, heads=4, vocab=97, max_seq_len=64,
               num_slots=4, deep=_DEEP_SMOKE,
+              # generous CPU-smoke SLOs: the COLD first wave compiles,
+              # so TTFT violations here are real and demonstrate the
+              # accounting, not an artifact bug
+              slo=dict(slo_ttft_ms=2000.0, slo_tpot_ms=250.0),
               specs=[(3, 6), (11, 9), (7, 4), (20, 12), (5, 8),
                      (13, 5), (9, 7), (17, 10)])
 # full config: GPT-124M-ish decode on the accelerator (falls back to
 # whatever backend JAX_PLATFORMS selects; the measurement is relative)
 _FULL = dict(hidden=768, layers=12, heads=12, vocab=50304,
              max_seq_len=512, num_slots=8, deep=_DEEP_FULL,
+             slo=dict(slo_ttft_ms=10000.0, slo_tpot_ms=200.0),
              specs=[(int(n), int(k)) for n, k in
                     [(40, 64), (120, 48), (24, 96), (200, 32),
                      (64, 64), (90, 80), (30, 48), (150, 64),
@@ -266,6 +327,7 @@ def main():
     deadline = float(os.environ.get("BENCH_DEADLINE_SECS",
                                     "120" if smoke else "900"))
     os.makedirs(_ARTIFACT_DIR, exist_ok=True)
+    _start_heartbeat()
 
     provisional = _cached_payload()
     if provisional is not None:
@@ -294,6 +356,7 @@ def main():
         _emit(payload)
         return
 
+    _set_phase("write-artifact")
     fname = ("serving_" + ("smoke_" if smoke else "")
              + time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()) + ".json")
     out_path = os.path.join(_ARTIFACT_DIR, fname)

@@ -35,15 +35,31 @@ Quick start::
 
     obs.default_recorder().dump_chrome_trace("host_trace.json")
     # -> open in chrome://tracing or ui.perfetto.dev
+
+PR 4 adds the REQUEST-level layer on top: flight.FlightRecorder gives
+every serving request a lifecycle trace (enqueued -> admitted ->
+prefill -> first token -> retired) flow-linked across engine step
+spans in the chrome trace; slo.SLOTracker accounts SLO attainment,
+goodput tokens, and sliding-window (registry.WindowedReservoir)
+p50/p90/p99; watchdog compile records carry device cost telemetry
+(executable_cost / device_memory_stats — graceful None on backends
+that don't report). start_metrics_server() now returns a cleanly
+stoppable MetricsServerHandle and mounts engine debug endpoints
+(/debug/requests, /debug/state) via extra_routes.
 """
-from .registry import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, Reservoir,
-    DEFAULT_TIME_BUCKETS, default_registry, start_metrics_server,
+from .flight import (  # noqa: F401
+    FlightRecorder, RequestTrace,
 )
+from .registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, MetricsServerHandle,
+    Reservoir, WindowedReservoir, DEFAULT_TIME_BUCKETS,
+    default_registry, start_metrics_server,
+)
+from .slo import SLOTracker  # noqa: F401
 from .tracing import (  # noqa: F401
-    HostSpan, HostSpanRecorder, default_recorder, span_timer,
+    FlowEvent, HostSpan, HostSpanRecorder, default_recorder, span_timer,
 )
 from .watchdog import (  # noqa: F401
     CompileAfterWarmupError, CompileWatchdog, abstract_signature,
-    watch_jax_lowering,
+    device_memory_stats, executable_cost, watch_jax_lowering,
 )

@@ -1,0 +1,212 @@
+"""Request-level flight recorder: one lifecycle trace per serving
+request, flow-linked across engine step spans.
+
+The engine-level observability (registry / host spans / watchdog)
+answers "what is the ENGINE doing"; operating continuous batching
+under heavy traffic is debugged per REQUEST — "why did request 4812
+take 900 ms to first token?". This module is that Dapper-style answer:
+every request gets a trace id and an append-only lifecycle record
+
+    enqueued -> admitted(slot, bucket, group_size)
+             -> prefill_dispatched -> first_token
+             -> decode_window(tokens) ...
+             -> retired(reason, slo_violations)
+
+with perf_counter timestamps (monotone by construction — appended
+under one lock from a monotonic clock).
+
+Every event ALSO lands in the host-span recorder twice: a zero-length
+marker span (``request/<event>`` with the rid and attrs) and a chrome
+FLOW event (``ph:"s"/"t"/"f"``, one flow chain per request, id = rid).
+Flow points bind to the slice enclosing their timestamp, so Perfetto
+draws arrows from a request's enqueue marker through the engine step
+spans it was admitted/prefilled/decoded in, to its retirement — load
+``dump_chrome_trace()`` output and follow one request's life across
+the steps.
+
+Completed traces park in a bounded keep-last-N ring (the same leak
+class PR 3 fixed for latency lists: a serve-forever process must not
+accumulate per-request state). ``ServingEngine.request_trace(rid)``
+reads one back; the ``/debug/requests`` endpoint serves them all.
+"""
+import collections
+import threading
+import time
+
+from .tracing import default_recorder
+
+# lifecycle event names (the validator test pins the order contract:
+# enqueued <= admitted <= prefill_dispatched <= first_token <= retired)
+ENQUEUED = "enqueued"
+ADMITTED = "admitted"
+PREFILL_DISPATCHED = "prefill_dispatched"
+FIRST_TOKEN = "first_token"
+DECODE_WINDOW = "decode_window"
+RETIRED = "retired"
+
+
+class RequestTrace:
+    """One request's lifecycle: an append-only list of
+    ``{"event", "t", ...attrs}`` records (``t`` on the perf_counter
+    clock) plus the retirement reason once retired."""
+
+    __slots__ = ("rid", "events", "reason")
+
+    def __init__(self, rid):
+        self.rid = int(rid)
+        self.events = []
+        self.reason = None
+
+    def t_of(self, event):
+        """Timestamp of the FIRST occurrence of ``event``; None if it
+        never happened (e.g. still queued)."""
+        for e in self.events:
+            if e["event"] == event:
+                return e["t"]
+        return None
+
+    @property
+    def retired(self):
+        return self.reason is not None
+
+    def as_dict(self):
+        """JSON-safe view: absolute t plus ms-since-enqueue per event
+        (the human-readable column when eyeballing /debug/requests)."""
+        t0 = self.t_of(ENQUEUED)
+        events = []
+        for e in self.events:
+            d = dict(e)
+            d["t"] = round(d["t"], 6)
+            if t0 is not None:
+                d["t_rel_ms"] = round((e["t"] - t0) * 1000.0, 3)
+            events.append(d)
+        return {"rid": self.rid, "reason": self.reason,
+                "events": events}
+
+
+class FlightRecorder:
+    """Thread-safe per-request lifecycle recorder.
+
+    ``keep_last`` bounds the completed-trace ring; ``decode_window``
+    sets the token-count granularity of mid-decode progress events
+    (every N tokens one ``decode_window`` event records the cumulative
+    count — cheap enough to leave on, detailed enough to see a slow
+    decode tail). ``recorder`` is the HostSpanRecorder receiving the
+    marker spans + flow events (default: the process-global one the
+    chrome trace dump exports).
+    """
+
+    def __init__(self, recorder=None, keep_last=256, decode_window=32,
+                 clock=time.perf_counter):
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        if decode_window < 1:
+            raise ValueError("decode_window must be >= 1")
+        self.keep_last = int(keep_last)
+        self.decode_window = int(decode_window)
+        self._recorder = recorder if recorder is not None \
+            else default_recorder()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active = {}                       # rid -> RequestTrace
+        self._done = collections.OrderedDict()  # rid -> RequestTrace
+        self._dropped = 0
+
+    # ------------------------------------------------------- recording
+    def _event(self, rid, event, phase, attrs):
+        t = self._clock()
+        with self._lock:
+            trace = self._active.get(rid)
+            if trace is None:
+                # first sighting of this rid — normally the enqueue,
+                # but a recorder attached mid-flight starts a partial
+                # trace rather than losing events. Either way the flow
+                # chain must START here.
+                trace = self._active[rid] = RequestTrace(rid)
+                phase = "s"
+            trace.events.append(dict({"event": event, "t": t}, **attrs))
+        args = dict({"rid": rid}, **attrs)
+        # marker span + flow point at the SAME timestamp: the flow
+        # binds to the marker (or any enclosing engine span), linking
+        # the request's life across step spans in Perfetto
+        self._recorder.record(f"request/{event}", t, 0.0, args)
+        self._recorder.record_flow(f"request {rid}", t, phase, rid,
+                                   {"event": event})
+        return t
+
+    def enqueued(self, req):
+        self._event(req.rid, ENQUEUED, "s",
+                    {"prompt_len": int(len(req.prompt)),
+                     "max_new_tokens": int(req.max_new_tokens)})
+
+    def admitted(self, req, slot, bucket, group_size):
+        self._event(req.rid, ADMITTED, "t",
+                    {"slot": int(slot), "bucket": int(bucket),
+                     "group_size": int(group_size)})
+
+    def prefill_dispatched(self, req, bucket, group_size):
+        self._event(req.rid, PREFILL_DISPATCHED, "t",
+                    {"bucket": int(bucket),
+                     "group_size": int(group_size)})
+
+    def token_emitted(self, req, n_tokens):
+        """Account one emitted token: the FIRST is the TTFT lifecycle
+        moment; thereafter every ``decode_window``-th token records a
+        cumulative progress point."""
+        n = int(n_tokens)
+        if n == 1:
+            self._event(req.rid, FIRST_TOKEN, "t", {})
+        elif n % self.decode_window == 0:
+            self._event(req.rid, DECODE_WINDOW, "t", {"tokens": n})
+
+    def retired(self, req, reason, **attrs):
+        """Close the request's trace (reason: "eos" / "max_tokens" /
+        anything the engine decides, e.g. future cancellations) and
+        move it into the bounded completed ring."""
+        self._event(req.rid, RETIRED, "f",
+                    dict({"reason": str(reason),
+                          "tokens": int(len(req.generated))}, **attrs))
+        with self._lock:
+            trace = self._active.pop(req.rid, None)
+            if trace is None:
+                return
+            trace.reason = str(reason)
+            self._done[req.rid] = trace
+            while len(self._done) > self.keep_last:
+                self._done.popitem(last=False)
+                self._dropped += 1
+
+    # -------------------------------------------------------- querying
+    def trace(self, rid):
+        """The RequestTrace for ``rid`` — completed or still active;
+        None when unknown (never seen, or evicted from the ring)."""
+        with self._lock:
+            return self._done.get(rid) or self._active.get(rid)
+
+    def completed(self):
+        """Completed traces, oldest first (bounded at keep_last)."""
+        with self._lock:
+            return list(self._done.values())
+
+    def active(self):
+        with self._lock:
+            return list(self._active.values())
+
+    def state(self):
+        with self._lock:
+            return {
+                "active": len(self._active),
+                "completed_kept": len(self._done),
+                "completed_dropped": self._dropped,
+                "keep_last": self.keep_last,
+                "decode_window": self.decode_window,
+            }
+
+    def debug_requests(self):
+        """The ``/debug/requests`` JSON body: recorder state plus every
+        kept trace, completed and in-flight."""
+        return {
+            "state": self.state(),
+            "completed": [t.as_dict() for t in self.completed()],
+            "active": [t.as_dict() for t in self.active()],
+        }

@@ -30,9 +30,12 @@ text) and ``/metrics.json`` (the snapshot) from a stdlib
 ThreadingHTTPServer daemon thread — the serving engine exposes it as
 ``ServingEngine.serve_metrics()``.
 """
+import collections
 import json
+import math
 import random
 import threading
+import time
 
 # prometheus-style latency buckets (seconds): sub-ms to tens of seconds
 DEFAULT_TIME_BUCKETS = (
@@ -60,8 +63,11 @@ def _escape_help(text):
 
 
 def _fmt(v):
-    """Sample-value formatting: integers without a trailing .0."""
+    """Sample-value formatting: integers without a trailing .0;
+    non-finite values in canonical Prometheus spelling."""
     f = float(v)
+    if not math.isfinite(f):
+        return "NaN" if f != f else ("+Inf" if f > 0 else "-Inf")
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
@@ -93,7 +99,24 @@ class _Child:
 
 
 class _GaugeChild(_Child):
-    __slots__ = ()
+    __slots__ = ("_fn",)
+
+    def __init__(self, lock):
+        super().__init__(lock)
+        self._fn = None
+
+    @property
+    def value(self):
+        fn = self._fn
+        if fn is not None:
+            # called OUTSIDE the registry lock: the callback may take
+            # its own locks (reservoir pruning); a failing callback
+            # must not 500 the scrape
+            try:
+                return float(fn())
+            except Exception:
+                return float("nan")
+        return self._value
 
     def inc(self, amount=1.0):
         with self._lock:
@@ -105,6 +128,13 @@ class _GaugeChild(_Child):
     def set(self, value):
         with self._lock:
             self._value = float(value)
+
+    def set_function(self, fn):
+        """Make this gauge PULL its value from ``fn()`` at every
+        exposition (snapshot / Prometheus scrape) — the sliding-window
+        percentile gauges use this so /metrics reflects the window at
+        scrape time, not at the last observation."""
+        self._fn = fn
 
 
 class _HistogramChild:
@@ -234,6 +264,9 @@ class Gauge(_Family):
     def dec(self, amount=1.0):
         self._default().dec(amount)
 
+    def set_function(self, fn):
+        self._default().set_function(fn)
+
     @property
     def value(self):
         return self._default().value
@@ -303,15 +336,80 @@ class Reservoir:
         q in [0, 100]; None when empty."""
         with self._lock:
             xs = sorted(self._samples)
-        if not xs:
-            return None
-        if len(xs) == 1:
-            return xs[0]
-        pos = (float(q) / 100.0) * (len(xs) - 1)
-        lo = int(pos)
-        hi = min(lo + 1, len(xs) - 1)
-        frac = pos - lo
-        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+        return _interp_percentile(xs, q)
+
+
+def _interp_percentile(xs, q):
+    """Linear-interpolated percentile of a sorted list; None if empty."""
+    if not xs:
+        return None
+    if len(xs) == 1:
+        return xs[0]
+    pos = (float(q) / 100.0) * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    frac = pos - lo
+    return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+
+class WindowedReservoir:
+    """Sliding-TIME-window observation buffer: percentiles over the
+    last ``window_s`` seconds of traffic instead of process lifetime
+    (the uniform Reservoir above never forgets — a latency spike from
+    an hour ago still shapes its p99). Bounded two ways: observations
+    older than the window are pruned at every add/read, and the buffer
+    never holds more than ``capacity`` points (burst overflow drops
+    the OLDEST — the window stays recency-faithful).
+
+    ``clock`` is injectable (tests drive a fake monotonic clock); an
+    explicit ``now=`` on any method overrides it per call.
+    """
+
+    def __init__(self, window_s=60.0, capacity=4096,
+                 clock=time.monotonic):
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.window_s = float(window_s)
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._buf = collections.deque()   # (t, value), t ascending
+        self._seen = 0
+        self._lock = threading.Lock()
+
+    def _prune(self, now):
+        cutoff = now - self.window_s
+        while self._buf and self._buf[0][0] < cutoff:
+            self._buf.popleft()
+
+    def add(self, value, now=None):
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            self._seen += 1
+            self._prune(now)
+            if len(self._buf) == self.capacity:
+                self._buf.popleft()
+            self._buf.append((now, float(value)))
+
+    @property
+    def seen(self):
+        """Observations ever added (window pruning doesn't unsee)."""
+        return self._seen
+
+    def values(self, now=None):
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            self._prune(now)
+            return [v for _, v in self._buf]
+
+    def count(self, now=None):
+        return len(self.values(now))
+
+    def percentile(self, q, now=None):
+        """Linear-interpolated percentile over the CURRENT window,
+        q in [0, 100]; None when the window is empty."""
+        return _interp_percentile(sorted(self.values(now)), q)
 
 
 class MetricsRegistry:
@@ -416,14 +514,72 @@ def default_registry():
     return _default_registry
 
 
-def start_metrics_server(registry=None, port=0, addr="127.0.0.1"):
+class MetricsServerHandle:
+    """Cleanly-stoppable handle for a running metrics HTTP server:
+    ``close()`` is idempotent (shutdown + socket close + thread join),
+    the handle is a context manager, and the legacy server surface
+    (``server_address``, ``shutdown()``) is preserved so existing
+    callers keep working. The serving engine tracks every handle it
+    hands out and closes them in ``ServingEngine.close()`` — the
+    daemon thread no longer leaks across tests."""
+
+    def __init__(self, server, thread):
+        self._server = server
+        self._thread = thread
+        self._lock = threading.Lock()
+        self._closed = False
+
+    @property
+    def server_address(self):
+        return self._server.server_address
+
+    @property
+    def port(self):
+        return self._server.server_address[1]
+
+    @property
+    def url(self):
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    @property
+    def closed(self):
+        return self._closed
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+    def shutdown(self):  # legacy alias (pre-handle callers)
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
+                         extra_routes=None):
     """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` (the
-    snapshot) on a stdlib HTTP server in a daemon thread. Returns the
-    live server; ``server.server_address[1]`` is the bound port
-    (``port=0`` picks a free one) and ``server.shutdown()`` stops it."""
+    snapshot) on a stdlib HTTP server in a daemon thread.
+    ``extra_routes`` maps additional paths to zero-arg callables whose
+    JSON-serializable return value is served as application/json — the
+    serving engine mounts ``/debug/requests`` and ``/debug/state``
+    this way. Returns a MetricsServerHandle: ``handle.port`` is the
+    bound port (``port=0`` picks a free one), ``handle.close()`` stops
+    it (idempotent; also a context manager)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
+    routes = dict(extra_routes or {})
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
@@ -433,6 +589,14 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1"):
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path == "/metrics.json":
                 body = reg.snapshot_json().encode("utf-8")
+                ctype = "application/json"
+            elif path in routes:
+                try:
+                    body = json.dumps(routes[path](),
+                                      sort_keys=True).encode("utf-8")
+                except Exception as e:  # noqa: BLE001
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                    return
                 ctype = "application/json"
             else:
                 self.send_error(404)
@@ -450,4 +614,4 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1"):
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="paddle-tpu-metrics")
     thread.start()
-    return server
+    return MetricsServerHandle(server, thread)

@@ -16,6 +16,15 @@ profiler proto).
 The buffer is a fixed-capacity ring (collections.deque maxlen):
 sustained traffic overwrites the oldest spans instead of growing —
 recording is always-on and O(1) per span with a single lock.
+
+Besides complete ("X") spans the recorder holds chrome FLOW events
+(``ph:"s"/"t"/"f"``): the request flight recorder
+(observability.flight) emits one flow chain per request, so Perfetto
+draws arrows linking a request's enqueue → admit → prefill → first
+token → retire markers ACROSS the engine step spans — the Dapper-style
+"follow one request" view. Flow events bind to the slice enclosing
+their timestamp on the same pid/tid, so every flow emission pairs with
+a marker span at the identical timestamp.
 """
 import collections
 import json
@@ -41,6 +50,26 @@ class HostSpan:
         return self.t0 + self.dur
 
 
+class FlowEvent:
+    """One chrome flow-event point: phase "s" (start), "t" (step) or
+    "f" (finish) of flow chain ``fid`` at instant ``t`` on thread
+    ``tid``. Chains with the same (cat, id) render as arrows between
+    the slices enclosing each point."""
+
+    __slots__ = ("name", "t", "phase", "fid", "tid", "args")
+
+    def __init__(self, name, t, phase, fid, tid, args=None):
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be 's', 't' or 'f', "
+                             f"got {phase!r}")
+        self.name = name
+        self.t = float(t)
+        self.phase = phase
+        self.fid = int(fid)
+        self.tid = int(tid)
+        self.args = args
+
+
 class HostSpanRecorder:
     """Thread-safe bounded recorder of completed host spans.
 
@@ -55,8 +84,10 @@ class HostSpanRecorder:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
         self._buf = collections.deque(maxlen=self.capacity)
+        self._flows = collections.deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._dropped = 0
+        self._flows_dropped = 0
         self._pid = os.getpid()
 
     def record(self, name, t0, dur, args=None):
@@ -66,6 +97,19 @@ class HostSpanRecorder:
                 self._dropped += 1
             self._buf.append(span)
         return span
+
+    def record_flow(self, name, t, phase, flow_id, args=None):
+        """Record one flow-event point ("s"/"t"/"f") of chain
+        ``flow_id`` at instant ``t`` on the calling thread. Pair it
+        with a marker span at the same timestamp so viewers have a
+        slice to bind the arrow to."""
+        ev = FlowEvent(name, t, phase, flow_id, threading.get_ident(),
+                       args)
+        with self._lock:
+            if len(self._flows) == self._flows.maxlen:
+                self._flows_dropped += 1
+            self._flows.append(ev)
+        return ev
 
     def __len__(self):
         with self._lock:
@@ -80,24 +124,33 @@ class HostSpanRecorder:
         with self._lock:
             return list(self._buf)
 
+    def flows(self):
+        with self._lock:
+            return list(self._flows)
+
     def clear(self):
         with self._lock:
             self._buf.clear()
+            self._flows.clear()
             self._dropped = 0
+            self._flows_dropped = 0
 
     # ---------------------------------------------------------- export
     def chrome_trace(self, process_name="paddle_tpu"):
         """The trace as a dict in Chrome Trace Event JSON format:
         complete ("X") events in microseconds with stable pid/tid,
-        plus process/thread-name metadata events. Load with
+        flow events ("s"/"t"/"f") linking request lifecycles across
+        spans, plus process/thread-name metadata events. Load with
         chrome://tracing or ui.perfetto.dev."""
         spans = self.spans()
+        flows = self.flows()
         pid = self._pid
         events = [{
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": process_name},
         }]
-        for tid in sorted({s.tid for s in spans}):
+        for tid in sorted({s.tid for s in spans}
+                          | {f.tid for f in flows}):
             events.append({
                 "name": "thread_name", "ph": "M", "pid": pid,
                 "tid": tid, "args": {"name": f"host-{tid}"},
@@ -112,11 +165,25 @@ class HostSpanRecorder:
             if s.args:
                 ev["args"] = dict(s.args)
             events.append(ev)
-        # deterministic viewer order: by start time, metadata first
+        for f in flows:
+            ev = {
+                "name": f.name, "ph": f.phase, "cat": "request",
+                "id": f.fid, "ts": round(f.t * 1e6, 3),
+                "pid": pid, "tid": f.tid,
+            }
+            if f.phase == "f":
+                ev["bp"] = "e"  # bind the finish to the ENCLOSING slice
+            if f.args:
+                ev["args"] = dict(f.args)
+            events.append(ev)
+        # deterministic viewer order: by start time, metadata first;
+        # stable sort keeps a flow point after the span it binds to
+        # when both share a timestamp
         events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0.0)))
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": {"recorder": "paddle_tpu.observability",
-                              "dropped_spans": self._dropped}}
+                              "dropped_spans": self._dropped,
+                              "dropped_flows": self._flows_dropped}}
 
     def dump_chrome_trace(self, path, process_name="paddle_tpu"):
         """Write the chrome trace JSON to ``path``; returns the path."""

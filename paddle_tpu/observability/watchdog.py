@@ -20,6 +20,15 @@ Two integration points:
     ``jax.stages.Lowered.compile`` AOT entry point for the duration of
     a ``with`` block, so any lowering-based compile in scope (training
     AOT paths, third-party code) is captured without its cooperation.
+
+Compile records also carry DEVICE COST telemetry: the engine attaches
+``compiled.cost_analysis()`` (flops, bytes accessed — via
+``executable_cost()``) and ``device.memory_stats()`` (HBM in-use /
+limit — via ``device_memory_stats()``) to each event with
+``annotate()``. Both helpers are best-effort: backends that don't
+report (CPU has no memory_stats; some runtimes hide cost_analysis)
+yield None, never an exception — the graceful-fallback contract the
+serving engine and bench artifacts rely on.
 """
 import contextlib
 import hashlib
@@ -61,6 +70,54 @@ def abstract_signature(args, max_leaves_shown=6):
     if more > 0:
         shown += f";+{more} leaves"
     return f"{shown}#{digest}"
+
+
+def executable_cost(compiled):
+    """Best-effort device cost model of one compiled executable:
+    ``{"flops": ..., "bytes_accessed": ...}`` (floats, per execution)
+    from ``compiled.cost_analysis()``; None when the backend doesn't
+    report. jax returns either a dict or a one-element list of dicts
+    depending on version — both shapes are handled."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    out = {}
+    for src, dst in (("flops", "flops"),
+                     ("bytes accessed", "bytes_accessed"),
+                     ("optimal_seconds", "optimal_seconds")):
+        v = analysis.get(src)
+        if isinstance(v, (int, float)) and v == v and v >= 0:
+            out[dst] = float(v)
+    return out or None
+
+
+def device_memory_stats(device=None):
+    """Best-effort ``device.memory_stats()`` as a JSON-safe dict of
+    numeric fields (PJRT reports e.g. bytes_in_use / bytes_limit /
+    peak_bytes_in_use on TPU/GPU); None where the backend doesn't
+    report (CPU). Adds ``bytes_free`` (limit - in_use, the HBM
+    headroom) when both sides are present."""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not isinstance(stats, dict):
+        return None
+    out = {k: v for k, v in stats.items()
+           if isinstance(v, (int, float)) and v == v}
+    if not out:
+        return None
+    if "bytes_limit" in out and "bytes_in_use" in out:
+        out["bytes_free"] = out["bytes_limit"] - out["bytes_in_use"]
+    return out
 
 
 def _call_site(skip=0):
@@ -111,6 +168,11 @@ class CompileWatchdog:
                 "signature": signature,
                 "call_site": call_site,
                 "steady_state": self._warmed,
+                # post-compile device telemetry, attached via
+                # annotate() once the executable exists (record() runs
+                # BEFORE the build so mode="raise" prevents it)
+                "cost": None,
+                "memory": None,
             }
             self._events.append(event)
             warmed = self._warmed
@@ -119,6 +181,14 @@ class CompileWatchdog:
                 f"compile after declared warmup: key={event['key']} "
                 f"signature={signature} at {call_site}")
         return event
+
+    def annotate(self, seq, **extra):
+        """Attach post-compile facts (device cost analysis, memory
+        stats) to an already-recorded event by its ``seq``. JSON-safe
+        values only — the events feed report() straight into bench
+        artifacts."""
+        with self._lock:
+            self._events[seq].update(extra)
 
     def declare_warmup_complete(self):
         """From here on, every compile is a steady-state violation."""

@@ -45,7 +45,35 @@ math into a multi-tenant server:
     and (3) accrued into the registry for the snapshot()/Prometheus
     numbers. Scrape with ``server = engine.serve_metrics()`` then
     ``GET http://127.0.0.1:<port>/metrics`` (Prometheus text) or
-    ``/metrics.json`` (the snapshot schema);
+    ``/metrics.json`` (the snapshot schema); the handle's ``close()``
+    stops the server (idempotent; ``engine.close()`` closes every
+    handle the engine handed out);
+  * **request flight recorder** (``engine.flight``, an
+    observability.FlightRecorder) — every request gets a lifecycle
+    trace (enqueued → admitted(slot, bucket, group) → prefill
+    dispatched → first token → per-decode-window progress →
+    retired(reason, SLO verdict)) emitted into the host chrome trace
+    as FLOW events, so Perfetto draws one arrow chain per request
+    across the engine step spans. Completed traces park in a bounded
+    keep-last-N ring (``trace_keep``); read one back with
+    ``engine.request_trace(rid)`` or all of them from the
+    ``/debug/requests`` endpoint (``/debug/state`` serves the live
+    queue/slot/pipeline/watchdog picture);
+  * **SLO & goodput accounting** (``metrics.slo``, an
+    observability.SLOTracker) — ``ServingConfig(slo_ttft_ms=...,
+    slo_tpot_ms=...)`` sets time-to-first-token / time-per-output-
+    token targets; per-request attainment and per-dimension violation
+    counters, goodput tokens (from requests that met their SLOs) vs
+    total, and sliding-window p50/p90/p99 TTFT/TPOT/latency gauges
+    (``slo_window_s``, default 60 s) computed AT SCRAPE TIME, so
+    /metrics reflects current traffic — all in ``snapshot()["slo"]``;
+  * **device cost telemetry** — every AOT build's
+    ``cost_analysis()`` (flops, bytes) and ``memory_stats()`` ride on
+    its watchdog compile record (graceful None on backends that don't
+    report); per-decode-step flops/bytes, estimated-MFU (vs the
+    device-kind peak-FLOP/s table, ``peak_flops=`` /
+    ``$PADDLE_TPU_PEAK_FLOPS`` override) and HBM in-use/free pull
+    gauges; ``engine.cost_model()`` is the artifact-ready summary;
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program
@@ -89,6 +117,18 @@ Tuning knobs
                 "flag" (default) records post-warmup compiles in
                 ``engine.watchdog.report()``; "raise" turns them into
                 CompileAfterWarmupError at the offending dispatch.
+``slo_ttft_ms`` / ``slo_tpot_ms`` / ``slo_window_s``
+                SLO targets (None = untargeted) and the sliding-
+                percentile window for the goodput/attainment
+                accounting above.
+``completed_keep`` / ``trace_keep`` / ``trace_decode_window``
+                retention bounds: completed Request objects kept by
+                the scheduler (default 4096), completed RequestTraces
+                kept by the flight recorder (default 256), and the
+                token granularity of mid-decode trace events.
+``peak_flops``  device peak FLOP/s for the estimated-MFU gauge
+                (default: device_kind table / $PADDLE_TPU_PEAK_FLOPS;
+                unknown -> the gauge reads 0).
 ``eos_id``      default stop token (per-request override on
                 add_request).
 

@@ -45,14 +45,46 @@ Compiled program inventory for a whole serving lifetime:
 so prompt-length AND queue-depth variety is O(buckets x group_sizes)
 compiles — the generate() LRU problem this engine exists to delete.
 """
+import os
 import warnings
 
 import numpy as np
 
-from ..observability import CompileWatchdog, abstract_signature
+from ..observability import (CompileWatchdog, FlightRecorder,
+                             abstract_signature, device_memory_stats,
+                             executable_cost)
 from .kv_pool import SlotKVPool
 from .metrics import ServingMetrics
 from .scheduler import RUNNING, Request, StepScheduler
+
+# published per-chip peak FLOP/s (bf16) by PJRT device_kind prefix —
+# the denominator of the estimated-MFU gauge. Unknown kinds (CPU, new
+# TPUs before this table learns them) fall back to the
+# PADDLE_TPU_PEAK_FLOPS env var or ServingConfig(peak_flops=...), else
+# the MFU gauge reads 0 (unknown, never a made-up number).
+_PEAK_FLOPS_BY_KIND = (
+    ("tpu v6", 918e12),
+    ("tpu v5p", 459e12),
+    ("tpu v5 lite", 197e12),
+    ("tpu v5e", 197e12),
+    ("tpu v4", 275e12),
+    ("tpu v3", 123e12),
+    ("tpu v2", 46e12),
+)
+
+
+def _peak_flops_for(device_kind):
+    kind = str(device_kind).lower()
+    for prefix, peak in _PEAK_FLOPS_BY_KIND:
+        if kind.startswith(prefix):
+            return peak
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return None
 
 # kc/vc/pos are donated into every serving executable; backends without
 # donation support (CPU) warn once per compiled program — expected, not
@@ -104,7 +136,10 @@ class ServingConfig:
     def __init__(self, num_slots=8, max_len=None, buckets=None,
                  bucket_min=32, eos_id=None, prefill_group_sizes=None,
                  async_depth=1, donate_buffers=None,
-                 watchdog_mode="flag"):
+                 watchdog_mode="flag", slo_ttft_ms=None,
+                 slo_tpot_ms=None, slo_window_s=60.0,
+                 completed_keep=4096, trace_keep=256,
+                 trace_decode_window=32, peak_flops=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -127,6 +162,23 @@ class ServingConfig:
         # called: "flag" records steady-state compiles in the report,
         # "raise" hard-fails at the offending compile (tests/canaries)
         self.watchdog_mode = watchdog_mode
+        # SLO targets (ms): time-to-first-token and time-per-output-
+        # token. None = no target (every request trivially attains;
+        # the sliding windows still run). slo_window_s sets the
+        # sliding-percentile window the /metrics gauges report over.
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_tpot_ms = slo_tpot_ms
+        self.slo_window_s = float(slo_window_s)
+        # retention bounds for a serve-forever process: completed
+        # Request objects kept by the scheduler, completed
+        # RequestTrace records kept by the flight recorder, and the
+        # token granularity of mid-decode trace progress events
+        self.completed_keep = completed_keep
+        self.trace_keep = int(trace_keep)
+        self.trace_decode_window = int(trace_decode_window)
+        # device peak FLOP/s override for the estimated-MFU gauge
+        # (default: a device_kind table, then $PADDLE_TPU_PEAK_FLOPS)
+        self.peak_flops = peak_flops
 
 
 class ServingEngine:
@@ -174,10 +226,19 @@ class ServingEngine:
         self.pool = SlotKVPool(
             config.num_slots, cfg.num_layers, cfg.num_heads, cache_len,
             cfg.hidden_size // cfg.num_heads)
-        self.scheduler = StepScheduler(buckets, cache_len)
-        self.metrics = ServingMetrics()
+        self.flight = FlightRecorder(
+            keep_last=config.trace_keep,
+            decode_window=config.trace_decode_window)
+        self.scheduler = StepScheduler(
+            buckets, cache_len, completed_keep=config.completed_keep,
+            flight=self.flight)
+        self.metrics = ServingMetrics(
+            slo_ttft_ms=config.slo_ttft_ms,
+            slo_tpot_ms=config.slo_tpot_ms,
+            slo_window_s=config.slo_window_s)
         self.watchdog = CompileWatchdog(mode=config.watchdog_mode)
         self._exec = {}  # (kind, bucket?, group?) -> XLA executable
+        self._metric_servers = []
 
         import jax
         import jax.numpy as jnp
@@ -196,6 +257,16 @@ class ServingEngine:
             # but never aliases on CPU)
             "effective": self._donate and effective,
         }
+        # device cost telemetry: peak FLOP/s for the MFU estimate, and
+        # HBM pull gauges where the backend reports memory_stats (CPU
+        # doesn't — the gauges simply aren't registered there)
+        dev = jax.devices()[0]
+        self._device = dev
+        self.metrics.set_peak_flops(
+            config.peak_flops or _peak_flops_for(dev.device_kind))
+        if device_memory_stats(dev) is not None:
+            self.metrics.enable_device_memory(
+                lambda: device_memory_stats(dev))
 
     # ---------------------------------------------------------- requests
 
@@ -229,7 +300,8 @@ class ServingEngine:
         ex = self._exec.get(key)
         if ex is None:
             import jax
-            self.watchdog.record(key, abstract_signature(args), skip=1)
+            event = self.watchdog.record(key, abstract_signature(args),
+                                         skip=1)
             if not self._donate:
                 donate = ()
             with self.metrics.span("serving/compile"):
@@ -237,6 +309,17 @@ class ServingEngine:
                     .lower(*args).compile()
             self._exec[key] = ex
             self.metrics.compiles += 1
+            # device cost telemetry rides on the compile record:
+            # flops/bytes from cost_analysis plus the memory picture
+            # at build time (both best-effort None on non-reporting
+            # backends — CPU has no memory_stats)
+            cost = executable_cost(ex)
+            self.watchdog.annotate(
+                event["seq"], cost=cost,
+                memory=device_memory_stats(self._device))
+            if key == ("decode",) and cost:
+                self.metrics.set_decode_cost(
+                    cost.get("flops"), cost.get("bytes_accessed"))
         return ex
 
     def declare_warmup(self):
@@ -248,27 +331,119 @@ class ServingEngine:
 
     def serve_metrics(self, port=0, addr="127.0.0.1"):
         """Expose this engine's metrics registry over HTTP: GET
-        /metrics (Prometheus text) and /metrics.json (the snapshot
-        schema). Returns the stdlib server; ``server_address[1]`` is
-        the bound port, ``shutdown()`` stops it."""
+        /metrics (Prometheus text), /metrics.json (the snapshot
+        schema), /debug/requests (flight-recorder traces) and
+        /debug/state (live engine state). Returns a
+        MetricsServerHandle — ``handle.port`` is the bound port,
+        ``handle.close()`` stops it (idempotent); every handle is also
+        closed by ``engine.close()`` so the server thread shuts down
+        with the engine."""
         from ..observability import start_metrics_server
-        return start_metrics_server(self.metrics.registry, port=port,
-                                    addr=addr)
+        handle = start_metrics_server(
+            self.metrics.registry, port=port, addr=addr,
+            extra_routes={
+                "/debug/requests": self.flight.debug_requests,
+                "/debug/state": self.debug_state,
+            })
+        self._metric_servers.append(handle)
+        return handle
+
+    def close(self):
+        """Shut down everything the engine started that outlives a
+        request wave — today: the metrics/debug HTTP servers.
+        Idempotent; the engine is also a context manager."""
+        servers, self._metric_servers = self._metric_servers, []
+        for handle in servers:
+            handle.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------- observability
+
+    def request_trace(self, rid):
+        """The flight-recorder RequestTrace for request ``rid`` —
+        completed (kept in the bounded ring) or still in flight; None
+        when unknown/evicted."""
+        return self.flight.trace(rid)
+
+    def debug_state(self):
+        """The ``/debug/state`` JSON body: live queue/slot/pipeline
+        state plus the compile + flight summaries — the first page to
+        look at when a serve loop misbehaves."""
+        sch = self.scheduler
+        wd = self.watchdog.report()
+        return {
+            "queue_depth": len(sch.queue),
+            "queued_rids": [r.rid for r in sch.queue],
+            "active_slots": {str(slot): req.rid
+                             for slot, req in sorted(sch.active.items())},
+            "slot_occupancy": self.pool.occupancy,
+            "inflight_harvests": len(self._pending),
+            "completed_kept": len(sch.completed),
+            "compiles": self.metrics.compiles,
+            "watchdog": {k: wd[k] for k in
+                         ("warmed", "mode", "compiles_total",
+                          "steady_state_compiles")},
+            "kv_donation": dict(self.metrics.kv_donation),
+            "flight": self.flight.state(),
+            "slo": self.metrics.slo.report(),
+        }
+
+    def cost_model(self):
+        """Device cost telemetry as a JSON-safe dict (the bench
+        artifact's ``cost_model`` section): per-executable
+        cost_analysis from the watchdog compile records, the decode
+        per-step flops/bytes, the estimated MFU against the device
+        peak, and the current memory picture — every field None-safe
+        on backends that don't report."""
+        events = self.watchdog.events()
+        per_exec = [{"key": e["key"], "signature": e["signature"],
+                     "cost": e["cost"]} for e in events]
+        costs = [e["cost"] for e in events if e.get("cost")]
+        decode_flops = self.metrics._g_decode_flops.value or None
+        decode_bytes = self.metrics._g_decode_bytes.value or None
+        peak = self.metrics._peak_flops
+        mfu = self.metrics.estimated_mfu()
+        return {
+            "device": {"platform": self._device.platform,
+                       "kind": self._device.device_kind},
+            "executables": per_exec,
+            "executables_with_cost": len(costs),
+            "compiled_flops_total": sum(
+                c.get("flops", 0.0) for c in costs) or None,
+            "decode_flops_per_step": decode_flops,
+            "decode_bytes_per_step": decode_bytes,
+            "peak_flops": peak,
+            "estimated_mfu": round(mfu, 6) if mfu else None,
+            "device_memory": device_memory_stats(self._device),
+        }
 
     # -------------------------------------------------------------- step
 
     def _emit(self, req, token):
-        """Account one generated token; retire the request on stop."""
+        """Account one generated token; retire the request on stop.
+        The flight recorder sees the first token, every
+        trace_decode_window-th token, and the retirement with its
+        reason + SLO verdict."""
         first = not req.generated
         req.generated.append(token)
         self.metrics.tokens_generated += 1
         if first:
             self.metrics.record_first_token(req)
+        self.flight.token_emitted(req, len(req.generated))
         if req.on_token is not None:
             req.on_token(req, token)
-        if self.scheduler.should_stop(req, token):
+        reason = self.scheduler.stop_reason(req, token)
+        if reason is not None:
             self.scheduler.finish(req, self.pool)
-            self.metrics.record_completion(req)
+            violations = self.metrics.record_completion(req)
+            self.flight.retired(req, reason,
+                                slo_violations=list(violations))
 
     def _harvest(self, pending):
         """Read back dispatched results (at most one step's worth: the
@@ -360,6 +535,8 @@ class ServingEngine:
                                 self._prefill_fn, args,
                                 donate=(5, 6, 7))
             with M.span("serving/prefill_dispatch"):
+                for req, _slot in group:
+                    self.flight.prefill_dispatched(req, bucket, G)
                 first, self._toks, self._pos, kc, vc = ex(*args)
             pool.rebind(kc, vc)
             M.prefills += 1

@@ -25,7 +25,7 @@ timeline — one scope, three sinks.
 import time
 
 from .. import profiler as _profiler
-from ..observability import MetricsRegistry, Reservoir
+from ..observability import MetricsRegistry, Reservoir, SLOTracker
 
 # serving latencies are sub-ms (CPU smoke) to tens of seconds (deep
 # queues on big models) — the default time buckets cover that span
@@ -46,14 +46,43 @@ def _counter_property(attr):
 class ServingMetrics:
     """Engine-scoped metrics facade. ``registry`` defaults to a fresh
     MetricsRegistry per engine (pass a shared one to aggregate several
-    engines into a single /metrics endpoint)."""
+    engines into a single /metrics endpoint).
+
+    ``slo_ttft_ms`` / ``slo_tpot_ms`` / ``slo_window_s`` configure the
+    attached observability.SLOTracker (``metrics.slo``): per-request
+    SLO verdicts, goodput tokens, and sliding-window p50/p90/p99
+    gauges — ``snapshot()["slo"]`` carries its report. Device cost
+    telemetry lands in gauges: per-decode-step flops/bytes (from the
+    decode executable's cost_analysis), an estimated-MFU pull gauge
+    (decode flops over busy wall time against the device's peak
+    FLOP/s, 0 when the peak is unknown), and HBM in-use/free pull
+    gauges where the backend reports memory_stats.
+    """
 
     RESERVOIR_SIZE = 1024
 
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, slo_ttft_ms=None,
+                 slo_tpot_ms=None, slo_window_s=60.0):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         r = self.registry
+        self.slo = SLOTracker(r, slo_ttft_ms=slo_ttft_ms,
+                              slo_tpot_ms=slo_tpot_ms,
+                              window_s=slo_window_s)
+        self._peak_flops = None
+        self._g_decode_flops = r.gauge(
+            "serving_decode_flops_per_step",
+            "cost_analysis flops of ONE pooled decode dispatch")
+        self._g_decode_bytes = r.gauge(
+            "serving_decode_bytes_per_step",
+            "cost_analysis bytes accessed by ONE pooled decode "
+            "dispatch")
+        self._g_mfu = r.gauge(
+            "serving_estimated_mfu",
+            "estimated model-flops utilization: decode flops issued "
+            "over busy wall time against device peak FLOP/s (0 when "
+            "peak or cost_analysis is unavailable)")
+        self._g_mfu.set_function(self.estimated_mfu)
         self._c_compiles = r.counter(
             "serving_compiles_total", "XLA executables built (ever)")
         self._c_prefills = r.counter(
@@ -184,10 +213,66 @@ class ServingMetrics:
         self._res["ttft"].add(ttft)
 
     def record_completion(self, request):
+        """Completion accounting + the request's SLO verdict; returns
+        the violated dimensions (empty list = SLO attained) so the
+        engine can stamp them onto the flight-recorder retirement."""
         self._c_completed.inc()
         latency = request.t_done - request.t_arrival
         self._h_latency.observe(latency)
         self._res["request_latency"].add(latency)
+        ttft = (None if request.t_first_token is None
+                else request.t_first_token - request.t_arrival)
+        return self.slo.observe_request(ttft, latency,
+                                        len(request.generated))
+
+    # ---------------------------------------------------- cost model
+    def set_decode_cost(self, flops=None, bytes_accessed=None):
+        """Per-decode-dispatch device cost from the compiled decode
+        executable's cost_analysis (the engine calls this when the
+        decode program is built)."""
+        if flops is not None:
+            self._g_decode_flops.set(flops)
+        if bytes_accessed is not None:
+            self._g_decode_bytes.set(bytes_accessed)
+
+    def set_peak_flops(self, peak_flops):
+        """Device peak FLOP/s the MFU estimate is computed against
+        (None = unknown -> the gauge reads 0)."""
+        self._peak_flops = None if not peak_flops else float(peak_flops)
+
+    def estimated_mfu(self):
+        """Rough MFU: decode_steps * flops_per_decode over the busy
+        wall window, against peak FLOP/s. An ESTIMATE — prefill flops
+        are excluded and the busy window includes host time — but it
+        trends correctly and costs nothing to keep on."""
+        peak = self._peak_flops
+        flops = self._g_decode_flops.value
+        if not peak or not flops or self._t_first_work is None \
+                or self._t_last_work is None:
+            return 0.0
+        busy = self._t_last_work - self._t_first_work
+        if busy <= 0:
+            return 0.0
+        return self.decode_steps * flops / (busy * peak)
+
+    def enable_device_memory(self, stats_fn):
+        """Register HBM pull gauges backed by ``stats_fn()`` (a
+        callable returning observability.device_memory_stats()-shaped
+        dicts). Only called on backends that actually report — CPU
+        serves no HBM gauges rather than zeros."""
+
+        def field(name):
+            stats = stats_fn()
+            v = (stats or {}).get(name)
+            return 0.0 if v is None else float(v)
+
+        self.registry.gauge(
+            "serving_hbm_bytes_in_use", "device memory in use (bytes)"
+        ).set_function(lambda: field("bytes_in_use"))
+        self.registry.gauge(
+            "serving_hbm_bytes_free",
+            "device memory headroom: bytes_limit - bytes_in_use"
+        ).set_function(lambda: field("bytes_free"))
 
     # --------------------------------------------------------- derived
     def tokens_per_sec(self):
@@ -253,4 +338,5 @@ class ServingMetrics:
             "sync_s": round(sync_s, 4),
             "span_s": {k: round(v, 4) for k, v in self.span_s.items()},
             "latency_percentiles": self.latency_percentiles(),
+            "slo": self.slo.report(),
         }

@@ -69,16 +69,31 @@ class Request:
 
 
 class StepScheduler:
-    """FIFO queue + slot table + per-slot stop conditions."""
+    """FIFO queue + slot table + per-slot stop conditions.
 
-    def __init__(self, buckets, cache_len):
+    ``completed`` is a keep-last-N ring (``completed_keep``, default
+    4096): a serve-forever process retires requests indefinitely, and
+    retaining every Request object ever finished is the same leak
+    class the unbounded latency lists were — aggregate accounting
+    lives in ServingMetrics, per-request forensics in the (also
+    bounded) flight recorder. ``flight`` is an optional
+    observability.FlightRecorder receiving enqueue/admission lifecycle
+    events (the engine feeds it the rest).
+    """
+
+    def __init__(self, buckets, cache_len, completed_keep=4096,
+                 flight=None):
         self.buckets = sorted(int(b) for b in buckets)
         self.cache_len = int(cache_len)
         if not self.buckets:
             raise ValueError("need at least one prefill bucket")
+        if completed_keep is not None and completed_keep < 1:
+            raise ValueError("completed_keep must be >= 1 (or None "
+                             "for unbounded)")
         self.queue = collections.deque()
         self.active = {}       # slot -> Request
-        self.completed = []
+        self.completed = collections.deque(maxlen=completed_keep)
+        self.flight = flight
 
     def bucket_for(self, prompt_len):
         """Smallest bucket that holds the prompt — prompt-length variety
@@ -98,6 +113,8 @@ class StepScheduler:
                 f"prompt {n} + max_new_tokens {request.max_new_tokens} "
                 f"exceeds the per-slot cache capacity {self.cache_len}")
         self.queue.append(request)
+        if self.flight is not None:
+            self.flight.enqueued(request)
         return request
 
     def admit(self, pool, group_sizes=(1,)):
@@ -124,18 +141,31 @@ class StepScheduler:
             by_bucket.setdefault(self.bucket_for(len(req.prompt)),
                                  []).append((req, slot))
         groups = []
-        for members in by_bucket.values():
+        for bucket, members in by_bucket.items():
             i = 0
             while i < len(members):
                 take = max(g for g in sizes if g <= len(members) - i)
-                groups.append(members[i:i + take])
+                group = members[i:i + take]
+                groups.append(group)
+                if self.flight is not None:
+                    for req, slot in group:
+                        self.flight.admitted(req, slot, bucket,
+                                             len(group))
                 i += take
         return groups
 
-    def should_stop(self, request, token):
+    def stop_reason(self, request, token):
+        """Why the request stops on ``token``: "eos" / "max_tokens" /
+        None (keep decoding) — the flight recorder's retirement
+        attribution."""
         if request.eos_id is not None and token == request.eos_id:
-            return True
-        return len(request.generated) >= request.max_new_tokens
+            return "eos"
+        if len(request.generated) >= request.max_new_tokens:
+            return "max_tokens"
+        return None
+
+    def should_stop(self, request, token):
+        return self.stop_reason(request, token) is not None
 
     def saturated(self, request):
         """True when the tokens already read plus the tokens still in

@@ -91,6 +91,41 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         assert wd["compiles_total"] == snap["compiles"] > 0
         assert all(e["call_site"] and e["signature"]
                    for e in wd["events"])   # every compile attributed
+        # PR 4 request-level sections: SLO/goodput accounting under
+        # the configured targets...
+        slo = evidence["slo"]
+        assert set(slo) >= {"config", "requests", "attained",
+                            "attainment", "violations",
+                            "goodput_tokens", "total_tokens",
+                            "goodput_fraction", "window"}
+        assert slo["config"]["slo_ttft_ms"] is not None
+        assert slo["requests"] == snap["requests_completed"] > 0
+        assert 0 <= slo["goodput_tokens"] <= slo["total_tokens"]
+        assert slo["total_tokens"] == snap["tokens_generated"]
+        assert set(slo["window"]) == {"ttft", "tpot", "request_latency"}
+        for entry in slo["window"].values():
+            assert set(entry) == {"count", "p50_ms", "p90_ms", "p99_ms"}
+        # ...the device cost model (graceful nulls on non-reporting
+        # backends — flops/bytes DO report on CPU)...
+        cm = evidence["cost_model"]
+        assert set(cm) >= {"device", "executables",
+                           "executables_with_cost",
+                           "decode_flops_per_step", "peak_flops",
+                           "estimated_mfu", "device_memory"}
+        assert len(cm["executables"]) == wd["compiles_total"]
+        assert cm["executables_with_cost"] > 0
+        assert cm["decode_flops_per_step"] > 0
+        # ...and sampled flight-recorder lifecycle traces with the
+        # full enqueue->retire event chain
+        traces = evidence["request_traces"]
+        assert traces
+        for tr in traces:
+            assert tr["reason"] in ("eos", "max_tokens")
+            names = [e["event"] for e in tr["events"]]
+            assert names[0] == "enqueued" and names[-1] == "retired"
+            assert "first_token" in names and "admitted" in names
+            ts = [e["t"] for e in tr["events"]]
+            assert ts == sorted(ts)          # lifecycle is monotone
         dq = evidence["deep_queue"]
         assert dq["group_sizes_used"] and \
             max(dq["group_sizes_used"]) > 1   # grouped prefill fired

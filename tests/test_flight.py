@@ -1,0 +1,442 @@
+"""Request-level observability (PR 4): the flight recorder's
+lifecycle traces flow-linked through the chrome trace (validated by a
+mini chrome-trace validator, not eyeballed), SLO/goodput accounting
+with sliding-window percentiles, bounded completed-request retention,
+the /debug endpoints, the cleanly-stoppable metrics server handle, and
+device cost telemetry on watchdog compile records.
+
+Acceptance criteria pinned here: a real engine run dumps a chrome
+trace where each request's admit -> prefill -> first-token -> retire
+path is flow-linked (matched s/f ids, every flow point inside an
+existing span) and per-request lifecycle timestamps are monotone;
+/metrics exposes SLO attainment, goodput tokens and window
+percentiles; cost_analysis appears in watchdog records with graceful
+fallback.
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import (
+    FlightRecorder, HostSpanRecorder, MetricsRegistry, SLOTracker,
+    WindowedReservoir, device_memory_stats, executable_cost,
+    start_metrics_server,
+)
+from paddle_tpu.observability.flight import (
+    ADMITTED, ENQUEUED, FIRST_TOKEN, PREFILL_DISPATCHED, RETIRED,
+)
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _drive(eng, rs, specs):
+    reqs = [eng.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                            max_new_tokens=k) for n, k in specs]
+    eng.run()
+    return reqs
+
+
+# ------------------------------------------- mini chrome-trace validator
+
+_EPS = 0.51  # us rounding slack (ts rounded to 3 decimals in export)
+
+
+def validate_chrome_flows(trace, expect_finished=True):
+    """Assert the flow events in a chrome trace dict are well-formed:
+    every chain has exactly one "s" (and, when ``expect_finished``,
+    exactly one terminal "f"), phases are time-ordered, and EVERY flow
+    point lies inside an existing "X" span on the same pid/tid (the
+    slice a viewer binds the arrow to). Returns {flow_id: chain}."""
+    events = trace["traceEvents"]
+    xs = [e for e in events if e["ph"] == "X"]
+    flows = [e for e in events if e["ph"] in ("s", "t", "f")]
+    assert flows, "no flow events in trace"
+    by_id = {}
+    for f in flows:
+        for field in ("name", "id", "ts", "pid", "tid", "cat"):
+            assert field in f, f"flow event missing {field}: {f}"
+        by_id.setdefault(f["id"], []).append(f)
+    for fid, chain in by_id.items():
+        chain.sort(key=lambda e: e["ts"])
+        phases = [e["ph"] for e in chain]
+        assert phases[0] == "s", f"flow {fid} doesn't start with s"
+        assert phases.count("s") == 1, f"flow {fid} has multiple starts"
+        if expect_finished:
+            assert phases[-1] == "f", f"flow {fid} never finishes"
+            assert phases.count("f") == 1
+            assert all(p == "t" for p in phases[1:-1])
+        for f in chain:
+            assert any(
+                x["pid"] == f["pid"] and x["tid"] == f["tid"]
+                and x["ts"] - _EPS <= f["ts"] <= x["ts"] + x["dur"] + _EPS
+                for x in xs), \
+                f"flow point binds to no span: {f}"
+    return by_id
+
+
+# ----------------------------------------------------- windowed reservoir
+
+def test_windowed_reservoir_slides_and_bounds():
+    clock = [0.0]
+    res = WindowedReservoir(window_s=10.0, capacity=4,
+                            clock=lambda: clock[0])
+    for i in range(4):
+        clock[0] = float(i)
+        res.add(float(i))
+    assert res.count() == 4 and res.seen == 4
+    # capacity bound: a 5th point inside the window drops the OLDEST
+    clock[0] = 8.0
+    res.add(100.0)
+    assert res.count() == 4
+    assert 0.0 not in res.values()
+    # the window slides: 12s later only the recent points remain
+    clock[0] = 15.0
+    assert res.values() == [100.0]
+    assert res.percentile(50) == 100.0
+    # and empties entirely once everything ages out
+    clock[0] = 100.0
+    assert res.count() == 0 and res.percentile(99) is None
+    # seen is lifetime, not window
+    assert res.seen == 5
+
+
+def test_gauge_set_function_pulls_at_exposition():
+    reg = MetricsRegistry()
+    state = {"v": 1.0}
+    reg.gauge("pull_g", "pull gauge").set_function(lambda: state["v"])
+    assert reg.get("pull_g").value == 1.0
+    state["v"] = 42.0            # no set() call — pulled at read
+    assert reg.get("pull_g").value == 42.0
+    assert "pull_g 42" in reg.prometheus_text()
+    assert reg.snapshot()["pull_g"]["values"][""] == 42.0
+
+
+# ------------------------------------------------- metrics server handle
+
+def test_metrics_server_handle_close_idempotent_and_ctx():
+    reg = MetricsRegistry()
+    reg.counter("hits_total").inc(3)
+    handle = start_metrics_server(reg, port=0)
+    port = handle.port
+    assert handle.server_address[1] == port      # legacy surface
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    assert "hits_total 3" in body
+    handle.close()
+    handle.close()                               # idempotent
+    assert handle.closed
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                               timeout=2)
+    # context-manager form, with an extra JSON route mounted
+    with start_metrics_server(
+            reg, port=0,
+            extra_routes={"/debug/x": lambda: {"ok": True}}) as h:
+        js = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{h.port}/debug/x", timeout=10).read())
+        assert js == {"ok": True}
+    assert h.closed
+
+
+# ------------------------------------------------------------ SLOTracker
+
+def test_slo_tracker_verdicts_and_goodput():
+    reg = MetricsRegistry()
+    slo = SLOTracker(reg, slo_ttft_ms=100.0, slo_tpot_ms=10.0)
+    # attained: ttft 50ms, 11 tokens over 150ms -> tpot 10ms exactly
+    assert slo.observe_request(0.05, 0.15, 11) == []
+    # ttft violation only (tpot 8ms, under the 10ms target)
+    assert slo.observe_request(0.5, 0.54, 6) == ["ttft"]
+    # both dimensions violated
+    assert slo.observe_request(0.2, 2.2, 11) == ["ttft", "tpot"]
+    # single-token request: TPOT not judged (no inter-token interval)
+    assert slo.observe_request(0.05, 0.05, 1) == []
+    rep = slo.report()
+    assert rep["requests"] == 4 and rep["attained"] == 2
+    assert rep["attainment"] == 0.5
+    assert rep["violations"] == {"ttft": 2, "tpot": 1}
+    assert rep["goodput_tokens"] == 12          # 11 + 1
+    assert rep["total_tokens"] == 29
+    assert rep["goodput_fraction"] == round(12 / 29, 4)
+    assert rep["window"]["ttft"]["count"] == 4
+    assert rep["window"]["tpot"]["count"] == 3  # 1-token req excluded
+    # registry counters back the same numbers (the /metrics view)
+    assert reg.get("serving_goodput_tokens_total").value == 12
+    assert reg.get("serving_slo_violations_total") \
+        .labels("ttft").value == 2
+
+
+def test_slo_tracker_untargeted_attains_everything():
+    reg = MetricsRegistry()
+    slo = SLOTracker(reg)                       # no SLOs configured
+    assert slo.observe_request(5.0, 50.0, 10) == []
+    rep = slo.report()
+    assert rep["attainment"] == 1.0 and rep["goodput_fraction"] == 1.0
+    assert rep["config"]["slo_ttft_ms"] is None
+
+
+# -------------------------------------------------- flight recorder unit
+
+class _FakeReq:
+    def __init__(self, rid, prompt_len=4, max_new_tokens=8):
+        self.rid = rid
+        self.prompt = list(range(prompt_len))
+        self.max_new_tokens = max_new_tokens
+        self.generated = []
+
+
+def test_flight_recorder_ring_bounded_and_lookup():
+    rec = HostSpanRecorder(capacity=1024)
+    fl = FlightRecorder(recorder=rec, keep_last=3, decode_window=2)
+    reqs = [_FakeReq(i) for i in range(5)]
+    for r in reqs:
+        fl.enqueued(r)
+        fl.admitted(r, slot=0, bucket=8, group_size=1)
+        fl.prefill_dispatched(r, bucket=8, group_size=1)
+        r.generated = [1]
+        fl.token_emitted(r, 1)
+        r.generated = [1, 2]
+        fl.token_emitted(r, 2)        # decode_window event (n=2)
+        fl.retired(r, "eos")
+    st = fl.state()
+    assert st["completed_kept"] == 3 and st["completed_dropped"] == 2
+    assert st["active"] == 0
+    assert fl.trace(0) is None        # evicted from the ring
+    tr = fl.trace(4)
+    assert tr.reason == "eos"
+    names = [e["event"] for e in tr.events]
+    assert names == [ENQUEUED, ADMITTED, PREFILL_DISPATCHED,
+                     FIRST_TOKEN, "decode_window", RETIRED]
+    ts = [e["t"] for e in tr.events]
+    assert ts == sorted(ts)
+    d = tr.as_dict()
+    assert d["events"][0]["t_rel_ms"] == 0.0
+    json.dumps(fl.debug_requests())   # JSON-safe end to end
+    # marker spans + flow chain landed in the host recorder
+    assert any(s.name == "request/enqueued" for s in rec.spans())
+    flows = [f for f in rec.flows() if f.fid == 4]
+    assert [f.phase for f in flows] == ["s", "t", "t", "t", "t", "f"]
+
+
+# -------------------------------- acceptance: flow-linked engine traces
+
+def test_engine_chrome_trace_flow_links_requests(tmp_path):
+    """A REAL engine run dumps a chrome trace where each request's
+    enqueue -> admit -> prefill -> first-token -> retire path is a
+    well-formed flow chain bound to existing spans, and each
+    RequestTrace's lifecycle timestamps are monotone."""
+    rec = obs.default_recorder()
+    rec.clear()
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    rs = np.random.RandomState(0)
+    reqs = _drive(eng, rs, [(5, 4), (9, 5), (12, 3), (6, 4)])
+    path = str(tmp_path / "flight_trace.json")
+    rec.dump_chrome_trace(path)
+    with open(path) as fh:
+        trace = json.load(fh)
+    chains = validate_chrome_flows(trace)
+    # one flow chain per request, id == rid
+    assert set(chains) == {r.rid for r in reqs}
+    for r in reqs:
+        chain = chains[r.rid]
+        events = [e["args"]["event"] for e in chain]
+        assert events[0] == ENQUEUED and events[-1] == RETIRED
+        assert ADMITTED in events and FIRST_TOKEN in events
+        assert PREFILL_DISPATCHED in events
+        # the engine-side record agrees and is monotone
+        tr = eng.request_trace(r.rid)
+        t_seq = [tr.t_of(ENQUEUED), tr.t_of(ADMITTED),
+                 tr.t_of(FIRST_TOKEN), tr.t_of(RETIRED)]
+        assert all(t is not None for t in t_seq)
+        assert t_seq == sorted(t_seq)
+        assert tr.reason == "max_tokens"
+        assert tr.as_dict()["events"][-1]["slo_violations"] == []
+
+
+def test_engine_flow_chains_span_multiple_steps(tmp_path):
+    """The flow chain of a long request crosses SEVERAL serving/step
+    spans — the 'follow one request across steps' property that makes
+    the Perfetto view useful, asserted by timestamp containment."""
+    rec = obs.default_recorder()
+    rec.clear()
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    rs = np.random.RandomState(1)
+    (req,) = _drive(eng, rs, [(5, 10)])
+    trace = rec.chrome_trace()
+    steps = [e for e in trace["traceEvents"]
+             if e["ph"] == "X" and e["name"] == "serving/step"]
+    chain = validate_chrome_flows(trace)[req.rid]
+
+    def step_of(f):
+        for i, s in enumerate(steps):
+            if s["ts"] - _EPS <= f["ts"] <= s["ts"] + s["dur"] + _EPS:
+                return i
+        return None
+
+    hit_steps = {step_of(f) for f in chain} - {None}
+    assert len(hit_steps) >= 2, \
+        "flow chain never crossed an engine step boundary"
+
+
+# --------------------------------------------- engine SLO + /metrics
+
+def test_engine_slo_exposed_on_metrics_and_snapshot():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8,
+                        slo_ttft_ms=60000.0, slo_tpot_ms=60000.0)
+    rs = np.random.RandomState(2)
+    _drive(eng, rs, [(5, 3), (9, 4), (7, 3)])
+    snap = eng.metrics.snapshot()
+    slo = snap["slo"]
+    assert slo["requests"] == 3 and slo["attainment"] == 1.0
+    assert slo["goodput_tokens"] == slo["total_tokens"] == 10
+    assert slo["window"]["ttft"]["count"] == 3
+    assert slo["window"]["ttft"]["p50_ms"] > 0
+    text = eng.metrics.prometheus_text()
+    assert "serving_slo_attained_total 3" in text
+    assert "serving_goodput_tokens_total 10" in text
+    assert 'serving_window_ttft_ms{quantile="p50"}' in text
+
+
+def test_engine_slo_violations_zero_goodput():
+    m = _model()
+    # impossible SLOs: every request violates, goodput is zero
+    eng = ServingEngine(m, num_slots=2, bucket_min=8,
+                        slo_ttft_ms=0.0001, slo_tpot_ms=0.0001)
+    rs = np.random.RandomState(3)
+    reqs = _drive(eng, rs, [(5, 3), (9, 4)])
+    slo = eng.metrics.snapshot()["slo"]
+    assert slo["attained"] == 0 and slo["goodput_tokens"] == 0
+    assert slo["violations"]["ttft"] == 2
+    assert slo["goodput_fraction"] == 0.0
+    # the flight recorder stamped the verdict on the retirement event
+    tr = eng.request_trace(reqs[0].rid)
+    assert "ttft" in tr.as_dict()["events"][-1]["slo_violations"]
+
+
+# ---------------------------------------------------- bounded retention
+
+def test_completed_retention_bounded():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8,
+                        completed_keep=4, trace_keep=3)
+    rs = np.random.RandomState(4)
+    specs = [(int(n), 2) for n in rs.randint(2, 12, 10)]
+    _drive(eng, rs, specs)
+    assert eng.metrics.requests_completed == 10   # accounting is exact
+    assert len(eng.scheduler.completed) == 4      # retention is bounded
+    st = eng.flight.state()
+    assert st["completed_kept"] == 3
+    assert st["completed_dropped"] == 7
+
+
+# ------------------------------------------------------ debug endpoints
+
+def test_engine_debug_endpoints_and_close():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8)
+    rs = np.random.RandomState(5)
+    reqs = _drive(eng, rs, [(5, 3), (9, 4)])
+    handle = eng.serve_metrics()
+    port = handle.port
+    req_js = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/requests", timeout=10).read())
+    assert {t["rid"] for t in req_js["completed"]} == \
+        {r.rid for r in reqs}
+    assert req_js["state"]["active"] == 0
+    state = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/state", timeout=10).read())
+    assert state["queue_depth"] == 0 and state["active_slots"] == {}
+    assert state["compiles"] == eng.metrics.compiles
+    assert state["watchdog"]["steady_state_compiles"] == 0
+    assert state["slo"]["requests"] == 2
+    # the engine shuts its servers down with itself
+    eng.close()
+    assert handle.closed
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/debug/state",
+                               timeout=2)
+    eng.close()                                   # idempotent
+
+
+# ------------------------------------------------- device cost telemetry
+
+def test_watchdog_compile_records_carry_cost():
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8, peak_flops=1e12)
+    rs = np.random.RandomState(6)
+    _drive(eng, rs, [(5, 3), (9, 4)])
+    events = eng.watchdog.events()
+    assert events
+    for e in events:
+        assert "cost" in e and "memory" in e      # keys always present
+    # CPU's XLA reports cost_analysis: the decode executable's record
+    # carries real flops/bytes
+    decode = [e for e in events if e["key"] == "('decode',)"]
+    assert decode and decode[0]["cost"]["flops"] > 0
+    assert decode[0]["cost"]["bytes_accessed"] > 0
+    # memory_stats is None on CPU — the graceful-fallback contract
+    assert decode[0]["memory"] is None
+
+    cm = eng.cost_model()
+    assert cm["decode_flops_per_step"] == decode[0]["cost"]["flops"]
+    assert cm["executables_with_cost"] == len(events)
+    assert cm["peak_flops"] == 1e12
+    assert cm["estimated_mfu"] > 0                # peak known -> estimate
+    assert cm["device_memory"] is None            # CPU
+    json.dumps(cm)                                # artifact-embeddable
+    # per-step gauges feed /metrics
+    text = eng.metrics.prometheus_text()
+    assert "serving_decode_flops_per_step" in text
+    assert "serving_estimated_mfu" in text
+
+
+def test_cost_helpers_graceful_on_nonreporting_backends():
+    class _NoCost:
+        def cost_analysis(self):
+            raise NotImplementedError
+
+    class _WeirdCost:
+        def cost_analysis(self):
+            return "not-a-dict"
+
+    class _ListCost:
+        def cost_analysis(self):
+            return [{"flops": 12.0, "bytes accessed": 34.0,
+                     "utilization0{}": 1.0}]
+
+    assert executable_cost(_NoCost()) is None
+    assert executable_cost(_WeirdCost()) is None
+    assert executable_cost(_ListCost()) == \
+        {"flops": 12.0, "bytes_accessed": 34.0}
+
+    class _NoMem:
+        def memory_stats(self):
+            return None
+
+    class _Mem:
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "bytes_limit": 110,
+                    "weird": object()}
+
+    assert device_memory_stats(_NoMem()) is None
+    stats = device_memory_stats(_Mem())
+    assert stats["bytes_free"] == 100
+    assert "weird" not in stats
