@@ -1,0 +1,51 @@
+"""Trace/graph static analysis: tracer-leak detection + jaxpr lint.
+
+Two tools over the compiler path, mirroring what PR 3/4 gave the
+serving path (the attributed compile watchdog):
+
+* **Tracer-leak detector** (:mod:`.birth`) — birth-site attribution
+  for Tensors created under a TraceContext, sub-trace scopes at the
+  static/nn.py cond/while lowering boundaries, and
+  :func:`check_trace`, which turns the classic dy2static failure
+  (a constant born inside a ``while_cond`` sub-trace captured by the
+  outer replay) into a structured :class:`TracerLeakError` naming the
+  birth op, the birth trace and the escape site — instead of JAX's
+  opaque UnexpectedTracerError. Off by default; enable with
+  :func:`birth_tracking` or ``PADDLE_TPU_ANALYSIS=1``.
+
+* **Jaxpr lint** (:mod:`.lint`) — :func:`lint_jaxpr` runs pluggable
+  passes (``f64-upcast``, ``donation``, ``dynamic-shape-risk``,
+  ``host-callback``) over lowered programs and emits machine-readable
+  findings. Entry points: ``ServingEngine.lint()`` (decode
+  executable + donation/watchdog cross-checks),
+  ``TracedFunction.lint()`` (to_static compiled steps), and
+  ``tools/lint_graft.py`` (repo self-lint, JSON output, nonzero exit
+  on error findings).
+
+Quick start::
+
+    from paddle_tpu import analysis
+
+    with analysis.birth_tracking():      # attribute any tracer leak
+        traced_step(x)                   # raises TracerLeakError w/ provenance
+
+    findings = analysis.lint_fn(fn, jnp.ones((8, 8)))
+    print(analysis.findings_to_json(findings))
+
+    engine.lint()                        # serving decode executable
+"""
+import os as _os
+
+from .birth import (  # noqa: F401
+    BirthSite, TracerLeakError, birth_of, birth_tracking, check_trace,
+    disable, enable, enabled, subtrace,
+)
+from .lint import (  # noqa: F401
+    Finding, SEVERITIES, donated_invars_from_argnums, eqn_site,
+    findings_to_json, iter_eqns, lint_fn, lint_jaxpr, lint_passes,
+    register_lint_pass,
+)
+
+if _os.environ.get("PADDLE_TPU_ANALYSIS", "").lower() not in (
+        "", "0", "false", "off"):
+    enable()

@@ -1,0 +1,337 @@
+"""Tracer-leak detector: birth-site attribution for trace-created Tensors.
+
+The to_static record/replay pipeline (core/trace.py, jit/to_static.py)
+discovers a compiled step's inputs by watching which pre-existing
+Tensors the step READS. That discovery has a failure shape with terrible
+ergonomics: a Tensor constructed *inside* a lax sub-trace (a cond branch
+or while cond/body lowered via static/nn.py) that is not registered as
+trace-created gets classified as a pre-existing capture — and the value
+it carries is a tracer of a sub-trace that is already dead by replay
+time. JAX eventually notices, deep inside the jitted call, with an
+UnexpectedTracerError that names neither the op that created the value
+nor the trace it belonged to.
+
+This module turns that failure into an attributed, structured error:
+
+  * **birth sites** — while tracking is enabled, every Tensor
+    constructed under a TraceContext records who made it (the creating
+    op or function), where (call-site ``file:line``), in which trace
+    and under which sub-trace scope (``while_cond#3``). Capture is a
+    single frame walk; when tracking is off (the default) the only cost
+    anywhere is one ``is not None`` test in ``Tensor.__init__``.
+  * **sub-trace scopes** — static/nn.py's ``_lift`` boundaries (the
+    cond/while/switch lowering points) push a labelled scope around
+    each branch/cond/body trace and run :func:`check_trace` when the
+    scope closes.
+  * **escape checks** — a read that would capture a tensor born under
+    a sub-trace (the leak-in-the-making) records the escape site; when
+    the sub-trace closes with such a capture outstanding — or a later
+    read touches a tensor whose birth sub-trace is already closed —
+    a :class:`TracerLeakError` is raised naming the birth op, the
+    birth trace, and the escape site, instead of JAX's opaque error.
+
+Enable with :func:`birth_tracking` (context manager), :func:`enable` /
+:func:`disable`, or the ``PADDLE_TPU_ANALYSIS=1`` environment variable
+(read at ``paddle_tpu.analysis`` import).
+"""
+import contextlib
+import os
+import sys
+import threading
+import weakref
+from collections import namedtuple
+
+from ..core import trace as trace_mod
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CORE_DIR = os.path.join(_PKG_DIR, "core")
+_SELF = os.path.abspath(__file__)
+
+#: Who created a Tensor, where, and under which (sub-)trace.
+BirthSite = namedtuple("BirthSite", ["op", "site", "trace", "subtrace"])
+
+
+class TracerLeakError(RuntimeError):
+    """A value born under a sub-trace escaped into its outer trace.
+
+    ``findings`` is a list of machine-readable dicts, each with keys
+    ``tensor``, ``birth_op``, ``birth_site``, ``birth_trace`` and
+    ``escape_site``.
+    """
+
+    def __init__(self, message, findings=()):
+        super().__init__(message)
+        self.findings = list(findings)
+
+
+class _BirthState:
+    __slots__ = ("enabled", "births", "captures", "stack", "closed",
+                 "counter")
+
+    def __init__(self):
+        self.enabled = 0
+        self.reset()
+
+    def reset(self):
+        self.births = {}    # id(tensor) -> (weakref, BirthSite)
+        self.captures = {}  # id(tensor) -> escape call-site
+        self.stack = []     # active sub-trace tags, innermost last
+        self.closed = set()  # tags of exited sub-traces
+        self.counter = 0
+
+
+_state = threading.local()
+
+
+def _st():
+    st = getattr(_state, "birth", None)
+    if st is None:
+        st = _state.birth = _BirthState()
+    return st
+
+
+def enabled():
+    return _st().enabled > 0
+
+
+def enable():
+    """Turn birth tracking on (reentrant; see :func:`birth_tracking`)."""
+    st = _st()
+    st.enabled += 1
+    if st.enabled == 1:
+        st.reset()
+    trace_mod._birth_hook = _record_birth
+    trace_mod._capture_hook = _on_capture
+
+
+def disable():
+    st = _st()
+    if st.enabled > 0:
+        st.enabled -= 1
+    if st.enabled == 0:
+        trace_mod._birth_hook = None
+        trace_mod._capture_hook = None
+
+
+@contextlib.contextmanager
+def birth_tracking():
+    """``with birth_tracking():`` — attribute tracer leaks in the block."""
+    enable()
+    try:
+        yield
+    finally:
+        disable()
+
+
+# ---------------------------------------------------------------- hooks
+
+def _is_internal(filename):
+    return (filename.startswith(_CORE_DIR) or filename == _SELF)
+
+
+def _birth_frame():
+    """(op, site) of the Tensor construction: the innermost frame
+    outside core/ — for op-dispatcher outputs the registered op name is
+    lifted from the Op.__call__ frame passed on the way out."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover - interpreter edge
+        return "<unknown>", "<unknown>"
+    op = None
+    for _ in range(32):
+        if f is None:
+            break
+        code = f.f_code
+        if _is_internal(code.co_filename):
+            if (os.path.basename(code.co_filename) == "dispatch.py"
+                    and code.co_name == "__call__" and op is None):
+                name = getattr(f.f_locals.get("self"), "name", None)
+                if name:
+                    op = str(name)
+            f = f.f_back
+            continue
+        site = f"{code.co_filename}:{f.f_lineno}"
+        return op or code.co_name, site
+    return op or "<unknown>", "<unknown>"
+
+
+_OPS_DIR = os.path.join(_PKG_DIR, "ops")
+
+
+def _caller_site():
+    """Innermost frame outside core/, ops/ and this module — the escape
+    site of a leaking read (the code that consumed the leaked value,
+    not the op wrapper it flowed through)."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:  # pragma: no cover
+        return "<unknown>"
+    for _ in range(32):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        if _is_internal(fn) or fn.startswith(_OPS_DIR):
+            f = f.f_back
+            continue
+        return f"{f.f_code.co_filename}:{f.f_lineno} ({f.f_code.co_name})"
+    return "<unknown>"
+
+
+def _record_birth(tensor):
+    """trace_mod._birth_hook: stamp a birth record on every Tensor
+    constructed under an active TraceContext while tracking is on."""
+    st = _st()
+    if not st.enabled:
+        return
+    ctx = trace_mod.current_trace()
+    if ctx is None:
+        return
+    op, site = _birth_frame()
+    tid = id(tensor)
+    births = st.births
+
+    def _gone(_ref, tid=tid, births=births):
+        births.pop(tid, None)
+
+    births[tid] = (weakref.ref(tensor, _gone),
+                   BirthSite(op, site,
+                             f"{ctx.mode}@{id(ctx) & 0xffffff:06x}",
+                             st.stack[-1] if st.stack else ""))
+
+
+def _is_tracer(value):
+    import jax.core as jcore
+    return isinstance(value, jcore.Tracer)
+
+
+def _on_capture(ctx, tensor):
+    """trace_mod._capture_hook: a read is about to CAPTURE ``tensor``
+    as a pre-existing input (record-mode read / jit-mode constant
+    embed). If the tensor was born under a sub-trace that has already
+    closed and still holds a tracer, that is a live leak — raise with
+    full provenance. Otherwise remember the escape site so the
+    sub-trace exit check can attribute it."""
+    st = _st()
+    if not st.enabled:
+        return
+    rec = st.births.get(id(tensor))
+    if rec is None:
+        return
+    birth = rec[1]
+    if not birth.subtrace:
+        return
+    site = _caller_site()
+    st.captures[id(tensor)] = site
+    if birth.subtrace not in st.stack and _is_tracer(tensor._value):
+        finding = _finding(tensor, birth, site)
+        raise TracerLeakError(_message(finding), [finding])
+
+
+def _finding(tensor, birth, escape_site):
+    return {
+        "tensor": tensor.name,
+        "birth_op": birth.op,
+        "birth_site": birth.site,
+        "birth_trace": birth.subtrace or birth.trace,
+        "escape_site": escape_site or "<captured by outer trace>",
+    }
+
+
+def _message(finding):
+    return (
+        f"tracer leak: value {finding['tensor']!r} born in "
+        f"{finding['birth_op']} at {finding['birth_site']} under trace "
+        f"{finding['birth_trace']} escaped its owning trace — captured "
+        f"by the outer replay at {finding['escape_site']}. A Tensor "
+        "created inside a cond/while sub-trace must be registered with "
+        "the active TraceContext (trace_mod.adopt / "
+        "ctx.register_created); an unregistered one is mis-classified "
+        "as a pre-existing capture and carries a dead sub-trace tracer "
+        "into the compiled replay.")
+
+
+# ------------------------------------------------------------- checking
+
+def birth_of(tensor):
+    """The BirthSite recorded for ``tensor``, or None."""
+    rec = _st().births.get(id(tensor))
+    return rec[1] if rec is not None else None
+
+
+def check_trace(ctx=None, raise_error=True):
+    """Walk ``ctx``'s recorded graph for escaped sub-trace values.
+
+    A leak is a tensor sitting in ``ctx.reads`` (a captured input of
+    the would-be compiled program) whose birth record says it was born
+    under a sub-trace that is no longer active, and whose value is
+    still a tracer of that dead trace. Returns the machine-readable
+    findings; raises :class:`TracerLeakError` carrying them when
+    ``raise_error`` (the default) and any were found. Run
+    automatically at every static/nn.py sub-trace exit and at
+    to_static record-phase end while tracking is enabled.
+    """
+    st = _st()
+    if ctx is None:
+        ctx = trace_mod.current_trace()
+    if ctx is None or not st.births:
+        return []
+    findings = []
+    for tid, tensor in list(ctx.reads.items()):
+        rec = st.births.get(tid)
+        if rec is None:
+            continue
+        birth = rec[1]
+        if not birth.subtrace or birth.subtrace in st.stack:
+            continue
+        if not _is_tracer(tensor._value):
+            continue
+        findings.append(_finding(tensor, birth, st.captures.get(tid)))
+    if findings and raise_error:
+        raise TracerLeakError(
+            "\n".join(_message(f) for f in findings), findings)
+    return findings
+
+
+# ------------------------------------------------------ sub-trace scope
+
+class _NullScope:
+    def __enter__(self):
+        return ""
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SCOPE = _NullScope()
+
+
+class _SubtraceScope:
+    __slots__ = ("tag",)
+
+    def __init__(self, label, st):
+        st.counter += 1
+        self.tag = f"{label}#{st.counter}"
+
+    def __enter__(self):
+        _st().stack.append(self.tag)
+        return self.tag
+
+    def __exit__(self, exc_type, *exc):
+        st = _st()
+        if self.tag in st.stack:
+            st.stack.remove(self.tag)
+        st.closed.add(self.tag)
+        if exc_type is None:
+            check_trace(trace_mod.current_trace())
+        return False
+
+
+def subtrace(label):
+    """Scope a lax sub-trace (cond branch / while cond / while body) for
+    leak attribution. No-op unless tracking is enabled; on exit the
+    current TraceContext is checked for values that escaped this
+    scope."""
+    st = _st()
+    if not st.enabled:
+        return _NULL_SCOPE
+    return _SubtraceScope(label, st)

@@ -51,6 +51,8 @@ class Tensor:
         self._grad_node = None
         self._hooks = None
         self.tp_spec = None
+        if trace_mod._birth_hook is not None:
+            trace_mod._birth_hook(self)
 
     # ---- value plumbing (trace-aware) -----------------------------------
     @property

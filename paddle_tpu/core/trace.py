@@ -24,9 +24,39 @@ import weakref
 
 _state = threading.local()
 
+# Static-analysis hooks (paddle_tpu.analysis.birth): None by default so
+# the untraced hot path pays ONE attribute test. When birth tracking is
+# enabled, _birth_hook(tensor) records a birth site for every Tensor
+# constructed under a trace, and _capture_hook(ctx, tensor) runs when a
+# read is about to CAPTURE a pre-existing tensor (record-mode read /
+# jit-mode constant embed) — the escape point of a tracer leak.
+_birth_hook = None
+_capture_hook = None
+
 
 def current_trace():
     return getattr(_state, "trace", None)
+
+
+def adopt(tensor):
+    """Register a freshly constructed constant Tensor with the innermost
+    active trace when its value is a tracer.
+
+    Constant-creating op paths (scalar wrapping, clip bounds, ...) build
+    Tensors directly instead of going through the op dispatcher, which
+    registers its outputs. Inside a lax sub-trace (cond/while bodies)
+    jnp.asarray of a python scalar yields a TRACER of that sub-trace; if
+    the Tensor holding it is not registered as trace-created, the
+    TraceContext later classifies it as a pre-existing capture and the
+    dead sub-trace tracer escapes into the outer replay
+    (UnexpectedTracerError). Concrete values keep today's capture
+    semantics untouched — only tracer-valued constants are adopted."""
+    ctx = current_trace()
+    if ctx is not None:
+        import jax.core as jcore
+        if isinstance(tensor._value, jcore.Tracer):
+            ctx.register_created(tensor)
+    return tensor
 
 
 class TraceContext:
@@ -37,8 +67,12 @@ class TraceContext:
         self.reads = {}
         # id(tensor) -> tensor, for pre-existing tensors mutated during the run
         self.writes = {}
-        # ids of tensors created during this run (their reads are internal)
-        self.created = set()
+        # id(tensor) -> weakref, for tensors created during this run
+        # (their reads are internal). Membership MUST be checked through
+        # is_created(): a dead created tensor's id can be recycled by a
+        # later allocation, and a raw id test would silently classify
+        # the newcomer as trace-created.
+        self.created = {}
         self.created_refs = []
         # jit phase: id(tensor) -> current traced value (tracer)
         self.values = {}
@@ -49,7 +83,7 @@ class TraceContext:
         tid = id(tensor)
         if tid in self.values:
             return self.values[tid]
-        if tid in self.created:
+        if self.is_created(tensor):
             # created during this very trace but its raw value still set
             return tensor._value
         if self.mode == "record":
@@ -57,6 +91,8 @@ class TraceContext:
                 raise RuntimeError(
                     f"Tensor {tensor.name!r} read inside a traced function but it "
                     "has no value (it may have escaped a previous trace)")
+            if _capture_hook is not None:
+                _capture_hook(self, tensor)
             self.reads[tid] = tensor
             return tensor._value
         # jit mode: not captured -> embed as a compile-time constant
@@ -65,11 +101,13 @@ class TraceContext:
                 f"Tensor {tensor.name!r} read inside jit trace has no concrete "
                 "value; it likely escaped a previous trace. Make sure the traced "
                 "step is self-contained (backward + step + clear_grad inside).")
+        if _capture_hook is not None:
+            _capture_hook(self, tensor)
         return tensor._value
 
     def write(self, tensor, value):
         tid = id(tensor)
-        if tid not in self.created:
+        if not self.is_created(tensor):
             self.writes[tid] = tensor
         if self.mode == "record":
             tensor._value = value
@@ -77,9 +115,15 @@ class TraceContext:
             self.values[tid] = value
 
     def register_created(self, tensor):
-        tid = id(tensor)
-        self.created.add(tid)
-        self.created_refs.append(weakref.ref(tensor))
+        ref = weakref.ref(tensor)
+        self.created[id(tensor)] = ref
+        self.created_refs.append(ref)
+
+    def is_created(self, tensor):
+        """Was THIS tensor (identity, not recycled id) created during
+        the trace?"""
+        ref = self.created.get(id(tensor))
+        return ref is not None and ref() is tensor
 
     # -- jit phase helpers -------------------------------------------------
     def bind(self, tensor, tracer):

@@ -24,6 +24,8 @@ data-dependent branching inside a compiled step should use tensor ops
 """
 import functools
 
+import numpy as np
+
 import jax
 
 from ..core import trace as trace_mod
@@ -147,6 +149,13 @@ class TracedFunction:
         ctx = trace_mod.TraceContext("record")
         with trace_mod.trace_guard(ctx):
             out = self._fn(*args, **kwargs)
+        if trace_mod._capture_hook is not None:
+            # birth tracking on: validate the recorded graph BEFORE
+            # compiling — a sub-trace value sitting in the captured
+            # reads raises an attributed TracerLeakError here instead
+            # of an opaque jax error at the first compiled call
+            from ..analysis import birth as _birth
+            _birth.check_trace(ctx)
         reads = [t for tid, t in ctx.reads.items()]
         writes = [t for tid, t in ctx.writes.items()]
         read_ids = set(ctx.reads)
@@ -187,7 +196,7 @@ class TracedFunction:
                 grad_arrays = []
                 for t in captured:
                     g = t._grad
-                    if isinstance(g, Tensor) and id(g) in jctx.created:
+                    if isinstance(g, Tensor) and jctx.is_created(g):
                         grad_owners.append(t)
                         grad_arrays.append(jctx.final_value(g))
             return out_arrays, mut_arrays, grad_arrays
@@ -195,6 +204,7 @@ class TracedFunction:
         jitted = jax.jit(compiled_fn, donate_argnums=(1,))
         entry["compiled"] = {
             "jitted": jitted,
+            "fn": compiled_fn,  # re-traceable for analysis.lint_jaxpr
             "captured": captured,
             "mutated": mutated,
             "mut_cap_idx": mutated_in_captured,
@@ -212,8 +222,23 @@ class TracedFunction:
         mut_caps = [captured[i].value for i in c["mut_cap_idx"]]
         ro_caps = [t.value for i, t in enumerate(captured) if i not in mset]
         arg_arrays = [t.value for t in leaves]
-        out_arrays, mut_arrays, grad_arrays = c["jitted"](
-            arg_arrays, mut_caps, ro_caps)
+        try:
+            out_arrays, mut_arrays, grad_arrays = c["jitted"](
+                arg_arrays, mut_caps, ro_caps)
+        except jax.errors.UnexpectedTracerError as e:
+            # structured replacement for jax's opaque leak error: a
+            # captured input carried a dead sub-trace tracer into the
+            # replay. With birth tracking on the leak usually raises
+            # earlier WITH provenance; this is the always-on net.
+            from ..analysis.birth import TracerLeakError
+            raise TracerLeakError(
+                "to_static replay captured a value that escaped a "
+                "cond/while sub-trace (a Tensor created inside the "
+                "sub-trace was not registered with the active "
+                "TraceContext — see trace_mod.adopt). Re-run under "
+                "paddle_tpu.analysis.birth_tracking() to attribute "
+                "the birth op/trace and escape site.\n\nOriginal "
+                f"error: {e}") from e
         for t, v in zip(c["mutated"], mut_arrays):
             t._value = v
         for t, g in zip(c["grad_owners"], grad_arrays):
@@ -223,6 +248,36 @@ class TracedFunction:
 
     def concrete_program(self):
         return self._entries
+
+    # -- static analysis ---------------------------------------------------
+    def lint(self, passes=None, **meta):
+        """Run the paddle_tpu.analysis jaxpr lint over every compiled
+        entry of this traced function (the whole captured step:
+        forward + backward + optimizer when they were traced).
+        Abstract args are rebuilt from the entry's signature, so no
+        device execution happens; the mutated-captures donation the
+        compiled step uses is threaded to the ``donation`` pass.
+        Returns the combined findings (see analysis.lint_jaxpr)."""
+        from ..analysis import lint as lint_mod
+        findings = []
+        for (struct, avals, _inst), entry in self._entries.items():
+            c = entry.get("compiled")
+            if not c or "fn" not in c:
+                continue
+            arg_sds = [jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+                       for shape, dtype in avals]
+            mset = set(c["mut_cap_idx"])
+            mut_caps = [c["captured"][i].value for i in c["mut_cap_idx"]]
+            ro_caps = [t.value for i, t in enumerate(c["captured"])
+                       if i not in mset]
+            args = (arg_sds, mut_caps, ro_caps)
+            closed = jax.make_jaxpr(c["fn"])(*args)
+            findings.extend(lint_mod.lint_jaxpr(
+                closed, passes=passes,
+                donated_invars=lint_mod.donated_invars_from_argnums(
+                    args, (1,)),
+                **meta))
+        return findings
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

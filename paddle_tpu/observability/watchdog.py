@@ -212,6 +212,24 @@ class CompileWatchdog:
     def steady_state_events(self):
         return [e for e in self.events() if e["steady_state"]]
 
+    def signature_groups(self):
+        """Compile signatures grouped by executable key — the feed for
+        the analysis ``dynamic-shape-risk`` lint pass: one key compiled
+        under more than one distinct abstract-shape signature means the
+        same logical executable re-specialized per input shape (the
+        python-int-shape-derived-from-traced-values recompile source),
+        attributed by the recorded dispatch call-sites."""
+        with self._lock:
+            groups = {}
+            for e in self._events:
+                g = groups.setdefault(
+                    e["key"], {"signatures": [], "call_sites": []})
+                if e["signature"] not in g["signatures"]:
+                    g["signatures"].append(e["signature"])
+                if e["call_site"] not in g["call_sites"]:
+                    g["call_sites"].append(e["call_site"])
+            return groups
+
     def report(self):
         """JSON-ready summary — the bench artifact's ``watchdog``
         section and the test surface for the zero-recompile

@@ -12,11 +12,20 @@ import jax.numpy as jnp
 from ..core.dispatch import register_op
 from ..core.tensor import Tensor
 from ..core import dtype as dtype_mod
+from ..core import trace as trace_mod
 
 
 def _wrap_scalar(x, other):
     """Convert python scalar to the dtype of the other operand (paddle
-    semantics: scalar adopts the tensor's dtype)."""
+    semantics: scalar adopts the tensor's dtype).
+
+    The wrapped constant is ADOPTED by the innermost active trace
+    (trace_mod.adopt): inside a lax sub-trace (while_cond / cond
+    branches) jnp.asarray yields a sub-trace tracer, and an unregistered
+    Tensor holding one would be mis-classified as a pre-existing capture
+    — the dy2static while/cond tracer leak this fix closes (see
+    paddle_tpu.analysis tracer-leak detector, which attributes exactly
+    this escape shape)."""
     if isinstance(x, Tensor):
         return x
     from ..core import dispatch as _d
@@ -31,7 +40,7 @@ def _wrap_scalar(x, other):
     else:
         dt = None
     arr = jnp.asarray(x, dtype=dt)
-    return Tensor(arr)
+    return trace_mod.adopt(Tensor(arr))
 
 
 def _binary(name, fn, differentiable=True):
@@ -238,7 +247,8 @@ def clip(x, min=None, max=None, name=None):  # noqa: A002
     mx = max.value if isinstance(max, Tensor) else (max if max is not None else np.inf)
     mn = jnp.asarray(mn, x.value.dtype)
     mx = jnp.asarray(mx, x.value.dtype)
-    return _clip(x, Tensor(mn), Tensor(mx))
+    return _clip(x, trace_mod.adopt(Tensor(mn)),
+                 trace_mod.adopt(Tensor(mx)))
 
 
 @register_op("lerp")
@@ -248,7 +258,7 @@ def _lerp(x, y, w):
 
 def lerp(x, y, weight, name=None):
     if not isinstance(weight, Tensor):
-        weight = Tensor(jnp.asarray(weight, x.value.dtype))
+        weight = trace_mod.adopt(Tensor(jnp.asarray(weight, x.value.dtype)))
     return _lerp(x, y, weight)
 
 

@@ -394,6 +394,33 @@ class ServingEngine:
             "slo": self.metrics.slo.report(),
         }
 
+    def lint(self, passes=None, min_donation_bytes=1 << 20):
+        """Static-analysis findings over this engine's hot path (see
+        paddle_tpu.analysis.lint_jaxpr): the decode executable's jaxpr
+        runs through the ``f64-upcast`` / ``host-callback`` / ``donation``
+        passes, and the engine's compile watchdog feeds
+        ``dynamic-shape-risk``. The donation metadata mirrors the real
+        AOT build: kc/vc/pos donated iff ``self._donate``
+        (``metrics.kv_donation["enabled"]``), aliasing iff the backend
+        aliases donated buffers (``kv_donation["effective"]`` on) — so
+        the ``donation`` pass cross-checks
+        ``snapshot()["kv_donation"]`` by construction: a non-aliasing
+        (CPU) backend lints clean, an aliasing backend lints clean
+        exactly when the big cache buffers are donated."""
+        import jax
+        from ..analysis import lint as lint_mod
+        args = (self.params, self._toks, self._pos, self.pool.kc,
+                self.pool.vc)
+        closed = jax.make_jaxpr(self._decode_fn)(*args)
+        donate = (2, 3, 4) if self._donate else ()
+        return lint_mod.lint_jaxpr(
+            closed, passes=passes,
+            donated_invars=lint_mod.donated_invars_from_argnums(
+                args, donate),
+            backend_aliases=self._device.platform != "cpu",
+            watchdog=self.watchdog,
+            min_donation_bytes=min_donation_bytes)
+
     def cost_model(self):
         """Device cost telemetry as a JSON-safe dict (the bench
         artifact's ``cost_model`` section): per-executable

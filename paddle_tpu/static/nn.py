@@ -17,10 +17,20 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..core import trace as trace_mod
+from ..core.lazy import LazyArray as _LazyArray
 
 
 def _to_arr(v):
-    return v.value if isinstance(v, Tensor) else jnp.asarray(v)
+    """Tensor/LazyArray/python value -> raw jax array. Deferred lazy
+    values MUST materialize here: a LazyArray is a registered pytree
+    CustomNode, so one reaching a lax.cond branch output (e.g. an
+    identity branch returning a captured not-yet-flushed tensor) makes
+    the two branch structures unequal."""
+    if isinstance(v, Tensor):
+        v = v.value  # trace-aware: notifies the active TraceContext
+    if isinstance(v, _LazyArray):
+        return v.materialize()
+    return v if isinstance(v, jax.Array) else jnp.asarray(v)
 
 
 def _wrap_out(tree):
@@ -37,8 +47,16 @@ def _wrap_out(tree):
     return t
 
 
-def _lift(fn):
-    """Make a user callable operate on raw arrays: Tensor-in, array-out."""
+def _lift(fn, label="subtrace"):
+    """Make a user callable operate on raw arrays: Tensor-in, array-out.
+
+    ``label`` names the lax sub-trace this callable is lowered under
+    (while_cond / while_body / cond branches). Under an active trace
+    the body runs inside an analysis sub-trace scope: with birth
+    tracking enabled (paddle_tpu.analysis), values born here that
+    escape into the outer trace are reported as structured
+    TracerLeakErrors at scope exit; with it disabled the scope is a
+    shared no-op."""
     def lifted(*arrays):
         ctx = trace_mod.current_trace()
 
@@ -51,7 +69,9 @@ def _lift(fn):
             return jax.tree.map(_to_arr, out,
                                is_leaf=lambda x: isinstance(x, Tensor))
         if ctx is not None:
-            return run()
+            from ..analysis import birth as _birth
+            with _birth.subtrace(label):
+                return run()
         # eager call sites still trace through lax primitives fine
         with trace_mod.trace_guard(trace_mod.TraceContext("jit")):
             return run()
@@ -62,7 +82,8 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     """Reference: control_flow.py cond → conditional_block ops; here
     lax.cond — both branches compile, the predicate selects on device."""
     p = _to_arr(pred).astype(bool).reshape(())
-    out = jax.lax.cond(p, _lift(true_fn), _lift(false_fn))
+    out = jax.lax.cond(p, _lift(true_fn, "cond_true"),
+                       _lift(false_fn, "cond_false"))
     return _wrap_out(out)
 
 
@@ -74,11 +95,11 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
             for v in loop_vars]
 
     def _cond(carry):
-        out = _lift(cond_fn)(*carry)
+        out = _lift(cond_fn, "while_cond")(*carry)
         return _to_arr(out).astype(bool).reshape(())
 
     def _body(carry):
-        out = _lift(body_fn)(*carry)
+        out = _lift(body_fn, "while_body")(*carry)
         out = out if isinstance(out, (tuple, list)) else (out,)
         return tuple(out)
 
@@ -96,9 +117,9 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     else:
         items = list(enumerate(branch_fns))
     keys = [k for k, _ in items]
-    fns = [_lift(f) for _, f in items]
+    fns = [_lift(f, f"switch_branch{i}") for i, (_, f) in enumerate(items)]
     if default is not None:
-        fns.append(_lift(default))
+        fns.append(_lift(default, "switch_default"))
         default_idx = len(fns) - 1
     else:
         default_idx = len(fns) - 1  # reference: last branch is default
@@ -114,9 +135,10 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
 def case(pred_fn_pairs, default=None, name=None):
     """Reference: control_flow.py case — first true predicate wins."""
     preds = [_to_arr(p).astype(bool).reshape(()) for p, _ in pred_fn_pairs]
-    fns = [_lift(f) for _, f in pred_fn_pairs]
+    fns = [_lift(f, f"case_branch{i}")
+           for i, (_, f) in enumerate(pred_fn_pairs)]
     if default is not None:
-        fns.append(_lift(default))
+        fns.append(_lift(default, "case_default"))
     else:
         fns.append(fns[-1])
     # index of first true predicate, else default slot

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Self-lint the repo's jitted entry points (paddle_tpu.analysis).
+
+Builds the three kinds of compiled programs this framework ships —
+
+  * ``serving_decode``   — a ServingEngine on a tiny GPT, drained once
+    and warm-declared, linted via ``engine.lint()`` (f64-upcast /
+    host-callback / donation over the decode jaxpr, dynamic-shape-risk
+    over the engine's compile watchdog);
+  * ``hapi_train_step``  — a hapi.Model static-adapter train step
+    (forward + loss + backward + optimizer captured as ONE to_static
+    program), linted via ``TracedFunction.lint()``;
+  * ``to_static_sample`` — a @to_static function with tensor-bound
+    control flow (the dy2static while/cond lowering path), linted the
+    same way —
+
+and prints every finding as JSON on stdout. Exit status: 0 when no
+error-severity findings (warnings are reported but don't fail),
+1 otherwise — wired into tier-1 via tests/test_analysis.py so the repo
+stays self-clean.
+
+Usage: python tools/lint_graft.py [--pretty]
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def lint_serving_decode():
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    paddle.seed(7)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, num_slots=4)
+    rs = np.random.RandomState(0)
+    for n in (5, 9, 17):
+        engine.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                           max_new_tokens=4)
+    engine.run()
+    engine.declare_warmup()
+    return engine.lint()
+
+
+def lint_hapi_train_step():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10))
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+        nn.CrossEntropyLoss())
+    paddle.enable_static()
+    try:
+        rs = np.random.RandomState(7)
+        for _ in range(3):  # eager -> record -> compiled
+            x = rs.randn(8, 16).astype("float32")
+            y = rs.randint(0, 10, (8, 1)).astype("int64")
+            model.train_batch([x], [y])
+        step = model._static_steps["train"]
+        assert any(e["compiled"] is not None
+                   for e in step.entries.values()), \
+            "hapi train step never reached the compiled phase"
+        return step.lint()
+    finally:
+        paddle.disable_static()
+
+
+def lint_to_static_sample():
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def sample(x, n):
+        s = x * 0.0
+        for _ in range(n):  # tensor bound -> ONE lax.while_loop program
+            if s.sum() < 100.0:  # tensor pred -> lax.cond
+                s = s + x
+        return s
+
+    xp = paddle.to_tensor(np.full((8,), 0.5, np.float32))
+    for _ in range(3):  # eager -> record -> compiled
+        sample(xp, paddle.to_tensor(np.int64(6)))
+    assert any(e["compiled"] is not None
+               for e in sample.entries.values()), \
+        "to_static sample never reached the compiled phase"
+    return sample.lint()
+
+
+TARGETS = {
+    "serving_decode": lint_serving_decode,
+    "hapi_train_step": lint_hapi_train_step,
+    "to_static_sample": lint_to_static_sample,
+}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--pretty", action="store_true",
+                        help="indent the JSON report")
+    parser.add_argument("--targets", nargs="*", choices=sorted(TARGETS),
+                        default=sorted(TARGETS),
+                        help="subset of entry points to lint")
+    args = parser.parse_args(argv)
+
+    from paddle_tpu.analysis import SEVERITIES, lint_passes
+
+    findings = []
+    for name in args.targets:
+        for f in TARGETS[name]():
+            d = f.to_dict()
+            d["target"] = name
+            findings.append(d)
+    counts = {sev: sum(1 for f in findings if f["severity"] == sev)
+              for sev in SEVERITIES}
+    report = {
+        "targets": list(args.targets),
+        "passes": lint_passes(),
+        "findings": findings,
+        "counts": counts,
+        "ok": counts.get("error", 0) == 0,
+    }
+    print(json.dumps(report, indent=2 if args.pretty else None))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
