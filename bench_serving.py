@@ -133,7 +133,7 @@ def _cached_payload():
 
 
 def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
-             specs, deep, slo, seed=7):
+             specs, deep, slo, shared, seed=7):
     """One cold engine-vs-sequential measurement; returns evidence."""
     import numpy as np
 
@@ -179,6 +179,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     t_seq = time.perf_counter() - t0
 
     deep_queue = _measure_deep_queue(m_eng, num_slots, deep)
+    shared_prefix = _measure_shared_prefix(shared)
 
     import jax
     dev = jax.devices()[0]
@@ -218,6 +219,86 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         "cost_model": eng.cost_model(),
         "request_traces": traces,
         "deep_queue": deep_queue,
+        "shared_prefix": shared_prefix,
+    }
+
+
+def _measure_shared_prefix(sp):
+    """Shared-prefix scenario (ISSUE 6 / ROADMAP direction #1): R
+    requests sharing one long system-prompt prefix, drained by the
+    paged engine (radix prefix cache: tail-only prefill) and by the
+    legacy slot-contiguous pool on identical traffic. Both engines
+    warm on one full wave first (compiles + the paged engine's cache
+    seeding excluded — steady state is what a chat fleet runs at),
+    then the timed wave reports median TTFT and drain throughput.
+    ``ttft_improvement`` >= 1.3x is the acceptance bar the contract
+    test pins on the CPU smoke config."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        TransformerLMConfig)
+
+    paddle.seed(11)
+    cfg = TransformerLMConfig(
+        vocab_size=sp["vocab"], hidden_size=sp["hidden"],
+        num_layers=sp["layers"], num_heads=sp["heads"],
+        max_seq_len=sp["max_seq_len"], dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(17)
+    prefix = rs.randint(0, sp["vocab"], (sp["prefix_tokens"],)) \
+        .astype(np.int64)
+    prompts = [np.concatenate(
+        [prefix, rs.randint(0, sp["vocab"], (int(k),)).astype(np.int64)])
+        for k in rs.randint(1, sp["suffix_max"] + 1, sp["requests"])]
+    new_tokens = sp["new_tokens"]
+
+    def drain(phase, paged):
+        _set_phase(f"shared-prefix-{phase}-warmup")
+        eng = ServingEngine(model, num_slots=sp["num_slots"],
+                            bucket_min=8, paged=paged,
+                            block_size=sp["block_size"])
+        for p in prompts:                  # warmup: compiles + (paged)
+            eng.add_request(p, max_new_tokens=new_tokens)
+        eng.run()                          # radix seeding
+        eng.declare_warmup()
+        _set_phase(f"shared-prefix-{phase}-timed")
+        t0 = time.perf_counter()
+        reqs = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        eng.run()
+        dt = time.perf_counter() - t0
+        ttfts = sorted((r.t_first_token - r.t_arrival) * 1000.0
+                       for r in reqs)
+        return eng, ttfts[len(ttfts) // 2], dt
+
+    eng_paged, ttft_paged, t_paged = drain("paged", True)
+    eng_flat, ttft_flat, t_flat = drain("nonpaged", False)
+    tokens = sp["requests"] * new_tokens
+    snap = eng_paged.metrics.snapshot()
+    wd = eng_paged.watchdog.report()
+    return {
+        "requests": sp["requests"],
+        "prefix_tokens": sp["prefix_tokens"],
+        "num_slots": sp["num_slots"],
+        "block_size": sp["block_size"],
+        "new_tokens_per_request": new_tokens,
+        "paged_ttft_p50_ms": round(ttft_paged, 3),
+        "nonpaged_ttft_p50_ms": round(ttft_flat, 3),
+        "ttft_improvement": round(ttft_flat / ttft_paged, 3),
+        "paged_tokens_per_sec": round(tokens / t_paged, 2),
+        "nonpaged_tokens_per_sec": round(tokens / t_flat, 2),
+        "goodput_improvement": round(t_flat / t_paged, 3),
+        # the paged engine's cache economy + the steady-state compile
+        # invariant under paging (warmup declared before the timed
+        # wave: any compile in it would be an attributed violation)
+        "prefix_cache": snap["prefix_cache"],
+        "prefill_accounting": eng_paged.cost_model()[
+            "prefill_accounting"],
+        "steady_state_new_compiles": wd["steady_state_compiles"],
+        "watchdog": wd,
     }
 
 
@@ -303,8 +384,22 @@ _DEEP_FULL = dict(reps=5, num_slots=8, specs=[
                            90, 120, 75, 110, 83, 101, 95, 70,
                            88, 115, 78, 105, 92, 99, 72, 118]])
 
+# shared-prefix cohorts: one long system prompt + short unique
+# suffixes — the chat-fleet shape the paged pool's radix cache turns
+# into tail-only prefill (prefill compute must dominate dispatch
+# overhead for the CPU smoke to measure the real lever, hence the
+# wider model and 192-token prefix)
+_SHARED_SMOKE = dict(hidden=64, layers=2, heads=4, vocab=128,
+                     max_seq_len=256, prefix_tokens=192, suffix_max=8,
+                     requests=12, num_slots=4, new_tokens=4,
+                     block_size=16)
+_SHARED_FULL = dict(hidden=768, layers=12, heads=12, vocab=50304,
+                    max_seq_len=512, prefix_tokens=384, suffix_max=16,
+                    requests=24, num_slots=8, new_tokens=16,
+                    block_size=16)
+
 _SMOKE = dict(hidden=32, layers=2, heads=4, vocab=97, max_seq_len=64,
-              num_slots=4, deep=_DEEP_SMOKE,
+              num_slots=4, deep=_DEEP_SMOKE, shared=_SHARED_SMOKE,
               # generous CPU-smoke SLOs: the COLD first wave compiles,
               # so TTFT violations here are real and demonstrate the
               # accounting, not an artifact bug
@@ -315,6 +410,7 @@ _SMOKE = dict(hidden=32, layers=2, heads=4, vocab=97, max_seq_len=64,
 # whatever backend JAX_PLATFORMS selects; the measurement is relative)
 _FULL = dict(hidden=768, layers=12, heads=12, vocab=50304,
              max_seq_len=512, num_slots=8, deep=_DEEP_FULL,
+             shared=_SHARED_FULL,
              slo=dict(slo_ttft_ms=10000.0, slo_tpot_ms=200.0),
              specs=[(int(n), int(k)) for n, k in
                     [(40, 64), (120, 48), (24, 96), (200, 32),
@@ -368,6 +464,8 @@ def main():
         "unit": "tokens/sec",
         "vs_baseline": evidence["vs_sequential"],
         "deep_queue_vs_pr1": evidence["deep_queue"]["vs_pr1_engine"],
+        "shared_prefix_ttft_x": evidence["shared_prefix"][
+            "ttft_improvement"],
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })

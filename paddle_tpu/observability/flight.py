@@ -39,6 +39,7 @@ from .tracing import default_recorder
 # enqueued <= admitted <= prefill_dispatched <= first_token <= retired)
 ENQUEUED = "enqueued"
 ADMITTED = "admitted"
+PREFIX_HIT = "prefix_hit"
 PREFILL_DISPATCHED = "prefill_dispatched"
 FIRST_TOKEN = "first_token"
 DECODE_WINDOW = "decode_window"
@@ -143,6 +144,16 @@ class FlightRecorder:
         self._event(req.rid, ADMITTED, "t",
                     {"slot": int(slot), "bucket": int(bucket),
                      "group_size": int(group_size)})
+
+    def prefix_hit(self, req, cached_tokens, tail_tokens):
+        """The request's admission reused ``cached_tokens`` prompt
+        tokens straight from the paged pool's radix prefix cache, so
+        the prefill that follows dispatches only the ``tail_tokens``
+        tail (emitted between ``admitted`` and ``prefill_dispatched``;
+        absent = the prompt missed the cache entirely)."""
+        self._event(req.rid, PREFIX_HIT, "t",
+                    {"cached_tokens": int(cached_tokens),
+                     "tail_tokens": int(tail_tokens)})
 
     def prefill_dispatched(self, req, bucket, group_size):
         self._event(req.rid, PREFILL_DISPATCHED, "t",

@@ -401,6 +401,36 @@ def cached_slot_attention(q, k_cache, v_cache, lengths):
                       v_cache)
 
 
+def cached_paged_attention(q, k_cache, v_cache, block_tables, lengths):
+    """Single-token decode attention over a PAGED cache addressed
+    through a fixed-shape block table (the serving paged decode step,
+    serving.paged.programs.build_paged_fns).
+
+    q [S, nh, hd] — one new-token query per slot;
+    k_cache/v_cache [num_blocks, nh, block_size, hd] — one layer's
+    pooled block arrays;
+    block_tables [S, max_blocks] int — each slot's logical->physical
+    block row (padding/released entries point at the trash block);
+    lengths [S] int — live prefix length per slot, INCLUDING the row
+    just written for this step.
+
+    Gathers each slot's blocks into a position-ordered contiguous view
+    [S, nh, max_blocks*block_size, hd] (view index block*BS + offset IS
+    the cache position) and defers to cached_slot_attention's length
+    masking — positions >= lengths[s], which includes every trash-block
+    row a padding entry gathered, get -1e30 before the f32 softmax and
+    carry exactly-zero weight. For block tables describing the same
+    live prefixes this computes bit-for-bit what the slot-contiguous
+    path computes; it is the XLA-composed gather baseline the Pallas
+    paged decode kernel (ROADMAP direction #2) exists to beat."""
+    S, nh, hd = q.shape
+    k = jnp.take(k_cache, block_tables, axis=0)  # [S, MB, nh, BS, hd]
+    v = jnp.take(v_cache, block_tables, axis=0)
+    k = k.transpose(0, 2, 1, 3, 4).reshape(S, nh, -1, hd)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(S, nh, -1, hd)
+    return cached_slot_attention(q, k, v, lengths)
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     out = scaled_dot_product_attention(query, key, value, is_causal=causal)

@@ -140,4 +140,5 @@ from .engine import (  # noqa: F401
 )
 from .kv_pool import SlotKVPool  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .paged import PagedKVPool, RadixPrefixIndex  # noqa: F401
 from .scheduler import Request, StepScheduler  # noqa: F401

@@ -139,7 +139,8 @@ class ServingConfig:
                  watchdog_mode="flag", slo_ttft_ms=None,
                  slo_tpot_ms=None, slo_window_s=60.0,
                  completed_keep=4096, trace_keep=256,
-                 trace_decode_window=32, peak_flops=None):
+                 trace_decode_window=32, peak_flops=None,
+                 paged=None, block_size=16, num_blocks=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -179,6 +180,19 @@ class ServingConfig:
         # device peak FLOP/s override for the estimated-MFU gauge
         # (default: a device_kind table, then $PADDLE_TPU_PEAK_FLOPS)
         self.peak_flops = peak_flops
+        # paged KV pool + radix prefix cache (serving.paged): None =
+        # the PADDLE_PAGED_KV env gate (default off — the legacy
+        # slot-contiguous pool stays the measured fallback, mirroring
+        # the PADDLE_FUSED_CE gating pattern); True/False forces.
+        # block_size is the paging granularity (prefix sharing happens
+        # at block multiples); num_blocks sizes the physical pool
+        # (default: every slot fully backed + the trash block, the
+        # legacy footprint — sharing stretches the same bytes further).
+        if paged is None:
+            paged = os.environ.get("PADDLE_PAGED_KV", "0") == "1"
+        self.paged = bool(paged)
+        self.block_size = int(block_size)
+        self.num_blocks = num_blocks
 
 
 class ServingEngine:
@@ -221,11 +235,24 @@ class ServingEngine:
                 f"num_slots {config.num_slots}")
         self.cache_len = cache_len
         self.params = model.export_decode_params()
-        self._prefill_fn, self._decode_fn = model.build_serving_fns(
-            config.num_slots, cache_len)
-        self.pool = SlotKVPool(
-            config.num_slots, cfg.num_layers, cfg.num_heads, cache_len,
-            cfg.hidden_size // cfg.num_heads)
+        self.paged = config.paged
+        if self.paged:
+            from .paged import PagedKVPool
+            self.pool = PagedKVPool(
+                config.num_slots, cfg.num_layers, cfg.num_heads,
+                cache_len, cfg.hidden_size // cfg.num_heads,
+                block_size=config.block_size,
+                num_blocks=config.num_blocks)
+            self._prefill_fn, self._decode_fn = \
+                model.build_paged_serving_fns(
+                    config.num_slots, self.pool.block_size,
+                    self.pool.num_blocks, self.pool.blocks_per_slot)
+        else:
+            self._prefill_fn, self._decode_fn = model.build_serving_fns(
+                config.num_slots, cache_len)
+            self.pool = SlotKVPool(
+                config.num_slots, cfg.num_layers, cfg.num_heads,
+                cache_len, cfg.hidden_size // cfg.num_heads)
         self.flight = FlightRecorder(
             keep_last=config.trace_keep,
             decode_window=config.trace_decode_window)
@@ -267,6 +294,8 @@ class ServingEngine:
         if device_memory_stats(dev) is not None:
             self.metrics.enable_device_memory(
                 lambda: device_memory_stats(dev))
+        if self.paged:
+            self.metrics.set_prefix_pool(self.pool.stats)
 
     # ---------------------------------------------------------- requests
 
@@ -392,6 +421,8 @@ class ServingEngine:
             "kv_donation": dict(self.metrics.kv_donation),
             "flight": self.flight.state(),
             "slo": self.metrics.slo.report(),
+            "paged": self.paged,
+            "prefix_cache": self.metrics.prefix_cache_report(),
         }
 
     def lint(self, passes=None, min_donation_bytes=1 << 20):
@@ -409,10 +440,16 @@ class ServingEngine:
         exactly when the big cache buffers are donated."""
         import jax
         from ..analysis import lint as lint_mod
-        args = (self.params, self._toks, self._pos, self.pool.kc,
-                self.pool.vc)
+        if self.paged:
+            args = (self.params, self._toks, self._pos,
+                    self.pool.device_tables(), self.pool.kc,
+                    self.pool.vc)
+            donate = (2, 4, 5) if self._donate else ()
+        else:
+            args = (self.params, self._toks, self._pos, self.pool.kc,
+                    self.pool.vc)
+            donate = (2, 3, 4) if self._donate else ()
         closed = jax.make_jaxpr(self._decode_fn)(*args)
-        donate = (2, 3, 4) if self._donate else ()
         return lint_mod.lint_jaxpr(
             closed, passes=passes,
             donated_invars=lint_mod.donated_invars_from_argnums(
@@ -436,6 +473,7 @@ class ServingEngine:
         decode_bytes = self.metrics._g_decode_bytes.value or None
         peak = self.metrics._peak_flops
         mfu = self.metrics.estimated_mfu()
+        prefix = self.metrics.prefix_cache_report()
         return {
             "device": {"platform": self._device.platform,
                        "kind": self._device.device_kind},
@@ -448,6 +486,16 @@ class ServingEngine:
             "peak_flops": peak,
             "estimated_mfu": round(mfu, 6) if mfu else None,
             "device_memory": device_memory_stats(self._device),
+            # prefill compute accounting: prefix-cache hits are SERVED
+            # tokens, never prefill flops — only tokens_computed may
+            # enter a prefill compute/MFU figure, else the cost model
+            # over-credits cached spans (estimated_mfu above is
+            # decode-only and unaffected either way)
+            "prefill_accounting": {
+                "tokens_computed": prefix["computed_tokens"],
+                "prefix_cached_tokens": prefix["cached_tokens"],
+                "cached_fraction": prefix["cached_fraction"],
+            },
         }
 
     # -------------------------------------------------------------- step
@@ -537,52 +585,26 @@ class ServingEngine:
                         if sch.saturated(r)]:
                 sch.prerelease(req, pool)
 
-        with M.span("serving/admit"):
-            groups = sch.admit(pool, self.group_sizes)
-            for group in groups:
-                for req, _slot in group:
-                    M.record_admission(req)
-
-        for group in groups:
-            G = len(group)
-            M.requests_admitted += G
-            bucket = sch.bucket_for(len(group[0][0].prompt))
-            tokens = np.zeros((G, bucket), np.int32)
-            lengths = np.zeros((G,), np.int32)
-            slots = np.zeros((G,), np.int32)
-            for g, (req, slot) in enumerate(group):
-                n = len(req.prompt)
-                tokens[g, :n] = req.prompt
-                lengths[g] = n
-                slots[g] = slot
-                req.inflight += 1
-            args = (self.params, tokens, lengths, slots, self._toks,
-                    self._pos, pool.kc, pool.vc)
-            ex = self._compiled(("prefill", bucket, G),
-                                self._prefill_fn, args,
-                                donate=(5, 6, 7))
-            with M.span("serving/prefill_dispatch"):
-                for req, _slot in group:
-                    self.flight.prefill_dispatched(req, bucket, G)
-                first, self._toks, self._pos, kc, vc = ex(*args)
-            pool.rebind(kc, vc)
-            M.prefills += 1
-            M.prefill_requests += G
-            M.record_prefill_group(G)
-            if sync:
-                self._harvest([("prefill", first, group)])
-            else:
-                self._pending.append(("prefill", first, group))
+        if self.paged:
+            self._paged_prefills(sync)
+        else:
+            self._legacy_prefills(sync)
 
         snapshot = {slot: req for slot, req in sch.active.items()
                     if not sch.saturated(req)}
         if snapshot:
             for req in snapshot.values():
                 req.inflight += 1
-            args = (self.params, self._toks, self._pos, pool.kc,
-                    pool.vc)
-            ex = self._compiled(("decode",), self._decode_fn, args,
-                                donate=(2, 3, 4))
+            if self.paged:
+                args = (self.params, self._toks, self._pos,
+                        pool.device_tables(), pool.kc, pool.vc)
+                ex = self._compiled(("decode",), self._decode_fn, args,
+                                    donate=(2, 4, 5))
+            else:
+                args = (self.params, self._toks, self._pos, pool.kc,
+                        pool.vc)
+                ex = self._compiled(("decode",), self._decode_fn, args,
+                                    donate=(2, 3, 4))
             with M.span("serving/decode_dispatch"):
                 nxt, self._pos, kc, vc = ex(*args)
             pool.rebind(kc, vc)
@@ -599,6 +621,110 @@ class ServingEngine:
         M.queue_depth = len(sch.queue)
         M.slot_occupancy = pool.occupancy
         return sch.pending or bool(self._pending)
+
+    def _legacy_prefills(self, sync):
+        """Admission + grouped bucketed prefill over the contiguous
+        slot pool. A dispatch failure (compile error, bad buffer)
+        rolls every not-yet-dispatched admission back to the queue and
+        releases its slot — acquire-to-dispatch is leak-free
+        (tests/test_serving.py::test_failed_prefill_dispatch...)."""
+        sch, pool, M = self.scheduler, self.pool, self.metrics
+        with M.span("serving/admit"):
+            groups = sch.admit(pool, self.group_sizes)
+            for group in groups:
+                for req, _slot in group:
+                    M.record_admission(req)
+
+        for gi, group in enumerate(groups):
+            G = len(group)
+            M.requests_admitted += G
+            bucket = sch.bucket_for(len(group[0][0].prompt))
+            tokens = np.zeros((G, bucket), np.int32)
+            lengths = np.zeros((G,), np.int32)
+            slots = np.zeros((G,), np.int32)
+            for g, (req, slot) in enumerate(group):
+                n = len(req.prompt)
+                tokens[g, :n] = req.prompt
+                lengths[g] = n
+                slots[g] = slot
+                req.inflight += 1
+            args = (self.params, tokens, lengths, slots, self._toks,
+                    self._pos, pool.kc, pool.vc)
+            try:
+                ex = self._compiled(("prefill", bucket, G),
+                                    self._prefill_fn, args,
+                                    donate=(5, 6, 7))
+                with M.span("serving/prefill_dispatch"):
+                    for req, _slot in group:
+                        self.flight.prefill_dispatched(req, bucket, G)
+                    first, self._toks, self._pos, kc, vc = ex(*args)
+            except BaseException:
+                for req, _slot in group:
+                    req.inflight -= 1
+                sch.rollback_admission(
+                    [r for g in groups[gi:] for r, _ in g], pool)
+                raise
+            pool.rebind(kc, vc)
+            M.prefills += 1
+            M.prefill_requests += G
+            M.record_prefill_group(G)
+            M.record_prefill_tokens(int(lengths.sum()))
+            if sync:
+                self._harvest([("prefill", first, group)])
+            else:
+                self._pending.append(("prefill", first, group))
+
+    def _paged_prefills(self, sync):
+        """Prefix-aware admission + tail-only prefill over the paged
+        pool: each admission pins its longest cached prefix (radix
+        lookup, block refcounts) and dispatches ONE [1, bucket] prefill
+        covering just the uncached tail — shared system prompts cost
+        their K/V once. The full prompt's frozen blocks are committed
+        to the radix index only AFTER the dispatch succeeded, so a
+        failed dispatch rolls back (slot + blocks released, request
+        requeued) without poisoning the cache."""
+        sch, pool, M = self.scheduler, self.pool, self.metrics
+        while True:
+            with M.span("serving/admit"):
+                admission = sch.admit_paged(pool)
+            if admission is None:
+                break
+            req, alloc, bucket = admission
+            M.record_admission(req)
+            M.requests_admitted += 1
+            start = alloc.prefix_tokens
+            tail = len(req.prompt) - start
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :tail] = req.prompt[start:]
+            args = (self.params, tokens, np.int32(tail),
+                    np.int32(start), np.int32(alloc.slot),
+                    pool.table_row(alloc.slot), self._toks, self._pos,
+                    pool.kc, pool.vc)
+            req.inflight += 1
+            try:
+                ex = self._compiled(("paged_prefill", bucket),
+                                    self._prefill_fn, args,
+                                    donate=(7, 8, 9))
+                with M.span("serving/prefill_dispatch"):
+                    if start:
+                        self.flight.prefix_hit(req, start, tail)
+                    self.flight.prefill_dispatched(req, bucket, 1)
+                    first, self._toks, self._pos, kc, vc = ex(*args)
+            except BaseException:
+                req.inflight -= 1
+                sch.rollback_admission([req], pool)
+                raise
+            pool.rebind(kc, vc)
+            pool.commit_prefix(alloc.slot, req.prompt)
+            M.prefills += 1
+            M.prefill_requests += 1
+            M.record_prefill_group(1)
+            M.record_prefix_reuse(start, tail)
+            if sync:
+                self._harvest([("prefill", first, [(req, alloc.slot)])])
+            else:
+                self._pending.append(
+                    ("prefill", first, [(req, alloc.slot)]))
 
     def run(self):
         """Drain the queue: step until every submitted request is done.

@@ -121,6 +121,25 @@ class ServingMetrics:
             "serving_request_latency_seconds", "arrival -> done")
         self._h_queue_wait = r.histogram(
             "serving_queue_wait_seconds", "arrival -> slot admission")
+        # prefix-cache economy (the paged pool moves these; the legacy
+        # pool only accrues computed tokens): admissions that reused a
+        # cached prefix vs not, tokens served FROM cache (never
+        # prefill-computed) vs tokens the prefill actually computed
+        self._c_prefix_hits = r.counter(
+            "serving_prefix_cache_hits_total",
+            "admissions that reused a cached prompt prefix")
+        self._c_prefix_misses = r.counter(
+            "serving_prefix_cache_misses_total",
+            "admissions with no reusable cached prefix")
+        self._c_prefix_cached_tokens = r.counter(
+            "serving_prefix_cached_tokens_total",
+            "prompt tokens served from the prefix cache instead of "
+            "being prefill-computed")
+        self._c_prefill_tokens = r.counter(
+            "serving_prefill_tokens_computed_total",
+            "prompt tokens actually computed by prefill dispatches "
+            "(excludes prefix-cache hits and bucket padding)")
+        self._prefix_pool_stats = None
         self._res = {
             "ttft": Reservoir(self.RESERVOIR_SIZE),
             "request_latency": Reservoir(self.RESERVOIR_SIZE),
@@ -197,6 +216,50 @@ class ServingMetrics:
 
     def record_prefill_group(self, group_size):
         self._c_groups.labels(str(int(group_size))).inc()
+
+    def record_prefix_reuse(self, cached_tokens, computed_tokens):
+        """One paged admission's prefix economy: ``cached_tokens``
+        came straight from the radix-matched blocks (a hit when > 0),
+        ``computed_tokens`` is the uncached tail the prefill actually
+        ran. The cached/computed split is what keeps engine.cost_model
+        honest — cached spans must not be credited as prefill compute."""
+        if cached_tokens > 0:
+            self._c_prefix_hits.inc()
+        else:
+            self._c_prefix_misses.inc()
+        if cached_tokens:
+            self._c_prefix_cached_tokens.inc(int(cached_tokens))
+        if computed_tokens:
+            self._c_prefill_tokens.inc(int(computed_tokens))
+
+    def record_prefill_tokens(self, computed_tokens):
+        """Legacy-pool prefill accounting: every prompt token is
+        computed (no cache to hit)."""
+        if computed_tokens:
+            self._c_prefill_tokens.inc(int(computed_tokens))
+
+    def set_prefix_pool(self, stats_fn):
+        """Attach the paged pool's ``stats()`` as the pull source for
+        snapshot()["prefix_cache"]["pool"] (None on legacy engines)."""
+        self._prefix_pool_stats = stats_fn
+
+    def prefix_cache_report(self):
+        hits = int(self._c_prefix_hits.value)
+        misses = int(self._c_prefix_misses.value)
+        cached = int(self._c_prefix_cached_tokens.value)
+        computed = int(self._c_prefill_tokens.value)
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / total, 4) if total else None,
+            "cached_tokens": cached,
+            "computed_tokens": computed,
+            "cached_fraction": round(cached / (cached + computed), 4)
+            if (cached + computed) else None,
+            "pool": self._prefix_pool_stats()
+            if self._prefix_pool_stats is not None else None,
+        }
 
     def record_admission(self, request):
         """Queue-wait accounting at slot-claim time (the scheduler
@@ -339,4 +402,5 @@ class ServingMetrics:
             "span_s": {k: round(v, 4) for k, v in self.span_s.items()},
             "latency_percentiles": self.latency_percentiles(),
             "slo": self.slo.report(),
+            "prefix_cache": self.prefix_cache_report(),
         }

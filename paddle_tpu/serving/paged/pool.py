@@ -1,0 +1,305 @@
+"""Paged KV pool: block-granular refcounted cache + fixed-shape block
+tables + the radix prefix index that makes blocks shareable.
+
+Physical layout: ONE pair of cache arrays
+``kc/vc [layers, num_blocks, heads, block_size, head_dim]`` and an
+int32 block table ``[num_slots, blocks_per_slot]`` mapping each slot's
+logical block i to a physical block. Both shapes are fixed at
+construction, so every AOT serving executable keeps one signature for
+the engine's lifetime — paging changes WHERE a slot's K/V lives, never
+the compiled program's shape.
+
+Block 0 is the reserved TRASH block: free table rows and row padding
+point at it, so a released slot's stale in-flight decode write (the
+one-step-deep pipeline keeps a token in flight past retirement) lands
+in garbage no reader sees instead of a block that may already belong
+to someone else.
+
+Refcounting: ``ref[b]`` counts live slots whose table references block
+b. Blocks indexed in the radix tree at ref 0 are EVICTABLE (kept,
+reusable as cache hits, reclaimed LRU-leaf-first when the free list
+runs dry); unindexed blocks free immediately at ref 0. An admission
+pins its matched prefix (ref++) BEFORE allocating anything, so it can
+never evict blocks it is about to reuse.
+
+Host/device discipline mirrors SlotKVPool: the engine routes every
+executable's returned kc/vc through ``rebind`` (single owner of the
+live buffers under donation), while the block table is host-authored
+(numpy) and uploaded via ``device_tables()`` only when admission or
+release dirtied it.
+"""
+import heapq
+
+import numpy as np
+
+from .radix import RadixPrefixIndex
+
+TRASH_BLOCK = 0
+
+
+class PagedAllocation:
+    """What ``acquire`` hands the engine: the claimed slot plus the
+    prefix-reuse facts the dispatch and the observability need."""
+
+    __slots__ = ("slot", "prefix_tokens", "prefix_blocks", "new_blocks")
+
+    def __init__(self, slot, prefix_tokens, prefix_blocks, new_blocks):
+        self.slot = slot
+        self.prefix_tokens = int(prefix_tokens)
+        self.prefix_blocks = list(prefix_blocks)
+        self.new_blocks = list(new_blocks)
+
+
+class PagedKVPool:
+    """Block allocator + slot table over the paged cache arrays."""
+
+    def __init__(self, num_slots, num_layers, num_heads, max_len,
+                 head_dim, block_size=16, num_blocks=None,
+                 dtype=None):
+        import jax.numpy as jnp
+        if dtype is None:
+            dtype = jnp.float32
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.num_slots = int(num_slots)
+        self.block_size = int(block_size)
+        self.max_len = int(max_len)
+        self.blocks_per_slot = -(-self.max_len // self.block_size)
+        # default: the legacy pool's footprint (every slot fully backed)
+        # plus the trash block — sharing then stretches the same bytes
+        # further. Smaller num_blocks oversubscribes: admission waits
+        # when blocks run dry (acquire returns None), never corrupts.
+        if num_blocks is None:
+            num_blocks = self.num_slots * self.blocks_per_slot + 1
+        self.num_blocks = int(num_blocks)
+        if self.num_blocks < self.blocks_per_slot + 1:
+            raise ValueError(
+                f"num_blocks {self.num_blocks} cannot back even one "
+                f"slot ({self.blocks_per_slot} blocks) plus the trash "
+                "block")
+        shape = (int(num_layers), self.num_blocks, int(num_heads),
+                 self.block_size, int(head_dim))
+        self.kc = jnp.zeros(shape, dtype)
+        self.vc = jnp.zeros(shape, dtype)
+        self.index = RadixPrefixIndex(self.block_size)
+        # block state: free heap (block 0 reserved as trash), refcounts
+        # for allocated blocks, the evictable count (indexed & ref 0)
+        self._free_blocks = list(range(1, self.num_blocks))
+        self._ref = {}
+        self._evictable = 0
+        self.evictions = 0
+        # slot state (mirrors SlotKVPool's deterministic allocator)
+        self._free_slots = list(range(self.num_slots))
+        self._owner = {}
+        self._slot_blocks = {}
+        self.reuse_count = 0
+        self._ever_used = set()
+        self.block_tables = np.full(
+            (self.num_slots, self.blocks_per_slot), TRASH_BLOCK,
+            np.int32)
+        self._tables_dev = None
+        self._dirty = True
+
+    # ------------------------------------------------------- slot facade
+    @property
+    def free_count(self):
+        return len(self._free_slots)
+
+    @property
+    def occupancy(self):
+        return 1.0 - len(self._free_slots) / self.num_slots
+
+    @property
+    def slot_capacity(self):
+        """Tokens one slot's table row can address."""
+        return self.blocks_per_slot * self.block_size
+
+    def owner_of(self, slot):
+        return self._owner.get(slot)
+
+    # ------------------------------------------------------ block alloc
+    @property
+    def free_blocks(self):
+        return len(self._free_blocks)
+
+    @property
+    def evictable_blocks(self):
+        return self._evictable
+
+    @property
+    def live_blocks(self):
+        return sum(1 for r in self._ref.values() if r > 0)
+
+    def _alloc_block(self):
+        if self._free_blocks:
+            b = heapq.heappop(self._free_blocks)
+        else:
+            b = self.index.evict_lru(
+                lambda blk: self._ref.get(blk, 0) == 0)
+            if b is None:
+                raise RuntimeError(
+                    "block allocation with no free or evictable blocks "
+                    "— acquire() capacity check should have refused")
+            self.evictions += 1
+            self._evictable -= 1
+        self._ref[b] = 1
+        return b
+
+    def match_prefix(self, prompt):
+        """Longest cached prefix of ``prompt`` in TOKENS (always a
+        block multiple). Touches the matched path's LRU ticks."""
+        return len(self.index.match(prompt)) * self.block_size
+
+    def acquire(self, owner, prompt, total_tokens, prefix_tokens):
+        """Claim the lowest free slot for ``owner``, pin the first
+        ``prefix_tokens`` (block-aligned, from the radix index) into
+        its table row, and allocate fresh blocks for the rest of
+        ``total_tokens`` (prompt + max_new). Returns a PagedAllocation,
+        or None when no slot is free or the fresh blocks don't fit in
+        free + evictable capacity (the caller keeps the request queued
+        — retirement frees blocks, never a deadlock while one request
+        fits the pool)."""
+        if not self._free_slots:
+            return None
+        bs = self.block_size
+        if prefix_tokens % bs:
+            raise ValueError(
+                f"prefix_tokens {prefix_tokens} is not block-aligned "
+                f"(block_size {bs})")
+        n_total = -(-int(total_tokens) // bs)
+        if n_total > self.blocks_per_slot:
+            raise ValueError(
+                f"{total_tokens} tokens need {n_total} blocks; a slot "
+                f"row holds {self.blocks_per_slot}")
+        n_prefix = prefix_tokens // bs
+        n_new = n_total - n_prefix
+        if n_new > len(self._free_blocks) + self._evictable:
+            return None
+        prefix_blocks = self.index.match(prompt)[:n_prefix]
+        if len(prefix_blocks) < n_prefix:
+            raise ValueError(
+                f"prefix_tokens {prefix_tokens} exceeds the cached "
+                f"prefix ({len(prefix_blocks) * bs} tokens)")
+        # pin the prefix FIRST: ref>0 blocks are invisible to eviction,
+        # so the fresh allocations below cannot steal our own prefix
+        for b in prefix_blocks:
+            r = self._ref.get(b, 0)
+            self._ref[b] = r + 1
+            if r == 0:
+                self._evictable -= 1
+        new_blocks = [self._alloc_block() for _ in range(n_new)]
+        slot = heapq.heappop(self._free_slots)
+        self._owner[slot] = owner
+        if slot in self._ever_used:
+            self.reuse_count += 1
+        self._ever_used.add(slot)
+        row = prefix_blocks + new_blocks
+        self._slot_blocks[slot] = row
+        self.block_tables[slot, :] = TRASH_BLOCK
+        self.block_tables[slot, :len(row)] = row
+        self._dirty = True
+        return PagedAllocation(slot, prefix_tokens, prefix_blocks,
+                               new_blocks)
+
+    def commit_prefix(self, slot, prompt):
+        """Index the slot's FULL prompt blocks in the radix tree so
+        later admissions can hit them. Only blocks every row of which
+        is a prompt token are shareable — the partial last block (and
+        every decode block after it) takes decode writes and stays
+        private. Call after the prefill dispatch succeeded; an
+        admission rolled back before commit leaves the index untouched."""
+        if slot not in self._owner:
+            raise ValueError(f"slot {slot} is not live")
+        n_full = len(prompt) // self.block_size
+        blocks = self._slot_blocks[slot][:n_full]
+        return self.index.insert(prompt, blocks)
+
+    def release(self, slot):
+        """Return a slot: deref every block in its row (indexed blocks
+        at ref 0 park evictable, unindexed ones free immediately) and
+        point the row at trash so the in-flight pipeline's stale write
+        for this slot cannot touch a reusable block."""
+        if slot not in self._owner:
+            raise ValueError(f"slot {slot} is not live")
+        del self._owner[slot]
+        for b in self._slot_blocks.pop(slot):
+            r = self._ref[b] = self._ref[b] - 1
+            if r < 0:
+                raise AssertionError(f"block {b} refcount underflow")
+            if r == 0:
+                if b in self.index:
+                    self._evictable += 1
+                else:
+                    del self._ref[b]
+                    heapq.heappush(self._free_blocks, b)
+        heapq.heappush(self._free_slots, slot)
+        self.block_tables[slot, :] = TRASH_BLOCK
+        self._dirty = True
+
+    # ---------------------------------------------------- device arrays
+    def device_tables(self):
+        """The block table as a device array, re-uploaded only when an
+        admission/release dirtied it ([num_slots, blocks_per_slot]
+        int32 — a few KB, dwarfed by one decode dispatch)."""
+        import jax.numpy as jnp
+        if self._tables_dev is None or self._dirty:
+            self._tables_dev = jnp.asarray(self.block_tables)
+            self._dirty = False
+        return self._tables_dev
+
+    def table_row(self, slot):
+        import jax.numpy as jnp
+        return jnp.asarray(self.block_tables[slot])
+
+    def rebind(self, kc, vc):
+        """Same single-owner discipline as SlotKVPool.rebind: the
+        compiled call's returned arrays become the live buffers; any
+        shape/dtype drift is caught here, before a donating backend's
+        next AOT call consumes a mismatched buffer."""
+        if kc.shape != self.kc.shape or vc.shape != self.vc.shape:
+            raise ValueError(
+                f"rebind shape drift: got {kc.shape}/{vc.shape}, pool "
+                f"owns {self.kc.shape}")
+        if kc.dtype != self.kc.dtype or vc.dtype != self.vc.dtype:
+            raise ValueError(
+                f"rebind dtype drift: got {kc.dtype}/{vc.dtype}, pool "
+                f"owns {self.kc.dtype}")
+        self.kc, self.vc = kc, vc
+
+    def nbytes(self):
+        return int(self.kc.nbytes + self.vc.nbytes)
+
+    # ------------------------------------------------------------ stats
+    def stats(self):
+        """The ``snapshot()["prefix_cache"]["pool"]`` section: block
+        economy + radix shape, all ints (JSON-safe)."""
+        return {
+            "block_size": self.block_size,
+            "blocks_per_slot": self.blocks_per_slot,
+            "num_blocks": self.num_blocks,
+            "free_blocks": len(self._free_blocks),
+            "live_blocks": self.live_blocks,
+            "evictable_blocks": self._evictable,
+            "indexed_blocks": len(self.index),
+            "radix_depth": self.index.stats()["depth"],
+            "evictions": self.evictions,
+        }
+
+    def check_conservation(self):
+        """Invariant audit for tests: trash + free + tracked refcounted
+        blocks partition the pool, and the evictable count equals the
+        indexed-ref-0 population."""
+        tracked = set(self._ref)
+        free = set(self._free_blocks)
+        assert not (tracked & free), (tracked, free)
+        assert tracked | free | {TRASH_BLOCK} == set(
+            range(self.num_blocks))
+        assert self._evictable == sum(
+            1 for b, r in self._ref.items() if r == 0 and b in self.index)
+        for b, r in self._ref.items():
+            assert r >= 0, (b, r)
+            if r == 0:
+                assert b in self.index  # unindexed ref-0 blocks free
+        return True

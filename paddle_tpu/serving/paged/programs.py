@@ -1,0 +1,119 @@
+"""AOT-compilable prefill/decode programs over the paged cache.
+
+Same decode math as ``text.models.build_serving_fns`` (both reuse
+``_decode_forward_builder``; greedy parity with ``generate()`` is by
+construction), with the cache addressed through the fixed-shape block
+table instead of a slot-contiguous region:
+
+  ``paged_prefill(params, tokens [1, B], tail_len, start, slot,
+                  bt_row [MB], toks [S], pos [S], kc, vc)``
+      One request's UNCACHED TAIL prefills in one dispatch: the slot's
+      MB blocks gather into a position-ordered contiguous view
+      ``[L, 1, nh, MB*BS, hd]`` (view index == cache position, so the
+      shared forward_t attends over the cached prefix below ``start``
+      exactly as if this slot had prefilled it itself), the tail's K/V
+      writes land at ``start..start+B``, and the view scatters back
+      block-by-block. ``start`` and ``tail_len`` are TRACED scalars:
+      every (prefix length, tail length) pair reuses the one compiled
+      program per tail bucket B — prefix variety costs zero compiles.
+
+  ``paged_decode(params, toks [S], pos [S], tables [S, MB], kc, vc)``
+      One fused program advancing every slot a token: each slot writes
+      its new K/V row into block ``tables[s, pos//BS]`` at offset
+      ``pos % BS`` (always a privately-owned block: decode positions
+      are >= prompt_len and only full-prompt blocks are ever shared),
+      then attends through ``ops.attention.cached_paged_attention``
+      under the per-slot length mask.
+
+Scatter/gather safety: table-row padding and released rows point at
+the reserved trash block, so pad-entry writes land in garbage, and the
+length mask keeps garbage reads at exactly-zero softmax weight — the
+same recycled-slot invariant the legacy pool pins, at block granularity.
+"""
+
+
+def build_paged_fns(cfg, num_slots, block_size, num_blocks,
+                    blocks_per_slot):
+    """(paged_prefill, paged_decode) for a GPT decode config. Pure and
+    shape-stable; the engine AOT-compiles them (decode once, prefill
+    once per tail bucket)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ...ops import attention as attn_ops
+    from ...text.models import _decode_forward_builder
+
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    hidden = cfg.hidden_size
+    ln, forward_t = _decode_forward_builder(nh, hd, hidden)
+    L = cfg.num_layers
+    BS = int(block_size)
+    MB = int(blocks_per_slot)
+    C = MB * BS   # one slot's gathered contiguous context length
+
+    def gather_slot(cache, bt_row):
+        # [L, NB, nh, BS, hd] + row [MB] -> [L, 1, nh, MB*BS, hd],
+        # position-ordered: view index bi*BS+off IS the cache position
+        g = jnp.take(cache, bt_row, axis=1)          # [L, MB, nh, BS, hd]
+        g = g.transpose(0, 2, 1, 3, 4).reshape(L, nh, C, hd)
+        return g[:, None]
+
+    def scatter_slot(cache, bt_row, view):
+        # inverse of gather_slot; pad entries of bt_row all point at
+        # the trash block (duplicate scatter indices land in garbage)
+        blocks = view[:, 0].reshape(L, nh, MB, BS, hd) \
+            .transpose(0, 2, 1, 3, 4)                # [L, MB, nh, BS, hd]
+        return cache.at[:, bt_row].set(blocks)
+
+    def paged_prefill(params, tokens, tail_len, start, slot, bt_row,
+                      toks, pos, kc, vc):
+        # tokens [1, B] right-padded tail; start = cached prefix length
+        kctx = gather_slot(kc, bt_row)
+        vctx = gather_slot(vc, bt_row)
+        logits, kctx, vctx = forward_t(params, tokens, start, kctx,
+                                       vctx)
+        kc = scatter_slot(kc, bt_row, kctx)
+        vc = scatter_slot(vc, bt_row, vctx)
+        last = jnp.take(logits[0], tail_len - 1, axis=0)   # [vocab]
+        first = jnp.argmax(last, -1).astype(jnp.int32)[None]   # [1]
+        toks = toks.at[slot].set(first[0])
+        # the next decode writes this slot at position prompt_len
+        pos = pos.at[slot].set(start + tail_len)
+        return first, toks, pos, kc, vc
+
+    def paged_decode(params, toks, pos, tables, kc, vc):
+        S = toks.shape[0]
+        x = params["wemb"][toks] + params["pemb"][pos]      # [S, h]
+        bidx = jnp.take_along_axis(
+            tables, (pos // jnp.int32(BS))[:, None], axis=1)[:, 0]
+        off = pos % jnp.int32(BS)
+
+        def body(carry, inp):
+            x = carry
+            p, kcl, vcl = inp
+            h_ = ln(x, p["ln1_w"], p["ln1_b"])
+            qkv = h_ @ p["qkv_w"] + p["qkv_b"]
+            qkv = qkv.reshape(S, 3, nh, hd).transpose(1, 0, 2, 3)
+            q, k, v = qkv[0], qkv[1], qkv[2]          # [S, nh, hd]
+            # per-slot row write into its current (privately-owned)
+            # block: advanced indexing [S],:,[S] scatters [S, nh, hd]
+            kcl = kcl.at[bidx, :, off].set(k)
+            vcl = vcl.at[bidx, :, off].set(v)
+            o = attn_ops.cached_paged_attention(
+                q, kcl, vcl, tables, pos + 1)
+            o = o.reshape(S, hidden)                  # concat heads
+            x = x + (o @ p["out_w"] + p["out_b"])
+            h2 = ln(x, p["ln2_w"], p["ln2_b"])
+            m = jax.nn.gelu(h2 @ p["fc1_w"] + p["fc1_b"],
+                            approximate=True)
+            return x + (m @ p["fc2_w"] + p["fc2_b"]), (kcl, vcl)
+
+        x, (kc, vc) = lax.scan(body, x, (params["stacked"], kc, vc))
+        logits = ln(x, params["lnf_w"], params["lnf_b"]) \
+            @ params["head"]                          # [S, vocab]
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        return nxt, pos + jnp.int32(1), kc, vc
+
+    return paged_prefill, paged_decode

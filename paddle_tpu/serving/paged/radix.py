@@ -1,0 +1,137 @@
+"""Radix/trie prefix index over prompt token IDs, block granularity.
+
+Each edge covers exactly ``block_size`` token IDs (one KV block), so a
+node at depth d caches the block holding positions
+``[(d-1)*block_size, d*block_size)`` of every prompt that starts with
+the node's token path. Fixed-width edges keep lookup a plain dict walk
+(no SGLang-style edge splitting needed: a prefix is shareable only at
+block granularity anyway, because a physical KV block is the unit the
+block table can point at).
+
+The index stores WHICH physical block caches a token path; it owns no
+refcounts — liveness is the pool's job (pool.PagedKVPool pins/derefs).
+Eviction is therefore a cooperation: ``evict_lru(evictable)`` removes
+the least-recently-used LEAF whose block the pool says is refcount
+zero, and returns its block for reuse. Leaves-only keeps every cached
+path contiguous from the root (evicting an interior node would orphan
+descendants whose prefix K/V no longer exists).
+
+LRU time is a deterministic monotone tick (bumped on every match that
+touches a node and every insert), not wall-clock — reproducible runs,
+reproducible tests.
+"""
+
+
+class _Node:
+    __slots__ = ("key", "block", "children", "parent", "tick")
+
+    def __init__(self, key, block, parent, tick):
+        self.key = key          # tuple of block_size token ids (root: None)
+        self.block = block      # physical block id (root: None)
+        self.children = {}      # key tuple -> _Node
+        self.parent = parent
+        self.tick = tick
+
+
+class RadixPrefixIndex:
+    """Longest-cached-prefix lookup + insert + LRU-leaf eviction."""
+
+    def __init__(self, block_size):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.block_size = int(block_size)
+        self._root = _Node(None, None, None, 0)
+        self._by_block = {}     # physical block id -> _Node
+        self._tick = 0
+
+    def __len__(self):
+        """Number of indexed blocks (nodes excluding the root)."""
+        return len(self._by_block)
+
+    def __contains__(self, block):
+        return block in self._by_block
+
+    def _keys(self, tokens):
+        bs = self.block_size
+        n = (len(tokens) // bs) * bs
+        return [tuple(int(t) for t in tokens[i:i + bs])
+                for i in range(0, n, bs)]
+
+    # ------------------------------------------------------------ lookup
+    def match(self, tokens):
+        """Longest cached prefix of ``tokens``: the list of physical
+        blocks caching it, walked full-block by full-block from the
+        root. Touches every matched node's LRU tick (a lookup is a
+        use: admission follows immediately and pins these blocks)."""
+        self._tick += 1
+        blocks = []
+        node = self._root
+        for key in self._keys(tokens):
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.tick = self._tick
+            blocks.append(child.block)
+            node = child
+        return blocks
+
+    # ------------------------------------------------------------ insert
+    def insert(self, tokens, blocks):
+        """Index ``blocks[i]`` as the cache of ``tokens``' i-th full
+        block. Where a node already exists the EXISTING block wins (the
+        first writer's K/V is the shared copy; a caller holding its own
+        private block for that span just doesn't get it indexed) —
+        returns the block ids actually newly indexed, so the pool can
+        mark exactly those as radix-owned."""
+        self._tick += 1
+        created = []
+        node = self._root
+        for key, block in zip(self._keys(tokens), blocks):
+            child = node.children.get(key)
+            if child is None:
+                block = int(block)
+                if block in self._by_block:
+                    raise ValueError(
+                        f"block {block} is already indexed elsewhere")
+                child = _Node(key, block, node, self._tick)
+                node.children[key] = child
+                self._by_block[block] = child
+                created.append(block)
+            else:
+                child.tick = self._tick
+            node = child
+        return created
+
+    # ---------------------------------------------------------- eviction
+    def evict_lru(self, evictable):
+        """Remove the least-recently-used LEAF whose block satisfies
+        ``evictable(block)`` (the pool passes refcount == 0) and return
+        its block id; None when nothing qualifies. Oldest tick first,
+        block id as the deterministic tie-break."""
+        best = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is self._root or node.children:
+                continue
+            if not evictable(node.block):
+                continue
+            if best is None or (node.tick, node.block) < (best.tick,
+                                                          best.block):
+                best = node
+        if best is None:
+            return None
+        del best.parent.children[best.key]
+        del self._by_block[best.block]
+        return best.block
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        depth = 0
+        stack = [(self._root, 0)]
+        while stack:
+            node, d = stack.pop()
+            depth = max(depth, d)
+            stack.extend((c, d + 1) for c in node.children.values())
+        return {"indexed_blocks": len(self._by_block), "depth": depth}

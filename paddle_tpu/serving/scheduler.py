@@ -154,6 +154,77 @@ class StepScheduler:
                 i += take
         return groups
 
+    def plan_prefix(self, prompt_len, cached_tokens, block_size,
+                    slot_capacity):
+        """How much of a cached prefix a paged admission actually uses:
+        ``(start, bucket)`` with ``start`` block-aligned and the tail
+        ``prompt_len - start`` padded into the existing bucket set.
+
+        Two trims on the raw radix match: (1) at least ONE prompt token
+        stays in the tail — the tail prefill's logits at the last
+        prompt position produce the first generated token, so a fully
+        cached prompt still dispatches a one-token tail; (2) the
+        bucket-padded tail must fit the slot's addressable capacity
+        (``start + bucket <= slot_capacity`` — bucket pad rows write
+        scratch K/V above the prompt), shrinking ``start`` a block at a
+        time until it does (start=0 always fits: the largest bucket is
+        capped at cache_len <= slot_capacity). Using LESS cached prefix
+        is always correct — the tail just recomputes it."""
+        start = min(int(cached_tokens), prompt_len - 1)
+        start -= start % block_size
+        while start > 0 and \
+                start + self.bucket_for(prompt_len - start) > slot_capacity:
+            start -= block_size
+        return start, self.bucket_for(prompt_len - start)
+
+    def admit_paged(self, pool):
+        """Prefix-aware FIFO admission over a paged pool, ONE request
+        at a time: longest-cached-prefix lookup plans the tail
+        (plan_prefix), then ``pool.acquire`` pins the prefix blocks
+        and allocates the rest. Returns ``(request, alloc, bucket)``
+        (PagedAllocation carries slot + prefix facts) or None when the
+        head of the queue doesn't fit (no free slot, or fresh blocks
+        exceed free + evictable — strict FIFO, no starvation
+        reordering; retirement frees capacity). Single-request
+        admission lets the engine dispatch + commit each prefill
+        before the NEXT lookup, so a burst of same-prompt arrivals
+        shares the first member's blocks within one engine step."""
+        if not self.queue:
+            return None
+        req = self.queue[0]
+        n = len(req.prompt)
+        cached = pool.match_prefix(req.prompt)
+        start, bucket = self.plan_prefix(
+            n, cached, pool.block_size, pool.slot_capacity)
+        alloc = pool.acquire(req.rid, req.prompt,
+                             n + req.max_new_tokens, start)
+        if alloc is None:
+            return None
+        self.queue.popleft()
+        req.slot = alloc.slot
+        req.state = RUNNING
+        req.t_admitted = time.perf_counter()
+        self.active[alloc.slot] = req
+        if self.flight is not None:
+            self.flight.admitted(req, alloc.slot, bucket, 1)
+        return req, alloc, bucket
+
+    def rollback_admission(self, requests, pool):
+        """Undo not-yet-dispatched admissions after a prefill dispatch
+        failure: each request's slot is released back to the pool (the
+        paged pool also derefs its pinned/allocated blocks) and the
+        request returns to the FRONT of the queue in its original
+        order — a failed dispatch can't leak a slot (or blocks), and a
+        retry sees the same FIFO."""
+        for req in reversed(list(requests)):
+            if req.slot is not None:
+                pool.release(req.slot)
+                self.active.pop(req.slot, None)
+                req.slot = None
+            req.state = QUEUED
+            req.t_admitted = None
+            self.queue.appendleft(req)
+
     def stop_reason(self, request, token):
         """Why the request stops on ``token``: "eos" / "max_tokens" /
         None (keep decoding) — the flight recorder's retirement

@@ -492,6 +492,29 @@ class GPTForCausalLM(nn.Layer):
 
         return prefill, decode_step
 
+    def build_paged_serving_fns(self, num_slots, block_size, num_blocks,
+                                blocks_per_slot):
+        """Paged-cache analogues of build_serving_fns for the
+        block-granular KV pool (serving.paged): same decode math via
+        the shared _decode_forward_builder, cache addressed through a
+        fixed-shape block table so shared-prefix blocks are reused
+        instead of re-prefilled —
+
+          paged_prefill(params, tokens [1, B], tail_len, start, slot,
+                        bt_row [MB], toks [S], pos [S], kc, vc)
+              -> (first [1], toks', pos', kc, vc)
+          paged_decode(params, toks [S], pos [S], tables [S, MB],
+                       kc, vc)
+              -> (next [S], pos + 1, kc, vc)
+
+        with kc/vc [L, num_blocks, nh, block_size, hd]. Both are pure
+        and shape-stable (start/tail_len are traced scalars, so prefix
+        variety costs zero compiles); the engine AOT-compiles them
+        (decode once, prefill once per tail bucket)."""
+        from ..serving.paged.programs import build_paged_fns
+        return build_paged_fns(self.cfg, num_slots, block_size,
+                               num_blocks, blocks_per_slot)
+
     _DECODE_CACHE_MAX = 16
 
     @staticmethod

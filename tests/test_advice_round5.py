@@ -9,24 +9,33 @@ import paddle_tpu as paddle
 
 
 def test_onnx_dot_general_rejects_numpy_batch_mismatch():
-    """ADVICE #1 (medium): a dot_general whose free dims diverge from
-    ONNX MatMul's all-but-last-two batching must refuse at export time
-    instead of silently emitting a graph that computes a different
-    function."""
+    """ADVICE #1 (medium), updated: a dot_general whose free dims
+    diverge from ONNX MatMul's all-but-last-two batching originally
+    had to REFUSE at export. The general canonicalization path
+    (Transpose -> Reshape -> MatMul -> Reshape, onnx._emit_dot) has
+    since made the case exportable — the advice's real contract was
+    never "must raise", it was "must not silently emit a graph that
+    computes a DIFFERENT function", so this now asserts the emitted
+    graph computes the RIGHT one (evaluated by the numpy ONNX
+    interpreter from test_onnx_export)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from paddle_tpu import onnx as onnx_mod
+    from test_onnx_export import _run_onnx
 
     def bad(a, b):  # lhs_free=2 beside a batched rhs -> not MatMul
         return lax.dot_general(a, b, (((3,), (1,)), ((0,), (0,))))
 
-    a = jnp.zeros((2, 3, 4, 5), jnp.float32)
-    b = jnp.zeros((2, 5, 6), jnp.float32)
-    closed = jax.make_jaxpr(bad)(a, b)
-    with pytest.raises(NotImplementedError, match="free dims"):
-        onnx_mod._convert(closed, [], [], ["a", "b"], "g")
+    rs = np.random.RandomState(0)
+    a = rs.randn(2, 3, 4, 5).astype(np.float32)
+    b = rs.randn(2, 5, 6).astype(np.float32)
+    closed = jax.make_jaxpr(bad)(jnp.asarray(a), jnp.asarray(b))
+    model, _ = onnx_mod._convert(closed, [], [], ["a", "b"], "g")
+    got, = _run_onnx(model, [a, b])
+    want = np.asarray(bad(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
     def ok(a, b):  # rank-2 unbatched rhs: numpy broadcast matches
         return lax.dot_general(a, b, (((2,), (0,)), ((), ())))

@@ -439,7 +439,8 @@ def test_lint_graft_self_lints_repo_clean():
     report = json.loads(res.stdout)
     assert report["ok"] is True
     assert report["counts"]["error"] == 0
-    assert set(report["targets"]) == {"serving_decode", "hapi_train_step",
+    assert set(report["targets"]) == {"serving_decode", "paged_decode",
+                                      "hapi_train_step",
                                       "to_static_sample"}
     assert {"donation", "dynamic-shape-risk", "f64-upcast",
             "host-callback"} <= set(report["passes"])

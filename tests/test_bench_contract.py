@@ -126,6 +126,29 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
             assert "first_token" in names and "admitted" in names
             ts = [e["t"] for e in tr["events"]]
             assert ts == sorted(ts)          # lifecycle is monotone
+        # PR 6 shared-prefix scenario: the paged pool's radix prefix
+        # cache vs the legacy pool on identical prefix-sharing
+        # traffic — the acceptance bar is >= 1.3x TTFT, the cache
+        # counters must show the tail-only prefill actually happened,
+        # and the timed wave must stay zero-recompile under paging
+        sp = evidence["shared_prefix"]
+        assert set(sp) >= {"requests", "prefix_tokens",
+                           "paged_ttft_p50_ms", "nonpaged_ttft_p50_ms",
+                           "ttft_improvement", "paged_tokens_per_sec",
+                           "nonpaged_tokens_per_sec",
+                           "goodput_improvement", "prefix_cache",
+                           "prefill_accounting",
+                           "steady_state_new_compiles", "watchdog"}
+        assert sp["ttft_improvement"] >= 1.3, sp
+        pc = sp["prefix_cache"]
+        assert pc["hits"] > 0 and pc["cached_tokens"] > 0
+        assert pc["cached_tokens"] > pc["computed_tokens"]
+        assert pc["pool"]["indexed_blocks"] > 0
+        acct = sp["prefill_accounting"]
+        assert acct["prefix_cached_tokens"] == pc["cached_tokens"]
+        assert sp["steady_state_new_compiles"] == 0
+        assert sp["watchdog"]["warmed"] is True
+        assert last["shared_prefix_ttft_x"] == sp["ttft_improvement"]
         dq = evidence["deep_queue"]
         assert dq["group_sizes_used"] and \
             max(dq["group_sizes_used"]) > 1   # grouped prefill fired

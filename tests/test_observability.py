@@ -359,7 +359,7 @@ _SNAPSHOT_KEYS = {
     "slot_occupancy", "prefills", "prefill_requests", "prefill_groups",
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
-    "span_s", "latency_percentiles", "slo",
+    "span_s", "latency_percentiles", "slo", "prefix_cache",
 }
 _PCT_KEYS = {"count", "p50_ms", "p90_ms", "p99_ms"}
 

@@ -372,8 +372,11 @@ def test_export_resnet18(tmp_path):
 
 def test_export_gpt_logits(tmp_path):
     """A whole decoder-only LM (embeddings, causal attention with the
-    mask folded as a constant, QKV Split, tied-embedding logits head)
-    exports; graph reproduces teacher-forced logits."""
+    mask folded as a constant, QKV projection, tied-embedding logits
+    head) exports; graph reproduces teacher-forced logits. (The QKV
+    tensor historically lowered through an ONNX Split node; the
+    current attention path reaches the exporter as strided Slices —
+    either lowering is fine, the NUMERIC check below is the contract.)"""
     from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
 
     paddle.seed(0)
@@ -385,7 +388,6 @@ def test_export_gpt_logits(tmp_path):
     path = paddle.onnx.export(net, str(tmp_path / "gpt"),
                               input_spec=[InputSpec([1, 16], "int64")])
     model = _load(path)
-    assert any(n.op_type == "Split" for n in model.graph.node)
     ids = np.random.RandomState(0).randint(0, 128, (1, 16)).astype(
         np.int64)
     got, = _run_onnx(model, [ids])

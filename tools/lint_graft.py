@@ -7,6 +7,12 @@ Builds the three kinds of compiled programs this framework ships —
     and warm-declared, linted via ``engine.lint()`` (f64-upcast /
     host-callback / donation over the decode jaxpr, dynamic-shape-risk
     over the engine's compile watchdog);
+  * ``paged_decode``     — the same engine with the paged KV pool
+    (``paged=True``): the decode jaxpr now threads the int32 block
+    table, and the f64-upcast + donation passes must stay clean with
+    that argument (the table is small and host-authored — donating it
+    would be noise, and the donation pass's size floor keeps it
+    silent);
   * ``hapi_train_step``  — a hapi.Model static-adapter train step
     (forward + loss + backward + optimizer captured as ONE to_static
     program), linted via ``TracedFunction.lint()``;
@@ -49,6 +55,31 @@ def lint_serving_decode():
                            max_new_tokens=4)
     engine.run()
     engine.declare_warmup()
+    return engine.lint()
+
+
+def lint_paged_decode():
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    paddle.seed(7)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, num_slots=4, paged=True, block_size=8)
+    rs = np.random.RandomState(0)
+    shared = rs.randint(0, 97, (16,)).astype(np.int64)
+    for n in (5, 9):
+        engine.add_request(
+            np.concatenate([shared,
+                            rs.randint(0, 97, (n,)).astype(np.int64)]),
+            max_new_tokens=4)
+    engine.run()
+    engine.declare_warmup()
+    assert engine.metrics.snapshot()["prefix_cache"]["hits"] >= 1, \
+        "paged lint target never exercised the prefix cache"
     return engine.lint()
 
 
@@ -100,6 +131,7 @@ def lint_to_static_sample():
 
 TARGETS = {
     "serving_decode": lint_serving_decode,
+    "paged_decode": lint_paged_decode,
     "hapi_train_step": lint_hapi_train_step,
     "to_static_sample": lint_to_static_sample,
 }
