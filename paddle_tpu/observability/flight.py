@@ -39,6 +39,7 @@ from .tracing import default_recorder
 # enqueued <= admitted <= prefill_dispatched <= first_token <= retired)
 ENQUEUED = "enqueued"
 ADMITTED = "admitted"
+ADMISSION_ROLLED_BACK = "admission_rolled_back"
 PREFIX_HIT = "prefix_hit"
 PREFILL_DISPATCHED = "prefill_dispatched"
 FIRST_TOKEN = "first_token"
@@ -144,6 +145,15 @@ class FlightRecorder:
         self._event(req.rid, ADMITTED, "t",
                     {"slot": int(slot), "bucket": int(bucket),
                      "group_size": int(group_size)})
+
+    def admission_rolled_back(self, req):
+        """The request's admission was undone before its prefill
+        dispatched (dispatch-failure rollback): the preceding
+        ``admitted`` event is void, the request is back at the front
+        of the queue, and a later ``admitted`` is a fresh attempt —
+        readers pairing admissions with retirements skip voided
+        ones."""
+        self._event(req.rid, ADMISSION_ROLLED_BACK, "t", {})
 
     def prefix_hit(self, req, cached_tokens, tail_tokens):
         """The request's admission reused ``cached_tokens`` prompt

@@ -631,13 +631,9 @@ class ServingEngine:
         sch, pool, M = self.scheduler, self.pool, self.metrics
         with M.span("serving/admit"):
             groups = sch.admit(pool, self.group_sizes)
-            for group in groups:
-                for req, _slot in group:
-                    M.record_admission(req)
 
         for gi, group in enumerate(groups):
             G = len(group)
-            M.requests_admitted += G
             bucket = sch.bucket_for(len(group[0][0].prompt))
             tokens = np.zeros((G, bucket), np.int32)
             lengths = np.zeros((G,), np.int32)
@@ -665,6 +661,12 @@ class ServingEngine:
                     [r for g in groups[gi:] for r, _ in g], pool)
                 raise
             pool.rebind(kc, vc)
+            # admission accounting lands only once the dispatch stuck:
+            # a rolled-back admission is re-counted on its retry, not
+            # counted twice
+            for req, _slot in group:
+                M.record_admission(req)
+            M.requests_admitted += G
             M.prefills += 1
             M.prefill_requests += G
             M.record_prefill_group(G)
@@ -690,8 +692,6 @@ class ServingEngine:
             if admission is None:
                 break
             req, alloc, bucket = admission
-            M.record_admission(req)
-            M.requests_admitted += 1
             start = alloc.prefix_tokens
             tail = len(req.prompt) - start
             tokens = np.zeros((1, bucket), np.int32)
@@ -716,6 +716,8 @@ class ServingEngine:
                 raise
             pool.rebind(kc, vc)
             pool.commit_prefix(alloc.slot, req.prompt)
+            M.record_admission(req)
+            M.requests_admitted += 1
             M.prefills += 1
             M.prefill_requests += 1
             M.record_prefill_group(1)

@@ -133,19 +133,36 @@ class PagedKVPool:
         return sum(1 for r in self._ref.values() if r > 0)
 
     def _alloc_block(self):
+        """One fresh block at ref 1, from the free heap or by evicting
+        the LRU ref-0 radix LEAF. Returns None when neither source has
+        a block: the evictable count includes ref-0 INTERIOR nodes that
+        leaf-only eviction cannot reach while live descendants pin the
+        path, so running dry here is a legitimate wait-for-retirement
+        condition, not a bug — acquire() rolls back and returns None."""
         if self._free_blocks:
             b = heapq.heappop(self._free_blocks)
         else:
             b = self.index.evict_lru(
                 lambda blk: self._ref.get(blk, 0) == 0)
             if b is None:
-                raise RuntimeError(
-                    "block allocation with no free or evictable blocks "
-                    "— acquire() capacity check should have refused")
+                return None
             self.evictions += 1
             self._evictable -= 1
         self._ref[b] = 1
         return b
+
+    def _deref(self, b):
+        """Drop one reference: at ref 0 an indexed block parks
+        evictable, an unindexed one frees immediately."""
+        r = self._ref[b] = self._ref[b] - 1
+        if r < 0:
+            raise AssertionError(f"block {b} refcount underflow")
+        if r == 0:
+            if b in self.index:
+                self._evictable += 1
+            else:
+                del self._ref[b]
+                heapq.heappush(self._free_blocks, b)
 
     def match_prefix(self, prompt):
         """Longest cached prefix of ``prompt`` in TOKENS (always a
@@ -157,10 +174,12 @@ class PagedKVPool:
         ``prefix_tokens`` (block-aligned, from the radix index) into
         its table row, and allocate fresh blocks for the rest of
         ``total_tokens`` (prompt + max_new). Returns a PagedAllocation,
-        or None when no slot is free or the fresh blocks don't fit in
-        free + evictable capacity (the caller keeps the request queued
-        — retirement frees blocks, never a deadlock while one request
-        fits the pool)."""
+        or None when no slot is free or the fresh blocks cannot all be
+        sourced from the free list + reachable evictable leaves — the
+        refusal is transactional (any pins/allocations made are rolled
+        back) so the caller keeps the request queued with the pool
+        untouched; retirement frees blocks, never a deadlock while one
+        request fits the pool."""
         if not self._free_slots:
             return None
         bs = self.block_size
@@ -175,13 +194,21 @@ class PagedKVPool:
                 f"row holds {self.blocks_per_slot}")
         n_prefix = prefix_tokens // bs
         n_new = n_total - n_prefix
-        if n_new > len(self._free_blocks) + self._evictable:
-            return None
         prefix_blocks = self.index.match(prompt)[:n_prefix]
         if len(prefix_blocks) < n_prefix:
             raise ValueError(
                 f"prefix_tokens {prefix_tokens} exceeds the cached "
                 f"prefix ({len(prefix_blocks) * bs} tokens)")
+        # capacity pre-check: ref-0 prefix blocks are about to be
+        # pinned, so they are NOT reclaimable supply for the fresh
+        # allocations — count them out. (Still optimistic about ref-0
+        # INTERIOR nodes leaf-only eviction can't reach; the allocation
+        # loop below handles that by rolling back, never raising.)
+        pinned_ref0 = sum(
+            1 for b in prefix_blocks if self._ref.get(b, 0) == 0)
+        if n_new > (len(self._free_blocks) + self._evictable
+                    - pinned_ref0):
+            return None
         # pin the prefix FIRST: ref>0 blocks are invisible to eviction,
         # so the fresh allocations below cannot steal our own prefix
         for b in prefix_blocks:
@@ -189,7 +216,19 @@ class PagedKVPool:
             self._ref[b] = r + 1
             if r == 0:
                 self._evictable -= 1
-        new_blocks = [self._alloc_block() for _ in range(n_new)]
+        new_blocks = []
+        for _ in range(n_new):
+            b = self._alloc_block()
+            if b is None:
+                # eviction ran out of reachable leaves: undo the pins
+                # and partial allocations so acquire either fully
+                # succeeds or leaves the pool untouched, and wait
+                for nb in new_blocks:
+                    self._deref(nb)
+                for pb in prefix_blocks:
+                    self._deref(pb)
+                return None
+            new_blocks.append(b)
         slot = heapq.heappop(self._free_slots)
         self._owner[slot] = owner
         if slot in self._ever_used:
@@ -225,15 +264,7 @@ class PagedKVPool:
             raise ValueError(f"slot {slot} is not live")
         del self._owner[slot]
         for b in self._slot_blocks.pop(slot):
-            r = self._ref[b] = self._ref[b] - 1
-            if r < 0:
-                raise AssertionError(f"block {b} refcount underflow")
-            if r == 0:
-                if b in self.index:
-                    self._evictable += 1
-                else:
-                    del self._ref[b]
-                    heapq.heappush(self._free_blocks, b)
+            self._deref(b)
         heapq.heappush(self._free_slots, slot)
         self.block_tables[slot, :] = TRASH_BLOCK
         self._dirty = True
@@ -245,13 +276,20 @@ class PagedKVPool:
         int32 — a few KB, dwarfed by one decode dispatch)."""
         import jax.numpy as jnp
         if self._tables_dev is None or self._dirty:
-            self._tables_dev = jnp.asarray(self.block_tables)
+            # snapshot before upload: device_put may defer reading the
+            # host buffer past this call, and acquire/release mutate
+            # block_tables in place — handing jax the live buffer lets
+            # an in-flight transfer observe FUTURE row edits (rare
+            # shared-prefix corruption under the async pipeline)
+            self._tables_dev = jnp.asarray(self.block_tables.copy())
             self._dirty = False
         return self._tables_dev
 
     def table_row(self, slot):
         import jax.numpy as jnp
-        return jnp.asarray(self.block_tables[slot])
+        # same snapshot discipline as device_tables: never hand jax a
+        # view of the live, in-place-mutated table
+        return jnp.asarray(self.block_tables[slot].copy())
 
     def rebind(self, kc, vc):
         """Same single-owner discipline as SlotKVPool.rebind: the

@@ -215,7 +215,11 @@ class StepScheduler:
         paged pool also derefs its pinned/allocated blocks) and the
         request returns to the FRONT of the queue in its original
         order — a failed dispatch can't leak a slot (or blocks), and a
-        retry sees the same FIFO."""
+        retry sees the same FIFO. Emits a compensating
+        ``admission_rolled_back`` flight event per request so trace
+        readers know the earlier ``admitted`` is void (the engine
+        defers metric admission accounting to dispatch success, so
+        counters never see the voided attempt)."""
         for req in reversed(list(requests)):
             if req.slot is not None:
                 pool.release(req.slot)
@@ -224,6 +228,8 @@ class StepScheduler:
             req.state = QUEUED
             req.t_admitted = None
             self.queue.appendleft(req)
+            if self.flight is not None:
+                self.flight.admission_rolled_back(req)
 
     def stop_reason(self, request, token):
         """Why the request stops on ``token``: "eos" / "max_tokens" /

@@ -129,6 +129,62 @@ def test_pool_eviction_reclaims_lru_cached_blocks():
     pool.check_conservation()
 
 
+def test_pool_acquire_refuses_when_prefix_pins_consume_evictable():
+    """free=0 and the only evictable blocks ARE the matched prefix the
+    admission is about to pin: acquire must refuse up front (the pins
+    make them non-reclaimable) instead of pinning, failing the fresh
+    allocation mid-way, and leaking the pinned refs."""
+    pool = _pool(num_slots=2, max_len=12, block_size=4, num_blocks=4)
+    pa = np.arange(8)
+    a = pool.acquire(0, pa, 8, 0)
+    pool.commit_prefix(a.slot, pa)
+    pool.release(a.slot)               # blocks 1,2 evictable; 3 free
+    b = pool.acquire(1, np.array([90, 91, 92, 93]), 4, 0)
+    assert b is not None and pool.free_blocks == 0
+    assert pool.evictable_blocks == 2
+    ref_before = dict(pool._ref)
+    # needs 1 fresh block; the 2 "evictable" blocks are its own prefix
+    assert pool.acquire(2, pa, 12, prefix_tokens=8) is None
+    assert pool._ref == ref_before     # nothing pinned, nothing leaked
+    assert pool.evictable_blocks == 2
+    pool.check_conservation()
+    # retirement restores real capacity and the same request admits
+    pool.release(b.slot)
+    c = pool.acquire(2, pa, 12, prefix_tokens=8)
+    assert c is not None and c.prefix_blocks == a.new_blocks
+    pool.check_conservation()
+
+
+def test_pool_acquire_rolls_back_when_eviction_cannot_reach_leaves():
+    """A ref-0 INTERIOR radix block under a live private tail counts
+    evictable but leaf-only eviction cannot reclaim it: acquire must
+    roll its pins back and return None (wait for retirement) instead
+    of raising mid-allocation."""
+    pool = _pool(num_slots=3, max_len=12, block_size=4, num_blocks=6)
+    pa = np.arange(8)
+    a = pool.acquire(0, pa, 8, 0)
+    pool.commit_prefix(a.slot, pa)
+    pool.release(a.slot)               # blocks 1,2 cached at ref 0
+    # trimmed-prefix admission: 8 tokens are cached but only 4 are
+    # used, so the private recompute of span [4,8) plus a divergent
+    # third block commits a LIVE leaf under cached ref-0 interior 2
+    pc = np.concatenate([pa, [70, 71, 72, 73]])
+    c = pool.acquire(1, pc, 12, prefix_tokens=4)
+    pool.commit_prefix(c.slot, pc)
+    d = pool.acquire(2, np.array([90, 91, 92, 93]), 4, 0)
+    assert pool.free_blocks == 0 and pool.evictable_blocks == 1
+    ref_before = dict(pool._ref)
+    pe = np.concatenate([pa[:4], [60, 61, 62, 63]])
+    assert pool.acquire(3, pe, 8, prefix_tokens=4) is None
+    assert pool._ref == ref_before     # pinned prefix rolled back
+    assert pool.evictable_blocks == 1
+    pool.check_conservation()
+    pool.release(d.slot)               # a real block frees
+    e = pool.acquire(3, pe, 8, prefix_tokens=4)
+    assert e is not None
+    pool.check_conservation()
+
+
 def test_pool_acquire_rejects_unaligned_or_oversized():
     pool = _pool(max_len=16, block_size=4)
     with pytest.raises(ValueError):
@@ -137,6 +193,25 @@ def test_pool_acquire_rejects_unaligned_or_oversized():
         pool.acquire(0, np.arange(8), 17, prefix_tokens=0)  # > capacity
     with pytest.raises(ValueError):        # prefix not actually cached
         pool.acquire(0, np.arange(8), 8, prefix_tokens=4)
+
+
+def test_device_tables_are_snapshots_immune_to_host_mutation():
+    """device_tables()/table_row() hand jax a SNAPSHOT: the pool
+    mutates block_tables in place (acquire/release), and a device
+    array that aliased or lazily read the live buffer would let an
+    in-flight async dispatch observe future row edits (observed as
+    rare shared-prefix corruption under the pipelined engine)."""
+    pool = _pool()
+    a = pool.acquire(0, np.arange(8), 8, 0)
+    dev = pool.device_tables()
+    row = pool.table_row(a.slot)
+    before_dev = np.asarray(dev).copy()
+    before_row = np.asarray(row).copy()
+    pool.release(a.slot)               # zeroes the row to TRASH in place
+    b = pool.acquire(1, np.arange(8) + 50, 16, 0)
+    assert b is not None               # rewrites rows again
+    np.testing.assert_array_equal(np.asarray(dev), before_dev)
+    np.testing.assert_array_equal(np.asarray(row), before_row)
 
 
 # ---------------------------------------------------------------- fuzz

@@ -214,12 +214,24 @@ def test_failed_prefill_dispatch_leaks_no_slot(paged):
     if paged:
         eng.pool.check_conservation()
         assert eng.pool.live_blocks == 0
+    # the rolled-back attempt never reached the admission counters
+    assert eng.metrics.requests_admitted == 0
     # fault clears: the same engine drains the queue with full parity
     eng._compiled = orig
     eng.run()
     for r, p in zip(reqs, prompts):
         assert r.done
         np.testing.assert_array_equal(r.output_ids, _ref(m, p, 4))
+    # admission accounting is once-per-request despite the retry, and
+    # the flight trace voids the first attempt explicitly
+    assert eng.metrics.requests_admitted == len(reqs)
+    pcts = eng.metrics.snapshot()["latency_percentiles"]
+    assert pcts["queue_wait"]["count"] == len(reqs)
+    names = [e["event"] for e in eng.request_trace(reqs[0].rid).events]
+    assert names.count("admitted") == 2        # voided attempt + retry
+    assert names.count("admission_rolled_back") == 1
+    i_rb = names.index("admission_rolled_back")
+    assert names.index("admitted") < i_rb and "admitted" in names[i_rb:]
 
 
 def test_cached_paged_attention_matches_slot_attention():
