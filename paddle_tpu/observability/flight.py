@@ -42,6 +42,9 @@ ADMITTED = "admitted"
 ADMISSION_ROLLED_BACK = "admission_rolled_back"
 PREFIX_HIT = "prefix_hit"
 PREFILL_DISPATCHED = "prefill_dispatched"
+PREFILL_CHUNK = "prefill_chunk"
+DEPRIORITIZED = "deprioritized"
+SHED = "shed"
 FIRST_TOKEN = "first_token"
 DECODE_WINDOW = "decode_window"
 RETIRED = "retired"
@@ -169,6 +172,37 @@ class FlightRecorder:
         self._event(req.rid, PREFILL_DISPATCHED, "t",
                     {"bucket": int(bucket),
                      "group_size": int(group_size)})
+
+    def prefill_chunk(self, req, index, start, chunk_len, final):
+        """One chunked-prefill dispatch for this request: chunk
+        ``index`` covers prompt positions ``start..start+chunk_len``
+        (``final`` marks the chunk whose logits emit the first token).
+        The chunk chain is WHY a long prompt's trace shows decode
+        windows of other requests progressing between its own prefill
+        events — chunking is the co-scheduling made visible."""
+        self._event(req.rid, PREFILL_CHUNK, "t",
+                    {"chunk": int(index), "start": int(start),
+                     "chunk_len": int(chunk_len),
+                     "final": bool(final)})
+
+    def deprioritized(self, req, headroom_ms):
+        """The admission policy moved this queued request behind the
+        still-SLO-viable queue (its own SLO is already lost);
+        ``headroom_ms`` (<= 0) is the TTFT budget balance at decision
+        time — the trace answers WHY it waited."""
+        self._event(req.rid, DEPRIORITIZED, "t",
+                    {"headroom_ms": round(float(headroom_ms), 3)})
+
+    def shed(self, req, reason, headroom_ms):
+        """The admission policy DROPPED this queued request (zero
+        tokens served): a ``shed`` event with the reason + headroom at
+        decision time, then the trace closes through the normal
+        retirement path (reason "shed") so every trace still ends
+        ``retired`` and the completed ring stays bounded."""
+        self._event(req.rid, SHED, "t",
+                    {"reason": str(reason),
+                     "headroom_ms": round(float(headroom_ms), 3)})
+        self.retired(req, "shed")
 
     def token_emitted(self, req, n_tokens):
         """Account one emitted token: the FIRST is the TTFT lifecycle

@@ -74,6 +74,16 @@ math into a multi-tenant server:
     device-kind peak-FLOP/s table, ``peak_flops=`` /
     ``$PADDLE_TPU_PEAK_FLOPS`` override) and HBM in-use/free pull
     gauges; ``engine.cost_model()`` is the artifact-ready summary;
+  * **scheduling subsystem** (serving.sched, PR 7 — all default-off):
+    chunked prefill (``prefill_chunk=`` — long prompts prefill in
+    fixed-width chunks co-scheduled with decode steps under a
+    per-step token budget; ONE compiled chunk program per pool
+    flavor, exact parity with whole-prompt prefill), SLO-feedback
+    admission (``policy="slo_feedback"`` — sheds/defers queued
+    requests whose TTFT SLO is already lost against live delivered
+    latency; counted, SLO-judged, flight-evented), and per-slot
+    sampling (``sampling=True`` — temperature/top-k/top-p per slot in
+    the one compiled decode, greedy slots bit-exact with generate());
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program
@@ -121,6 +131,23 @@ Tuning knobs
                 SLO targets (None = untargeted) and the sliding-
                 percentile window for the goodput/attainment
                 accounting above.
+``prefill_chunk`` / ``prefill_token_budget``
+                chunked prefill (serving.sched): prompts longer than
+                ``prefill_chunk`` prefill in fixed-width chunks
+                interleaved with decode steps, at most
+                ``prefill_token_budget`` chunk tokens per step
+                (default: one chunk). None (default) = whole-prompt
+                prefill; ``PADDLE_PREFILL_CHUNK`` sets an env default.
+``policy``      admission policy: "fifo" (default), "slo_feedback"
+                (shed queued requests whose TTFT SLO is already
+                lost, judged against live delivered latency), or a
+                serving.sched.SchedulingPolicy instance;
+                ``PADDLE_SCHED_POLICY`` sets an env default.
+``sampling``    True threads per-slot temperature / top-k / top-p
+                (``add_request(..., temperature=, top_k=, top_p=,
+                seed=)``) through the one compiled decode/prefill
+                executable; False (default) keeps the greedy-only
+                signatures and rejects sampled requests.
 ``completed_keep`` / ``trace_keep`` / ``trace_decode_window``
                 retention bounds: completed Request objects kept by
                 the scheduler (default 4096), completed RequestTraces
@@ -141,4 +168,8 @@ from .engine import (  # noqa: F401
 from .kv_pool import SlotKVPool  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .paged import PagedKVPool, RadixPrefixIndex  # noqa: F401
+from .sched import (  # noqa: F401
+    ChunkPlan, FIFOPolicy, SchedulingPolicy, SLOFeedbackPolicy,
+    SlotSampler, plan_chunks,
+)
 from .scheduler import Request, StepScheduler  # noqa: F401

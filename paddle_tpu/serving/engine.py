@@ -38,12 +38,23 @@ ticks:
     when decode dominates the step.
 
 Compiled program inventory for a whole serving lifetime:
-  * one decode step at the fixed pooled-cache shape, and
+  * one decode step at the fixed pooled-cache shape,
   * at most ``len(buckets) * len(group_sizes)`` prefill programs
     (prompts pad up to a small geometric bucket set, admission groups
-    up to a small geometric size set),
+    up to a small geometric size set), and
+  * with chunked prefill enabled (``prefill_chunk=``), ONE chunk
+    program per pool flavor (traced start/len/slot/final scalars —
+    the paged pool's chunks reuse its tail-prefill program outright),
 so prompt-length AND queue-depth variety is O(buckets x group_sizes)
 compiles — the generate() LRU problem this engine exists to delete.
+
+Scheduling (serving.sched, all default-off): long prompts can prefill
+in fixed-width chunks interleaved with decode steps under a per-step
+token budget (no more one-4k-prefill-stalls-63-decoders), an
+SLO-feedback admission policy can shed/defer queued requests whose
+TTFT target is already unrecoverable (goodput under overload), and
+per-slot sampling threads temperature/top-k/top-p through the one
+compiled decode.
 """
 import os
 import warnings
@@ -140,7 +151,9 @@ class ServingConfig:
                  slo_tpot_ms=None, slo_window_s=60.0,
                  completed_keep=4096, trace_keep=256,
                  trace_decode_window=32, peak_flops=None,
-                 paged=None, block_size=16, num_blocks=None):
+                 paged=None, block_size=16, num_blocks=None,
+                 prefill_chunk=None, prefill_token_budget=None,
+                 policy=None, sampling=False):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -193,6 +206,41 @@ class ServingConfig:
         self.paged = bool(paged)
         self.block_size = int(block_size)
         self.num_blocks = num_blocks
+        # chunked prefill (serving.sched): prompts longer than
+        # prefill_chunk split into fixed-width chunks interleaved with
+        # decode steps under prefill_token_budget chunk tokens per
+        # step (default: one chunk per step), so a long prompt never
+        # monopolizes the step loop. None = off (whole-prompt prefill,
+        # prior behavior); the PADDLE_PREFILL_CHUNK env var sets a
+        # default width, mirroring the PADDLE_PAGED_KV gating pattern.
+        if prefill_chunk is None:
+            env = os.environ.get("PADDLE_PREFILL_CHUNK")
+            if env:
+                prefill_chunk = int(env)
+        self.prefill_chunk = None if prefill_chunk is None \
+            else int(prefill_chunk)
+        if self.prefill_chunk is not None and self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if prefill_token_budget is None:
+            prefill_token_budget = self.prefill_chunk
+        self.prefill_token_budget = prefill_token_budget
+        if self.prefill_chunk is not None \
+                and self.prefill_token_budget < self.prefill_chunk:
+            raise ValueError(
+                f"prefill_token_budget {prefill_token_budget} cannot "
+                f"be smaller than prefill_chunk {prefill_chunk} (no "
+                f"chunk could ever dispatch)")
+        # admission policy: "fifo" (default) | "slo_feedback" | a
+        # serving.sched.SchedulingPolicy instance; the env var mirrors
+        # the other ops gates
+        if policy is None:
+            policy = os.environ.get("PADDLE_SCHED_POLICY") or None
+        self.policy = policy
+        # per-slot sampling threaded through the compiled decode/
+        # prefill programs; greedy stays the default (and the only
+        # mode whose signatures match prior PRs bit-for-bit)
+        self.sampling = bool(sampling)
 
 
 class ServingEngine:
@@ -236,6 +284,13 @@ class ServingEngine:
         self.cache_len = cache_len
         self.params = model.export_decode_params()
         self.paged = config.paged
+        self.sampling = bool(config.sampling)
+        self.chunk_len = config.prefill_chunk
+        self.prefill_token_budget = config.prefill_token_budget
+        if self.chunk_len is not None and self.chunk_len > cache_len:
+            raise ValueError(
+                f"prefill_chunk {self.chunk_len} exceeds the per-slot "
+                f"capacity {cache_len}")
         if self.paged:
             from .paged import PagedKVPool
             self.pool = PagedKVPool(
@@ -246,23 +301,39 @@ class ServingEngine:
             self._prefill_fn, self._decode_fn = \
                 model.build_paged_serving_fns(
                     config.num_slots, self.pool.block_size,
-                    self.pool.num_blocks, self.pool.blocks_per_slot)
+                    self.pool.num_blocks, self.pool.blocks_per_slot,
+                    sampling=self.sampling)
+            self._chunk_fn = None   # chunks reuse the paged prefill
         else:
             self._prefill_fn, self._decode_fn = model.build_serving_fns(
-                config.num_slots, cache_len)
+                config.num_slots, cache_len, sampling=self.sampling)
+            self._chunk_fn = model.build_chunk_prefill_fn(
+                cache_len, sampling=self.sampling) \
+                if self.chunk_len is not None else None
             self.pool = SlotKVPool(
                 config.num_slots, cfg.num_layers, cfg.num_heads,
                 cache_len, cfg.hidden_size // cfg.num_heads)
+        from .sched import ChunkPlan, SlotSampler, resolve_policy
+        self._ChunkPlan = ChunkPlan
+        self._sampler = SlotSampler(config.num_slots) \
+            if self.sampling else None
+        self._chunk_q = []        # ChunkPlans awaiting chunk dispatch
+        self._prefilling = set()  # slots parked mid-chunked-prefill
+        self._policy = resolve_policy(config.policy,
+                                      config.slo_ttft_ms)
         self.flight = FlightRecorder(
             keep_last=config.trace_keep,
             decode_window=config.trace_decode_window)
         self.scheduler = StepScheduler(
             buckets, cache_len, completed_keep=config.completed_keep,
-            flight=self.flight)
+            flight=self.flight, policy=self._policy)
         self.metrics = ServingMetrics(
             slo_ttft_ms=config.slo_ttft_ms,
             slo_tpot_ms=config.slo_tpot_ms,
             slo_window_s=config.slo_window_s)
+        self.metrics.set_scheduler_info(
+            self._policy.name, self.chunk_len,
+            self.prefill_token_budget)
         self.watchdog = CompileWatchdog(mode=config.watchdog_mode)
         self._exec = {}  # (kind, bucket?, group?) -> XLA executable
         self._metric_servers = []
@@ -300,15 +371,28 @@ class ServingEngine:
     # ---------------------------------------------------------- requests
 
     def add_request(self, prompt, max_new_tokens, eos_id=None,
-                    on_token=None):
+                    on_token=None, temperature=0.0, top_k=0,
+                    top_p=1.0, seed=None):
         """Enqueue a prompt; returns the Request handle immediately.
         Tokens stream through on_token(request, token) as steps run
         (with async_depth=1 a token surfaces one engine step after the
-        decode that produced it was dispatched)."""
+        decode that produced it was dispatched).
+
+        ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` select
+        per-slot sampling for THIS request (the engine must be built
+        with ``sampling=True`` — greedy engines reject sampled
+        requests rather than silently argmaxing them); the defaults
+        are greedy, matching ``generate(temperature=0.0)`` exactly."""
         req = Request(prompt, max_new_tokens,
                       eos_id=self.config.eos_id if eos_id is None
                       else eos_id,
-                      on_token=on_token)
+                      on_token=on_token, temperature=temperature,
+                      top_k=top_k, top_p=top_p, seed=seed)
+        if req.sampled and not self.sampling:
+            raise ValueError(
+                "sampled request on a greedy engine: build the engine "
+                "with ServingConfig(sampling=True) to serve "
+                "temperature/top-k/top-p traffic")
         return self.scheduler.submit(req)
 
     @property
@@ -423,15 +507,22 @@ class ServingEngine:
             "slo": self.metrics.slo.report(),
             "paged": self.paged,
             "prefix_cache": self.metrics.prefix_cache_report(),
+            "scheduler": dict(
+                self.metrics.scheduler_report(),
+                chunked_inflight=len(self._chunk_q)),
         }
 
-    def lint(self, passes=None, min_donation_bytes=1 << 20):
+    def lint(self, passes=None, min_donation_bytes=1 << 20,
+             program="decode"):
         """Static-analysis findings over this engine's hot path (see
-        paddle_tpu.analysis.lint_jaxpr): the decode executable's jaxpr
+        paddle_tpu.analysis.lint_jaxpr): the chosen executable's jaxpr
         runs through the ``f64-upcast`` / ``host-callback`` / ``donation``
         passes, and the engine's compile watchdog feeds
-        ``dynamic-shape-risk``. The donation metadata mirrors the real
-        AOT build: kc/vc/pos donated iff ``self._donate``
+        ``dynamic-shape-risk``. ``program`` picks the jaxpr:
+        "decode" (default) or "chunk" (the chunked-prefill program —
+        legacy pool only; the paged flavor's chunks ARE its prefill
+        program). The donation metadata mirrors the real AOT build:
+        kc/vc/pos donated iff ``self._donate``
         (``metrics.kv_donation["enabled"]``), aliasing iff the backend
         aliases donated buffers (``kv_donation["effective"]`` on) — so
         the ``donation`` pass cross-checks
@@ -440,16 +531,37 @@ class ServingEngine:
         exactly when the big cache buffers are donated."""
         import jax
         from ..analysis import lint as lint_mod
-        if self.paged:
+        if program == "chunk":
+            if self._chunk_fn is None:
+                raise ValueError(
+                    "no chunk program on this engine (legacy pool + "
+                    "ServingConfig(prefill_chunk=...) builds one)")
+            C = self.chunk_len
+            args = (self.params, np.zeros((1, C), np.int32),
+                    np.int32(C), np.int32(0), np.int32(0),
+                    np.int32(1), self._toks, self._pos, self.pool.kc,
+                    self.pool.vc)
+            if self.sampling:
+                args = args + (np.int32(0), np.float32(0.0),
+                               np.int32(0), np.float32(1.0))
+            fn = self._chunk_fn
+            donate = (7, 8, 9) if self._donate else ()
+        elif self.paged:
             args = (self.params, self._toks, self._pos,
                     self.pool.device_tables(), self.pool.kc,
                     self.pool.vc)
+            if self.sampling:
+                args = args + self._sampler.device_arrays()
+            fn = self._decode_fn
             donate = (2, 4, 5) if self._donate else ()
         else:
             args = (self.params, self._toks, self._pos, self.pool.kc,
                     self.pool.vc)
+            if self.sampling:
+                args = args + self._sampler.device_arrays()
+            fn = self._decode_fn
             donate = (2, 3, 4) if self._donate else ()
-        closed = jax.make_jaxpr(self._decode_fn)(*args)
+        closed = jax.make_jaxpr(fn)(*args)
         return lint_mod.lint_jaxpr(
             closed, passes=passes,
             donated_invars=lint_mod.donated_invars_from_argnums(
@@ -585,26 +697,37 @@ class ServingEngine:
                         if sch.saturated(r)]:
                 sch.prerelease(req, pool)
 
+        self._triage()
+
         if self.paged:
             self._paged_prefills(sync)
         else:
             self._legacy_prefills(sync)
+        if self._chunk_q:
+            self._dispatch_chunks(sync)
 
+        # slots parked mid-chunked-prefill decode physically (the
+        # pooled dispatch advances every slot) but their parked writes
+        # land in always-overwritten-before-visible rows and their
+        # tokens are never harvested — excluded here
         snapshot = {slot: req for slot, req in sch.active.items()
-                    if not sch.saturated(req)}
+                    if not sch.saturated(req)
+                    and slot not in self._prefilling}
         if snapshot:
             for req in snapshot.values():
                 req.inflight += 1
             if self.paged:
                 args = (self.params, self._toks, self._pos,
                         pool.device_tables(), pool.kc, pool.vc)
-                ex = self._compiled(("decode",), self._decode_fn, args,
-                                    donate=(2, 4, 5))
+                donate = (2, 4, 5)
             else:
                 args = (self.params, self._toks, self._pos, pool.kc,
                         pool.vc)
-                ex = self._compiled(("decode",), self._decode_fn, args,
-                                    donate=(2, 3, 4))
+                donate = (2, 3, 4)
+            if self.sampling:
+                args = args + self._sampler.device_arrays()
+            ex = self._compiled(("decode",), self._decode_fn, args,
+                                donate=donate)
             with M.span("serving/decode_dispatch"):
                 nxt, self._pos, kc, vc = ex(*args)
             pool.rebind(kc, vc)
@@ -622,15 +745,36 @@ class ServingEngine:
         M.slot_occupancy = pool.occupancy
         return sch.pending or bool(self._pending)
 
+    def _triage(self):
+        """Apply the admission policy to the queue (scheduler does the
+        queue surgery and request state; this engine layer emits the
+        counters + flight events the decisions owe the observability
+        contract: every shed/deferred request is counted, SLO-judged,
+        and trace-attributed with its headroom at decision time)."""
+        sch, M = self.scheduler, self.metrics
+        with M.span("serving/triage"):
+            shed, deprioritized = sch.triage()
+        for req, headroom in deprioritized:
+            M.record_deprioritized()
+            self.flight.deprioritized(req, headroom)
+        for req, headroom in shed:
+            M.record_shed(req.shed_reason)
+            self.flight.shed(req, req.shed_reason, headroom)
+
     def _legacy_prefills(self, sync):
         """Admission + grouped bucketed prefill over the contiguous
         slot pool. A dispatch failure (compile error, bad buffer)
         rolls every not-yet-dispatched admission back to the queue and
         releases its slot — acquire-to-dispatch is leak-free
-        (tests/test_serving.py::test_failed_prefill_dispatch...)."""
+        (tests/test_serving.py::test_failed_prefill_dispatch...).
+        With chunked prefill enabled, prompts longer than the chunk
+        width claim their slot here but dispatch chunk by chunk in
+        ``_dispatch_chunks`` instead of joining a group."""
         sch, pool, M = self.scheduler, self.pool, self.metrics
         with M.span("serving/admit"):
-            groups = sch.admit(pool, self.group_sizes)
+            groups, chunked = sch.admit_chunked(pool, self.group_sizes,
+                                                self.chunk_len)
+        self._register_chunked(chunked)
 
         for gi, group in enumerate(groups):
             G = len(group)
@@ -644,8 +788,13 @@ class ServingEngine:
                 lengths[g] = n
                 slots[g] = slot
                 req.inflight += 1
+                if self._sampler is not None:
+                    self._sampler.set_slot(slot, req)
             args = (self.params, tokens, lengths, slots, self._toks,
                     self._pos, pool.kc, pool.vc)
+            if self.sampling:
+                from .sched import SlotSampler
+                args = args + SlotSampler.gather([r for r, _ in group])
             try:
                 ex = self._compiled(("prefill", bucket, G),
                                     self._prefill_fn, args,
@@ -688,23 +837,34 @@ class ServingEngine:
         sch, pool, M = self.scheduler, self.pool, self.metrics
         while True:
             with M.span("serving/admit"):
-                admission = sch.admit_paged(pool)
+                admission = sch.admit_paged(pool, self.chunk_len)
             if admission is None:
                 break
-            req, alloc, bucket = admission
+            req, alloc, bucket, chunked = admission
+            if self._sampler is not None:
+                self._sampler.set_slot(alloc.slot, req)
+            if chunked:
+                # long uncached tail: slot + blocks are claimed, the
+                # prefill itself runs chunk by chunk under the per-
+                # step budget (_dispatch_chunks); commit-to-index
+                # still waits for the FINAL chunk's dispatch success
+                self._register_chunked([(req, alloc.slot)], alloc)
+                continue
             start = alloc.prefix_tokens
             tail = len(req.prompt) - start
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :tail] = req.prompt[start:]
             args = (self.params, tokens, np.int32(tail),
                     np.int32(start), np.int32(alloc.slot),
-                    pool.table_row(alloc.slot), self._toks, self._pos,
-                    pool.kc, pool.vc)
+                    np.int32(1), pool.table_row(alloc.slot),
+                    self._toks, self._pos, pool.kc, pool.vc)
+            if self.sampling:
+                args = args + self._samp_scalars(req)
             req.inflight += 1
             try:
                 ex = self._compiled(("paged_prefill", bucket),
                                     self._prefill_fn, args,
-                                    donate=(7, 8, 9))
+                                    donate=(8, 9, 10))
                 with M.span("serving/prefill_dispatch"):
                     if start:
                         self.flight.prefix_hit(req, start, tail)
@@ -727,6 +887,108 @@ class ServingEngine:
             else:
                 self._pending.append(
                     ("prefill", first, [(req, alloc.slot)]))
+
+    # ---------------------------------------------- chunked prefill
+
+    @staticmethod
+    def _samp_scalars(req):
+        """Per-dispatch sampling scalars for singleton prefills (the
+        chunk and paged-tail programs)."""
+        from .sched import request_sampling_params
+        seed, temp, topk, topp = request_sampling_params(req)
+        return (np.int32(seed), np.float32(temp), np.int32(topk),
+                np.float32(topp))
+
+    def _register_chunked(self, chunked, alloc=None):
+        """Queue freshly admitted long prompts for chunk-by-chunk
+        prefill and park their slots out of decode harvest."""
+        for req, slot in chunked:
+            if self._sampler is not None:
+                self._sampler.set_slot(slot, req)
+            start0 = alloc.prefix_tokens if alloc is not None else 0
+            self._chunk_q.append(self._ChunkPlan(
+                req, slot, start0, self.chunk_len, alloc=alloc))
+            self._prefilling.add(slot)
+
+    def _dispatch_chunks(self, sync):
+        """Advance chunked prefills: dispatch chunks FIFO across the
+        queued plans until the per-step token budget runs out. Every
+        dispatch is the ONE compiled chunk program per pool flavor
+        (traced start/len/slot/final — any prompt-length mix, zero
+        steady-state compiles). Interior chunks park the slot (no
+        token emitted, decode ignores it); the FINAL chunk emits the
+        first token, restores the slot to the decode set, and lands
+        the deferred admission accounting — so a dispatch failure
+        anywhere rolls the request back to the queue uncounted, the
+        PR-6 rollback discipline."""
+        sch, pool, M = self.scheduler, self.pool, self.metrics
+        budget = self.prefill_token_budget
+        C = self.chunk_len
+        while self._chunk_q and budget > 0:
+            plan = self._chunk_q[0]
+            req = plan.req
+            start, clen, final = plan.peek()
+            if clen > budget:
+                break           # FIFO: never skip ahead past the head
+            tokens = np.zeros((1, C), np.int32)
+            tokens[0, :clen] = req.prompt[start:start + clen]
+            if self.paged:
+                args = (self.params, tokens, np.int32(clen),
+                        np.int32(start), np.int32(plan.slot),
+                        np.int32(1 if final else 0),
+                        pool.table_row(plan.slot), self._toks,
+                        self._pos, pool.kc, pool.vc)
+                key, fn, donate = ("paged_prefill", C), \
+                    self._prefill_fn, (8, 9, 10)
+            else:
+                args = (self.params, tokens, np.int32(clen),
+                        np.int32(start), np.int32(plan.slot),
+                        np.int32(1 if final else 0), self._toks,
+                        self._pos, pool.kc, pool.vc)
+                key, fn, donate = ("chunk_prefill", C), \
+                    self._chunk_fn, (7, 8, 9)
+            if self.sampling:
+                args = args + self._samp_scalars(req)
+            if final:
+                req.inflight += 1
+            try:
+                ex = self._compiled(key, fn, args, donate=donate)
+                with M.span("serving/chunk_dispatch"):
+                    if plan.next == 0 and plan.start0:
+                        self.flight.prefix_hit(
+                            req, plan.start0,
+                            len(req.prompt) - plan.start0)
+                    self.flight.prefill_chunk(req, plan.next, start,
+                                              clen, final)
+                    if final:
+                        self.flight.prefill_dispatched(req, C, 1)
+                    first, self._toks, self._pos, kc, vc = ex(*args)
+            except BaseException:
+                if final:
+                    req.inflight -= 1
+                self._chunk_q.remove(plan)
+                self._prefilling.discard(plan.slot)
+                sch.rollback_admission([req], pool)
+                raise
+            pool.rebind(kc, vc)
+            M.record_prefill_chunk(clen)
+            budget -= clen
+            plan.advance()
+            if final:
+                self._chunk_q.pop(0)
+                self._prefilling.discard(plan.slot)
+                if self.paged:
+                    pool.commit_prefix(plan.slot, req.prompt)
+                    M.record_prefix_reuse(plan.start0, 0)
+                M.record_admission(req)
+                M.requests_admitted += 1
+                M.prefill_requests += 1
+                M.record_chunked_request()
+                entry = ("prefill", first, [(req, plan.slot)])
+                if sync:
+                    self._harvest([entry])
+                else:
+                    self._pending.append(entry)
 
     def run(self):
         """Drain the queue: step until every submitted request is done.

@@ -139,6 +139,31 @@ class ServingMetrics:
             "serving_prefill_tokens_computed_total",
             "prompt tokens actually computed by prefill dispatches "
             "(excludes prefix-cache hits and bucket padding)")
+        # scheduling-subsystem accounting (serving.sched): load-shed /
+        # deferred admissions and chunked-prefill dispatches, plus a
+        # scheduler_policy info label on the serving family so a
+        # Prometheus query can slice any serving metric by the policy
+        # that produced it
+        self._c_shed = r.counter(
+            "serving_requests_shed_total",
+            "requests dropped by the admission policy before serving "
+            "(by reason)", labelnames=("reason",))
+        self._c_deprioritized = r.counter(
+            "serving_requests_deprioritized_total",
+            "requests moved behind still-SLO-viable queue members by "
+            "the admission policy")
+        self._c_chunks = r.counter(
+            "serving_prefill_chunks_total",
+            "chunked-prefill dispatches (one per chunk)")
+        self._c_chunked_reqs = r.counter(
+            "serving_chunked_requests_total",
+            "requests whose prefill ran chunk-by-chunk")
+        self._g_policy = r.gauge(
+            "serving_scheduler_policy",
+            "active scheduling policy (the labeled policy reads 1)",
+            labelnames=("scheduler_policy",))
+        self._sched_info = {"policy": "fifo", "prefill_chunk": None,
+                            "prefill_token_budget": None}
         self._prefix_pool_stats = None
         self._res = {
             "ttft": Reservoir(self.RESERVOIR_SIZE),
@@ -260,6 +285,55 @@ class ServingMetrics:
             "pool": self._prefix_pool_stats()
             if self._prefix_pool_stats is not None else None,
         }
+
+    def set_scheduler_info(self, policy_name, prefill_chunk,
+                           prefill_token_budget):
+        """Stamp the engine's scheduling configuration: the
+        ``scheduler_policy`` info label (value 1) and the static
+        fields of ``snapshot()["scheduler"]``."""
+        self._sched_info = {
+            "policy": str(policy_name),
+            "prefill_chunk": prefill_chunk,
+            "prefill_token_budget": prefill_token_budget,
+        }
+        self._g_policy.labels(str(policy_name)).set(1)
+
+    def record_shed(self, reason):
+        """One request dropped by the admission policy: counted by
+        reason here AND judged by the SLO tracker (a shed request is a
+        violated request with zero goodput tokens — shedding must
+        never inflate attainment)."""
+        self._c_shed.labels(str(reason)).inc()
+        self.slo.observe_shed(str(reason))
+
+    def record_deprioritized(self):
+        self._c_deprioritized.inc()
+
+    def record_prefill_chunk(self, computed_tokens):
+        """One chunked-prefill dispatch: the chunk counter plus the
+        real computed-token accounting (chunk overlap recompute tokens
+        included — they ARE prefill compute)."""
+        self._c_chunks.inc()
+        if computed_tokens:
+            self._c_prefill_tokens.inc(int(computed_tokens))
+
+    def record_chunked_request(self):
+        self._c_chunked_reqs.inc()
+
+    def scheduler_report(self):
+        """The ``snapshot()["scheduler"]`` section: policy identity,
+        chunking configuration, and the shed / deferred / chunk
+        decision counters."""
+        shed = {labels[0]: int(child.value)
+                for labels, child in self._c_shed.series()}
+        return dict(
+            self._sched_info,
+            shed=shed,
+            shed_total=sum(shed.values()),
+            deprioritized=int(self._c_deprioritized.value),
+            prefill_chunks=int(self._c_chunks.value),
+            chunked_requests=int(self._c_chunked_reqs.value),
+        )
 
     def record_admission(self, request):
         """Queue-wait accounting at slot-claim time (the scheduler
@@ -403,4 +477,5 @@ class ServingMetrics:
             "latency_percentiles": self.latency_percentiles(),
             "slo": self.slo.report(),
             "prefix_cache": self.prefix_cache_report(),
+            "scheduler": self.scheduler_report(),
         }

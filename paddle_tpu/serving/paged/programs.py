@@ -5,35 +5,52 @@ Same decode math as ``text.models.build_serving_fns`` (both reuse
 construction), with the cache addressed through the fixed-shape block
 table instead of a slot-contiguous region:
 
-  ``paged_prefill(params, tokens [1, B], tail_len, start, slot,
-                  bt_row [MB], toks [S], pos [S], kc, vc)``
-      One request's UNCACHED TAIL prefills in one dispatch: the slot's
-      MB blocks gather into a position-ordered contiguous view
+  ``paged_prefill(params, tokens [1, B], tail_len, start, slot, final,
+                  bt_row [MB], toks [S], pos [S], kc, vc[, samp...])``
+      One request's UNCACHED TAIL (or, under chunked prefill, one
+      CHUNK of it) prefills in one dispatch: the slot's MB blocks
+      gather into a position-ordered contiguous view
       ``[L, 1, nh, MB*BS, hd]`` (view index == cache position, so the
       shared forward_t attends over the cached prefix below ``start``
       exactly as if this slot had prefilled it itself), the tail's K/V
       writes land at ``start..start+B``, and the view scatters back
-      block-by-block. ``start`` and ``tail_len`` are TRACED scalars:
-      every (prefix length, tail length) pair reuses the one compiled
-      program per tail bucket B — prefix variety costs zero compiles.
+      block-by-block. ``start``, ``tail_len`` and ``final`` are TRACED
+      scalars: every (prefix length, tail length, chunk index) triple
+      reuses the one compiled program per tail bucket B — prefix AND
+      chunk variety cost zero compiles. Only a ``final != 0`` dispatch
+      emits the first token and sets ``pos[slot] = start + tail_len``;
+      interior chunk dispatches PARK the slot at the row's last
+      addressable position instead (``MB*BS - 1`` — trash-backed or
+      legitimately overwritten before its length mask exposes it), so
+      the decode steps interleaving between chunks never write inside
+      prompt rows earlier chunks filled.
 
-  ``paged_decode(params, toks [S], pos [S], tables [S, MB], kc, vc)``
+  ``paged_decode(params, toks [S], pos [S], tables [S, MB], kc, vc
+                 [, samp...])``
       One fused program advancing every slot a token: each slot writes
       its new K/V row into block ``tables[s, pos//BS]`` at offset
       ``pos % BS`` (always a privately-owned block: decode positions
       are >= prompt_len and only full-prompt blocks are ever shared),
       then attends through ``ops.attention.cached_paged_attention``
-      under the per-slot length mask.
+      under the per-slot length mask. The block-table column index is
+      clamped to MB-1: parked/released slots' positions keep
+      incrementing past the row, and the clamped write lands in the
+      row's last entry (trash for any slot not using its full
+      capacity) instead of gathering out of bounds.
 
 Scatter/gather safety: table-row padding and released rows point at
 the reserved trash block, so pad-entry writes land in garbage, and the
 length mask keeps garbage reads at exactly-zero softmax weight — the
 same recycled-slot invariant the legacy pool pins, at block granularity.
+
+``sampling=True`` threads per-slot sampling parameters (seeds / temps
+/ top-k / top-p — serving.sched.sampling) through both programs; the
+greedy path is the default and keeps the original signatures.
 """
 
 
 def build_paged_fns(cfg, num_slots, block_size, num_blocks,
-                    blocks_per_slot):
+                    blocks_per_slot, sampling=False):
     """(paged_prefill, paged_decode) for a GPT decode config. Pure and
     shape-stable; the engine AOT-compiles them (decode once, prefill
     once per tail bucket)."""
@@ -43,11 +60,13 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
 
     from ...ops import attention as attn_ops
     from ...text.models import _decode_forward_builder
+    from ..sched.sampling import build_sampling_head
 
     nh = cfg.num_heads
     hd = cfg.hidden_size // nh
     hidden = cfg.hidden_size
     ln, forward_t = _decode_forward_builder(nh, hd, hidden)
+    head = build_sampling_head(cfg.vocab_size) if sampling else None
     L = cfg.num_layers
     BS = int(block_size)
     MB = int(blocks_per_slot)
@@ -67,8 +86,8 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
             .transpose(0, 2, 1, 3, 4)                # [L, MB, nh, BS, hd]
         return cache.at[:, bt_row].set(blocks)
 
-    def paged_prefill(params, tokens, tail_len, start, slot, bt_row,
-                      toks, pos, kc, vc):
+    def _prefill_core(params, tokens, tail_len, start, slot, final,
+                      bt_row, toks, pos, kc, vc, samp):
         # tokens [1, B] right-padded tail; start = cached prefix length
         kctx = gather_slot(kc, bt_row)
         vctx = gather_slot(vc, bt_row)
@@ -77,17 +96,40 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
         kc = scatter_slot(kc, bt_row, kctx)
         vc = scatter_slot(vc, bt_row, vctx)
         last = jnp.take(logits[0], tail_len - 1, axis=0)   # [vocab]
-        first = jnp.argmax(last, -1).astype(jnp.int32)[None]   # [1]
-        toks = toks.at[slot].set(first[0])
-        # the next decode writes this slot at position prompt_len
-        pos = pos.at[slot].set(start + tail_len)
-        return first, toks, pos, kc, vc
+        if samp is None:
+            first = jnp.argmax(last, -1).astype(jnp.int32)
+        else:
+            seed, temp, topk, topp = samp
+            first = head(last[None], seed[None],
+                         (start + tail_len - 1)[None], temp[None],
+                         topk[None], topp[None])[0]
+        toks = jnp.where(final > 0, toks.at[slot].set(first), toks)
+        # final: the next decode writes this slot at prompt_len;
+        # interior chunk: park at the row's last addressable position
+        pos = pos.at[slot].set(
+            jnp.where(final > 0, start + tail_len, jnp.int32(C - 1)))
+        return first[None], toks, pos, kc, vc
 
-    def paged_decode(params, toks, pos, tables, kc, vc):
+    if sampling:
+        def paged_prefill(params, tokens, tail_len, start, slot,
+                          final, bt_row, toks, pos, kc, vc, seed,
+                          temp, topk, topp):
+            return _prefill_core(params, tokens, tail_len, start,
+                                 slot, final, bt_row, toks, pos, kc,
+                                 vc, (seed, temp, topk, topp))
+    else:
+        def paged_prefill(params, tokens, tail_len, start, slot,
+                          final, bt_row, toks, pos, kc, vc):
+            return _prefill_core(params, tokens, tail_len, start,
+                                 slot, final, bt_row, toks, pos, kc,
+                                 vc, None)
+
+    def _decode_core(params, toks, pos, tables, kc, vc, samp):
         S = toks.shape[0]
-        x = params["wemb"][toks] + params["pemb"][pos]      # [S, h]
-        bidx = jnp.take_along_axis(
-            tables, (pos // jnp.int32(BS))[:, None], axis=1)[:, 0]
+        x = params["wemb"][toks] + params["pemb"][
+            jnp.minimum(pos, params["pemb"].shape[0] - 1)]  # [S, h]
+        col = jnp.minimum(pos // jnp.int32(BS), jnp.int32(MB - 1))
+        bidx = jnp.take_along_axis(tables, col[:, None], axis=1)[:, 0]
         off = pos % jnp.int32(BS)
 
         def body(carry, inp):
@@ -113,7 +155,21 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
         x, (kc, vc) = lax.scan(body, x, (params["stacked"], kc, vc))
         logits = ln(x, params["lnf_w"], params["lnf_b"]) \
             @ params["head"]                          # [S, vocab]
-        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        if samp is None:
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        else:
+            seeds, temps, topks, topps = samp
+            nxt = head(logits, seeds, pos, temps, topks, topps)
         return nxt, pos + jnp.int32(1), kc, vc
+
+    if sampling:
+        def paged_decode(params, toks, pos, tables, kc, vc, seeds,
+                         temps, topks, topps):
+            return _decode_core(params, toks, pos, tables, kc, vc,
+                                (seeds, temps, topks, topps))
+    else:
+        def paged_decode(params, toks, pos, tables, kc, vc):
+            return _decode_core(params, toks, pos, tables, kc, vc,
+                                None)
 
     return paged_prefill, paged_decode

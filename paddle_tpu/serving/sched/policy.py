@@ -1,0 +1,143 @@
+"""Admission policies: FIFO (default) and SLO-feedback load shedding.
+
+PR 4 built full SLO attainment/violation/goodput ACCOUNTING; nothing
+in the engine acted on it. These policies close the loop at the only
+point where acting is free: the queue. Under overload a FIFO queue
+grows without bound and every late request blows its TTFT target —
+the engine then spends decode capacity generating tokens nobody counts
+(goodput ~ 0 while tokens/sec looks fine). The SLO-feedback policy
+reads each queued request's live TTFT headroom and shed/defers the
+ones whose SLO is ALREADY lost, so slots go to requests that can still
+attain — the classic load-shedding result: goodput under 2-10x
+oversubscription approaches the no-overload ceiling instead of
+collapsing.
+
+Headroom for a queued request is
+
+    slo_ttft_ms - elapsed_since_arrival_ms - service_estimate_ms
+
+where the service estimate is a live EWMA of recent admission->first-
+token times the engine feeds back (``observe_service``) — the
+"SLO-feedback" in the name: the shedding threshold tracks what the
+engine is ACTUALLY delivering right now, so a slow spell sheds earlier
+and a fast engine admits aggressively (headroom stays high, nothing
+sheds, behavior is exactly FIFO).
+
+Policies only DECIDE (pure: queue snapshot in, decision out); the
+StepScheduler applies the queue surgery and the engine emits the
+flight events / counters — same separation the paged pool keeps
+between planning and dispatch.
+"""
+
+
+class TriageDecision:
+    """What a policy wants done with the current queue: ``shed`` and
+    ``deprioritized`` are ``[(request, headroom_ms), ...]`` lists
+    (headroom at decision time, <= 0 for lost causes)."""
+
+    __slots__ = ("shed", "deprioritized")
+
+    def __init__(self, shed=(), deprioritized=()):
+        self.shed = list(shed)
+        self.deprioritized = list(deprioritized)
+
+    @property
+    def empty(self):
+        return not self.shed and not self.deprioritized
+
+
+class SchedulingPolicy:
+    """Base policy: pure-FIFO admission, nothing shed. ``triage`` sees
+    a queue SNAPSHOT (list, arrival order) and the current
+    perf_counter time; ``observe_service`` receives each request's
+    admission->first-token latency in ms as live feedback."""
+
+    name = "fifo"
+
+    def triage(self, queue, now):
+        return TriageDecision()
+
+    def observe_service(self, service_ms):
+        pass
+
+
+class FIFOPolicy(SchedulingPolicy):
+    """The default: strict arrival order, every request served no
+    matter how late — PR-1..6 behavior, bit-for-bit."""
+
+
+class SLOFeedbackPolicy(SchedulingPolicy):
+    """Shed (or defer) queued requests whose TTFT SLO is already lost.
+
+    ``mode="shed"`` drops lost causes entirely (they retire with zero
+    tokens, reason "shed" — the goodput-maximizing choice under
+    sustained overload); ``mode="defer"`` moves them behind the
+    still-viable queue instead (served late, counted violating — the
+    choice when every request must eventually answer). ``margin_ms``
+    biases the headroom estimate conservative (> 0 sheds later).
+
+    With no ``slo_ttft_ms`` target the policy is inert (= FIFO).
+    """
+
+    name = "slo_feedback"
+
+    def __init__(self, slo_ttft_ms=None, mode="shed", margin_ms=0.0,
+                 ewma=0.25):
+        if mode not in ("shed", "defer"):
+            raise ValueError(f"mode must be 'shed' or 'defer', "
+                             f"got {mode!r}")
+        self.slo_ttft_ms = None if slo_ttft_ms is None \
+            else float(slo_ttft_ms)
+        self.mode = mode
+        self.margin_ms = float(margin_ms)
+        self.ewma = float(ewma)
+        self.service_est_ms = 0.0
+
+    def observe_service(self, service_ms):
+        """EWMA of admission->first-token ms — the live feedback that
+        makes headroom track delivered latency, not a config guess."""
+        s = float(service_ms)
+        if self.service_est_ms == 0.0:
+            self.service_est_ms = s
+        else:
+            self.service_est_ms += self.ewma * (s - self.service_est_ms)
+
+    def headroom_ms(self, request, now):
+        """TTFT budget left if the request were admitted right now
+        (<= 0: the SLO is already lost). None when untargeted."""
+        if self.slo_ttft_ms is None:
+            return None
+        elapsed = (now - request.t_arrival) * 1000.0
+        return self.slo_ttft_ms - elapsed - self.service_est_ms \
+            - self.margin_ms
+
+    def triage(self, queue, now):
+        decision = TriageDecision()
+        if self.slo_ttft_ms is None:
+            return decision
+        for req in queue:
+            h = self.headroom_ms(req, now)
+            if h >= 0.0:
+                continue
+            if self.mode == "shed":
+                decision.shed.append((req, h))
+            elif not req.deprioritized:
+                # defer once: a request already at the back stays in
+                # line (re-deferring forever would starve it silently)
+                decision.deprioritized.append((req, h))
+        return decision
+
+
+def resolve_policy(policy, slo_ttft_ms=None):
+    """ServingConfig's ``policy=`` knob -> a policy instance: None /
+    "fifo" -> FIFOPolicy, "slo_feedback" -> SLOFeedbackPolicy wired to
+    the engine's TTFT target, or any SchedulingPolicy passed through."""
+    if policy is None or policy == "fifo":
+        return FIFOPolicy()
+    if policy == "slo_feedback":
+        return SLOFeedbackPolicy(slo_ttft_ms=slo_ttft_ms)
+    if isinstance(policy, SchedulingPolicy):
+        return policy
+    raise ValueError(
+        f"policy must be 'fifo', 'slo_feedback' or a SchedulingPolicy "
+        f"instance, got {policy!r}")

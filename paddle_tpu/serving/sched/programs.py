@@ -1,0 +1,84 @@
+"""AOT-compilable chunked-prefill program over the slot-contiguous
+pool.
+
+``chunk_prefill(params, tokens [1, C], chunk_len, start, slot, final,
+                toks [S], pos [S], kc, vc[, seed, temp, topk, topp])``
+
+One chunk of one request's prompt prefills in one dispatch: the slot's
+contiguous cache gathers to ``[L, 1, nh, cache_len, hd]``, the shared
+``forward_t`` writes K/V at ``start..start+C`` and attends causally
+over everything below (earlier chunks included), and the slice
+scatters back. ``start`` / ``chunk_len`` / ``slot`` / ``final`` are
+TRACED scalars — every (prompt length, chunk index) pair reuses the
+ONE compiled program per chunk width, so chunked prompt-length variety
+costs zero compiles (the PR-6 tail-only-prefill trick at chunk
+granularity).
+
+Only the FINAL chunk (``final != 0``) produces the first generated
+token (argmax — or the per-slot sampling head when the engine runs
+with ``sampling=True`` — of the logits at ``chunk_len - 1``, the
+prompt's last position) and sets ``pos[slot] = start + chunk_len``
+(= prompt_len: chunk plans are end-aligned). Interior chunks PARK the
+slot instead: ``pos[slot] = cache_len - 1``, so the pooled decode
+steps that interleave between chunks write their (ignored) K/V row
+for this slot at the cache's last position — a row every request
+legitimately overwrites before its length mask ever exposes it — and
+never inside the prompt region a chunk already filled. The engine
+excludes parked slots from decode harvest; parking only neutralizes
+the physical all-slots dispatch.
+"""
+
+
+def build_chunk_fns(cfg, cache_len, sampling=False):
+    """The chunk_prefill program for a GPT decode config over a
+    ``[L, num_slots, nh, cache_len, hd]`` pooled cache. Pure and
+    shape-stable; the engine AOT-compiles it once per chunk width."""
+    import jax.numpy as jnp
+
+    from ...text.models import _decode_forward_builder
+    from .sampling import build_sampling_head
+
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    _, forward_t = _decode_forward_builder(nh, hd, cfg.hidden_size)
+    head = build_sampling_head(cfg.vocab_size) if sampling else None
+    parked = int(cache_len) - 1
+
+    def _chunk_core(params, tokens, chunk_len, start, slot, final,
+                    toks, pos, kc, vc, samp):
+        kcs = jnp.take(kc, jnp.expand_dims(slot, 0), axis=1)
+        vcs = jnp.take(vc, jnp.expand_dims(slot, 0), axis=1)
+        logits, kcs, vcs = forward_t(params, tokens, start, kcs, vcs)
+        kc = kc.at[:, slot].set(kcs[:, 0])
+        vc = vc.at[:, slot].set(vcs[:, 0])
+        last = jnp.take(logits[0], chunk_len - 1, axis=0)  # [vocab]
+        if samp is None:
+            first = jnp.argmax(last, -1).astype(jnp.int32)
+        else:
+            seed, temp, topk, topp = samp
+            # key index = prompt_len - 1, identical to the unchunked
+            # prefill's lengths-1, so chunking never perturbs a
+            # sampled request's token stream
+            first = head(last[None], seed[None],
+                         (start + chunk_len - 1)[None], temp[None],
+                         topk[None], topp[None])[0]
+        toks = jnp.where(final > 0, toks.at[slot].set(first), toks)
+        pos = pos.at[slot].set(
+            jnp.where(final > 0, start + chunk_len,
+                      jnp.int32(parked)))
+        return first[None], toks, pos, kc, vc
+
+    if sampling:
+        def chunk_prefill(params, tokens, chunk_len, start, slot,
+                          final, toks, pos, kc, vc, seed, temp, topk,
+                          topp):
+            return _chunk_core(params, tokens, chunk_len, start, slot,
+                               final, toks, pos, kc, vc,
+                               (seed, temp, topk, topp))
+    else:
+        def chunk_prefill(params, tokens, chunk_len, start, slot,
+                          final, toks, pos, kc, vc):
+            return _chunk_core(params, tokens, chunk_len, start, slot,
+                               final, toks, pos, kc, vc, None)
+
+    return chunk_prefill

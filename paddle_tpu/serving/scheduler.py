@@ -26,10 +26,18 @@ class Request:
     ``on_token(request, token)`` streams tokens as they are produced
     (the first call is the TTFT moment); ``output_ids`` is the full
     prompt+generation sequence once ``done``.
+
+    ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` select per-slot
+    sampling (engines built with ``sampling=True``); the default is
+    greedy — ``sampled`` mirrors ``generate()``'s greedy condition
+    (temperature <= 0 or top_k == 1 means argmax). ``seed`` defaults
+    to the request id, so reruns of the same submission order
+    reproduce the same sampled streams.
     """
 
     def __init__(self, prompt, max_new_tokens, eos_id=None,
-                 on_token=None):
+                 on_token=None, temperature=0.0, top_k=0, top_p=1.0,
+                 seed=None):
         self.rid = next(_rid)
         self.prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
         if self.prompt.size == 0:
@@ -39,10 +47,27 @@ class Request:
             raise ValueError("max_new_tokens must be >= 1")
         self.eos_id = eos_id
         self.on_token = on_token
+        self.temperature = float(temperature)
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
+        self.top_k = int(top_k)
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        self.top_p = float(top_p)
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        self.seed = self.rid if seed is None else int(seed)
+        self.sampled = self.temperature > 0.0 and self.top_k != 1
         self.state = QUEUED
         self.slot = None
         self.generated = []
         self.inflight = 0   # tokens dispatched on device, not yet read
+        # scheduling-policy facts: deferred-once flag (SLO-feedback
+        # "defer" mode) and the shed reason when load-shedding dropped
+        # the request before admission (done with zero tokens)
+        self.deprioritized = False
+        self.shed_reason = None
         # lifecycle timestamps (perf_counter clock): arrival ->
         # admission (slot claimed) -> first token -> done. The deltas
         # feed ServingMetrics' queue-wait / TTFT / latency histograms.
@@ -82,7 +107,7 @@ class StepScheduler:
     """
 
     def __init__(self, buckets, cache_len, completed_keep=4096,
-                 flight=None):
+                 flight=None, policy=None):
         self.buckets = sorted(int(b) for b in buckets)
         self.cache_len = int(cache_len)
         if not self.buckets:
@@ -94,6 +119,11 @@ class StepScheduler:
         self.active = {}       # slot -> Request
         self.completed = collections.deque(maxlen=completed_keep)
         self.flight = flight
+        # admission policy (serving.sched.policy): None = strict FIFO.
+        # triage() consults it each step BEFORE admission; the policy
+        # decides, the scheduler applies (queue surgery + request
+        # state), the engine observes (counters + flight events).
+        self.policy = policy
 
     def bucket_for(self, prompt_len):
         """Smallest bucket that holds the prompt — prompt-length variety
@@ -117,6 +147,38 @@ class StepScheduler:
             self.flight.enqueued(request)
         return request
 
+    def triage(self):
+        """Apply the scheduling policy to the queue before admission:
+        the policy decides (pure — queue snapshot in, TriageDecision
+        out), this method executes. Shed requests leave the queue and
+        retire immediately with zero tokens (state DONE, ``shed_reason``
+        set, parked in ``completed``); deprioritized requests move to
+        the BACK of the queue in their relative order, flagged so the
+        defer happens once. Returns ``(shed, deprioritized)`` as
+        ``[(request, headroom_ms), ...]`` for the engine's counters and
+        flight events. A policy of None (or one that decides nothing)
+        leaves the queue untouched — strict FIFO."""
+        if self.policy is None or not self.queue:
+            return [], []
+        decision = self.policy.triage(list(self.queue),
+                                      time.perf_counter())
+        if decision.empty:
+            return [], []
+        drop = {id(r) for r, _ in decision.shed}
+        defer = {id(r) for r, _ in decision.deprioritized}
+        keep = [r for r in self.queue
+                if id(r) not in drop and id(r) not in defer]
+        self.queue = collections.deque(
+            keep + [r for r, _ in decision.deprioritized])
+        for req, _ in decision.deprioritized:
+            req.deprioritized = True
+        for req, _ in decision.shed:
+            req.state = DONE
+            req.shed_reason = "slo_lost"
+            req.t_done = time.perf_counter()
+            self.completed.append(req)
+        return decision.shed, decision.deprioritized
+
     def admit(self, pool, group_sizes=(1,)):
         """Claim free slots for queued requests (FIFO) and return the
         admissions as SAME-BUCKET prefill groups: a list of
@@ -126,11 +188,23 @@ class StepScheduler:
         dispatch per group instead of one per request. Groups keep FIFO
         order: buckets appear in first-arrival order, members in
         arrival order within each bucket."""
+        return self.admit_chunked(pool, group_sizes, None)[0]
+
+    def admit_chunked(self, pool, group_sizes=(1,), chunk_len=None):
+        """``admit`` plus chunked-prefill routing: prompts LONGER than
+        ``chunk_len`` claim their slot like everyone else but return
+        as singleton ``(request, slot)`` chunked admissions instead of
+        joining a bucket group — the engine prefills them chunk by
+        chunk under its per-step token budget while the group members
+        dispatch whole. Returns ``(groups, chunked)``, both in FIFO
+        admission order; ``chunk_len=None`` (the default) routes
+        nothing and makes this exactly ``admit``."""
         sizes = sorted(int(g) for g in group_sizes)
         if not sizes or sizes[0] != 1:
             raise ValueError(f"group_sizes must include 1, got "
                              f"{group_sizes}")
         by_bucket = {}
+        chunked = []
         while self.queue and pool.free_count:
             req = self.queue.popleft()
             slot = pool.acquire(req.rid)
@@ -138,6 +212,12 @@ class StepScheduler:
             req.state = RUNNING
             req.t_admitted = time.perf_counter()
             self.active[slot] = req
+            if chunk_len is not None and len(req.prompt) > chunk_len:
+                chunked.append((req, slot))
+                if self.flight is not None:
+                    # chunked prefills dispatch at the chunk width
+                    self.flight.admitted(req, slot, int(chunk_len), 1)
+                continue
             by_bucket.setdefault(self.bucket_for(len(req.prompt)),
                                  []).append((req, slot))
         groups = []
@@ -152,7 +232,7 @@ class StepScheduler:
                         self.flight.admitted(req, slot, bucket,
                                              len(group))
                 i += take
-        return groups
+        return groups, chunked
 
     def plan_prefix(self, prompt_len, cached_tokens, block_size,
                     slot_capacity):
@@ -177,25 +257,40 @@ class StepScheduler:
             start -= block_size
         return start, self.bucket_for(prompt_len - start)
 
-    def admit_paged(self, pool):
+    def admit_paged(self, pool, chunk_len=None):
         """Prefix-aware FIFO admission over a paged pool, ONE request
         at a time: longest-cached-prefix lookup plans the tail
         (plan_prefix), then ``pool.acquire`` pins the prefix blocks
-        and allocates the rest. Returns ``(request, alloc, bucket)``
-        (PagedAllocation carries slot + prefix facts) or None when the
-        head of the queue doesn't fit (no free slot, or fresh blocks
-        exceed free + evictable — strict FIFO, no starvation
-        reordering; retirement frees capacity). Single-request
-        admission lets the engine dispatch + commit each prefill
-        before the NEXT lookup, so a burst of same-prompt arrivals
-        shares the first member's blocks within one engine step."""
+        and allocates the rest. Returns ``(request, alloc, bucket,
+        chunked)`` (PagedAllocation carries slot + prefix facts) or
+        None when the head of the queue doesn't fit (no free slot, or
+        fresh blocks exceed free + evictable — strict FIFO, no
+        starvation reordering; retirement frees capacity).
+        Single-request admission lets the engine dispatch + commit
+        each prefill before the NEXT lookup, so a burst of same-prompt
+        arrivals shares the first member's blocks within one engine
+        step.
+
+        With ``chunk_len`` set, an uncached tail LONGER than one chunk
+        comes back ``chunked=True`` with ``bucket = chunk_len`` (the
+        chunk dispatch width): the engine prefills it chunk by chunk.
+        Chunked tails skip plan_prefix's capacity trim — end-aligned
+        chunk plans never write a K/V position >= prompt_len, so the
+        full block-aligned cached prefix is always usable."""
         if not self.queue:
             return None
         req = self.queue[0]
         n = len(req.prompt)
         cached = pool.match_prefix(req.prompt)
-        start, bucket = self.plan_prefix(
-            n, cached, pool.block_size, pool.slot_capacity)
+        bs = pool.block_size
+        raw = min(int(cached), n - 1)
+        raw -= raw % bs
+        if chunk_len is not None and n - raw > chunk_len:
+            start, bucket, chunked = raw, int(chunk_len), True
+        else:
+            start, bucket = self.plan_prefix(
+                n, cached, bs, pool.slot_capacity)
+            chunked = False
         alloc = pool.acquire(req.rid, req.prompt,
                              n + req.max_new_tokens, start)
         if alloc is None:
@@ -207,7 +302,7 @@ class StepScheduler:
         self.active[alloc.slot] = req
         if self.flight is not None:
             self.flight.admitted(req, alloc.slot, bucket, 1)
-        return req, alloc, bucket
+        return req, alloc, bucket, chunked
 
     def rollback_admission(self, requests, pool):
         """Undo not-yet-dispatched admissions after a prefill dispatch

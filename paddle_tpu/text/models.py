@@ -390,7 +390,7 @@ class GPTForCausalLM(nn.Layer):
                 "lnf_w": W(self.gpt.ln_f.weight),
                 "lnf_b": W(self.gpt.ln_f.bias), "head": head}
 
-    def build_serving_fns(self, num_slots, cache_len):
+    def build_serving_fns(self, num_slots, cache_len, sampling=False):
         """Slot-indexed cache programs for the continuous-batching
         engine (paddle_tpu.serving), over a pooled cache
         kc/vc [L, num_slots, nh, cache_len, hd]. Both programs thread
@@ -423,20 +423,29 @@ class GPTForCausalLM(nn.Layer):
               device-side.
 
         Both are pure and shape-stable; the engine AOT-compiles them
-        (decode once, prefill once per (bucket, group size))."""
+        (decode once, prefill once per (bucket, group size)).
+
+        ``sampling=True`` threads per-slot sampling parameters
+        (serving.sched.sampling — seeds/temps/top-k/top-p arrays)
+        through both programs so temperature / top-k / top-p requests
+        share the one compiled dispatch with greedy ones; the default
+        keeps the original greedy-only signatures."""
         import jax
         import jax.numpy as jnp
         from jax import lax
 
         from ..ops import attention as attn_ops
+        from ..serving.sched.sampling import build_sampling_head
 
         cfg = self.cfg
         nh = cfg.num_heads
         hd = cfg.hidden_size // nh
         hidden = cfg.hidden_size
         ln, forward_t = _decode_forward_builder(nh, hd, hidden)
+        head = build_sampling_head(cfg.vocab_size) if sampling else None
 
-        def prefill(params, tokens, lengths, slots, toks, pos, kc, vc):
+        def _prefill_core(params, tokens, lengths, slots, toks, pos,
+                          kc, vc, samp):
             # tokens [G, bucket]; lengths/slots [G]; toks/pos [S]
             kcs = jnp.take(kc, slots, axis=1)   # [L, G, nh, C, hd]
             vcs = jnp.take(vc, slots, axis=1)
@@ -446,12 +455,29 @@ class GPTForCausalLM(nn.Layer):
             vc = vc.at[:, slots].set(vcs)
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-            first = jnp.argmax(last, -1).astype(jnp.int32)   # [G]
+            if samp is None:
+                first = jnp.argmax(last, -1).astype(jnp.int32)  # [G]
+            else:
+                seeds, temps, topks, topps = samp
+                first = head(last, seeds, lengths - 1, temps, topks,
+                             topps)
             toks = toks.at[slots].set(first)
             # the next decode writes each group member at position
             # lengths[g] (its first generated token's cache row)
             pos = pos.at[slots].set(lengths)
             return first, toks, pos, kc, vc
+
+        if sampling:
+            def prefill(params, tokens, lengths, slots, toks, pos, kc,
+                        vc, seeds, temps, topks, topps):
+                return _prefill_core(params, tokens, lengths, slots,
+                                     toks, pos, kc, vc,
+                                     (seeds, temps, topks, topps))
+        else:
+            def prefill(params, tokens, lengths, slots, toks, pos, kc,
+                        vc):
+                return _prefill_core(params, tokens, lengths, slots,
+                                     toks, pos, kc, vc, None)
 
         def write_slot(cache_l, new, pos):
             # cache_l [S, nh, C, hd], new [S, nh, hd]: each slot writes
@@ -461,9 +487,12 @@ class GPTForCausalLM(nn.Layer):
                     c, n[:, None], (jnp.int32(0), p, jnp.int32(0))))(
                     cache_l, new, pos)
 
-        def decode_step(params, toks, pos, kc, vc):
+        def _decode_core(params, toks, pos, kc, vc, samp):
             S = toks.shape[0]
-            x = params["wemb"][toks] + params["pemb"][pos]  # [S, h]
+            # parked / idle slots' positions keep incrementing past
+            # the table; clamp so the (ignored) row reads in-bounds
+            x = params["wemb"][toks] + params["pemb"][
+                jnp.minimum(pos, params["pemb"].shape[0] - 1)]
 
             def body(carry, inp):
                 x = carry
@@ -487,13 +516,26 @@ class GPTForCausalLM(nn.Layer):
                                    (params["stacked"], kc, vc))
             logits = ln(x, params["lnf_w"], params["lnf_b"]) \
                 @ params["head"]                      # [S, vocab]
-            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            if samp is None:
+                nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            else:
+                seeds, temps, topks, topps = samp
+                nxt = head(logits, seeds, pos, temps, topks, topps)
             return nxt, pos + jnp.int32(1), kc, vc
+
+        if sampling:
+            def decode_step(params, toks, pos, kc, vc, seeds, temps,
+                            topks, topps):
+                return _decode_core(params, toks, pos, kc, vc,
+                                    (seeds, temps, topks, topps))
+        else:
+            def decode_step(params, toks, pos, kc, vc):
+                return _decode_core(params, toks, pos, kc, vc, None)
 
         return prefill, decode_step
 
     def build_paged_serving_fns(self, num_slots, block_size, num_blocks,
-                                blocks_per_slot):
+                                blocks_per_slot, sampling=False):
         """Paged-cache analogues of build_serving_fns for the
         block-granular KV pool (serving.paged): same decode math via
         the shared _decode_forward_builder, cache addressed through a
@@ -501,19 +543,33 @@ class GPTForCausalLM(nn.Layer):
         instead of re-prefilled —
 
           paged_prefill(params, tokens [1, B], tail_len, start, slot,
-                        bt_row [MB], toks [S], pos [S], kc, vc)
+                        final, bt_row [MB], toks [S], pos [S], kc, vc)
               -> (first [1], toks', pos', kc, vc)
           paged_decode(params, toks [S], pos [S], tables [S, MB],
                        kc, vc)
               -> (next [S], pos + 1, kc, vc)
 
         with kc/vc [L, num_blocks, nh, block_size, hd]. Both are pure
-        and shape-stable (start/tail_len are traced scalars, so prefix
-        variety costs zero compiles); the engine AOT-compiles them
-        (decode once, prefill once per tail bucket)."""
+        and shape-stable (start/tail_len/final are traced scalars, so
+        prefix AND chunk variety costs zero compiles); the engine
+        AOT-compiles them (decode once, prefill once per tail bucket).
+        ``sampling=True`` appends per-slot sampling parameters to both
+        signatures (serving.sched.sampling)."""
         from ..serving.paged.programs import build_paged_fns
         return build_paged_fns(self.cfg, num_slots, block_size,
-                               num_blocks, blocks_per_slot)
+                               num_blocks, blocks_per_slot,
+                               sampling=sampling)
+
+    def build_chunk_prefill_fn(self, cache_len, sampling=False):
+        """The chunked-prefill program over the slot-contiguous pool
+        (serving.sched.programs.build_chunk_fns): one fixed-width
+        ``[1, chunk]`` dispatch per chunk with traced start / length /
+        slot / final scalars, so ANY prompt-length mix reuses one
+        compiled program per chunk width — the program that lets a
+        long prompt interleave with decode steps instead of stalling
+        them (ServingConfig(prefill_chunk=...))."""
+        from ..serving.sched.programs import build_chunk_fns
+        return build_chunk_fns(self.cfg, cache_len, sampling=sampling)
 
     _DECODE_CACHE_MAX = 16
 

@@ -440,6 +440,7 @@ def test_lint_graft_self_lints_repo_clean():
     assert report["ok"] is True
     assert report["counts"]["error"] == 0
     assert set(report["targets"]) == {"serving_decode", "paged_decode",
+                                      "chunked_prefill",
                                       "hapi_train_step",
                                       "to_static_sample"}
     assert {"donation", "dynamic-shape-risk", "f64-upcast",

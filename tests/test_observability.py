@@ -360,6 +360,12 @@ _SNAPSHOT_KEYS = {
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
+    "scheduler",
+}
+_SCHEDULER_KEYS = {
+    "policy", "prefill_chunk", "prefill_token_budget", "shed",
+    "shed_total", "deprioritized", "prefill_chunks",
+    "chunked_requests",
 }
 _PCT_KEYS = {"count", "p50_ms", "p90_ms", "p99_ms"}
 
@@ -371,6 +377,13 @@ def test_serving_snapshot_schema_contract():
     snap = eng.metrics.snapshot()
     assert set(snap) == _SNAPSHOT_KEYS
     json.dumps(snap)                       # artifact-embeddable
+    # the PR-7 scheduling section: policy identity + chunk config +
+    # shed/defer/chunk decision counters (all zero on a default FIFO
+    # whole-prompt engine, but the SCHEMA is the contract)
+    sched = snap["scheduler"]
+    assert set(sched) == _SCHEDULER_KEYS
+    assert sched["policy"] == "fifo" and sched["shed_total"] == 0
+    assert sched["prefill_chunks"] == 0
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():

@@ -13,6 +13,11 @@ Builds the three kinds of compiled programs this framework ships —
     that argument (the table is small and host-authored — donating it
     would be noise, and the donation pass's size floor keeps it
     silent);
+  * ``chunked_prefill``  — a chunked-prefill + per-slot-sampling
+    engine (``prefill_chunk=``, ``sampling=True``): the chunk program
+    (traced start/len/slot/final scalars + sampling params) and the
+    sampling decode linted via ``engine.lint(program="chunk")`` /
+    ``engine.lint()`` — both must stay f64/donation clean;
   * ``hapi_train_step``  — a hapi.Model static-adapter train step
     (forward + loss + backward + optimizer captured as ONE to_static
     program), linted via ``TracedFunction.lint()``;
@@ -83,6 +88,34 @@ def lint_paged_decode():
     return engine.lint()
 
 
+def lint_chunked_prefill():
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    paddle.seed(7)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    engine = ServingEngine(model, num_slots=4, prefill_chunk=8,
+                           sampling=True)
+    rs = np.random.RandomState(0)
+    for n in (5, 23, 40):       # two chunked, one grouped
+        engine.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                           max_new_tokens=4)
+    engine.add_request(rs.randint(0, 97, (30,)).astype(np.int64),
+                       max_new_tokens=4, temperature=0.8, top_k=10)
+    engine.run()
+    engine.declare_warmup()
+    sched = engine.metrics.snapshot()["scheduler"]
+    assert sched["prefill_chunks"] >= 4, \
+        "chunked-prefill lint target never actually chunked"
+    # the chunk program (traced start/len/slot/final + sampling args)
+    # AND the sampling decode must both stay f64/donation clean
+    return engine.lint(program="chunk") + engine.lint()
+
+
 def lint_hapi_train_step():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -132,6 +165,7 @@ def lint_to_static_sample():
 TARGETS = {
     "serving_decode": lint_serving_decode,
     "paged_decode": lint_paged_decode,
+    "chunked_prefill": lint_chunked_prefill,
     "hapi_train_step": lint_hapi_train_step,
     "to_static_sample": lint_to_static_sample,
 }
