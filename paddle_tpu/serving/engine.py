@@ -57,6 +57,7 @@ per-slot sampling threads temperature/top-k/top-p through the one
 compiled decode.
 """
 import os
+import time
 import warnings
 
 import numpy as np
@@ -222,15 +223,22 @@ class ServingConfig:
         if self.prefill_chunk is not None and self.prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
-        if prefill_token_budget is None:
+        if prefill_token_budget is not None:
+            if self.prefill_chunk is None:
+                raise ValueError(
+                    "prefill_token_budget requires chunked prefill "
+                    "(set prefill_chunk); without chunking the budget "
+                    "would silently never apply")
+            prefill_token_budget = int(prefill_token_budget)
+            if prefill_token_budget < self.prefill_chunk:
+                raise ValueError(
+                    f"prefill_token_budget {prefill_token_budget} "
+                    f"cannot be smaller than prefill_chunk "
+                    f"{self.prefill_chunk} (no chunk could ever "
+                    f"dispatch)")
+        else:
             prefill_token_budget = self.prefill_chunk
         self.prefill_token_budget = prefill_token_budget
-        if self.prefill_chunk is not None \
-                and self.prefill_token_budget < self.prefill_chunk:
-            raise ValueError(
-                f"prefill_token_budget {prefill_token_budget} cannot "
-                f"be smaller than prefill_chunk {prefill_chunk} (no "
-                f"chunk could ever dispatch)")
         # admission policy: "fifo" (default) | "slo_feedback" | a
         # serving.sched.SchedulingPolicy instance; the env var mirrors
         # the other ops gates
@@ -336,6 +344,7 @@ class ServingEngine:
             self.prefill_token_budget)
         self.watchdog = CompileWatchdog(mode=config.watchdog_mode)
         self._exec = {}  # (kind, bucket?, group?) -> XLA executable
+        self._t_last_compile = float("-inf")  # SLO-feedback taint mark
         self._metric_servers = []
 
         import jax
@@ -422,6 +431,12 @@ class ServingEngine:
                     .lower(*args).compile()
             self._exec[key] = ex
             self.metrics.compiles += 1
+            # compile-taint watermark for the SLO-feedback loop: any
+            # first token whose admission predates this stamp paid
+            # compile time and is excluded from the service EWMA (a
+            # seconds-scale compile fed into a milliseconds-scale
+            # estimate would shed every fresh arrival on sight)
+            self._t_last_compile = time.perf_counter()
             # device cost telemetry rides on the compile record:
             # flops/bytes from cost_analysis plus the memory picture
             # at build time (both best-effort None on non-reporting
@@ -439,8 +454,13 @@ class ServingEngine:
         """Declare warmup complete: the compiled-executable inventory
         is final, and any further compile is an attributed steady-state
         violation (flagged in ``watchdog.report()``, or raised when
-        the engine was built with watchdog_mode="raise")."""
+        the engine was built with watchdog_mode="raise"). Also resets
+        the admission policy's service-latency estimate: warmup
+        first tokens paid compile time, which would otherwise poison
+        the SLO-feedback EWMA into shedding the whole steady-state
+        queue."""
         self.watchdog.declare_warmup_complete()
+        self._policy.reset_service()
 
     def serve_metrics(self, port=0, addr="127.0.0.1"):
         """Expose this engine's metrics registry over HTTP: GET
@@ -622,6 +642,19 @@ class ServingEngine:
         self.metrics.tokens_generated += 1
         if first:
             self.metrics.record_first_token(req)
+            # close the SLO-feedback loop: the policy's shedding
+            # threshold tracks the admission->first-token latency the
+            # engine is ACTUALLY delivering. Compile-tainted samples
+            # (a build happened after this request's admission) are
+            # excluded — they measure XLA, not steady-state service,
+            # and one seconds-scale sample in a milliseconds-scale
+            # EWMA would shed every fresh arrival (including the rest
+            # of the warmup sweep) on sight. t_admitted is None only
+            # for requests that never went through admit().
+            if req.t_admitted is not None \
+                    and req.t_admitted > self._t_last_compile:
+                self._policy.observe_service(
+                    (req.t_first_token - req.t_admitted) * 1000.0)
         self.flight.token_emitted(req, len(req.generated))
         if req.on_token is not None:
             req.on_token(req, token)

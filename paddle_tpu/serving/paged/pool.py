@@ -194,6 +194,18 @@ class PagedKVPool:
                 f"row holds {self.blocks_per_slot}")
         n_prefix = prefix_tokens // bs
         n_new = n_total - n_prefix
+        # the row's LAST block must be freshly allocated (private):
+        # the decode/parked-chunk programs clamp overflowing write
+        # positions into it, so a shared prefix block there would
+        # corrupt every sharer. total_tokens includes max_new >= 1
+        # beyond the prompt while the pinned prefix is block-aligned
+        # within it, so n_new >= 1 always holds — assert it rather
+        # than assume, so a future sharing change fails loudly here.
+        if n_new < 1:
+            raise ValueError(
+                f"total_tokens {total_tokens} must exceed the pinned "
+                f"prefix ({prefix_tokens} tokens): the row's last "
+                f"block must be private, never a shared prefix block")
         prefix_blocks = self.index.match(prompt)[:n_prefix]
         if len(prefix_blocks) < n_prefix:
             raise ValueError(

@@ -32,11 +32,14 @@ table instead of a slot-contiguous region:
       ``pos % BS`` (always a privately-owned block: decode positions
       are >= prompt_len and only full-prompt blocks are ever shared),
       then attends through ``ops.attention.cached_paged_attention``
-      under the per-slot length mask. The block-table column index is
-      clamped to MB-1: parked/released slots' positions keep
-      incrementing past the row, and the clamped write lands in the
-      row's last entry (trash for any slot not using its full
-      capacity) instead of gathering out of bounds.
+      under the per-slot length mask. The write position is clamped to
+      the row's last entry (``MB*BS - 1``): parked/released slots'
+      positions keep incrementing past the row, and clamping the whole
+      position (not just the block column) pins their stray write to
+      that one entry — which is always private, never a shared prefix
+      block (see the invariant asserted in ``pool.acquire``) — instead
+      of cycling across block MB-1's offsets or gathering out of
+      bounds.
 
 Scatter/gather safety: table-row padding and released rows point at
 the reserved trash block, so pad-entry writes land in garbage, and the
@@ -128,9 +131,21 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
         S = toks.shape[0]
         x = params["wemb"][toks] + params["pemb"][
             jnp.minimum(pos, params["pemb"].shape[0] - 1)]  # [S, h]
-        col = jnp.minimum(pos // jnp.int32(BS), jnp.int32(MB - 1))
+        # clamp the WRITE position as a whole (column AND offset):
+        # parked / released slots' positions keep incrementing past
+        # the row, and clamping only the column would spray their
+        # stray K/V across every offset of block MB-1 as pos % BS
+        # cycles. Clamped, the stray write pins to the row's single
+        # last entry (MB-1, BS-1) — always safe because the last row
+        # block is never shared (only full-PROMPT blocks are indexed
+        # for sharing, and acquire() guarantees at least one fresh
+        # private block after the pinned prefix; pool.acquire asserts
+        # this) and position C-1 is either trash-backed, beyond the
+        # length mask, or legitimately rewritten before exposure.
+        wpos = jnp.minimum(pos, jnp.int32(C - 1))
+        col = wpos // jnp.int32(BS)
         bidx = jnp.take_along_axis(tables, col[:, None], axis=1)[:, 0]
-        off = pos % jnp.int32(BS)
+        off = wpos % jnp.int32(BS)
 
         def body(carry, inp):
             x = carry

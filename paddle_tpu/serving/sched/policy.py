@@ -60,6 +60,14 @@ class SchedulingPolicy:
     def observe_service(self, service_ms):
         pass
 
+    def reset_service(self):
+        """Forget accumulated service feedback. The engine calls this
+        from ``declare_warmup()`` so steady state starts from a clean
+        estimate: warmup observations come from synthetic warmup
+        traffic (the engine already excludes compile-tainted samples
+        at the source), not from the workload about to be served."""
+        pass
+
 
 class FIFOPolicy(SchedulingPolicy):
     """The default: strict arrival order, every request served no
@@ -101,6 +109,9 @@ class SLOFeedbackPolicy(SchedulingPolicy):
             self.service_est_ms = s
         else:
             self.service_est_ms += self.ewma * (s - self.service_est_ms)
+
+    def reset_service(self):
+        self.service_est_ms = 0.0
 
     def headroom_ms(self, request, now):
         """TTFT budget left if the request were admitted right now

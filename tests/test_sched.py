@@ -506,6 +506,56 @@ def test_engine_sheds_under_overload_and_accounts_it():
         eng.metrics.prometheus_text()
 
 
+def test_engine_feeds_service_latency_back_to_policy():
+    """The engine closes the SLO-feedback loop: after a served
+    request's first token, the policy's service EWMA reflects the
+    delivered admission->first-token latency (it is NOT a config guess
+    that stays 0.0 forever). Compile-tainted samples are excluded —
+    only requests admitted after the last build feed the estimate —
+    and declare_warmup() resets the estimate for steady state."""
+    m = _model()
+    pol = SLOFeedbackPolicy(slo_ttft_ms=60_000.0)   # never sheds
+    eng = ServingEngine(m, num_slots=2, bucket_min=8, policy=pol)
+    assert pol.service_est_ms == 0.0
+    rs = np.random.RandomState(11)
+    prompts = _prompts(rs, [6, 9])
+    # first pass compiles the inventory: every first token here paid
+    # an XLA build, so none of them may seed the EWMA
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=3)
+    eng.run()
+    assert pol.service_est_ms == 0.0
+    # steady-state pass over the compiled paths: the estimate moves
+    reqs = [eng.add_request(p, max_new_tokens=3) for p in prompts]
+    eng.run()
+    assert all(r.generated for r in reqs)
+    assert pol.service_est_ms > 0.0
+    # the estimate is a plausible admission->first-token figure for
+    # the served requests, not garbage
+    ttfts = [(r.t_first_token - r.t_admitted) * 1000.0 for r in reqs]
+    assert pol.service_est_ms <= max(ttfts) + 1e-6
+    eng.declare_warmup()
+    assert pol.service_est_ms == 0.0
+
+
+def test_prefill_token_budget_validation():
+    from paddle_tpu.serving import ServingConfig
+    # budget without chunking would silently never apply
+    with pytest.raises(ValueError):
+        ServingConfig(prefill_token_budget=16)
+    # coerced to int, then range-checked against the chunk width
+    with pytest.raises(ValueError):
+        ServingConfig(prefill_chunk=8, prefill_token_budget=7.9)
+    with pytest.raises(ValueError):
+        ServingConfig(prefill_chunk=8, prefill_token_budget=-8)
+    cfg = ServingConfig(prefill_chunk=8, prefill_token_budget=16.0)
+    assert cfg.prefill_token_budget == 16
+    assert isinstance(cfg.prefill_token_budget, int)
+    # default: one chunk per step
+    assert ServingConfig(prefill_chunk=8).prefill_token_budget == 8
+    assert ServingConfig().prefill_token_budget is None
+
+
 def test_fifo_default_never_sheds():
     m = _model()
     eng = ServingEngine(m, num_slots=1, bucket_min=8, slo_ttft_ms=1.0)
