@@ -46,9 +46,22 @@ p50/p90/p99; watchdog compile records carry device cost telemetry
 that don't report). start_metrics_server() now returns a cleanly
 stoppable MetricsServerHandle and mounts engine debug endpoints
 (/debug/requests, /debug/state) via extra_routes.
+
+PR 8 closes the loop with the health observatory (health/): a per-step
+ledger of structured engine-state rows, pluggable online anomaly
+detectors (step-time spike, queue stall, goodput collapse, KV-block
+leak, steady-state compile) counted in
+``serving_anomalies_total{detector}``, and debounced black-box
+incident bundles on disk — rolled up at ``/debug/health`` (the
+per-replica router signal) and ``/debug/ledger``.
 """
 from .flight import (  # noqa: F401
     FlightRecorder, RequestTrace,
+)
+from .health import (  # noqa: F401
+    HealthMonitor, IncidentRecorder, LEDGER_ROW_KEYS, StepLedger,
+    build_detectors, detector_names, disabled_health_summary,
+    register_detector, unregister_detector,
 )
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, MetricsServerHandle,

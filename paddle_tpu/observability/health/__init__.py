@@ -1,0 +1,43 @@
+"""Serving health observatory: per-step ledger, online anomaly
+detectors, black-box incident capture.
+
+PRs 3-4 made the serving engine richly OBSERVABLE (metrics registry,
+chrome spans, flight recorder, SLO tracker, compile watchdog); this
+package makes it SELF-monitoring — the closed loop production serving
+stacks run:
+
+  * **ledger.StepLedger** — a bounded ring of per-step structured
+    rows appended by the engine (wall/dispatch/sync seconds, queue and
+    slot state, token/shed deltas, paged-pool block economy, compile
+    flags); ``/debug/ledger`` serves it, incident bundles snapshot it;
+  * **detectors** — a pluggable ``register_detector`` framework
+    (mirroring analysis.lint.register_lint_pass) evaluated every step:
+    step-time spike (rolling-median MAD), queue stall, goodput
+    collapse, KV-block leak, steady-state compile; each firing
+    increments ``serving_anomalies_total{detector}`` and drops a
+    ``health/<detector>`` marker span into the host timeline;
+  * **incidents.IncidentRecorder / HealthMonitor** — on (debounced)
+    firing, a JSON incident bundle (ledger tail, metrics snapshot,
+    active request traces, span tail, watchdog report, verdict) lands
+    on disk with keep-last-N rotation, and ``/debug/health`` returns
+    ``{healthy, detectors, last_incident}`` — the per-replica signal
+    the ROADMAP direction-#5 router polls.
+
+Engine wiring: ``ServingConfig(health=True)`` (default; env gate
+``PADDLE_HEALTH=0``), ``health_audit_every=`` for the periodic paged-
+pool conservation audit (its cost visible as a ``serving/health_audit``
+host span), ``incident_dir=`` to enable bundle capture
+(``PADDLE_INCIDENT_DIR``), ``health_detectors=`` for per-detector
+threshold overrides. ``tools/incident_report.py`` pretty-prints a
+bundle.
+"""
+from .detectors import (  # noqa: F401
+    Detector, GoodputCollapse, KVBlockLeak, QueueStall,
+    SteadyStateCompileAnomaly, StepTimeSpike, build_detectors,
+    detector_names, register_detector, unregister_detector,
+)
+from .incidents import (  # noqa: F401
+    INCIDENT_KEYS, INCIDENT_SCHEMA, HealthMonitor, IncidentRecorder,
+    disabled_health_summary,
+)
+from .ledger import LEDGER_ROW_KEYS, StepLedger  # noqa: F401

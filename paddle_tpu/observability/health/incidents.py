@@ -1,0 +1,288 @@
+"""Black-box incident capture + the health monitor that drives it.
+
+``IncidentRecorder`` writes one JSON bundle per (debounced) detector
+firing — last-K ledger rows, metrics snapshot, active request traces,
+host-span tail, watchdog report, the detector's verdict — to a
+directory with keep-last-N rotation, so the evidence of WHAT the
+engine was doing at the moment of anomaly survives the process (the
+flight-data-recorder answer to BENCH_r05's unattributable wedge).
+
+``HealthMonitor`` is the per-engine orchestrator: the engine feeds it
+one ledger row per step; it appends to the ledger, evaluates every
+detector, and on each firing (1) increments
+``serving_anomalies_total{detector=...}``, (2) emits a
+``health/<detector>`` marker span into the host-span recorder (visible
+in the chrome trace next to the step it fired on), and (3) captures an
+incident bundle when the per-detector debounce allows. ``report()`` is
+the ``/debug/health`` body — ``{healthy, detectors, last_incident}``,
+the per-replica signal a scale-out router polls (ROADMAP direction
+#5); ``summary()`` is the lighter ``snapshot()["health"]`` section.
+"""
+import itertools
+import json
+import os
+import threading
+import time
+
+from ..tracing import default_recorder
+from .detectors import build_detectors
+from .ledger import StepLedger
+
+INCIDENT_SCHEMA = "paddle_tpu.health.incident/v1"
+
+# bundle sections every incident carries (tests pin this contract;
+# tools/incident_report.py renders from it)
+INCIDENT_KEYS = (
+    "schema", "written_at", "detector", "verdict", "ledger_tail",
+    "metrics", "watchdog", "requests", "spans_tail", "health",
+)
+
+
+def disabled_health_summary():
+    """The ``snapshot()["health"]`` section of an engine built with
+    health=False — same key set as a live summary, so the schema
+    contract holds either way."""
+    return {"enabled": False, "healthy": True, "anomalies_total": 0,
+            "detectors": {}, "incidents_written": 0,
+            "last_incident": None, "ledger_steps": 0}
+
+
+class IncidentRecorder:
+    """Debounced incident-bundle writer with keep-last-N rotation.
+
+    ``debounce_s`` bounds disk churn per detector (the first firing of
+    an episode captures; a flapping detector doesn't write a bundle
+    per step); ``keep_last`` bounds the DIRECTORY — rotation prunes
+    the oldest ``incident_*.json`` regardless of which recorder wrote
+    them, so a long-lived fleet's incident dir never grows without
+    bound. Capture is best-effort everywhere: a failing context
+    callable contributes an error stub, never an exception into the
+    serve loop."""
+
+    def __init__(self, directory, keep_last=16, ledger_tail=64,
+                 span_tail=120, debounce_s=60.0, clock=time.time):
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.directory = str(directory)
+        self.keep_last = int(keep_last)
+        self.ledger_tail = int(ledger_tail)
+        self.span_tail = int(span_tail)
+        self.debounce_s = float(debounce_s)
+        self._clock = clock
+        self._last = {}
+        self._seq = itertools.count()
+        self._lock = threading.Lock()
+        self.written = 0
+        self.last_path = None
+
+    def should_capture(self, detector):
+        with self._lock:
+            last = self._last.get(detector)
+        return last is None or (self._clock() - last) >= self.debounce_s
+
+    def _section(self, context, key):
+        fn = context.get(key)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - capture must not raise
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def capture(self, detector, verdict, ledger, context,
+                health_report=None):
+        """Write one bundle; returns its path. ``context`` maps section
+        names (metrics / watchdog / requests / spans_tail) to zero-arg
+        callables evaluated NOW — the moment-of-anomaly snapshot."""
+        with self._lock:
+            self._last[detector] = self._clock()
+            seq = next(self._seq)
+        bundle = {
+            "schema": INCIDENT_SCHEMA,
+            "written_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime()),
+            "detector": str(detector),
+            "verdict": dict(verdict),
+            "ledger_tail": ledger.rows(last=self.ledger_tail)
+            if ledger is not None else [],
+            "metrics": self._section(context, "metrics"),
+            "watchdog": self._section(context, "watchdog"),
+            "requests": self._section(context, "requests"),
+            "spans_tail": self._section(context, "spans_tail"),
+            "health": health_report,
+        }
+        os.makedirs(self.directory, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        fname = f"incident_{stamp}_{seq:03d}_{detector}.json"
+        path = os.path.join(self.directory, fname)
+        with open(path, "w") as fh:
+            json.dump(bundle, fh, indent=1, default=str)
+        with self._lock:
+            self.written += 1
+            self.last_path = path
+        self._rotate()
+        return path
+
+    def _rotate(self):
+        try:
+            files = sorted(f for f in os.listdir(self.directory)
+                           if f.startswith("incident_")
+                           and f.endswith(".json"))
+        except OSError:
+            return
+        for f in files[:-self.keep_last]:
+            try:
+                os.unlink(os.path.join(self.directory, f))
+            except OSError:
+                pass
+
+    def list_incidents(self):
+        try:
+            return sorted(
+                os.path.join(self.directory, f)
+                for f in os.listdir(self.directory)
+                if f.startswith("incident_") and f.endswith(".json"))
+        except OSError:
+            return []
+
+
+class HealthMonitor:
+    """Per-engine health orchestrator: ledger + detectors + anomaly
+    accounting + (optional) incident capture.
+
+    ``registry`` hosts ``serving_anomalies_total{detector}`` and
+    ``serving_detector_errors_total{detector}`` (a broken detector is
+    counted and skipped, never allowed to take down the serve loop).
+    ``context`` maps incident-bundle section names to zero-arg
+    callables the engine provides (metrics snapshot, watchdog report,
+    request traces, span tail)."""
+
+    def __init__(self, registry, ledger_keep=512, detectors=None,
+                 detector_config=None, incidents=None, recorder=None,
+                 context=None, clock=time.perf_counter):
+        self.ledger = StepLedger(keep=ledger_keep)
+        self.detectors = build_detectors(detector_config) \
+            if detectors is None else list(detectors)
+        self.incidents = incidents
+        self._recorder = recorder if recorder is not None \
+            else default_recorder()
+        self._context = dict(context or {})
+        self._clock = clock
+        self._c_anomalies = registry.counter(
+            "serving_anomalies_total",
+            "health-detector firings over the step ledger",
+            labelnames=("detector",))
+        self._c_errors = registry.counter(
+            "serving_detector_errors_total",
+            "health detectors that raised while evaluating a step "
+            "(the detector is skipped for that step, never fatal)",
+            labelnames=("detector",))
+        self._state = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------- stepping
+    def observe(self, row):
+        """Feed one ledger row; returns the verdicts that fired (often
+        empty). Called from the engine's stepping thread."""
+        self.ledger.append(row)
+        fired = []
+        for det in self.detectors:
+            try:
+                verdict = det.observe(row, self.ledger)
+            except Exception:  # noqa: BLE001 - detectors can't be fatal
+                self._c_errors.labels(det.name).inc()
+                continue
+            if verdict:
+                self._fire(det.name, verdict)
+                fired.append(verdict)
+        return fired
+
+    def _fire(self, name, verdict):
+        self._c_anomalies.labels(name).inc()
+        # marker span at the firing instant: the anomaly is visible in
+        # the chrome/Perfetto timeline right next to the step it hit
+        args = {k: v for k, v in verdict.items()
+                if isinstance(v, (int, float, str, bool))}
+        self._recorder.record(f"health/{name}", self._clock(), 0.0,
+                              args=args)
+        # state FIRST, so the incident bundle's health section already
+        # reflects this firing (healthy: false, detector counted)
+        with self._lock:
+            st = self._state.setdefault(
+                name, {"fired": 0, "last_step": None,
+                       "last_verdict": None, "last_incident": None})
+            st["fired"] += 1
+            st["last_step"] = verdict.get("step")
+            st["last_verdict"] = dict(verdict)
+        if self.incidents is not None \
+                and self.incidents.should_capture(name):
+            try:
+                incident = self.incidents.capture(
+                    name, verdict, self.ledger, self._context,
+                    health_report=self.summary())
+            except Exception:  # noqa: BLE001 - capture is best-effort
+                incident = None
+            if incident is not None:
+                with self._lock:
+                    self._state[name]["last_incident"] = incident
+
+    # ------------------------------------------------------- querying
+    @property
+    def anomalies_total(self):
+        with self._lock:
+            return sum(st["fired"] for st in self._state.values())
+
+    @property
+    def healthy(self):
+        return self.anomalies_total == 0
+
+    def detector_counts(self):
+        """{detector name: firings} for EVERY configured detector
+        (zeros included — the detector list is part of the surface)."""
+        with self._lock:
+            return {d.name: self._state.get(d.name, {}).get("fired", 0)
+                    for d in self.detectors}
+
+    def report(self):
+        """The ``/debug/health`` JSON body — the per-replica health
+        signal a scale-out router polls."""
+        with self._lock:
+            detectors = {
+                d.name: dict(self._state.get(
+                    d.name, {"fired": 0, "last_step": None,
+                             "last_verdict": None,
+                             "last_incident": None}))
+                for d in self.detectors}
+        total = sum(st["fired"] for st in detectors.values())
+        return {
+            "healthy": total == 0,
+            "anomalies_total": total,
+            "detectors": detectors,
+            "last_incident": self.incidents.last_path
+            if self.incidents is not None else None,
+            "incidents_written": self.incidents.written
+            if self.incidents is not None else 0,
+            "ledger": {"steps": self.ledger.steps,
+                       "kept": len(self.ledger),
+                       "last_step": self.ledger.last_step_id},
+        }
+
+    def summary(self):
+        """The ``snapshot()["health"]`` section (lighter than
+        report(): firing counts only, no verdict payloads)."""
+        total = self.anomalies_total
+        return {
+            "enabled": True,
+            "healthy": total == 0,
+            "anomalies_total": total,
+            "detectors": self.detector_counts(),
+            "incidents_written": self.incidents.written
+            if self.incidents is not None else 0,
+            "last_incident": self.incidents.last_path
+            if self.incidents is not None else None,
+            "ledger_steps": self.ledger.steps,
+        }
+
+    def debug_ledger(self):
+        """The ``/debug/ledger`` JSON body."""
+        return self.ledger.as_dict()

@@ -99,11 +99,12 @@ class _Child:
 
 
 class _GaugeChild(_Child):
-    __slots__ = ("_fn",)
+    __slots__ = ("_fn", "_on_error")
 
-    def __init__(self, lock):
+    def __init__(self, lock, on_error=None):
         super().__init__(lock)
         self._fn = None
+        self._on_error = on_error
 
     @property
     def value(self):
@@ -111,10 +112,16 @@ class _GaugeChild(_Child):
         if fn is not None:
             # called OUTSIDE the registry lock: the callback may take
             # its own locks (reservoir pruning); a failing callback
-            # must not 500 the scrape
+            # must not 500 the scrape — the series exports NaN and the
+            # failure is counted in metrics_scrape_errors_total
             try:
                 return float(fn())
             except Exception:
+                if self._on_error is not None:
+                    try:
+                        self._on_error()
+                    except Exception:
+                        pass
                 return float("nan")
         return self._value
 
@@ -194,6 +201,7 @@ class _Family:
         self.labelnames = tuple(labelnames)
         for ln in self.labelnames:
             _check_name(ln)
+        self._registry = registry
         self._lock = registry._lock
         self._children = {}
         if not self.labelnames:
@@ -253,7 +261,10 @@ class Gauge(_Family):
     kind = "gauge"
 
     def _make_child(self):
-        return _GaugeChild(self._lock)
+        return _GaugeChild(self._lock, on_error=self._scrape_error)
+
+    def _scrape_error(self):
+        self._registry.scrape_error(self.name)
 
     def set(self, value):
         self._default().set(value)
@@ -444,6 +455,18 @@ class MetricsRegistry:
                   buckets=DEFAULT_TIME_BUCKETS):
         return self._register(Histogram, name, help_text, labelnames,
                               buckets=buckets)
+
+    def scrape_error(self, metric_name):
+        """Record one gauge pull-callback failure at scrape/snapshot
+        time (the series exported NaN instead of 500ing the whole
+        exposition). The ``metrics_scrape_errors_total{metric}``
+        counter is registered LAZILY on the first failure, so a clean
+        registry exposes no error family at all."""
+        self.counter(
+            "metrics_scrape_errors_total",
+            "gauge set_function callbacks that raised at scrape time "
+            "(the series exported NaN; the exposition survived)",
+            labelnames=("metric",)).labels(str(metric_name)).inc()
 
     def get(self, name):
         with self._lock:

@@ -84,6 +84,24 @@ math into a multi-tenant server:
     latency; counted, SLO-judged, flight-evented), and per-slot
     sampling (``sampling=True`` — temperature/top-k/top-p per slot in
     the one compiled decode, greedy slots bit-exact with generate());
+  * **health observatory** (``engine.health``, an
+    observability.health.HealthMonitor; ON by default,
+    ``PADDLE_HEALTH=0`` / ``health=False`` opts out) — every step
+    appends a structured row to a bounded step ledger
+    (wall/dispatch/sync seconds, queue + slot state, token/shed
+    deltas, paged block economy, compile flags; ``/debug/ledger``)
+    and runs pluggable online anomaly detectors over it (step-time
+    spike, queue stall, goodput collapse, KV-block leak via the
+    periodic ``health_audit_every`` pool conservation audit, steady-
+    state compile). Firings count in
+    ``serving_anomalies_total{detector}``, drop ``health/<detector>``
+    marker spans into the chrome timeline, and (with
+    ``incident_dir=`` set) capture debounced black-box incident
+    bundles — ledger tail, metrics snapshot, request traces, span
+    tail — with keep-last-N rotation (``tools/incident_report.py``
+    renders them). ``/debug/health`` returns ``{healthy, detectors,
+    last_incident}``: the per-replica readiness signal a scale-out
+    router polls;
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program
@@ -148,6 +166,23 @@ Tuning knobs
                 seed=)``) through the one compiled decode/prefill
                 executable; False (default) keeps the greedy-only
                 signatures and rejects sampled requests.
+``health``      True (default; env gate ``PADDLE_HEALTH=0``) runs the
+                health observatory: per-step ledger + online anomaly
+                detectors + ``/debug/health`` / ``/debug/ledger``.
+``health_audit_every``
+                steps between periodic paged-pool conservation audits
+                (default 64; cost visible as a
+                ``serving/health_audit`` host span).
+``health_ledger_keep`` / ``health_detectors``
+                ledger ring size (default 512) and per-detector
+                threshold overrides, e.g.
+                ``{"queue_stall": {"stall_steps": 8}}``.
+``incident_dir`` / ``incident_keep`` / ``health_debounce_s``
+                where detector firings dump black-box incident
+                bundles (None (default) = no disk writes; env
+                ``PADDLE_INCIDENT_DIR``), how many bundles the
+                directory keeps (default 16), and the per-detector
+                capture debounce (default 60 s).
 ``completed_keep`` / ``trace_keep`` / ``trace_decode_window``
                 retention bounds: completed Request objects kept by
                 the scheduler (default 4096), completed RequestTraces

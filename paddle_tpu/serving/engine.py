@@ -154,7 +154,10 @@ class ServingConfig:
                  trace_decode_window=32, peak_flops=None,
                  paged=None, block_size=16, num_blocks=None,
                  prefill_chunk=None, prefill_token_budget=None,
-                 policy=None, sampling=False):
+                 policy=None, sampling=False, health=None,
+                 health_audit_every=64, health_ledger_keep=512,
+                 health_detectors=None, incident_dir=None,
+                 incident_keep=16, health_debounce_s=60.0):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -249,6 +252,29 @@ class ServingConfig:
         # prefill programs; greedy stays the default (and the only
         # mode whose signatures match prior PRs bit-for-bit)
         self.sampling = bool(sampling)
+        # health observatory (observability.health): per-step ledger +
+        # online anomaly detectors, ON by default (continuous
+        # self-monitoring is the point; PADDLE_HEALTH=0 opts out).
+        # Incident-bundle capture engages only when incident_dir is
+        # set (or $PADDLE_INCIDENT_DIR) — detectors/counters/debug
+        # endpoints run either way, disk writes are opt-in.
+        if health is None:
+            health = os.environ.get("PADDLE_HEALTH", "1") != "0"
+        self.health = bool(health)
+        self.health_audit_every = int(health_audit_every)
+        if self.health_audit_every < 1:
+            raise ValueError(
+                f"health_audit_every must be >= 1, got "
+                f"{health_audit_every}")
+        self.health_ledger_keep = int(health_ledger_keep)
+        # per-detector threshold overrides, e.g.
+        # {"queue_stall": {"stall_steps": 8}} (tests tighten this way)
+        self.health_detectors = health_detectors
+        if incident_dir is None:
+            incident_dir = os.environ.get("PADDLE_INCIDENT_DIR") or None
+        self.incident_dir = incident_dir
+        self.incident_keep = int(incident_keep)
+        self.health_debounce_s = float(health_debounce_s)
 
 
 class ServingEngine:
@@ -346,6 +372,44 @@ class ServingEngine:
         self._exec = {}  # (kind, bucket?, group?) -> XLA executable
         self._t_last_compile = float("-inf")  # SLO-feedback taint mark
         self._metric_servers = []
+        # health observatory: per-step ledger + anomaly detectors +
+        # (when an incident_dir is configured) black-box bundle capture
+        self._step_id = 0
+        self._hprev = None      # previous step's cumulative counters
+        self._hspan_kids = None  # cached span children (tick fast path)
+        self._slo_on = (config.slo_ttft_ms is not None
+                        or config.slo_tpot_ms is not None)
+        if config.health:
+            from ..observability import default_recorder
+            from ..observability.health import (HealthMonitor,
+                                                IncidentRecorder)
+            incidents = None
+            if config.incident_dir:
+                incidents = IncidentRecorder(
+                    config.incident_dir,
+                    keep_last=config.incident_keep,
+                    debounce_s=config.health_debounce_s)
+            rec = default_recorder()
+
+            def _spans_tail(rec=rec):
+                return [{"name": s.name, "t0": round(s.t0, 6),
+                         "dur": round(s.dur, 6), "tid": s.tid}
+                        for s in rec.spans()[-120:]]
+
+            self.health = HealthMonitor(
+                self.metrics.registry,
+                ledger_keep=config.health_ledger_keep,
+                detector_config=config.health_detectors,
+                incidents=incidents,
+                context={
+                    "metrics": self.metrics.snapshot,
+                    "watchdog": self.watchdog.report,
+                    "requests": self.flight.debug_requests,
+                    "spans_tail": _spans_tail,
+                })
+            self.metrics.set_health(self.health.summary)
+        else:
+            self.health = None
 
         import jax
         import jax.numpy as jnp
@@ -465,19 +529,26 @@ class ServingEngine:
     def serve_metrics(self, port=0, addr="127.0.0.1"):
         """Expose this engine's metrics registry over HTTP: GET
         /metrics (Prometheus text), /metrics.json (the snapshot
-        schema), /debug/requests (flight-recorder traces) and
-        /debug/state (live engine state). Returns a
+        schema), /debug/requests (flight-recorder traces),
+        /debug/state (live engine state) and — with the health
+        observatory on — /debug/health ({healthy, detectors,
+        last_incident}: the per-replica router signal) and
+        /debug/ledger (the per-step ring). Returns a
         MetricsServerHandle — ``handle.port`` is the bound port,
         ``handle.close()`` stops it (idempotent); every handle is also
         closed by ``engine.close()`` so the server thread shuts down
         with the engine."""
         from ..observability import start_metrics_server
+        routes = {
+            "/debug/requests": self.flight.debug_requests,
+            "/debug/state": self.debug_state,
+        }
+        if self.health is not None:
+            routes["/debug/health"] = self.health.report
+            routes["/debug/ledger"] = self.health.debug_ledger
         handle = start_metrics_server(
             self.metrics.registry, port=port, addr=addr,
-            extra_routes={
-                "/debug/requests": self.flight.debug_requests,
-                "/debug/state": self.debug_state,
-            })
+            extra_routes=routes)
         self._metric_servers.append(handle)
         return handle
 
@@ -530,6 +601,7 @@ class ServingEngine:
             "scheduler": dict(
                 self.metrics.scheduler_report(),
                 chunked_inflight=len(self._chunk_q)),
+            "health": self.metrics.health_report(),
         }
 
     def lint(self, passes=None, min_donation_bytes=1 << 20,
@@ -716,9 +788,21 @@ class ServingEngine:
         → grouped prefill → decode dispatch → harvest) is readable in
         the chrome host timeline
         (observability.default_recorder().dump_chrome_trace()) as well
-        as the XPlane capture and the span counters."""
+        as the XPlane capture and the span counters.
+
+        With the health observatory on (the default), every step also
+        appends one structured row to the step ledger and runs the
+        online anomaly detectors over it — the ledger build happens
+        AFTER the timed step, so the observatory's own bookkeeping
+        never pollutes the wall time it judges."""
+        if self.health is None:
+            with self.metrics.span("serving/step"):
+                return self._step_inner()
+        t0 = time.perf_counter()
         with self.metrics.span("serving/step"):
-            return self._step_inner()
+            more = self._step_inner()
+        self._health_tick(time.perf_counter() - t0)
+        return more
 
     def _step_inner(self):
         sch, pool, M = self.scheduler, self.pool, self.metrics
@@ -777,6 +861,99 @@ class ServingEngine:
         M.queue_depth = len(sch.queue)
         M.slot_occupancy = pool.occupancy
         return sch.pending or bool(self._pending)
+
+    def _health_tick(self, wall_s):
+        """Author one step-ledger row (counter deltas against the
+        previous tick) and feed the health monitor. The periodic
+        paged-pool conservation audit runs here every
+        ``health_audit_every`` steps under its own
+        ``serving/health_audit`` host span, so the observatory's own
+        overhead is visible in traces — and excluded from the step
+        wall time the spike detector judges."""
+        M = self.metrics
+        self._step_id += 1
+        step = self._step_id
+        conservation_ok = conservation_error = None
+        if self.paged and step % self.config.health_audit_every == 0:
+            with M.span("serving/health_audit"):
+                audit = self.pool.audit()
+            conservation_ok = audit["ok"]
+            conservation_error = audit["error"]
+        # per-tick fast path: cache the counter/span CHILDREN once and
+        # read their values directly — the general family-property
+        # reads (dispatch_sync_split, facade properties) re-resolve
+        # labels and series per call, and this path runs on EVERY
+        # engine step. Deltas are computed tuple-wise: one allocation,
+        # no intermediate dicts (GC pressure IS step-time overhead).
+        k = self._hspan_kids
+        if k is None:
+            k = self._hspan_kids = (
+                M._c_tokens._default(),
+                M._c_admitted._default(),
+                M._c_completed._default(),
+                M.slo._c_goodput._default(),
+                M._c_prefill_tokens._default(),
+                M._c_chunks._default(),
+                M._c_deprioritized._default(),
+                M._c_compiles._default(),
+                M._c_span.labels("serving/prefill_dispatch"),
+                M._c_span.labels("serving/decode_dispatch"),
+                M._c_span.labels("serving/chunk_dispatch"),
+                M._c_span.labels("serving/sync"),
+                M._c_prefix_hits._default(),
+                M._c_prefix_misses._default(),
+            )
+        # raw child-slot reads (not the .value property): counters are
+        # plain floats behind __slots__, and 14 property hops per step
+        # are real money on a sub-ms step
+        cur = (k[0]._value, k[1]._value, k[2]._value, k[3]._value,
+               k[4]._value, k[5]._value, k[6]._value, k[7]._value,
+               k[8]._value + k[9]._value + k[10]._value, k[11]._value,
+               M.shed_count)
+        prev = self._hprev
+        self._hprev = cur
+        if prev is None:
+            prev = (0,) * len(cur)
+        new_compiles = int(cur[7] - prev[7])
+        hits = int(k[12]._value)
+        misses = int(k[13]._value)
+        queue = self.scheduler.queue
+        self.health.observe({
+            "step": step,
+            "t": time.time(),
+            "wall_s": wall_s,
+            "dispatch_s": cur[8] - prev[8],
+            "sync_s": cur[9] - prev[9],
+            "queue_depth": len(queue),
+            "queue_age_s": time.perf_counter() - queue[0].t_arrival
+            if queue else 0.0,
+            "occupied_slots": len(self.scheduler.active),
+            "chunked_inflight": len(self._chunk_q),
+            "admitted": int(cur[1] - prev[1]),
+            "tokens": int(cur[0] - prev[0]),
+            "completed": int(cur[2] - prev[2]),
+            "goodput_tokens": int(cur[3] - prev[3]),
+            "prefill_tokens": int(cur[4] - prev[4]),
+            "prefill_chunks": int(cur[5] - prev[5]),
+            "shed": int(cur[10] - prev[10]),
+            "deprioritized": int(cur[6] - prev[6]),
+            "new_compiles": new_compiles,
+            # a post-warmup build is a steady-state violation; the
+            # steady_state_compile detector turns it into an anomaly
+            "steady_compiles": new_compiles if self.watchdog.warmed
+            else 0,
+            "slo_on": self._slo_on,
+            "prefix_hit_rate": round(hits / (hits + misses), 4)
+            if (hits + misses) else None,
+            "pool_free_blocks": self.pool.free_blocks
+            if self.paged else None,
+            "pool_evictable_blocks": self.pool.evictable_blocks
+            if self.paged else None,
+            "pool_live_blocks": self.pool.live_blocks
+            if self.paged else None,
+            "conservation_ok": conservation_ok,
+            "conservation_error": conservation_error,
+        })
 
     def _triage(self):
         """Apply the admission policy to the queue (scheduler does the

@@ -165,6 +165,11 @@ class ServingMetrics:
         self._sched_info = {"policy": "fifo", "prefill_chunk": None,
                             "prefill_token_budget": None}
         self._prefix_pool_stats = None
+        self._health_fn = None
+        # plain-int mirror of the labeled shed counter: the health
+        # tick reads a shed total on EVERY engine step, and iterating
+        # the labeled series per step is measurable overhead there
+        self.shed_count = 0
         self._res = {
             "ttft": Reservoir(self.RESERVOIR_SIZE),
             "request_latency": Reservoir(self.RESERVOIR_SIZE),
@@ -286,6 +291,19 @@ class ServingMetrics:
             if self._prefix_pool_stats is not None else None,
         }
 
+    def set_health(self, summary_fn):
+        """Attach the health monitor's ``summary()`` as the pull
+        source for ``snapshot()["health"]`` (engines built with
+        health=False report the disabled shape instead — same keys,
+        so the snapshot schema contract holds either way)."""
+        self._health_fn = summary_fn
+
+    def health_report(self):
+        if self._health_fn is not None:
+            return self._health_fn()
+        from ..observability.health import disabled_health_summary
+        return disabled_health_summary()
+
     def set_scheduler_info(self, policy_name, prefill_chunk,
                            prefill_token_budget):
         """Stamp the engine's scheduling configuration: the
@@ -304,6 +322,7 @@ class ServingMetrics:
         violated request with zero goodput tokens — shedding must
         never inflate attainment)."""
         self._c_shed.labels(str(reason)).inc()
+        self.shed_count += 1
         self.slo.observe_shed(str(reason))
 
     def record_deprioritized(self):
@@ -478,4 +497,5 @@ class ServingMetrics:
             "slo": self.slo.report(),
             "prefix_cache": self.prefix_cache_report(),
             "scheduler": self.scheduler_report(),
+            "health": self.health_report(),
         }

@@ -89,6 +89,10 @@ class PagedKVPool:
         self._free_blocks = list(range(1, self.num_blocks))
         self._ref = {}
         self._evictable = 0
+        self._live = 0   # blocks at ref > 0, maintained incrementally
+        # (the health tick reads live_blocks EVERY step — an O(blocks)
+        # scan there would be per-step overhead; check_conservation
+        # validates this counter against the full scan)
         self.evictions = 0
         # slot state (mirrors SlotKVPool's deterministic allocator)
         self._free_slots = list(range(self.num_slots))
@@ -130,7 +134,7 @@ class PagedKVPool:
 
     @property
     def live_blocks(self):
-        return sum(1 for r in self._ref.values() if r > 0)
+        return self._live
 
     def _alloc_block(self):
         """One fresh block at ref 1, from the free heap or by evicting
@@ -149,6 +153,7 @@ class PagedKVPool:
             self.evictions += 1
             self._evictable -= 1
         self._ref[b] = 1
+        self._live += 1
         return b
 
     def _deref(self, b):
@@ -158,6 +163,7 @@ class PagedKVPool:
         if r < 0:
             raise AssertionError(f"block {b} refcount underflow")
         if r == 0:
+            self._live -= 1
             if b in self.index:
                 self._evictable += 1
             else:
@@ -228,6 +234,7 @@ class PagedKVPool:
             self._ref[b] = r + 1
             if r == 0:
                 self._evictable -= 1
+                self._live += 1
         new_blocks = []
         for _ in range(n_new):
             b = self._alloc_block()
@@ -337,6 +344,18 @@ class PagedKVPool:
             "evictions": self.evictions,
         }
 
+    def audit(self):
+        """``check_conservation`` as a report instead of an assert —
+        the health observatory's periodic leak probe
+        (``ServingConfig(health_audit_every=)``): a violated invariant
+        feeds the ``kv_block_leak`` detector as evidence, it must not
+        crash the serve loop that is about to capture the incident."""
+        try:
+            self.check_conservation()
+        except AssertionError as e:
+            return {"ok": False, "error": str(e) or repr(e)}
+        return {"ok": True, "error": None}
+
     def check_conservation(self):
         """Invariant audit for tests: trash + free + tracked refcounted
         blocks partition the pool, and the evictable count equals the
@@ -348,6 +367,9 @@ class PagedKVPool:
             range(self.num_blocks))
         assert self._evictable == sum(
             1 for b, r in self._ref.items() if r == 0 and b in self.index)
+        assert self._live == sum(
+            1 for r in self._ref.values() if r > 0), \
+            (self._live, dict(self._ref))
         for b, r in self._ref.items():
             assert r >= 0, (b, r)
             if r == 0:

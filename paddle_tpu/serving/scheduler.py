@@ -326,6 +326,15 @@ class StepScheduler:
             if self.flight is not None:
                 self.flight.admission_rolled_back(req)
 
+    def queue_age_s(self, now=None):
+        """Seconds the HEAD of the queue has been waiting (0.0 when
+        empty) — the health observatory's how-long-has-nobody-moved
+        fact on every ledger row and queue-stall verdict."""
+        if not self.queue:
+            return 0.0
+        now = time.perf_counter() if now is None else now
+        return max(0.0, now - self.queue[0].t_arrival)
+
     def stop_reason(self, request, token):
         """Why the request stops on ``token``: "eos" / "max_tokens" /
         None (keep decoding) — the flight recorder's retirement

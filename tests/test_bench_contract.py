@@ -53,6 +53,9 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_DEADLINE_SECS"] = "150"
+    # fast beats so the run is long enough to capture several ledger-
+    # attributed heartbeat lines (the wedge-attribution satellite)
+    env["BENCH_HEARTBEAT_SECS"] = "2"
     try:
         res = subprocess.run(
             [sys.executable, os.path.join(_ROOT, "bench_serving.py"),
@@ -193,6 +196,42 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
             assert sec["watchdog"]["warmed"] is True
         assert last["overload_goodput_x"] == \
             ovl["goodput_improvement"]
+        # PR 8 health observatory: a clean smoke bench must fire ZERO
+        # anomalies across every scenario engine (the false-positive
+        # acceptance bar), the per-scenario rollups must be present,
+        # and the observatory's measured step-time overhead must stay
+        # small (<2% is the target; the CI bound is loose because CPU
+        # timers are noisy)
+        health = evidence["health"]
+        assert set(health) >= {"anomalies_total", "scenarios",
+                               "incident_dir", "incidents", "overhead"}
+        assert health["anomalies_total"] == 0, health
+        scen = health["scenarios"]
+        assert {"headline", "deep_queue_grouped", "deep_queue_pr1",
+                "shared_prefix_paged", "shared_prefix_nonpaged",
+                "overload_fifo", "overload_slo_feedback"} <= set(scen)
+        for name, s in scen.items():
+            assert s["enabled"] is True, name
+            assert s["healthy"] is True and s["anomalies_total"] == 0, \
+                (name, s)
+            assert s["ledger_steps"] > 0, name
+        ohd = health["overhead"]
+        assert ohd["health_on_s"] > 0 and ohd["health_off_s"] > 0
+        # direct per-tick measurement over a representative low-ms
+        # step: the target is <2% (measured ~1.5% on the smoke
+        # runner); the CI bound carries slack for shared-runner noise
+        assert ohd["overhead_frac"] < 0.05, ohd
+        assert ohd["per_step_overhead_us"] < 150, ohd
+        assert ohd["step_wall_us"] > 1000, ohd   # representative step
+        # the headline snapshot carries the same health rollup
+        assert snap["health"]["enabled"] is True
+        assert snap["health"]["anomalies_total"] == 0
+        # heartbeat wedge attribution: beats name the last ledger step
+        # and the phase-relative step rate
+        beats = [ln for ln in res.stderr.splitlines()
+                 if ln.startswith("# heartbeat") and " step=" in ln]
+        assert beats, res.stderr[-2000:]
+        assert all("step_rate=" in ln for ln in beats)
         dq = evidence["deep_queue"]
         assert dq["group_sizes_used"] and \
             max(dq["group_sizes_used"]) > 1   # grouped prefill fired

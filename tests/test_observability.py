@@ -157,6 +157,54 @@ def test_prometheus_text_parses_with_label_escaping():
         assert name in types or base in types
 
 
+def test_poisoned_gauge_callback_does_not_kill_scrape():
+    """A set_function callback that raises at scrape time must not
+    take down the whole exposition: the series exports NaN, every
+    other metric still scrapes, and the failure is counted in
+    metrics_scrape_errors_total{metric} (registered lazily — a clean
+    registry exposes no error family)."""
+    import math
+
+    reg = MetricsRegistry()
+    reg.counter("fine_total", "healthy neighbor").inc(3)
+    g = reg.gauge("poisoned", "always raises")
+    g.set_function(lambda: 1 / 0)
+    # clean registries never grew the error family (lazy registration)
+    assert reg.get("metrics_scrape_errors_total") is None
+    text = reg.prometheus_text()             # does not raise
+    types, samples = _parse_prometheus(text)
+    by_name = {name: value for name, labels, value in samples}
+    assert by_name["fine_total"] == 3        # neighbors survive
+    assert math.isnan(by_name["poisoned"])   # canonical NaN spelling
+    # the failure was counted (the family registers lazily mid-scrape,
+    # so it rides along from the NEXT exposition onward)
+    assert reg.get("metrics_scrape_errors_total") \
+        .labels("poisoned").value == 1
+    _, samples = _parse_prometheus(reg.prometheus_text())
+    errs = [(labels, v) for name, labels, v in samples
+            if name == "metrics_scrape_errors_total"]
+    assert errs == [({"metric": "poisoned"}, 1.0)]
+    # snapshot() is the second exposition surface: same survival, and
+    # the counter keeps counting per failed scrape
+    snap = reg.snapshot()
+    assert snap["fine_total"]["values"][""] == 3
+    assert math.isnan(snap["poisoned"]["values"][""])
+    assert reg.get("metrics_scrape_errors_total") \
+        .labels("poisoned").value == 3       # one per failed scrape
+    # a labeled pull gauge attributes the error to its family name
+    fam = reg.gauge("labeled_pull", "per-series pulls",
+                    labelnames=("which",))
+    fam.labels("bad").set_function(lambda: {}["missing"])
+    fam.labels("good").set_function(lambda: 7.0)
+    _, samples = _parse_prometheus(reg.prometheus_text())
+    vals = {tuple(sorted(lb.items())): v for name, lb, v in samples
+            if name == "labeled_pull"}
+    assert vals[(("which", "good"),)] == 7.0
+    assert math.isnan(vals[(("which", "bad"),)])
+    assert reg.get("metrics_scrape_errors_total") \
+        .labels("labeled_pull").value == 1
+
+
 def test_metric_name_validation():
     reg = MetricsRegistry()
     with pytest.raises(ValueError):
@@ -360,7 +408,7 @@ _SNAPSHOT_KEYS = {
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
-    "scheduler",
+    "scheduler", "health",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",
@@ -368,6 +416,12 @@ _SCHEDULER_KEYS = {
     "chunked_requests",
 }
 _PCT_KEYS = {"count", "p50_ms", "p90_ms", "p99_ms"}
+# the PR-8 health observatory section: enabled flag + anomaly rollup
+# (same key set whether the observatory is on or off)
+_HEALTH_KEYS = {
+    "enabled", "healthy", "anomalies_total", "detectors",
+    "incidents_written", "last_incident", "ledger_steps",
+}
 
 
 def test_serving_snapshot_schema_contract():
@@ -384,6 +438,22 @@ def test_serving_snapshot_schema_contract():
     assert set(sched) == _SCHEDULER_KEYS
     assert sched["policy"] == "fifo" and sched["shed_total"] == 0
     assert sched["prefill_chunks"] == 0
+    # the PR-8 health section: observatory on by default, clean run
+    # fires nothing, and the default detector roster is the surface
+    health = snap["health"]
+    assert set(health) == _HEALTH_KEYS
+    assert health["enabled"] is True and health["healthy"] is True
+    assert health["anomalies_total"] == 0
+    assert set(health["detectors"]) == {
+        "goodput_collapse", "kv_block_leak", "queue_stall",
+        "steady_state_compile", "step_time_spike"}
+    assert health["ledger_steps"] > 0
+    # health=False keeps the SAME key shape (schema contract holds)
+    eng_off = ServingEngine(m, num_slots=2, bucket_min=8, health=False)
+    _drive(eng_off, np.random.RandomState(1), [(4, 3)])
+    off = eng_off.metrics.snapshot()["health"]
+    assert set(off) == _HEALTH_KEYS
+    assert off["enabled"] is False and off["ledger_steps"] == 0
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():

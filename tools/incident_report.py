@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Pretty-print a health-observatory incident bundle (or /debug/health
+report) and exit nonzero when unhealthy.
+
+The serving engine's health observatory (paddle_tpu.observability.
+health) dumps a JSON incident bundle the moment a detector fires —
+detector verdict, last-K step-ledger rows, metrics snapshot, active
+request traces, host-span tail. This CLI renders the triage view a
+human (or a CI gate) wants first:
+
+  * the header: which detector fired, when, on what step, why;
+  * the ledger tail as a table — the per-step flight data leading up
+    to the anomaly (step id, wall/dispatch/sync ms, queue, slots,
+    tokens, compiles), with the firing step marked;
+  * top regressed step phases: the tail rows' wall/dispatch/sync
+    columns compared, final stretch vs the window median, sorted by
+    regression — "sync went 14x" beats eyeballing raw JSON;
+  * the engine vitals from the embedded metrics snapshot.
+
+Exit status is the CI contract: an incident bundle is by definition
+UNHEALTHY -> exit 1; a ``/debug/health`` body (the ``{healthy, ...}``
+shape) exits 0 iff ``healthy`` — so
+``python tools/incident_report.py <(curl .../debug/health)`` is a
+readiness probe. Wired into tier-1 via tests/test_health.py, which
+self-runs it against a synthetic incident.
+
+Usage: python tools/incident_report.py PATH [--tail N]
+"""
+import argparse
+import json
+import sys
+
+_TAIL_COLS = (
+    ("step", "step", "{:d}"),
+    ("wall_ms", "wall_s", None),       # seconds -> ms, special-cased
+    ("disp_ms", "dispatch_s", None),
+    ("sync_ms", "sync_s", None),
+    ("queue", "queue_depth", "{:d}"),
+    ("slots", "occupied_slots", "{:d}"),
+    ("admit", "admitted", "{:d}"),
+    ("toks", "tokens", "{:d}"),
+    ("done", "completed", "{:d}"),
+    ("shed", "shed", "{:d}"),
+    ("compile", "new_compiles", "{:d}"),
+)
+
+
+def _fmt_cell(key, row):
+    v = row.get(key)
+    if v is None:
+        return "-"
+    if key in ("wall_s", "dispatch_s", "sync_s"):
+        return f"{float(v) * 1000.0:.2f}"
+    try:
+        return f"{int(v):d}"
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def _median(xs):
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def render_ledger_table(rows, mark_step=None, out=sys.stdout):
+    """Fixed-width table of ledger rows, the anomaly step marked."""
+    headers = [h for h, _, _ in _TAIL_COLS]
+    table = [[_fmt_cell(key, r) for _, key, _ in _TAIL_COLS]
+             for r in rows]
+    widths = [max(len(h), *(len(t[i]) for t in table)) if table
+              else len(h) for i, h in enumerate(headers)]
+    line = "  " + "  ".join(h.rjust(w) for h, w in zip(headers, widths))
+    print(line, file=out)
+    print("  " + "-" * (len(line) - 2), file=out)
+    for r, cells in zip(rows, table):
+        mark = "<<" if mark_step is not None \
+            and r.get("step") == mark_step else "  "
+        print("  " + "  ".join(c.rjust(w) for c, w in
+                               zip(cells, widths)) + " " + mark,
+              file=out)
+
+
+def regressed_phases(rows, final_n=3):
+    """[(phase, final_avg_s, median_s, ratio)] sorted by ratio desc:
+    the tail's last ``final_n`` rows against the whole-tail median per
+    timed phase column — which part of the step blew up."""
+    out = []
+    if len(rows) < 2:
+        return out
+    final = rows[-final_n:]
+    for phase in ("wall_s", "dispatch_s", "sync_s"):
+        series = [float(r.get(phase) or 0.0) for r in rows]
+        med = _median(series)
+        fin = sum(float(r.get(phase) or 0.0)
+                  for r in final) / len(final)
+        ratio = fin / med if med > 0 else (float("inf") if fin > 0
+                                           else 1.0)
+        out.append((phase, fin, med, ratio))
+    out.sort(key=lambda e: -e[3])
+    return out
+
+
+def report_incident(bundle, tail=None, out=sys.stdout):
+    verdict = bundle.get("verdict") or {}
+    print(f"INCIDENT  detector={bundle.get('detector')}  "
+          f"written_at={bundle.get('written_at')}", file=out)
+    print(f"  step:   {verdict.get('step')}", file=out)
+    print(f"  reason: {verdict.get('reason')}", file=out)
+    extras = {k: v for k, v in verdict.items()
+              if k not in ("detector", "step", "reason")}
+    if extras:
+        print(f"  facts:  {json.dumps(extras, sort_keys=True)}",
+              file=out)
+    rows = bundle.get("ledger_tail") or []
+    if tail is not None:
+        rows = rows[-tail:]
+    if rows:
+        print(f"\nLEDGER TAIL ({len(rows)} steps)", file=out)
+        render_ledger_table(rows, mark_step=verdict.get("step"),
+                            out=out)
+        print("\nTOP REGRESSED STEP PHASES (final 3 steps vs tail "
+              "median)", file=out)
+        for phase, fin, med, ratio in regressed_phases(rows):
+            rtxt = "inf" if ratio == float("inf") else f"{ratio:.2f}x"
+            print(f"  {phase:<11} {fin * 1000.0:9.2f}ms vs "
+                  f"{med * 1000.0:9.2f}ms  ({rtxt})", file=out)
+    snap = bundle.get("metrics") or {}
+    if snap:
+        print("\nENGINE VITALS", file=out)
+        for key in ("tokens_per_sec", "queue_depth", "slot_occupancy",
+                    "requests_admitted", "requests_completed",
+                    "compiles", "speculative_masked"):
+            if key in snap:
+                print(f"  {key:<20} {snap[key]}", file=out)
+        sched = snap.get("scheduler") or {}
+        if sched:
+            print(f"  policy               {sched.get('policy')}  "
+                  f"shed_total={sched.get('shed_total')}", file=out)
+    wd = bundle.get("watchdog") or {}
+    if isinstance(wd, dict) and wd.get("steady_state_compiles"):
+        print(f"\nWATCHDOG  steady_state_compiles="
+              f"{wd['steady_state_compiles']}", file=out)
+        for e in (wd.get("steady_state_events") or [])[:3]:
+            print(f"  {e.get('key')} at {e.get('call_site')}",
+                  file=out)
+    reqs = bundle.get("requests") or {}
+    active = reqs.get("active") if isinstance(reqs, dict) else None
+    if active:
+        print(f"\nACTIVE REQUESTS ({len(active)})", file=out)
+        for t in active[:8]:
+            events = [e.get("event") for e in t.get("events", [])]
+            print(f"  rid={t.get('rid')}  last={events[-1] if events else '?'}"
+                  f"  events={len(events)}", file=out)
+    return 1    # an incident bundle is unhealthy by definition
+
+
+def report_health(body, out=sys.stdout):
+    healthy = bool(body.get("healthy"))
+    print(f"HEALTH  healthy={healthy}  "
+          f"anomalies_total={body.get('anomalies_total')}", file=out)
+    for name, st in sorted((body.get("detectors") or {}).items()):
+        if isinstance(st, dict):
+            fired = st.get("fired", 0)
+            extra = f"  last_step={st.get('last_step')}" if fired else ""
+        else:
+            fired, extra = st, ""
+        print(f"  {name:<22} fired={fired}{extra}", file=out)
+    if body.get("last_incident"):
+        print(f"  last_incident: {body['last_incident']}", file=out)
+    return 0 if healthy else 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("path", help="incident bundle or /debug/health"
+                        " JSON file")
+    parser.add_argument("--tail", type=int, default=None,
+                        help="show only the last N ledger rows")
+    args = parser.parse_args(argv)
+    with open(args.path) as fh:
+        body = json.load(fh)
+    if isinstance(body, dict) and str(body.get("schema", "")) \
+            .startswith("paddle_tpu.health.incident"):
+        return report_incident(body, tail=args.tail)
+    if isinstance(body, dict) and "healthy" in body:
+        return report_health(body)
+    print(f"unrecognized document: {args.path} (neither an incident "
+          f"bundle nor a /debug/health body)", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
