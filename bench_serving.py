@@ -49,6 +49,7 @@ marked WHERE it wedged.
 line shape (source: "live-smoke") — the emission-format contract test
 (tests/test_bench_contract.py) drives it.
 """
+import gc
 import json
 import os
 import sys
@@ -90,6 +91,12 @@ def _set_phase(phase):
     # phase-relative step accounting: the heartbeat's step_rate is
     # steps since THIS phase started, not since process start
     _rearm_engine_clock()
+    # collect the PREVIOUS phase's dead engines here, outside any
+    # timed window: deferred gen-2 cycle collections otherwise land
+    # as ~100-250ms pauses inside a later scenario's drive loop and
+    # corrupt its latency tail (measured: the smoke overload p99 went
+    # 20ms -> 260ms from exactly this)
+    gc.collect()
     print(f"# phase={phase} +{time.time() - _PHASE['t0']:.0f}s",
           file=sys.stderr, flush=True)
 
@@ -177,7 +184,7 @@ def _cached_payload():
 
 
 def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
-             specs, deep, slo, shared, overload, seed=7):
+             specs, deep, slo, shared, overload, chaos_cfg, seed=7):
     """One cold engine-vs-sequential measurement; returns evidence."""
     import numpy as np
 
@@ -227,6 +234,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     deep_queue = _measure_deep_queue(m_eng, num_slots, deep)
     shared_prefix = _measure_shared_prefix(shared)
     overload_sec = _measure_overload(overload)
+    chaos_sec = _measure_chaos(chaos_cfg)
     health_sec = _health_section(m_eng, num_slots)
 
     import jax
@@ -269,6 +277,11 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         "deep_queue": deep_queue,
         "shared_prefix": shared_prefix,
         "overload": overload_sec,
+        # PR 9 chaos scenario: identical traffic + identical seeded
+        # fault schedule, hardened (retry/quarantine/supervisor) vs
+        # unhardened — completion under faults, leak-free recovery,
+        # and the zero-steady-state-compiles-outside-restarts claim
+        "chaos": chaos_sec,
         # PR 8 health observatory rollup: per-scenario anomaly counts
         # (a clean bench fires ZERO — the false-positive acceptance
         # bar), incident bundle inventory, and the observatory's own
@@ -677,6 +690,196 @@ def _measure_overload(ov):
     }
 
 
+def _measure_chaos(cz):
+    """Chaos-hardened serving scenario (ISSUE 9): identical traffic
+    under an identical SEEDED fault schedule (serving.resilience
+    FaultPlan — dispatch/transfer/pool/callback faults plus a
+    deterministic decode-failure burst that forces a supervisor
+    restart), served by a hardened engine (bounded retry, quarantine,
+    self-healing supervisor) and by an unhardened baseline
+    (max_dispatch_retries=0, no supervisor — the PR-6..8 failure
+    behavior).
+
+    The hardened engine must complete >= 95% of requests BIT-EXACT
+    with an unfaulted reference drain, leak zero slots/blocks (the
+    paged pool conservation audit runs EVERY step via
+    health_audit_every=1, so every recovery is audited), and show
+    zero steady-state compiles outside supervisor restarts. The
+    unhardened baseline demonstrably wedges on the same seed — the
+    first injected dispatch fault escapes run() — and leaks its
+    in-flight slots/blocks. Both facts are in the artifact; the
+    contract test pins the schema and the 95% bar."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.resilience import FaultPlan, InjectedFault
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        TransformerLMConfig)
+
+    paddle.seed(37)
+    cfg = TransformerLMConfig(
+        vocab_size=cz["vocab"], hidden_size=cz["hidden"],
+        num_layers=cz["layers"], num_heads=cz["heads"],
+        max_seq_len=cz["max_seq_len"], dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rs = np.random.RandomState(41)
+    N = cz["requests"]
+    chunk = cz["chunk"]
+    specs = []
+    for i in range(N):
+        lo, hi = cz["long_len"] if i % cz["long_every"] == 0 \
+            else cz["short_len"]
+        n = int(rs.randint(lo, hi))
+        k = int(rs.randint(*cz["new_tokens"]))
+        specs.append((rs.randint(0, cz["vocab"], (n,))
+                      .astype(np.int64), k))
+
+    def plan():
+        # a fresh injector per engine, same seed: the decode burst
+        # (rate 1.0 after `burst_after` checks, 5 fires) deterministically
+        # exceeds the retry budget — the supervisor restart is part of
+        # the measured schedule, not a lucky draw
+        return FaultPlan(seed=cz["seed"], faults=dict(
+            cz["rates"],
+            decode_dispatch={"rate": 1.0, "after": cz["burst_after"],
+                             "max_fires": 5}))
+
+    def build(hardened, chaos):
+        return ServingEngine(
+            model, num_slots=cz["num_slots"], bucket_min=8,
+            paged=True, prefill_chunk=chunk, chaos=chaos,
+            max_dispatch_retries=3 if hardened else 0,
+            supervisor=hardened, supervisor_cooldown_s=0.0,
+            health_audit_every=1, incident_dir=_INCIDENT_DIR)
+
+    def warm(eng):
+        """Cover the whole paged compile inventory, so the timed
+        wave's only legitimate compiles are a supervisor restart's
+        rebuilds. With chunked prefill every tail LONGER than the
+        chunk width runs through the one chunk program, so the
+        reachable bucketed-prefill programs are exactly the buckets a
+        tail of <= chunk tokens can pad to."""
+        for b in eng.scheduler.buckets:
+            t = min(b, chunk)
+            if eng.scheduler.bucket_for(t) != b:
+                continue        # unreachable under chunking
+            eng.add_request(rs.randint(0, cz["vocab"], (t,))
+                            .astype(np.int64), 2)
+            eng.run()
+        eng.add_request(rs.randint(0, cz["vocab"], (chunk + 3,))
+                        .astype(np.int64), 2)   # the chunk program
+        eng.run()
+
+    # unfaulted reference: the parity + completion yardstick
+    _set_phase("chaos-reference")
+    ref = build(hardened=True, chaos=False)
+    _watch_engine(ref)
+    warm(ref)
+    refs = [ref.add_request(p, max_new_tokens=k) for p, k in specs]
+    ref.run()
+    reference = [list(r.generated) for r in refs]
+
+    # hardened engine under the seeded fault schedule
+    _set_phase("chaos-hardened")
+    eng = build(hardened=True, chaos=plan())
+    _watch_engine(eng)
+    warm(eng)
+    eng.declare_warmup()
+    t0 = time.perf_counter()
+    reqs = [eng.add_request(p, max_new_tokens=k) for p, k in specs]
+    steps = 0
+    wedged_hardened = False
+    while eng.step():
+        steps += 1
+        if steps > cz["max_steps"]:
+            wedged_hardened = True
+            break
+    wall = time.perf_counter() - t0
+    streams = [list(r.generated) for r in reqs]
+    completed = sum(1 for got, want in zip(streams, reference)
+                    if got == want)
+    parity_ok = all(got == want for got, want
+                    in zip(streams, reference) if got)
+    snap = eng.metrics.snapshot()
+    res = snap["resilience"]
+    wd = eng.watchdog.report()
+    try:
+        eng.pool.check_conservation()
+        conservation_ok, conservation_error = True, None
+    except AssertionError as e:
+        conservation_ok, conservation_error = False, str(e)
+    hardened_sec = {
+        "wedged": wedged_hardened,
+        "steps": steps,
+        "wall_s": round(wall, 3),
+        "completed": completed,
+        "completion_rate": round(completed / N, 4),
+        "parity_ok": parity_ok,
+        "tokens_per_sec": round(sum(len(s) for s in streams) / wall, 2),
+        "faults_injected": res["faults_injected"],
+        "dispatch_retries": res["dispatch_retries"],
+        "requests_aborted": res["requests_aborted"],
+        "supervisor_restarts": res["supervisor_restarts"],
+        "quarantined_slots": res["quarantined_slots"],
+        "slots_leaked": eng.pool.num_slots - eng.pool.free_count
+        - len(eng.pool.quarantined),
+        "live_blocks_at_idle": eng.pool.live_blocks,
+        "conservation_ok": conservation_ok,
+        "conservation_error": conservation_error,
+        # the invariant the supervisor protects: post-warmup compiles
+        # happen ONLY under a restart's reopened warmup window
+        "steady_state_new_compiles": wd["steady_state_compiles"],
+        "health": snap["health"],
+    }
+
+    # unhardened baseline, SAME seed: the first injected dispatch
+    # fault escapes run() — the engine wedges mid-drain and leaks its
+    # in-flight slots/blocks (the failure mode this PR deletes)
+    _set_phase("chaos-unhardened")
+    base = build(hardened=False, chaos=plan())
+    _watch_engine(base)
+    warm(base)
+    base.declare_warmup()
+    breqs = [base.add_request(p, max_new_tokens=k) for p, k in specs]
+    wedged, error = False, None
+    steps_b = 0
+    try:
+        while base.step():
+            steps_b += 1
+            if steps_b > cz["max_steps"]:
+                break
+    except InjectedFault as e:
+        wedged, error = True, str(e)
+    except Exception as e:  # noqa: BLE001 - evidence, not control flow
+        wedged, error = True, f"{type(e).__name__}: {e}"
+    bstreams = [list(r.generated) for r in breqs]
+    bcompleted = sum(1 for got, want in zip(bstreams, reference)
+                     if got == want)
+    unhardened_sec = {
+        "wedged": wedged,
+        "error": error,
+        "steps": steps_b,
+        "completed": bcompleted,
+        "completion_rate": round(bcompleted / N, 4),
+        "slots_leaked": base.pool.num_slots - base.pool.free_count
+        - len(base.pool.quarantined),
+        "live_blocks_leaked": base.pool.live_blocks,
+    }
+    return {
+        "requests": N,
+        "seed": cz["seed"],
+        "fault_plan": plan().as_dict(),
+        "num_slots": cz["num_slots"],
+        "prefill_chunk": chunk,
+        "hardened": hardened_sec,
+        "unhardened": unhardened_sec,
+        "completion_rate": hardened_sec["completion_rate"],
+        "parity_ok": parity_ok,
+    }
+
+
 def _measure_deep_queue(model, num_slots, dq):
     """Deep-queue grouped-prefill scenario: the full request set is
     enqueued before the first step, so admission happens in
@@ -798,9 +1001,28 @@ _OVERLOAD_FULL = dict(hidden=768, layers=12, heads=12, vocab=50304,
                       slo_ttft_floor_ms=20.0, slo_tpot_ms=500.0,
                       shed_margin_frac=0.35)
 
+# chaos cohorts: identical traffic + an identical seeded fault
+# schedule (dispatch/transfer/pool/callback faults at absorbable
+# rates, plus a deterministic 5-deep decode-failure burst that forces
+# a supervisor restart), hardened vs unhardened on the paged pool
+_CHAOS_SMOKE = dict(hidden=32, layers=2, heads=4, vocab=97,
+                    max_seq_len=64, num_slots=4, chunk=12, requests=40,
+                    long_every=8, long_len=(20, 36), short_len=(3, 14),
+                    new_tokens=(3, 7), seed=5, burst_after=30,
+                    max_steps=4000,
+                    rates={"prefill_dispatch": 0.06,
+                           "chunk_dispatch": 0.06, "transfer": 0.03,
+                           "block_exhaustion": 0.05, "callback": 0.2,
+                           "step_latency": {"rate": 0.02,
+                                            "latency_s": 0.002}})
+_CHAOS_FULL = dict(_CHAOS_SMOKE, hidden=768, layers=12, heads=12,
+                   vocab=50304, max_seq_len=512, num_slots=8,
+                   chunk=64, requests=64, long_len=(100, 220),
+                   short_len=(8, 48), new_tokens=(8, 24))
+
 _SMOKE = dict(hidden=32, layers=2, heads=4, vocab=97, max_seq_len=64,
               num_slots=4, deep=_DEEP_SMOKE, shared=_SHARED_SMOKE,
-              overload=_OVERLOAD_SMOKE,
+              overload=_OVERLOAD_SMOKE, chaos_cfg=_CHAOS_SMOKE,
               # generous CPU-smoke SLOs: the COLD first wave compiles,
               # so TTFT violations here are real and demonstrate the
               # accounting, not an artifact bug
@@ -812,6 +1034,7 @@ _SMOKE = dict(hidden=32, layers=2, heads=4, vocab=97, max_seq_len=64,
 _FULL = dict(hidden=768, layers=12, heads=12, vocab=50304,
              max_seq_len=512, num_slots=8, deep=_DEEP_FULL,
              shared=_SHARED_FULL, overload=_OVERLOAD_FULL,
+             chaos_cfg=_CHAOS_FULL,
              slo=dict(slo_ttft_ms=10000.0, slo_tpot_ms=200.0),
              specs=[(int(n), int(k)) for n, k in
                     [(40, 64), (120, 48), (24, 96), (200, 32),
@@ -869,6 +1092,7 @@ def main():
             "ttft_improvement"],
         "overload_goodput_x": evidence["overload"][
             "goodput_improvement"],
+        "chaos_completion_rate": evidence["chaos"]["completion_rate"],
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })

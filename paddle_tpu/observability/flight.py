@@ -45,6 +45,10 @@ PREFILL_DISPATCHED = "prefill_dispatched"
 PREFILL_CHUNK = "prefill_chunk"
 DEPRIORITIZED = "deprioritized"
 SHED = "shed"
+DISPATCH_FAILED = "dispatch_failed"
+REQUEUED = "requeued"
+CALLBACK_ERROR = "callback_error"
+DEADLINE_EXCEEDED = "deadline_exceeded"
 FIRST_TOKEN = "first_token"
 DECODE_WINDOW = "decode_window"
 RETIRED = "retired"
@@ -203,6 +207,39 @@ class FlightRecorder:
                     {"reason": str(reason),
                      "headroom_ms": round(float(headroom_ms), 3)})
         self.retired(req, "shed")
+
+    def dispatch_failed(self, req, kind, error):
+        """A dispatch carrying this request raised (and its admission
+        rolled back): ``kind`` names the seam (prefill / chunk /
+        decode), ``error`` the exception. A later ``admitted`` is the
+        bounded-retry attempt; a ``retired(reason="error")`` means the
+        retry budget ran out."""
+        self._event(req.rid, DISPATCH_FAILED, "t",
+                    {"kind": str(kind),
+                     "error": f"{type(error).__name__}: {error}"[:200],
+                     "failures": int(req.dispatch_failures)})
+
+    def requeued(self, req, reason):
+        """A supervisor restart re-queued this in-flight request for
+        re-prefill of its prompt + already-emitted tokens; the earlier
+        ``admitted``/``prefill_dispatched`` chain is void (like a
+        rollback) and the replay re-runs it."""
+        self._event(req.rid, REQUEUED, "t",
+                    {"reason": str(reason),
+                     "tokens_kept": int(len(req.generated))})
+
+    def callback_error(self, req, error):
+        """The user ``on_token`` callback raised; the engine caught it
+        and kept streaming (the token WAS emitted and counted)."""
+        self._event(req.rid, CALLBACK_ERROR, "t",
+                    {"error": f"{type(error).__name__}: {error}"[:200]})
+
+    def deadline_exceeded(self, req, overrun_ms):
+        """The request blew its ``deadline_ms`` and is being retired
+        (reason "deadline" follows); ``overrun_ms`` is how far past
+        the deadline the engine noticed."""
+        self._event(req.rid, DEADLINE_EXCEEDED, "t",
+                    {"overrun_ms": round(float(overrun_ms), 3)})
 
     def token_emitted(self, req, n_tokens):
         """Account one emitted token: the FIRST is the TTFT lifecycle

@@ -31,10 +31,14 @@ from .ledger import StepLedger
 INCIDENT_SCHEMA = "paddle_tpu.health.incident/v1"
 
 # bundle sections every incident carries (tests pin this contract;
-# tools/incident_report.py renders from it)
+# tools/incident_report.py renders from it). ``chaos`` is the active
+# FaultPlan + fault log when the engine runs under the fault-injection
+# harness (None otherwise) — a chaos-found incident is replayable from
+# the bundle alone.
 INCIDENT_KEYS = (
     "schema", "written_at", "detector", "verdict", "ledger_tail",
     "metrics", "watchdog", "requests", "spans_tail", "health",
+    "chaos",
 )
 
 
@@ -44,7 +48,8 @@ def disabled_health_summary():
     contract holds either way."""
     return {"enabled": False, "healthy": True, "anomalies_total": 0,
             "detectors": {}, "incidents_written": 0,
-            "last_incident": None, "ledger_steps": 0}
+            "last_incident": None, "ledger_steps": 0,
+            "degraded": False, "draining": False, "restarts": 0}
 
 
 class IncidentRecorder:
@@ -110,6 +115,7 @@ class IncidentRecorder:
             "requests": self._section(context, "requests"),
             "spans_tail": self._section(context, "spans_tail"),
             "health": health_report,
+            "chaos": self._section(context, "chaos"),
         }
         os.makedirs(self.directory, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
@@ -178,7 +184,31 @@ class HealthMonitor:
             "(the detector is skipped for that step, never fatal)",
             labelnames=("detector",))
         self._state = {}
+        self._resolved_total = 0   # anomalies acknowledged-recovered
+        self._resilience_fn = None  # engine's degraded/draining state
         self._lock = threading.Lock()
+
+    def attach_resilience(self, state_fn):
+        """Attach the engine's resilience state (``{"degraded",
+        "draining", "restarts"}``) so ``/debug/health`` tells the
+        router the replica's TRUE serving posture, not just its
+        anomaly history."""
+        self._resilience_fn = state_fn
+
+    def _resilience(self):
+        if self._resilience_fn is None:
+            return {"degraded": False, "draining": False, "restarts": 0}
+        return self._resilience_fn()
+
+    def resolve(self):
+        """Mark every anomaly fired so far RECOVERED (the supervisor
+        calls this when its restart's replay set drains): ``healthy``
+        goes back to true unless NEW anomalies fire. The cumulative
+        firing counters are untouched — resolution is a health-status
+        fact, not an eraser."""
+        with self._lock:
+            self._resolved_total = sum(
+                st["fired"] for st in self._state.values())
 
     # ------------------------------------------------------- stepping
     def observe(self, row):
@@ -233,8 +263,19 @@ class HealthMonitor:
             return sum(st["fired"] for st in self._state.values())
 
     @property
+    def unresolved_total(self):
+        """Anomalies fired since the last supervisor-declared
+        recovery (= all of them when nothing ever resolved)."""
+        with self._lock:
+            total = sum(st["fired"] for st in self._state.values())
+            return max(0, total - self._resolved_total)
+
+    @property
     def healthy(self):
-        return self.anomalies_total == 0
+        """No unresolved anomalies AND not currently degraded — the
+        bar a router's readiness poll should use."""
+        return self.unresolved_total == 0 \
+            and not self._resilience()["degraded"]
 
     def detector_counts(self):
         """{detector name: firings} for EVERY configured detector
@@ -254,9 +295,20 @@ class HealthMonitor:
                              "last_incident": None}))
                 for d in self.detectors}
         total = sum(st["fired"] for st in detectors.values())
+        with self._lock:
+            resolved = self._resolved_total
+        res = self._resilience()
+        unresolved = max(0, total - resolved)
         return {
-            "healthy": total == 0,
+            "healthy": unresolved == 0 and not res["degraded"],
             "anomalies_total": total,
+            "anomalies_resolved": resolved,
+            # the router-facing replica posture: degraded while a
+            # supervisor restart's replay is still draining, draining
+            # during a graceful engine drain, restarts cumulative
+            "degraded": res["degraded"],
+            "draining": res["draining"],
+            "restarts": res["restarts"],
             "detectors": detectors,
             "last_incident": self.incidents.last_path
             if self.incidents is not None else None,
@@ -271,9 +323,10 @@ class HealthMonitor:
         """The ``snapshot()["health"]`` section (lighter than
         report(): firing counts only, no verdict payloads)."""
         total = self.anomalies_total
+        res = self._resilience()
         return {
             "enabled": True,
-            "healthy": total == 0,
+            "healthy": self.healthy,
             "anomalies_total": total,
             "detectors": self.detector_counts(),
             "incidents_written": self.incidents.written
@@ -281,6 +334,9 @@ class HealthMonitor:
             "last_incident": self.incidents.last_path
             if self.incidents is not None else None,
             "ledger_steps": self.ledger.steps,
+            "degraded": res["degraded"],
+            "draining": res["draining"],
+            "restarts": res["restarts"],
         }
 
     def debug_ledger(self):

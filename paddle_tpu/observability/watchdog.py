@@ -195,6 +195,15 @@ class CompileWatchdog:
         with self._lock:
             self._warmed = True
 
+    def reopen_warmup(self):
+        """Re-enter warmup (supervisor restart): the rebuilt AOT
+        table's compiles are recovery work, not steady-state
+        violations — the supervisor re-declares warmup once the replay
+        drains, so the alarm re-arms the moment recovery completes.
+        Already-flagged events keep their steady_state attribution."""
+        with self._lock:
+            self._warmed = False
+
     # -------------------------------------------------------- querying
     @property
     def warmed(self):

@@ -102,6 +102,23 @@ math into a multi-tenant server:
     renders them). ``/debug/health`` returns ``{healthy, detectors,
     last_incident}``: the per-replica readiness signal a scale-out
     router polls;
+  * **resilience** (serving.resilience, PR 9) — a deterministic,
+    seeded fault-injection harness (``chaos=FaultPlan(seed)`` /
+    ``PADDLE_CHAOS``, off by default) at the engine's real seams
+    (dispatches, transfers, pool exhaustion, compile storms, poisoned
+    callbacks; identical seed => identical fault log AND token
+    streams), plus the hardening it forces: per-request deadlines
+    (``add_request(..., deadline_ms=)``, timeout retirement
+    SLO-judged), bounded leak-free dispatch retry
+    (``max_dispatch_retries=``), slot quarantine
+    (``quarantine_after=``), guarded ``on_token`` callbacks, graceful
+    ``drain()`` and explicit-abort ``close()`` — and a self-healing
+    supervisor that turns wedge verdicts (queue stall, KV-block leak,
+    repeated dispatch failure) into an in-process restart: rebuilt
+    AOT tables, fresh pools, in-flight requests replayed bit-exact;
+    ``/debug/health`` reports ``{degraded, draining, restarts}``
+    truthfully throughout (``snapshot()["resilience"]`` carries the
+    counters; ``tools/chaos_sweep.py`` is the CI fault matrix);
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program
@@ -183,6 +200,37 @@ Tuning knobs
                 ``PADDLE_INCIDENT_DIR``), how many bundles the
                 directory keeps (default 16), and the per-detector
                 capture debounce (default 60 s).
+``chaos``       arm the fault-injection harness: a
+                ``resilience.FaultPlan``, an int seed (default
+                rates), or a ``{seed, faults}`` dict; None (default)
+                consults ``PADDLE_CHAOS`` (``<seed>`` or
+                ``<seed>:<rate>``), False forces off. Deterministic
+                per seed; fires counted in
+                ``serving_faults_injected_total{site}``.
+``max_dispatch_retries``
+                failed prefill/chunk/decode dispatches (and harvest
+                transfers) absorbed per request/step before the
+                request retires ``"error"`` (0 = default = the raise-
+                through prior behavior). Rollback is leak-free on
+                both pools; decode failures past the budget escalate
+                to the supervisor.
+``retry_backoff_s``
+                base of the exponential admission backoff after an
+                absorbed dispatch failure (0 = retry next step).
+``quarantine_after``
+                same-slot dispatch failures before the slot is
+                excluded from admission (default 3; never the last
+                admissible slot; reset by a supervisor restart).
+``supervisor`` / ``supervisor_max_restarts`` / ``supervisor_cooldown_s``
+                the self-healing supervisor (None = on whenever the
+                health observatory is on): consumes queue_stall /
+                kv_block_leak verdicts + repeated dispatch failure,
+                performs an in-process restart (rebuilt AOT tables,
+                fresh pools, bit-exact greedy replay of in-flight
+                requests), reports ``{degraded, draining, restarts}``
+                on ``/debug/health``; max_restarts bounds the
+                crash-loop, cooldown_s debounces same-episode
+                verdicts.
 ``completed_keep`` / ``trace_keep`` / ``trace_decode_window``
                 retention bounds: completed Request objects kept by
                 the scheduler (default 4096), completed RequestTraces
@@ -203,6 +251,10 @@ from .engine import (  # noqa: F401
 from .kv_pool import SlotKVPool  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .paged import PagedKVPool, RadixPrefixIndex  # noqa: F401
+from .resilience import (  # noqa: F401
+    EngineSupervisor, FaultInjector, FaultPlan, FaultSpec,
+    InjectedFault,
+)
 from .sched import (  # noqa: F401
     ChunkPlan, FIFOPolicy, SchedulingPolicy, SLOFeedbackPolicy,
     SlotSampler, plan_chunks,

@@ -59,6 +59,7 @@ compiled decode.
 import os
 import time
 import warnings
+import weakref
 
 import numpy as np
 
@@ -67,7 +68,7 @@ from ..observability import (CompileWatchdog, FlightRecorder,
                              executable_cost)
 from .kv_pool import SlotKVPool
 from .metrics import ServingMetrics
-from .scheduler import RUNNING, Request, StepScheduler
+from .scheduler import QUEUED, RUNNING, Request, StepScheduler
 
 # published per-chip peak FLOP/s (bf16) by PJRT device_kind prefix —
 # the denominator of the estimated-MFU gauge. Unknown kinds (CPU, new
@@ -83,6 +84,21 @@ _PEAK_FLOPS_BY_KIND = (
     ("tpu v3", 123e12),
     ("tpu v2", 46e12),
 )
+
+
+def _weak_method(method, default):
+    """Wrap a bound engine method as a weakly-referencing callable
+    (``default()`` once the engine is gone). Pull callbacks handed to
+    long-lived collaborators (metrics registry, health monitor) must
+    not strongly reference the engine: every such back-edge turns a
+    dead engine into cyclic garbage whose gen-2 collection pause lands
+    inside some LIVE engine's timed step."""
+    ref = weakref.WeakMethod(method)
+
+    def call():
+        m = ref()
+        return default() if m is None else m()
+    return call
 
 
 def _peak_flops_for(device_kind):
@@ -157,7 +173,11 @@ class ServingConfig:
                  policy=None, sampling=False, health=None,
                  health_audit_every=64, health_ledger_keep=512,
                  health_detectors=None, incident_dir=None,
-                 incident_keep=16, health_debounce_s=60.0):
+                 incident_keep=16, health_debounce_s=60.0,
+                 chaos=None, max_dispatch_retries=0,
+                 retry_backoff_s=0.0, quarantine_after=3,
+                 supervisor=None, supervisor_max_restarts=8,
+                 supervisor_cooldown_s=1.0):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -275,6 +295,31 @@ class ServingConfig:
         self.incident_dir = incident_dir
         self.incident_keep = int(incident_keep)
         self.health_debounce_s = float(health_debounce_s)
+        # resilience (serving.resilience): chaos arms the seeded
+        # fault-injection harness (None = the PADDLE_CHAOS env gate,
+        # default off); max_dispatch_retries bounds how many times a
+        # failed dispatch is rolled back and retried before the
+        # request retires with reason "error" (0 = prior behavior:
+        # the exception propagates); retry_backoff_s is the base of
+        # the exponential admission backoff between retries;
+        # quarantine_after excludes a slot from admission after that
+        # many same-slot dispatch failures; supervisor=None enables
+        # the self-healing supervisor whenever the health observatory
+        # is on (True/False forces).
+        self.chaos = chaos
+        self.max_dispatch_retries = int(max_dispatch_retries)
+        if self.max_dispatch_retries < 0:
+            raise ValueError(
+                f"max_dispatch_retries must be >= 0, got "
+                f"{max_dispatch_retries}")
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.quarantine_after = int(quarantine_after)
+        if self.quarantine_after < 1:
+            raise ValueError(
+                f"quarantine_after must be >= 1, got {quarantine_after}")
+        self.supervisor = supervisor
+        self.supervisor_max_restarts = int(supervisor_max_restarts)
+        self.supervisor_cooldown_s = float(supervisor_cooldown_s)
 
 
 class ServingEngine:
@@ -327,11 +372,16 @@ class ServingEngine:
                 f"capacity {cache_len}")
         if self.paged:
             from .paged import PagedKVPool
-            self.pool = PagedKVPool(
-                config.num_slots, cfg.num_layers, cfg.num_heads,
-                cache_len, cfg.hidden_size // cfg.num_heads,
-                block_size=config.block_size,
-                num_blocks=config.num_blocks)
+
+            def _pool_factory():
+                return PagedKVPool(
+                    config.num_slots, cfg.num_layers, cfg.num_heads,
+                    cache_len, cfg.hidden_size // cfg.num_heads,
+                    block_size=config.block_size,
+                    num_blocks=config.num_blocks)
+
+            self._pool_factory = _pool_factory
+            self.pool = _pool_factory()
             self._prefill_fn, self._decode_fn = \
                 model.build_paged_serving_fns(
                     config.num_slots, self.pool.block_size,
@@ -344,9 +394,14 @@ class ServingEngine:
             self._chunk_fn = model.build_chunk_prefill_fn(
                 cache_len, sampling=self.sampling) \
                 if self.chunk_len is not None else None
-            self.pool = SlotKVPool(
-                config.num_slots, cfg.num_layers, cfg.num_heads,
-                cache_len, cfg.hidden_size // cfg.num_heads)
+
+            def _pool_factory():
+                return SlotKVPool(
+                    config.num_slots, cfg.num_layers, cfg.num_heads,
+                    cache_len, cfg.hidden_size // cfg.num_heads)
+
+            self._pool_factory = _pool_factory
+            self.pool = _pool_factory()
         from .sched import ChunkPlan, SlotSampler, resolve_policy
         self._ChunkPlan = ChunkPlan
         self._sampler = SlotSampler(config.num_slots) \
@@ -372,6 +427,28 @@ class ServingEngine:
         self._exec = {}  # (kind, bucket?, group?) -> XLA executable
         self._t_last_compile = float("-inf")  # SLO-feedback taint mark
         self._metric_servers = []
+        # resilience: chaos harness + retry/quarantine/drain state
+        # (the supervisor attaches after the health observatory below)
+        from .resilience import resolve_chaos
+        self.chaos = resolve_chaos(config.chaos)
+        if self.chaos is not None:
+            from ..observability import default_recorder as _rec
+            self.chaos.bind(on_fire=self.metrics.record_fault,
+                            recorder=_rec())
+        self.max_dispatch_retries = config.max_dispatch_retries
+        self.retry_backoff_s = config.retry_backoff_s
+        self._retry_at = 0.0        # admission backoff gate
+        self._decode_fail_streak = 0
+        self._slot_failures = {}    # slot -> consecutive failures
+        self._draining = False
+        self._closed = False
+        self._deadlines_armed = False
+        self._restart_epoch = 0     # bumped by supervisor restarts
+        self.metrics.set_resilience(_weak_method(
+            self._resilience_state,
+            lambda: {"quarantined_slots": [], "draining": False,
+                     "supervisor": {"enabled": False},
+                     "chaos": {"enabled": False}}))
         # health observatory: per-step ledger + anomaly detectors +
         # (when an incident_dir is configured) black-box bundle capture
         self._step_id = 0
@@ -396,20 +473,42 @@ class ServingEngine:
                          "dur": round(s.dur, 6), "tid": s.tid}
                         for s in rec.spans()[-120:]]
 
+            context = {
+                "metrics": self.metrics.snapshot,
+                "watchdog": self.watchdog.report,
+                "requests": self.flight.debug_requests,
+                "spans_tail": _spans_tail,
+            }
+            if self.chaos is not None:
+                # a chaos-found incident must be replayable from its
+                # bundle alone: embed the plan (seed) + fault history
+                context["chaos"] = self.chaos.report
             self.health = HealthMonitor(
                 self.metrics.registry,
                 ledger_keep=config.health_ledger_keep,
                 detector_config=config.health_detectors,
                 incidents=incidents,
-                context={
-                    "metrics": self.metrics.snapshot,
-                    "watchdog": self.watchdog.report,
-                    "requests": self.flight.debug_requests,
-                    "spans_tail": _spans_tail,
-                })
+                context=context)
+            self.health.attach_resilience(_weak_method(
+                self._health_resilience,
+                lambda: {"degraded": False, "draining": False,
+                         "restarts": 0}))
             self.metrics.set_health(self.health.summary)
         else:
             self.health = None
+        # self-healing supervisor: default ON alongside the health
+        # observatory (its restart triggers are the observatory's
+        # wedge verdicts); explicit True works without it too (the
+        # dispatch-failure escalation path needs no detectors)
+        sup_on = config.supervisor if config.supervisor is not None \
+            else (self.health is not None)
+        if sup_on:
+            from .resilience import EngineSupervisor
+            self.supervisor = EngineSupervisor(
+                self, max_restarts=config.supervisor_max_restarts,
+                cooldown_s=config.supervisor_cooldown_s)
+        else:
+            self.supervisor = None
 
         import jax
         import jax.numpy as jnp
@@ -445,7 +544,7 @@ class ServingEngine:
 
     def add_request(self, prompt, max_new_tokens, eos_id=None,
                     on_token=None, temperature=0.0, top_k=0,
-                    top_p=1.0, seed=None):
+                    top_p=1.0, seed=None, deadline_ms=None):
         """Enqueue a prompt; returns the Request handle immediately.
         Tokens stream through on_token(request, token) as steps run
         (with async_depth=1 a token surfaces one engine step after the
@@ -455,17 +554,30 @@ class ServingEngine:
         per-slot sampling for THIS request (the engine must be built
         with ``sampling=True`` — greedy engines reject sampled
         requests rather than silently argmaxing them); the defaults
-        are greedy, matching ``generate(temperature=0.0)`` exactly."""
+        are greedy, matching ``generate(temperature=0.0)`` exactly.
+
+        ``deadline_ms`` bounds the request end to end: past
+        ``t_arrival + deadline_ms`` the engine retires it (queued or
+        mid-decode) with stop reason "deadline", counted in
+        ``serving_requests_timed_out_total`` and SLO-judged as a
+        violation. None (default) = no deadline."""
+        if self._draining or self._closed:
+            raise RuntimeError(
+                "engine is draining/closed: no new requests (drain() "
+                "finishes already-submitted work, close() aborts it)")
         req = Request(prompt, max_new_tokens,
                       eos_id=self.config.eos_id if eos_id is None
                       else eos_id,
                       on_token=on_token, temperature=temperature,
-                      top_k=top_k, top_p=top_p, seed=seed)
+                      top_k=top_k, top_p=top_p, seed=seed,
+                      deadline_ms=deadline_ms)
         if req.sampled and not self.sampling:
             raise ValueError(
                 "sampled request on a greedy engine: build the engine "
                 "with ServingConfig(sampling=True) to serve "
                 "temperature/top-k/top-p traffic")
+        if req.deadline_ms is not None:
+            self._deadlines_armed = True
         return self.scheduler.submit(req)
 
     @property
@@ -483,6 +595,13 @@ class ServingEngine:
         after declare_warmup() a build here is a flagged/raised
         steady-state violation. ``donate`` argnums are recorded in the
         lowered program (in-place cache updates on TPU/GPU)."""
+        if self.chaos is not None and key in self._exec \
+                and self.chaos.fires("compile_storm", key=str(key)):
+            # compile storm: the cached executable evaporates and the
+            # very next dispatch pays a rebuild — watchdog-attributed,
+            # a steady-state violation when warmed (by design: this
+            # fault exists to prove the alarm fires)
+            del self._exec[key]
         ex = self._exec.get(key)
         if ex is None:
             import jax
@@ -552,13 +671,65 @@ class ServingEngine:
         self._metric_servers.append(handle)
         return handle
 
+    def drain(self):
+        """Graceful drain: stop accepting NEW requests (add_request
+        raises), finish every already-submitted request — queued and
+        in-flight — then close. ``/debug/health`` reports
+        ``draining: true`` for the duration, so a router stops
+        routing to this replica while it finishes its commitments.
+        Returns the completed requests (submission order)."""
+        self._draining = True
+        while self.step():
+            pass
+        done = sorted(self.scheduler.completed, key=lambda r: r.rid)
+        self.close()
+        return done
+
     def close(self):
-        """Shut down everything the engine started that outlives a
-        request wave — today: the metrics/debug HTTP servers.
-        Idempotent; the engine is also a context manager."""
+        """Shut down the engine: any still-in-flight work is retired
+        with an explicit ``aborted`` stop reason (slot/block
+        conservation audited by tests — nothing leaks, nothing is
+        silently abandoned; use ``drain()`` to finish it instead),
+        then the metrics/debug HTTP servers stop. Idempotent; the
+        engine is also a context manager."""
+        if not self._closed and (self.scheduler.pending
+                                 or self._pending or self._chunk_q):
+            self._abort_inflight()
+        self._closed = True
         servers, self._metric_servers = self._metric_servers, []
         for handle in servers:
             handle.close()
+
+    def _abort_inflight(self):
+        """Retire every request the engine still owes tokens —
+        queued, active, mid-chunk, or pending harvest — with reason
+        "aborted" (zero further tokens, slots/blocks released, flight
+        traces closed). The close()-with-work-in-flight path."""
+        sch = self.scheduler
+        owed = {}
+        for r in sch.queue:
+            owed[r.rid] = r
+        for r in sch.active.values():
+            owed[r.rid] = r
+        for plan in self._chunk_q:
+            owed.setdefault(plan.req.rid, plan.req)
+        for entry in self._pending:
+            coll = entry[2]
+            rs = coll.values() if isinstance(coll, dict) \
+                else [r for r, _ in coll]
+            for r in rs:
+                if r.state == RUNNING:   # prereleased finals included
+                    owed.setdefault(r.rid, r)
+        self._pending = []
+        self._chunk_q = []
+        self._prefilling.clear()
+        for r in sorted(owed.values(), key=lambda r: r.rid):
+            r.inflight = 0
+            sch.abort(r, self.pool)
+            self.metrics.record_abort()
+            self.flight.retired(r, "aborted")
+            if self.supervisor is not None:
+                self.supervisor.note_completion(r.rid)
 
     def __enter__(self):
         return self
@@ -602,6 +773,7 @@ class ServingEngine:
                 self.metrics.scheduler_report(),
                 chunked_inflight=len(self._chunk_q)),
             "health": self.metrics.health_report(),
+            "resilience": self.metrics.resilience_report(),
         }
 
     def lint(self, passes=None, min_donation_bytes=1 << 20,
@@ -729,13 +901,26 @@ class ServingEngine:
                     (req.t_first_token - req.t_admitted) * 1000.0)
         self.flight.token_emitted(req, len(req.generated))
         if req.on_token is not None:
-            req.on_token(req, token)
+            # a user callback must never take down the step loop: a
+            # raise is caught, counted, trace-attributed — and every
+            # other slot keeps streaming (the token itself was already
+            # emitted and accounted above)
+            try:
+                if self.chaos is not None:
+                    self.chaos.maybe_raise("callback",
+                                           step=self._step_id + 1)
+                req.on_token(req, token)
+            except Exception as e:  # noqa: BLE001 - isolation boundary
+                self.metrics.record_callback_error()
+                self.flight.callback_error(req, e)
         reason = self.scheduler.stop_reason(req, token)
         if reason is not None:
             self.scheduler.finish(req, self.pool)
             violations = self.metrics.record_completion(req)
             self.flight.retired(req, reason,
                                 slo_violations=list(violations))
+            if self.supervisor is not None:
+                self.supervisor.note_completion(req.rid)
 
     def _harvest(self, pending):
         """Read back dispatched results (at most one step's worth: the
@@ -748,7 +933,7 @@ class ServingEngine:
         M = self.metrics
         for entry in pending:
             with M.span("serving/sync"):
-                vals = np.asarray(entry[1])
+                vals = self._read_back(entry[1])
             if entry[0] == "prefill":
                 for (req, slot), tok in zip(entry[2], vals):
                     req.inflight -= 1
@@ -764,6 +949,27 @@ class ServingEngine:
                         continue
                     req.inflight -= 1
                     self._emit(req, int(vals[slot]))
+
+    def _read_back(self, device_vals):
+        """One device->host token read, with bounded retry for
+        transient transfer failures: the values stay resident on
+        device across attempts, so a failed read retries immediately
+        and loses nothing. Past the retry budget (or on a hardened=off
+        engine) the failure propagates — a persistently dead transfer
+        path is the supervisor/operator's problem, not a spin loop."""
+        attempt = 0
+        while True:
+            try:
+                if self.chaos is not None:
+                    self.chaos.maybe_raise("transfer")
+                return np.asarray(device_vals)
+            except Exception as e:  # noqa: BLE001 - gated below
+                self.metrics.record_dispatch_failure("transfer")
+                if attempt >= self.max_dispatch_retries \
+                        or not self._retryable(e):
+                    raise
+                attempt += 1
+                self.metrics.record_retry()
 
     def step(self):
         """One engine iteration of the pipelined hot path:
@@ -796,18 +1002,30 @@ class ServingEngine:
         AFTER the timed step, so the observatory's own bookkeeping
         never pollutes the wall time it judges."""
         if self.health is None:
+            more = False
             with self.metrics.span("serving/step"):
-                return self._step_inner()
+                more = self._step_inner()
+            # a supervisor restart mid-step re-queued work the stale
+            # `more` verdict predates
+            return more or self.scheduler.pending or bool(self._pending)
         t0 = time.perf_counter()
         with self.metrics.span("serving/step"):
             more = self._step_inner()
         self._health_tick(time.perf_counter() - t0)
-        return more
+        return more or self.scheduler.pending or bool(self._pending)
 
     def _step_inner(self):
         sch, pool, M = self.scheduler, self.pool, self.metrics
         sync = self.config.async_depth == 0
         prev, self._pending = self._pending, []
+        epoch = self._restart_epoch
+
+        if self.chaos is not None \
+                and self.chaos.fires("step_latency",
+                                     step=self._step_id + 1):
+            time.sleep(self.chaos.latency_s())
+        if self._deadlines_armed:
+            self._expire_deadlines()
 
         with M.span("serving/retirement"):
             for req in [r for r in sch.active.values()
@@ -816,12 +1034,17 @@ class ServingEngine:
 
         self._triage()
 
-        if self.paged:
-            self._paged_prefills(sync)
-        else:
-            self._legacy_prefills(sync)
-        if self._chunk_q:
-            self._dispatch_chunks(sync)
+        # the exponential-backoff gate: after an absorbed dispatch
+        # failure, admission/prefill pauses until the retry moment
+        # (decode of already-running slots continues — backoff starves
+        # nobody who already holds a slot)
+        if time.perf_counter() >= self._retry_at:
+            if self.paged:
+                self._paged_prefills(sync)
+            else:
+                self._legacy_prefills(sync)
+            if self._chunk_q:
+                self._dispatch_chunks(sync)
 
         # slots parked mid-chunked-prefill decode physically (the
         # pooled dispatch advances every slot) but their parked writes
@@ -843,23 +1066,46 @@ class ServingEngine:
                 donate = (2, 3, 4)
             if self.sampling:
                 args = args + self._sampler.device_arrays()
-            ex = self._compiled(("decode",), self._decode_fn, args,
-                                donate=donate)
-            with M.span("serving/decode_dispatch"):
-                nxt, self._pos, kc, vc = ex(*args)
-            pool.rebind(kc, vc)
-            self._toks = nxt
-            M.decode_steps += 1
-            if sync:
-                self._harvest([("decode", nxt, snapshot)])
-            else:
-                self._pending.append(("decode", nxt, snapshot))
+            ok = False
+            try:
+                if self.chaos is not None:
+                    self.chaos.maybe_raise("decode_dispatch",
+                                           step=self._step_id + 1)
+                ex = self._compiled(("decode",), self._decode_fn, args,
+                                    donate=donate)
+                with M.span("serving/decode_dispatch"):
+                    nxt, self._pos, kc, vc = ex(*args)
+                ok = True
+            except BaseException as e:
+                # the dispatch never ran (chaos injects BEFORE the
+                # call; a compile error dies before donation), so the
+                # device state is intact — undo the inflight marks and
+                # either absorb (retry next step / supervisor restart)
+                # or propagate
+                for req in snapshot.values():
+                    req.inflight -= 1
+                if not self._absorb_decode_failure(e):
+                    raise
+            if ok:
+                pool.rebind(kc, vc)
+                self._toks = nxt
+                M.decode_steps += 1
+                self._decode_fail_streak = 0
+                if sync:
+                    self._harvest([("decode", nxt, snapshot)])
+                else:
+                    self._pending.append(("decode", nxt, snapshot))
 
-        with M.span("serving/harvest"):
-            self._harvest(prev)
+        if epoch == self._restart_epoch:
+            with M.span("serving/harvest"):
+                self._harvest(prev)
+        # else: a supervisor restart happened this step — `prev`
+        # belongs to the pre-restart schedule; its requests were
+        # re-queued with inflight reset, and greedy replay regenerates
+        # every unread token bit-exactly
 
         M.queue_depth = len(sch.queue)
-        M.slot_occupancy = pool.occupancy
+        M.slot_occupancy = self.pool.occupancy
         return sch.pending or bool(self._pending)
 
     def _health_tick(self, wall_s):
@@ -918,7 +1164,7 @@ class ServingEngine:
         hits = int(k[12]._value)
         misses = int(k[13]._value)
         queue = self.scheduler.queue
-        self.health.observe({
+        fired = self.health.observe({
             "step": step,
             "t": time.time(),
             "wall_s": wall_s,
@@ -954,6 +1200,10 @@ class ServingEngine:
             "conservation_ok": conservation_ok,
             "conservation_error": conservation_error,
         })
+        if fired and self.supervisor is not None:
+            # the observatory's wedge verdicts are the supervisor's
+            # restart triggers — this is PR 8's loop, closed
+            self.supervisor.consider(fired)
 
     def _triage(self):
         """Apply the admission policy to the queue (scheduler does the
@@ -981,6 +1231,10 @@ class ServingEngine:
         width claim their slot here but dispatch chunk by chunk in
         ``_dispatch_chunks`` instead of joining a group."""
         sch, pool, M = self.scheduler, self.pool, self.metrics
+        if self.chaos is not None \
+                and self.chaos.fires("block_exhaustion",
+                                     step=self._step_id + 1):
+            return          # simulated dry pool: admission waits
         with M.span("serving/admit"):
             groups, chunked = sch.admit_chunked(pool, self.group_sizes,
                                                 self.chunk_len)
@@ -988,13 +1242,14 @@ class ServingEngine:
 
         for gi, group in enumerate(groups):
             G = len(group)
-            bucket = sch.bucket_for(len(group[0][0].prompt))
+            bucket = sch.bucket_for(len(group[0][0].prefill_ids))
             tokens = np.zeros((G, bucket), np.int32)
             lengths = np.zeros((G,), np.int32)
             slots = np.zeros((G,), np.int32)
             for g, (req, slot) in enumerate(group):
-                n = len(req.prompt)
-                tokens[g, :n] = req.prompt
+                ids = req.prefill_ids   # prompt (+ replayed tokens)
+                n = len(ids)
+                tokens[g, :n] = ids
                 lengths[g] = n
                 slots[g] = slot
                 req.inflight += 1
@@ -1006,6 +1261,9 @@ class ServingEngine:
                 from .sched import SlotSampler
                 args = args + SlotSampler.gather([r for r, _ in group])
             try:
+                if self.chaos is not None:
+                    self.chaos.maybe_raise("prefill_dispatch",
+                                           step=self._step_id + 1)
                 ex = self._compiled(("prefill", bucket, G),
                                     self._prefill_fn, args,
                                     donate=(5, 6, 7))
@@ -1013,11 +1271,13 @@ class ServingEngine:
                     for req, _slot in group:
                         self.flight.prefill_dispatched(req, bucket, G)
                     first, self._toks, self._pos, kc, vc = ex(*args)
-            except BaseException:
+            except BaseException as e:
                 for req, _slot in group:
                     req.inflight -= 1
                 sch.rollback_admission(
                     [r for g in groups[gi:] for r, _ in g], pool)
+                if self._absorb_dispatch_failure(e, "prefill", group):
+                    return   # rolled back; the retry runs next step
                 raise
             pool.rebind(kc, vc)
             # admission accounting lands only once the dispatch stuck:
@@ -1046,6 +1306,10 @@ class ServingEngine:
         requeued) without poisoning the cache."""
         sch, pool, M = self.scheduler, self.pool, self.metrics
         while True:
+            if self.chaos is not None \
+                    and self.chaos.fires("block_exhaustion",
+                                         step=self._step_id + 1):
+                break       # simulated dry pool: admission waits
             with M.span("serving/admit"):
                 admission = sch.admit_paged(pool, self.chunk_len)
             if admission is None:
@@ -1060,10 +1324,11 @@ class ServingEngine:
                 # still waits for the FINAL chunk's dispatch success
                 self._register_chunked([(req, alloc.slot)], alloc)
                 continue
+            ids = req.prefill_ids   # prompt (+ replayed tokens)
             start = alloc.prefix_tokens
-            tail = len(req.prompt) - start
+            tail = len(ids) - start
             tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :tail] = req.prompt[start:]
+            tokens[0, :tail] = ids[start:]
             args = (self.params, tokens, np.int32(tail),
                     np.int32(start), np.int32(alloc.slot),
                     np.int32(1), pool.table_row(alloc.slot),
@@ -1072,6 +1337,9 @@ class ServingEngine:
                 args = args + self._samp_scalars(req)
             req.inflight += 1
             try:
+                if self.chaos is not None:
+                    self.chaos.maybe_raise("prefill_dispatch",
+                                           step=self._step_id + 1)
                 ex = self._compiled(("paged_prefill", bucket),
                                     self._prefill_fn, args,
                                     donate=(8, 9, 10))
@@ -1080,12 +1348,15 @@ class ServingEngine:
                         self.flight.prefix_hit(req, start, tail)
                     self.flight.prefill_dispatched(req, bucket, 1)
                     first, self._toks, self._pos, kc, vc = ex(*args)
-            except BaseException:
+            except BaseException as e:
                 req.inflight -= 1
                 sch.rollback_admission([req], pool)
+                if self._absorb_dispatch_failure(
+                        e, "prefill", [(req, alloc.slot)]):
+                    return   # rolled back; the retry runs next step
                 raise
             pool.rebind(kc, vc)
-            pool.commit_prefix(alloc.slot, req.prompt)
+            pool.commit_prefix(alloc.slot, ids)
             M.record_admission(req)
             M.requests_admitted += 1
             M.prefills += 1
@@ -1141,7 +1412,7 @@ class ServingEngine:
             if clen > budget:
                 break           # FIFO: never skip ahead past the head
             tokens = np.zeros((1, C), np.int32)
-            tokens[0, :clen] = req.prompt[start:start + clen]
+            tokens[0, :clen] = plan.ids[start:start + clen]
             if self.paged:
                 args = (self.params, tokens, np.int32(clen),
                         np.int32(start), np.int32(plan.slot),
@@ -1162,24 +1433,31 @@ class ServingEngine:
             if final:
                 req.inflight += 1
             try:
+                if self.chaos is not None:
+                    self.chaos.maybe_raise("chunk_dispatch",
+                                           step=self._step_id + 1,
+                                           chunk=plan.next)
                 ex = self._compiled(key, fn, args, donate=donate)
                 with M.span("serving/chunk_dispatch"):
                     if plan.next == 0 and plan.start0:
                         self.flight.prefix_hit(
                             req, plan.start0,
-                            len(req.prompt) - plan.start0)
+                            len(plan.ids) - plan.start0)
                     self.flight.prefill_chunk(req, plan.next, start,
                                               clen, final)
                     if final:
                         self.flight.prefill_dispatched(req, C, 1)
                     first, self._toks, self._pos, kc, vc = ex(*args)
-            except BaseException:
+            except BaseException as e:
                 if final:
                     req.inflight -= 1
                 self._chunk_q.remove(plan)
                 self._prefilling.discard(plan.slot)
                 sch.rollback_admission([req], pool)
-                raise
+                if self._absorb_dispatch_failure(
+                        e, "chunk", [(req, plan.slot)]):
+                    return   # rolled back (all chunk progress voided;
+                raise        # the retry re-plans from the queue)
             pool.rebind(kc, vc)
             M.record_prefill_chunk(clen)
             budget -= clen
@@ -1188,7 +1466,7 @@ class ServingEngine:
                 self._chunk_q.pop(0)
                 self._prefilling.discard(plan.slot)
                 if self.paged:
-                    pool.commit_prefix(plan.slot, req.prompt)
+                    pool.commit_prefix(plan.slot, plan.ids)
                     M.record_prefix_reuse(plan.start0, 0)
                 M.record_admission(req)
                 M.requests_admitted += 1
@@ -1199,6 +1477,200 @@ class ServingEngine:
                     self._harvest([entry])
                 else:
                     self._pending.append(entry)
+
+    # ------------------------------------------------------ resilience
+
+    def _retryable(self, exc):
+        """Whether a failed dispatch/transfer may be absorbed by the
+        bounded-retry machinery: the engine must be hardened
+        (max_dispatch_retries > 0) and the failure an ordinary
+        Exception (KeyboardInterrupt & friends always propagate).
+        Unhardened engines keep the PR-6 behavior bit-for-bit: roll
+        back, then raise."""
+        return self.max_dispatch_retries > 0 \
+            and isinstance(exc, Exception)
+
+    def _absorb_dispatch_failure(self, exc, kind, pairs):
+        """Account a rolled-back prefill/chunk dispatch failure and
+        decide its fate: True = absorbed (requests are back in the
+        queue; retry next step, minus any whose budget ran out — those
+        retire with reason "error"), False = caller re-raises. Also
+        drives slot quarantine: the slot(s) the failed dispatch wrote
+        through accumulate failure counts, and a slot that keeps
+        failing is excluded from admission so one bad lane cannot eat
+        every retry budget in the queue."""
+        M = self.metrics
+        M.record_dispatch_failure(kind)
+        for req, slot in pairs:
+            req.dispatch_failures += 1
+            self.flight.dispatch_failed(req, kind, exc)
+            self._slot_failures[slot] = \
+                self._slot_failures.get(slot, 0) + 1
+        if not self._retryable(exc):
+            return False
+        for req, slot in pairs:
+            self._maybe_quarantine(slot)
+            if req.dispatch_failures > self.max_dispatch_retries:
+                self._abort_request(req, "error")
+            else:
+                M.record_retry()
+        if self.retry_backoff_s > 0:
+            worst = max(r.dispatch_failures for r, _ in pairs)
+            self._retry_at = time.perf_counter() \
+                + self.retry_backoff_s * (2 ** (worst - 1))
+        return True
+
+    def _absorb_decode_failure(self, exc):
+        """The pooled decode dispatch failed. It advances EVERY slot,
+        so the failure is not attributable to one request: the engine
+        retries the whole step up to the budget, then escalates to
+        the supervisor (repeated dispatch failure IS the wedge the
+        in-process restart exists for). False = re-raise."""
+        M = self.metrics
+        M.record_dispatch_failure("decode")
+        self._decode_fail_streak += 1
+        if not self._retryable(exc):
+            return False
+        if self._decode_fail_streak <= self.max_dispatch_retries:
+            M.record_retry()
+            if self.retry_backoff_s > 0:
+                self._retry_at = time.perf_counter() \
+                    + self.retry_backoff_s \
+                    * (2 ** (self._decode_fail_streak - 1))
+            return True
+        if self.supervisor is not None and self.supervisor.trigger(
+                "dispatch_failure",
+                {"detector": "dispatch_failure",
+                 "streak": self._decode_fail_streak,
+                 "error": f"{type(exc).__name__}: {exc}"[:200]}):
+            return True
+        return False
+
+    def _maybe_quarantine(self, slot):
+        """Quarantine ``slot`` once its failure count reaches the
+        threshold — unless it is the last admissible slot (a fully
+        quarantined pool would deadlock the queue; the supervisor's
+        pool rebuild is the reset path)."""
+        if self._slot_failures.get(slot, 0) < self.config.quarantine_after:
+            return
+        pool = self.pool
+        if slot in pool.quarantined:
+            return
+        admissible = pool.num_slots - len(pool.quarantined)
+        if admissible <= 1:
+            return
+        pool.quarantine(slot)
+        self.metrics.record_quarantine()
+        self._slot_failures.pop(slot, None)
+
+    def _abort_request(self, req, reason):
+        """Retire a request that exhausted its retry budget (it is
+        already rolled back into the queue): counted, flight-closed,
+        zero further tokens."""
+        self.scheduler.abort(req, self.pool)
+        self.metrics.record_abort()
+        self.flight.retired(req, reason)
+        if self.supervisor is not None:
+            self.supervisor.note_completion(req.rid)
+
+    def _expire_deadlines(self):
+        """Retire requests past their ``deadline_ms`` (queued or
+        actively decoding): timeout-counted, SLO-judged as violations,
+        flight-retired with reason "deadline"."""
+        now = time.perf_counter()
+        expired_q, expired_a = self.scheduler.expire_deadlines(
+            self.pool, prefilling=self._prefilling, now=now)
+        for req in expired_q + expired_a:
+            self.metrics.record_timeout()
+            over = (now - req.t_arrival) * 1000.0 - req.deadline_ms
+            self.flight.deadline_exceeded(req, over)
+            self.flight.retired(req, "deadline",
+                                slo_violations=["deadline"])
+            if self.supervisor is not None:
+                self.supervisor.note_completion(req.rid)
+
+    def _supervisor_restart(self, reason):
+        """In-process recovery (called ONLY by the supervisor): drop
+        every piece of suspect state — in-flight device results, both
+        pools' bookkeeping, the AOT executable table, per-slot failure
+        tallies — and re-queue every request still owed tokens for a
+        re-prefill of its prompt + already-emitted tokens. Greedy
+        decoding makes the replay continuation bit-exact; on paged
+        pools the (rebuilt-empty) radix index re-warms as replays
+        commit, so sibling requests sharing a prefix soften each
+        other's recompute. Returns the re-queued requests; the whole
+        recovery runs under a ``serving/supervisor_restart`` span and
+        increments ``supervisor_restarts_total``."""
+        M = self.metrics
+        with M.span("serving/supervisor_restart"):
+            sch = self.scheduler
+            owed = {}
+            for r in sch.active.values():
+                owed[r.rid] = r
+            for plan in self._chunk_q:
+                owed.setdefault(plan.req.rid, plan.req)
+            for entry in self._pending:
+                coll = entry[2]
+                rs = coll.values() if isinstance(coll, dict) \
+                    else [r for r, _ in coll]
+                for r in rs:
+                    if r.state == RUNNING:  # prereleased finals too
+                        owed.setdefault(r.rid, r)
+            replayed = sorted(owed.values(), key=lambda r: r.rid)
+            # unread device results are DISCARDED, not harvested: the
+            # tokens they carry were never surfaced, and the greedy
+            # replay regenerates them bit-exactly from clean state
+            self._pending = []
+            self._chunk_q = []
+            self._prefilling.clear()
+            sch.active.clear()
+            self.pool = self._pool_factory()
+            if self.paged:
+                M.set_prefix_pool(self.pool.stats)
+            import jax.numpy as jnp
+            self._toks = jnp.zeros((self.config.num_slots,), jnp.int32)
+            self._pos = jnp.zeros((self.config.num_slots,), jnp.int32)
+            # rebuild the AOT table from scratch; the rebuild compiles
+            # land under a reopened warmup (the supervisor re-declares
+            # once the replay drains), so "zero steady-state compiles
+            # outside supervisor restarts" stays a checkable invariant
+            self._exec = {}
+            self.watchdog.reopen_warmup()
+            self._slot_failures.clear()
+            self._decode_fail_streak = 0
+            self._retry_at = 0.0
+            self._restart_epoch += 1
+            for req in reversed(replayed):
+                req.slot = None
+                req.state = QUEUED
+                req.t_admitted = None
+                req.inflight = 0
+                req.dispatch_failures = 0
+                sch.queue.appendleft(req)
+                self.flight.requeued(req, reason)
+            M.record_restart()
+        return replayed
+
+    def _resilience_state(self):
+        """The live half of ``snapshot()["resilience"]``."""
+        sup = self.supervisor
+        return {
+            "quarantined_slots": list(self.pool.quarantined),
+            "draining": self._draining,
+            "supervisor": sup.report() if sup is not None
+            else {"enabled": False},
+            "chaos": self.chaos.report() if self.chaos is not None
+            else {"enabled": False},
+        }
+
+    def _health_resilience(self):
+        """The replica-posture facts ``/debug/health`` folds in."""
+        sup = self.supervisor
+        return {
+            "degraded": sup.degraded if sup is not None else False,
+            "draining": self._draining,
+            "restarts": sup.restarts if sup is not None else 0,
+        }
 
     def run(self):
         """Drain the queue: step until every submitted request is done.

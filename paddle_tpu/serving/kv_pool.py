@@ -44,6 +44,7 @@ class SlotKVPool:
         self.vc = jnp.zeros(shape, dtype)
         self._free = list(range(self.num_slots))  # heap: lowest first
         self._owner = {}                          # slot -> request id
+        self._quarantined = set()  # excluded from admission (resilience)
         self.reuse_count = 0   # acquisitions of a previously-used slot
         self._ever_used = set()
 
@@ -53,8 +54,33 @@ class SlotKVPool:
 
     @property
     def occupancy(self):
-        """Fraction of slots currently owned by live requests."""
-        return 1.0 - len(self._free) / self.num_slots
+        """Fraction of slots currently owned by live requests
+        (quarantined slots are neither free nor occupied)."""
+        return len(self._owner) / self.num_slots
+
+    @property
+    def quarantined(self):
+        """Slots excluded from admission (sorted)."""
+        return sorted(self._quarantined)
+
+    def quarantine(self, slot):
+        """Exclude a FREE slot from future admission (the engine's
+        repeated-same-slot-failure response). Raises when the slot is
+        live — quarantine happens after rollback released it."""
+        if slot in self._owner:
+            raise ValueError(f"slot {slot} is live; release it first")
+        if slot in self._quarantined:
+            return
+        self._free.remove(slot)
+        heapq.heapify(self._free)
+        self._quarantined.add(slot)
+
+    def unquarantine_all(self):
+        """Return every quarantined slot to the free heap (supervisor
+        restart / operator reset)."""
+        for slot in sorted(self._quarantined):
+            heapq.heappush(self._free, slot)
+        self._quarantined.clear()
 
     def acquire(self, owner):
         """Claim the lowest free slot for ``owner``; None when full."""

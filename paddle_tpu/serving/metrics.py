@@ -162,6 +162,42 @@ class ServingMetrics:
             "serving_scheduler_policy",
             "active scheduling policy (the labeled policy reads 1)",
             labelnames=("scheduler_policy",))
+        # resilience accounting (serving.resilience): dispatch
+        # failures by seam, retry absorptions, deadline timeouts,
+        # aborts, caught callback errors, quarantined slots, injected
+        # chaos faults by site, and supervisor recoveries
+        self._c_dispatch_failures = r.counter(
+            "serving_dispatch_failures_total",
+            "dispatch attempts that raised (rolled back, then retried "
+            "or escalated)", labelnames=("kind",))
+        self._c_retries = r.counter(
+            "serving_dispatch_retries_total",
+            "failed dispatches absorbed by the bounded-retry budget")
+        self._c_timeouts = r.counter(
+            "serving_requests_timed_out_total",
+            "requests retired at their deadline_ms (SLO-judged as "
+            "violations)")
+        self._c_aborted = r.counter(
+            "serving_requests_aborted_total",
+            "requests retired unfinished (engine close with in-flight "
+            "work, or dispatch retry budget exhausted)")
+        self._c_callback_errors = r.counter(
+            "serving_callback_errors_total",
+            "user on_token callbacks that raised (caught and counted; "
+            "the step loop kept streaming)")
+        self._c_quarantine = r.counter(
+            "serving_slots_quarantined_total",
+            "slots excluded from admission after repeated same-slot "
+            "dispatch failures")
+        self._c_faults = r.counter(
+            "serving_faults_injected_total",
+            "chaos-harness fault injections by site",
+            labelnames=("site",))
+        self._c_restarts = r.counter(
+            "supervisor_restarts_total",
+            "in-process supervisor recoveries (AOT tables rebuilt, "
+            "pools reset, in-flight requests replayed)")
+        self._resilience_fn = None
         self._sched_info = {"policy": "fifo", "prefill_chunk": None,
                             "prefill_token_budget": None}
         self._prefix_pool_stats = None
@@ -354,6 +390,66 @@ class ServingMetrics:
             chunked_requests=int(self._c_chunked_reqs.value),
         )
 
+    # ------------------------------------------------------- resilience
+    def record_dispatch_failure(self, kind):
+        self._c_dispatch_failures.labels(str(kind)).inc()
+
+    def record_retry(self):
+        self._c_retries.inc()
+
+    def record_timeout(self):
+        """One request retired at its deadline: counted here AND
+        SLO-judged as a violation (dimension "deadline", zero goodput)
+        — a timed-out answer is worth nothing to its caller, so
+        timeouts must never inflate attainment."""
+        self._c_timeouts.inc()
+        self.slo.observe_shed("deadline")
+
+    def record_abort(self):
+        self._c_aborted.inc()
+
+    def record_callback_error(self):
+        self._c_callback_errors.inc()
+
+    def record_quarantine(self):
+        self._c_quarantine.inc()
+
+    def record_fault(self, site):
+        self._c_faults.labels(str(site)).inc()
+
+    def record_restart(self):
+        self._c_restarts.inc()
+
+    def set_resilience(self, state_fn):
+        """Attach the engine's live resilience state (quarantined
+        slots, draining flag, supervisor + chaos reports) as the pull
+        source for ``snapshot()["resilience"]``."""
+        self._resilience_fn = state_fn
+
+    def resilience_report(self):
+        """The ``snapshot()["resilience"]`` section: failure/retry/
+        timeout/abort counters plus the engine's live quarantine,
+        supervisor and chaos state."""
+        fails = {labels[0]: int(child.value) for labels, child
+                 in self._c_dispatch_failures.series()}
+        faults = {labels[0]: int(child.value) for labels, child
+                  in self._c_faults.series()}
+        state = self._resilience_fn() if self._resilience_fn is not None \
+            else {"quarantined_slots": [], "draining": False,
+                  "supervisor": {"enabled": False},
+                  "chaos": {"enabled": False}}
+        return dict({
+            "dispatch_failures": fails,
+            "dispatch_failures_total": sum(fails.values()),
+            "dispatch_retries": int(self._c_retries.value),
+            "requests_timed_out": int(self._c_timeouts.value),
+            "requests_aborted": int(self._c_aborted.value),
+            "callback_errors": int(self._c_callback_errors.value),
+            "slots_quarantined_total": int(self._c_quarantine.value),
+            "faults_injected": faults,
+            "supervisor_restarts": int(self._c_restarts.value),
+        }, **state)
+
     def record_admission(self, request):
         """Queue-wait accounting at slot-claim time (the scheduler
         stamps request.t_admitted in admit())."""
@@ -498,4 +594,5 @@ class ServingMetrics:
             "prefix_cache": self.prefix_cache_report(),
             "scheduler": self.scheduler_report(),
             "health": self.health_report(),
+            "resilience": self.resilience_report(),
         }

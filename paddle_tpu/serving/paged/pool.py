@@ -97,6 +97,7 @@ class PagedKVPool:
         # slot state (mirrors SlotKVPool's deterministic allocator)
         self._free_slots = list(range(self.num_slots))
         self._owner = {}
+        self._quarantined = set()
         self._slot_blocks = {}
         self.reuse_count = 0
         self._ever_used = set()
@@ -113,7 +114,31 @@ class PagedKVPool:
 
     @property
     def occupancy(self):
-        return 1.0 - len(self._free_slots) / self.num_slots
+        """Fraction of slots owned by live requests (quarantined
+        slots are neither free nor occupied)."""
+        return len(self._owner) / self.num_slots
+
+    @property
+    def quarantined(self):
+        """Slots excluded from admission (sorted)."""
+        return sorted(self._quarantined)
+
+    def quarantine(self, slot):
+        """Exclude a FREE slot from future admission (same contract
+        as SlotKVPool.quarantine; the slot's table row already points
+        at trash, so no blocks are pinned by a quarantined slot)."""
+        if slot in self._owner:
+            raise ValueError(f"slot {slot} is live; release it first")
+        if slot in self._quarantined:
+            return
+        self._free_slots.remove(slot)
+        heapq.heapify(self._free_slots)
+        self._quarantined.add(slot)
+
+    def unquarantine_all(self):
+        for slot in sorted(self._quarantined):
+            heapq.heappush(self._free_slots, slot)
+        self._quarantined.clear()
 
     @property
     def slot_capacity(self):

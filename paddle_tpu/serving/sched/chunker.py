@@ -25,19 +25,24 @@ prompt length.
 
 
 class ChunkPlan:
-    """One request's remaining chunked-prefill schedule."""
+    """One request's remaining chunked-prefill schedule.
 
-    __slots__ = ("req", "slot", "starts", "next", "chunk", "start0",
-                 "alloc")
+    Plans over ``req.prefill_ids`` — the prompt plus any tokens a
+    supervisor-restart replay already emitted — snapshotted at plan
+    time so the chunk windows stay stable while the plan drains."""
+
+    __slots__ = ("req", "slot", "ids", "starts", "next", "chunk",
+                 "start0", "alloc")
 
     def __init__(self, req, slot, start0, chunk, alloc=None):
-        n = len(req.prompt)
         self.req = req
         self.slot = slot
+        self.ids = req.prefill_ids
         self.chunk = int(chunk)
         self.start0 = int(start0)       # cached-prefix end (paged)
         self.alloc = alloc              # PagedAllocation (paged pool)
-        self.starts = plan_chunks(self.start0, n, self.chunk)
+        self.starts = plan_chunks(self.start0, len(self.ids),
+                                  self.chunk)
         self.next = 0                   # index of the next chunk
 
     @property
@@ -51,7 +56,7 @@ class ChunkPlan:
     def peek(self):
         """(start, length, final) of the next chunk to dispatch."""
         start = self.starts[self.next]
-        n = len(self.req.prompt)
+        n = len(self.ids)
         return start, min(self.chunk, n - start), self.final_is_next
 
     def advance(self):

@@ -37,7 +37,7 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens, eos_id=None,
                  on_token=None, temperature=0.0, top_k=0, top_p=1.0,
-                 seed=None):
+                 seed=None, deadline_ms=None):
         self.rid = next(_rid)
         self.prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
         if self.prompt.size == 0:
@@ -59,10 +59,20 @@ class Request:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         self.seed = self.rid if seed is None else int(seed)
         self.sampled = self.temperature > 0.0 and self.top_k != 1
+        # end-to-end deadline: past t_arrival + deadline_ms the engine
+        # retires the request ("deadline" stop reason, SLO-judged as a
+        # violation) instead of spending capacity on an answer nobody
+        # is waiting for. None = no deadline (prior behavior).
+        self.deadline_ms = None if deadline_ms is None \
+            else float(deadline_ms)
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
         self.state = QUEUED
         self.slot = None
         self.generated = []
         self.inflight = 0   # tokens dispatched on device, not yet read
+        self.dispatch_failures = 0  # dispatch attempts that raised
         # scheduling-policy facts: deferred-once flag (SLO-feedback
         # "defer" mode) and the shed reason when load-shedding dropped
         # the request before admission (done with zero tokens)
@@ -91,6 +101,32 @@ class Request:
         """Cache position the NEXT decode step writes at: the last
         emitted token goes in at prompt_len + len(generated) - 1."""
         return len(self.prompt) + len(self.generated) - 1
+
+    @property
+    def prefill_ids(self):
+        """What a (re-)prefill must cover: the prompt plus every token
+        already emitted. Identical to ``prompt`` for a fresh request;
+        after a supervisor restart re-queues an in-flight request, the
+        replay prefills this whole prefix in one pass (greedy decoding
+        makes the continuation bit-exact) instead of losing the
+        generated tokens already streamed to the caller."""
+        if not self.generated:
+            return self.prompt
+        return np.concatenate(
+            [self.prompt, np.asarray(self.generated, np.int64)])
+
+    @property
+    def cache_tokens(self):
+        """Total cache rows the request can ever need (prompt +
+        max_new) — invariant under restart replay, where prefill_ids
+        already contains emitted tokens."""
+        return len(self.prompt) + self.max_new_tokens
+
+    def past_deadline(self, now=None):
+        if self.deadline_ms is None:
+            return False
+        now = time.perf_counter() if now is None else now
+        return (now - self.t_arrival) * 1000.0 > self.deadline_ms
 
 
 class StepScheduler:
@@ -212,13 +248,16 @@ class StepScheduler:
             req.state = RUNNING
             req.t_admitted = time.perf_counter()
             self.active[slot] = req
-            if chunk_len is not None and len(req.prompt) > chunk_len:
+            # prefill_ids (not prompt): a restart-replayed request
+            # re-prefills its prompt PLUS already-emitted tokens
+            n_fill = len(req.prefill_ids)
+            if chunk_len is not None and n_fill > chunk_len:
                 chunked.append((req, slot))
                 if self.flight is not None:
                     # chunked prefills dispatch at the chunk width
                     self.flight.admitted(req, slot, int(chunk_len), 1)
                 continue
-            by_bucket.setdefault(self.bucket_for(len(req.prompt)),
+            by_bucket.setdefault(self.bucket_for(n_fill),
                                  []).append((req, slot))
         groups = []
         for bucket, members in by_bucket.items():
@@ -280,8 +319,9 @@ class StepScheduler:
         if not self.queue:
             return None
         req = self.queue[0]
-        n = len(req.prompt)
-        cached = pool.match_prefix(req.prompt)
+        ids = req.prefill_ids   # prompt (+ replayed tokens, restart)
+        n = len(ids)
+        cached = pool.match_prefix(ids)
         bs = pool.block_size
         raw = min(int(cached), n - 1)
         raw -= raw % bs
@@ -291,8 +331,7 @@ class StepScheduler:
             start, bucket = self.plan_prefix(
                 n, cached, bs, pool.slot_capacity)
             chunked = False
-        alloc = pool.acquire(req.rid, req.prompt,
-                             n + req.max_new_tokens, start)
+        alloc = pool.acquire(req.rid, ids, req.cache_tokens, start)
         if alloc is None:
             return None
         self.queue.popleft()
@@ -325,6 +364,47 @@ class StepScheduler:
             self.queue.appendleft(req)
             if self.flight is not None:
                 self.flight.admission_rolled_back(req)
+
+    def abort(self, request, pool):
+        """Retire ``request`` unfinished, with no further tokens: a
+        queued request leaves the queue, a running one frees its slot
+        (the paged pool also derefs its blocks). State/timestamps land
+        as a normal retirement so completed-ring readers see one
+        coherent record; the ENGINE owns the abort accounting (reason
+        counter + flight retirement) like every other retirement
+        flavor."""
+        if request.slot is not None and request.slot in self.active:
+            pool.release(request.slot)
+            del self.active[request.slot]
+            request.slot = None
+        try:
+            self.queue.remove(request)
+        except ValueError:
+            pass
+        request.state = DONE
+        request.t_done = time.perf_counter()
+        self.completed.append(request)
+
+    def expire_deadlines(self, pool, prefilling=(), now=None):
+        """Retire requests past their ``deadline_ms``: queued ones
+        (never admitted, zero tokens) and running ones that are
+        actively decoding (first token already harvested — requests
+        mid-prefill or parked in ``prefilling`` are skipped; their
+        in-flight prefill must land first, and they expire on a later
+        step). Returns ``(expired_queued, expired_active)``; the
+        engine stamps the timeout counters / SLO verdicts / flight
+        retirements. A retired decode's still-in-flight token is
+        masked at harvest exactly like an EOS stop (state != RUNNING)."""
+        now = time.perf_counter() if now is None else now
+        expired_q = [r for r in self.queue if r.past_deadline(now)]
+        for req in expired_q:
+            self.abort(req, pool)
+        expired_a = [r for slot, r in sorted(self.active.items())
+                     if r.generated and slot not in prefilling
+                     and r.past_deadline(now)]
+        for req in expired_a:
+            self.finish(req, pool)
+        return expired_q, expired_a
 
     def queue_age_s(self, now=None):
         """Seconds the HEAD of the queue has been waiting (0.0 when

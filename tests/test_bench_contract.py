@@ -196,6 +196,41 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
             assert sec["watchdog"]["warmed"] is True
         assert last["overload_goodput_x"] == \
             ovl["goodput_improvement"]
+        # PR 9 chaos scenario: identical traffic + identical seeded
+        # fault schedule, hardened vs unhardened. The acceptance bars:
+        # the hardened engine completes >= 95% of requests bit-exact
+        # with the unfaulted reference (parity through rollback /
+        # retry / supervisor restart), leaks zero slots/blocks with
+        # the conservation audit passing after every recovery
+        # (health_audit_every=1), and shows zero steady-state compiles
+        # outside supervisor restarts — while the unhardened baseline
+        # demonstrably wedges AND leaks on the same seed
+        cz = evidence["chaos"]
+        assert set(cz) >= {"requests", "seed", "fault_plan",
+                           "hardened", "unhardened",
+                           "completion_rate", "parity_ok"}
+        assert cz["fault_plan"]["seed"] == cz["seed"]
+        hz = cz["hardened"]
+        assert hz["wedged"] is False
+        assert hz["completion_rate"] >= 0.95, hz
+        assert cz["completion_rate"] == hz["completion_rate"]
+        assert hz["parity_ok"] is True and cz["parity_ok"] is True
+        assert sum(hz["faults_injected"].values()) > 0   # chaos ran
+        assert hz["slots_leaked"] == 0
+        assert hz["live_blocks_at_idle"] == 0
+        assert hz["conservation_ok"] is True
+        # the deterministic decode-failure burst forces at least one
+        # supervisor recovery, and steady state stays compile-free
+        # outside the restart's reopened warmup window
+        assert hz["supervisor_restarts"] >= 1
+        assert hz["steady_state_new_compiles"] == 0
+        assert hz["health"]["detectors"]["kv_block_leak"] == 0
+        assert hz["health"]["restarts"] == hz["supervisor_restarts"]
+        uz = cz["unhardened"]
+        assert uz["wedged"] is True and uz["error"]
+        assert uz["completion_rate"] < hz["completion_rate"]
+        assert uz["slots_leaked"] > 0 or uz["live_blocks_leaked"] > 0
+        assert last["chaos_completion_rate"] == cz["completion_rate"]
         # PR 8 health observatory: a clean smoke bench must fire ZERO
         # anomalies across every scenario engine (the false-positive
         # acceptance bar), the per-scenario rollups must be present,

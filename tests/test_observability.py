@@ -408,7 +408,7 @@ _SNAPSHOT_KEYS = {
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
-    "scheduler", "health",
+    "scheduler", "health", "resilience",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",
@@ -421,6 +421,16 @@ _PCT_KEYS = {"count", "p50_ms", "p90_ms", "p99_ms"}
 _HEALTH_KEYS = {
     "enabled", "healthy", "anomalies_total", "detectors",
     "incidents_written", "last_incident", "ledger_steps",
+    "degraded", "draining", "restarts",
+}
+# the PR-9 resilience section: failure/retry/timeout/abort counters +
+# quarantine, supervisor and chaos state (same key set hardened or not)
+_RESILIENCE_KEYS = {
+    "dispatch_failures", "dispatch_failures_total", "dispatch_retries",
+    "requests_timed_out", "requests_aborted", "callback_errors",
+    "slots_quarantined_total", "faults_injected",
+    "supervisor_restarts", "quarantined_slots", "draining",
+    "supervisor", "chaos",
 }
 
 
@@ -448,12 +458,28 @@ def test_serving_snapshot_schema_contract():
         "goodput_collapse", "kv_block_leak", "queue_stall",
         "steady_state_compile", "step_time_spike"}
     assert health["ledger_steps"] > 0
+    # the PR-9 resilience section: schema + clean-run zeros + the
+    # supervisor enabled by default alongside the observatory
+    res = snap["resilience"]
+    assert set(res) == _RESILIENCE_KEYS
+    assert res["dispatch_failures_total"] == 0
+    assert res["requests_timed_out"] == 0
+    assert res["requests_aborted"] == 0
+    assert res["callback_errors"] == 0
+    assert res["quarantined_slots"] == []
+    assert res["draining"] is False
+    assert res["supervisor"]["enabled"] is True
+    assert res["supervisor"]["restarts"] == 0
+    assert res["chaos"] == {"enabled": False}   # chaos is opt-in
     # health=False keeps the SAME key shape (schema contract holds)
     eng_off = ServingEngine(m, num_slots=2, bucket_min=8, health=False)
     _drive(eng_off, np.random.RandomState(1), [(4, 3)])
     off = eng_off.metrics.snapshot()["health"]
     assert set(off) == _HEALTH_KEYS
     assert off["enabled"] is False and off["ledger_steps"] == 0
+    off_res = eng_off.metrics.snapshot()["resilience"]
+    assert set(off_res) == _RESILIENCE_KEYS
+    assert off_res["supervisor"] == {"enabled": False}
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Seeded chaos sweep over the serving engine: N seeds x fault kinds,
+exit nonzero on any leak / hang / parity break.
+
+Every cell of the matrix runs the SAME smoke workload on a hardened
+engine (bounded retry + supervisor) under one armed fault site (plus
+an "all" cell arming the default mix), then verifies the contract the
+resilience layer owes:
+
+  * **no hang** — the drain finishes within a step budget;
+  * **no leak** — every slot free afterwards, and on the paged pool a
+    full ``check_conservation()`` audit passes;
+  * **parity** — every completed request's token stream is bit-exact
+    with the unfaulted reference drain (greedy replay correctness
+    through rollback, retry and supervisor restart);
+  * **determinism** — the cell is re-run at the same seed and must
+    reproduce the identical fault log and streams.
+
+Output: one JSON line per cell plus a summary line; exit 1 on any
+failure (the CI gate). Tier-1 self-runs ``--fast`` (one seed, both
+pools) via tests/test_resilience.py; a nightly can widen ``--seeds``.
+
+Usage: python tools/chaos_sweep.py [--seeds N] [--fast] [--paged 0|1]
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the per-site arming each cell uses: rates high enough that every
+# recovery path actually runs during a ~40-request smoke drain
+_SITE_RATES = {
+    "prefill_dispatch": 0.25,
+    "chunk_dispatch": 0.25,
+    "decode_dispatch": 0.10,
+    "transfer": 0.10,
+    "block_exhaustion": 0.15,
+    "callback": 0.30,
+    "step_latency": {"rate": 0.05, "latency_s": 0.001},
+}
+_MAX_STEPS = 3000      # hang budget: a clean drain needs ~100 steps
+
+
+def _build_model():
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models import (GPTForCausalLM,
+                                        TransformerLMConfig)
+    paddle.seed(11)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _workload(n_requests=16):
+    import numpy as np
+    rs = np.random.RandomState(5)
+    lengths = rs.randint(3, 20, n_requests)
+    return [(rs.randint(0, 97, (int(n),)).astype(np.int64),
+             int(rs.randint(3, 8))) for n in lengths]
+
+
+def _drain(model, specs, paged, chaos=None, chunk=None):
+    """One engine drain; returns (streams, engine, steps, fault_log)."""
+    from paddle_tpu.serving import ServingEngine
+    eng = ServingEngine(
+        model, num_slots=4, bucket_min=8, paged=paged,
+        prefill_chunk=chunk, chaos=chaos, max_dispatch_retries=3,
+        supervisor_cooldown_s=0.0, health_audit_every=8)
+    reqs = [eng.add_request(p, max_new_tokens=k,
+                            on_token=lambda r, t: None)
+            for p, k in specs]
+    steps = 0
+    while eng.step():
+        steps += 1
+        if steps > _MAX_STEPS:
+            return None, eng, steps, None   # hang
+    streams = [list(r.generated) for r in reqs]
+    log = eng.chaos.fault_log() if eng.chaos is not None else None
+    return streams, eng, steps, log
+
+
+def _check_cell(site, seed, model, specs, reference, paged, chunk):
+    """Run one (site, seed) cell twice; returns a result dict with
+    ok=False and a reason on any contract break."""
+    from paddle_tpu.serving.resilience import FaultPlan
+    faults = dict(_SITE_RATES) if site == "all" \
+        else {site: _SITE_RATES[site]}
+
+    def plan():
+        return FaultPlan(seed=seed, faults=faults)
+
+    out = {"site": site, "seed": seed, "paged": paged, "ok": True}
+    streams, eng, steps, log = _drain(model, specs, paged,
+                                      chaos=plan(), chunk=chunk)
+    out["steps"] = steps
+    if streams is None:
+        return dict(out, ok=False, reason=f"hang: > {_MAX_STEPS} steps")
+    res = eng.metrics.snapshot()["resilience"]
+    out["faults"] = res["faults_injected"]
+    out["retries"] = res["dispatch_retries"]
+    out["restarts"] = res["supervisor_restarts"]
+    # leak checks: every slot free, paged block conservation intact
+    if eng.pool.free_count + len(eng.pool.quarantined) \
+            != eng.pool.num_slots:
+        return dict(out, ok=False, reason="slot leak after drain")
+    if paged:
+        try:
+            eng.pool.check_conservation()
+        except AssertionError as e:
+            return dict(out, ok=False,
+                        reason=f"block conservation: {e}")
+        if eng.pool.live_blocks > 0:
+            return dict(out, ok=False, reason="live blocks at idle")
+    # parity: completed requests match the unfaulted reference
+    bad = [i for i, (got, want) in enumerate(zip(streams, reference))
+           if got and got != want]
+    if bad:
+        return dict(out, ok=False,
+                    reason=f"parity break on requests {bad}")
+    incomplete = sum(1 for got, want in zip(streams, reference)
+                     if got != want)
+    out["incomplete"] = incomplete   # aborted-after-retries allowed,
+    if incomplete > len(specs) // 4:  # but not wholesale failure
+        return dict(out, ok=False,
+                    reason=f"{incomplete}/{len(specs)} incomplete")
+    # determinism: same seed => identical fault log and streams
+    streams2, _, _, log2 = _drain(model, specs, paged, chaos=plan(),
+                                  chunk=chunk)
+    if log2 != log:
+        return dict(out, ok=False, reason="fault log not deterministic")
+    if streams2 != streams:
+        return dict(out, ok=False, reason="streams not deterministic")
+    return out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    parser.add_argument("--seeds", type=int, default=3)
+    parser.add_argument("--fast", action="store_true",
+                        help="one seed, reduced site matrix (tier-1)")
+    parser.add_argument("--paged", type=int, choices=(0, 1),
+                        default=None,
+                        help="restrict to one pool flavor")
+    args = parser.parse_args(argv)
+
+    sites = ["prefill_dispatch", "decode_dispatch", "transfer",
+             "callback", "block_exhaustion", "chunk_dispatch", "all"]
+    seeds = [1] if args.fast else list(range(1, args.seeds + 1))
+    if args.fast:
+        sites = ["prefill_dispatch", "decode_dispatch", "chunk_dispatch",
+                 "all"]
+    pools = [False, True] if args.paged is None else [bool(args.paged)]
+
+    model = _build_model()
+    specs = _workload(12 if args.fast else 16)
+    # one long prompt so chunk_dispatch cells exercise real chunking
+    chunk = 8
+    import numpy as np
+    rs = np.random.RandomState(9)
+    specs = specs + [(rs.randint(0, 97, (28,)).astype(np.int64), 4)]
+
+    failures = 0
+    cells = 0
+    for paged in pools:
+        reference, ref_eng, _, _ = _drain(model, specs, paged,
+                                          chunk=chunk)
+        assert reference is not None, "reference drain hung"
+        for seed in seeds:
+            for site in sites:
+                if site == "block_exhaustion" and not paged:
+                    continue   # legacy pool has no block economy
+                cells += 1
+                result = _check_cell(site, seed, model, specs,
+                                     reference, paged, chunk)
+                print(json.dumps(result), flush=True)
+                if not result["ok"]:
+                    failures += 1
+    print(json.dumps({"summary": True, "cells": cells,
+                      "failures": failures}), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,6 +155,24 @@ def report_incident(bundle, tail=None, out=sys.stdout):
             events = [e.get("event") for e in t.get("events", [])]
             print(f"  rid={t.get('rid')}  last={events[-1] if events else '?'}"
                   f"  events={len(events)}", file=out)
+    chaos = bundle.get("chaos")
+    if isinstance(chaos, dict) and chaos.get("enabled"):
+        # the replay recipe: this incident was found under the fault-
+        # injection harness and reproduces from the plan's seed alone
+        plan = chaos.get("plan") or {}
+        print(f"\nCHAOS  seed={plan.get('seed')}  "
+              f"fires_total={chaos.get('fires_total')}", file=out)
+        for site, st in sorted((chaos.get("sites") or {}).items()):
+            if st.get("fires"):
+                print(f"  {site:<18} fires={st['fires']}"
+                      f"  checks={st['checks']}", file=out)
+        tail = chaos.get("fault_log_tail") or []
+        if tail:
+            print(f"  last fires: " + ", ".join(
+                f"{e.get('site')}@check{e.get('check')}"
+                for e in tail[-6:]), file=out)
+        print(f"  replay: FaultPlan(seed={plan.get('seed')}, "
+              f"faults=<plan.faults>) on the same workload", file=out)
     return 1    # an incident bundle is unhealthy by definition
 
 
@@ -162,6 +180,11 @@ def report_health(body, out=sys.stdout):
     healthy = bool(body.get("healthy"))
     print(f"HEALTH  healthy={healthy}  "
           f"anomalies_total={body.get('anomalies_total')}", file=out)
+    if body.get("degraded") or body.get("draining") \
+            or body.get("restarts"):
+        print(f"  degraded={bool(body.get('degraded'))}  "
+              f"draining={bool(body.get('draining'))}  "
+              f"restarts={body.get('restarts', 0)}", file=out)
     for name, st in sorted((body.get("detectors") or {}).items()):
         if isinstance(st, dict):
             fired = st.get("fired", 0)
