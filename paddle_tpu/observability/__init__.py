@@ -54,6 +54,13 @@ leak, steady-state compile) counted in
 ``serving_anomalies_total{detector}``, and debounced black-box
 incident bundles on disk — rolled up at ``/debug/health`` (the
 per-replica router signal) and ``/debug/ledger``.
+
+PR 10 adds the performance observatory (perf/): per-program
+device-time attribution (every AOT dispatch's measured dispatch/sync
+wall accumulated per program key — ``snapshot()["perf"]``,
+``/debug/perf``), a decode-step roofline model joined with
+``executable_cost`` into ``serving_roofline_fraction{program}``, and
+the cross-run perf ledger + ``tools/perf_diff.py`` regression gate.
 """
 from .flight import (  # noqa: F401
     FlightRecorder, RequestTrace,
@@ -62,6 +69,10 @@ from .health import (  # noqa: F401
     HealthMonitor, IncidentRecorder, LEDGER_ROW_KEYS, StepLedger,
     build_detectors, detector_names, disabled_health_summary,
     register_detector, unregister_detector,
+)
+from .perf import (  # noqa: F401
+    PERF_KEYS, PERF_PROGRAM_KEYS, ProgramPerf, disabled_perf_report,
+    format_program_key, hbm_bps_for,
 )
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, MetricsServerHandle,

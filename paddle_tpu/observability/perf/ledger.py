@@ -1,0 +1,218 @@
+"""Cross-run perf ledger: one normalized JSONL row per
+(scenario, metric) per bench run, plus the robust comparison logic
+``tools/perf_diff.py`` gates CI with.
+
+``bench_artifacts/`` holds a dozen serving artifacts no tool compares;
+this ledger is the durable, append-only record that makes performance
+a TRAJECTORY: every ``bench_serving.py`` run appends rows like::
+
+    {"schema": "paddle_tpu.perf_ledger/v1", "timestamp": "...",
+     "run_id": "serving_smoke_...json", "source": "live-smoke",
+     "scenario": "overload", "metric": "goodput_improvement",
+     "value": 4.2, "unit": "ratio", "direction": "higher_better",
+     "config_digest": "1a2b3c4d5e6f", "device": "cpu",
+     "rel_threshold": 0.35}
+
+Rows are self-describing on purpose: ``direction`` says which way is
+worse, ``config_digest`` isolates incomparable configurations (a
+changed workload starts a fresh baseline instead of a false alarm),
+and the optional per-row ``rel_threshold`` lets the WRITER declare a
+metric's noise floor (raw CPU timings get a looser gate than ratios).
+Timestamps are passed in by the caller — this module never reads a
+clock, so replays and tests are deterministic.
+
+``compare()`` implements the regression verdict: current (last) row
+per group vs the median of its history, flagged only when the
+relative worsening exceeds the threshold AND clears a MAD-based noise
+gate over that history (a single noisy baseline row can't shadow-ban
+a metric, a genuinely bimodal history widens its own gate).
+
+Deliberately dependency-free (stdlib only): tools/perf_diff.py loads
+this file directly via importlib, so the CI gate starts in
+milliseconds without importing paddle_tpu (or jax).
+"""
+import hashlib
+import json
+import math
+
+PERF_LEDGER_SCHEMA = "paddle_tpu.perf_ledger/v1"
+
+# required row fields (rel_threshold is optional, writer-declared)
+LEDGER_ROW_KEYS = (
+    "schema", "timestamp", "run_id", "source", "scenario", "metric",
+    "value", "unit", "direction", "config_digest", "device",
+)
+
+_DIRECTIONS = ("higher_better", "lower_better")
+
+
+def config_digest(config):
+    """Short stable digest of a (JSON-serializable) config dict: rows
+    from different workload configurations never compare against each
+    other — a config change establishes a fresh baseline."""
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def make_row(*, timestamp, run_id, source, scenario, metric, value,
+             unit, direction, config_digest, device,
+             rel_threshold=None):
+    """Validated ledger row. ``timestamp`` is caller-provided (no
+    clock reads here); ``direction`` must name which way is worse;
+    ``value`` must be a finite number."""
+    if direction not in _DIRECTIONS:
+        raise ValueError(f"direction must be one of {_DIRECTIONS}, "
+                         f"got {direction!r}")
+    v = float(value)
+    if not math.isfinite(v):
+        raise ValueError(f"value must be finite, got {value!r}")
+    if not scenario or not metric:
+        raise ValueError("scenario and metric must be non-empty")
+    row = {
+        "schema": PERF_LEDGER_SCHEMA,
+        "timestamp": str(timestamp),
+        "run_id": str(run_id),
+        "source": str(source),
+        "scenario": str(scenario),
+        "metric": str(metric),
+        "value": v,
+        "unit": str(unit),
+        "direction": direction,
+        "config_digest": str(config_digest),
+        "device": str(device),
+    }
+    if rel_threshold is not None:
+        t = float(rel_threshold)
+        if not (0.0 < t < 10.0):
+            raise ValueError(f"rel_threshold out of range: {t}")
+        row["rel_threshold"] = t
+    return row
+
+
+def append_rows(path, rows):
+    """Append validated rows to the JSONL ledger (one object per
+    line). Rows missing required keys are rejected before anything is
+    written — a partial append never corrupts the ledger."""
+    rows = list(rows)
+    for row in rows:
+        missing = [k for k in LEDGER_ROW_KEYS if k not in row]
+        if missing:
+            raise ValueError(f"ledger row missing {missing}: {row}")
+    with open(path, "a") as fh:
+        for row in rows:
+            fh.write(json.dumps(row, sort_keys=True) + "\n")
+    return len(rows)
+
+
+def read_rows(path):
+    """(rows, skipped): every parseable row carrying the ledger
+    schema, in file (= append) order; junk lines and foreign schemas
+    are counted, never fatal — one corrupt line must not kill the CI
+    gate."""
+    rows, skipped = [], 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                skipped += 1
+                continue
+            if not isinstance(row, dict) \
+                    or row.get("schema") != PERF_LEDGER_SCHEMA \
+                    or not isinstance(row.get("value"), (int, float)):
+                skipped += 1
+                continue
+            rows.append(row)
+    return rows, skipped
+
+
+def _median(xs):
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return None
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _mad(xs, center):
+    """Median absolute deviation around ``center``."""
+    if not xs:
+        return 0.0
+    return _median([abs(x - center) for x in xs]) or 0.0
+
+
+def compare(rows, default_rel_threshold=0.35, mad_k=3.0):
+    """Judge the LAST row of every (scenario, metric, config_digest)
+    group against the median of its earlier rows.
+
+    Verdicts: ``baseline`` (no history — first run establishes it),
+    ``ok``, ``improvement`` (better than baseline by more than the
+    threshold), ``regression``. A regression requires BOTH gates:
+
+      * relative: worse than baseline by > rel_threshold (the row's
+        own ``rel_threshold`` when present, else the default);
+      * noise: |current - baseline| > mad_k * 1.4826 * MAD(history)
+        (vacuous when history is too short to estimate spread — the
+        relative gate alone decides then).
+
+    Returns a list of group results sorted by (scenario, metric),
+    each carrying the trajectory (history values + current) so
+    callers can print it."""
+    groups = {}
+    for row in rows:
+        key = (row["scenario"], row["metric"],
+               row.get("config_digest", ""))
+        groups.setdefault(key, []).append(row)
+    results = []
+    for (scenario, metric, digest) in sorted(groups):
+        grp = groups[(scenario, metric, digest)]
+        cur = grp[-1]
+        history = [float(r["value"]) for r in grp[:-1]]
+        value = float(cur["value"])
+        direction = cur.get("direction", "higher_better")
+        threshold = float(cur.get("rel_threshold",
+                                  default_rel_threshold))
+        result = {
+            "scenario": scenario,
+            "metric": metric,
+            "config_digest": digest,
+            "unit": cur.get("unit", ""),
+            "direction": direction,
+            "runs": len(grp),
+            "history": history,
+            "current": value,
+            "current_run": cur.get("run_id"),
+            "threshold": threshold,
+            "baseline": None,
+            "worse_by": None,
+            "verdict": "baseline",
+        }
+        if history:
+            baseline = _median(history)
+            result["baseline"] = baseline
+            if baseline:
+                delta = (value - baseline) / abs(baseline)
+                worse_by = -delta if direction == "higher_better" \
+                    else delta
+                result["worse_by"] = round(worse_by, 4)
+                noise = mad_k * 1.4826 * _mad(history, baseline)
+                beyond_noise = abs(value - baseline) > noise
+                if worse_by > threshold and beyond_noise:
+                    result["verdict"] = "regression"
+                elif worse_by < -threshold:
+                    result["verdict"] = "improvement"
+                else:
+                    result["verdict"] = "ok"
+            else:
+                # a zero baseline carries no scale: judge on absolute
+                # worsening direction only, never divide
+                worse = (value < 0) if direction == "higher_better" \
+                    else (value > 0)
+                result["worse_by"] = None
+                result["verdict"] = "regression" if worse else "ok"
+        results.append(result)
+    return results

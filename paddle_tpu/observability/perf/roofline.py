@@ -1,0 +1,157 @@
+"""Decode-step roofline model: the analytic floor a decode dispatch
+cannot beat, and the device tables to price it.
+
+ROADMAP direction #2 ("Pallas paged decode attention kernel") starts
+with "roofline first: extend tools/gpt_roofline.py with a decode-step
+HBM model" — this module IS that model, shared between the engine's
+perf attribution (snapshot()["perf"], /debug/perf), the roofline CLI
+(tools/gpt_roofline.py --decode) and tests. A decode step is
+memory-bound long before it is FLOP-bound: every step re-reads the
+whole parameter set plus the K/V cache, so the HBM traffic term —
+KV-read bytes per token as a function of batch, sequence length,
+heads, and paged-vs-contiguous layout — is the yardstick any paged
+attention kernel gets judged by.
+
+Deliberately dependency-free (stdlib only): tools/perf_diff.py and
+tools/gpt_roofline.py load this file directly via importlib without
+importing the paddle_tpu package (no jax at tool startup), and the
+engine imports it through paddle_tpu.observability.perf.
+
+Layout model (why paged costs more under plain XLA):
+
+  * **contiguous** (SlotKVPool): attention reads the pooled
+    ``[slots, heads, cache_len, head_dim]`` K/V directly — one read of
+    the full fixed-shape cache per step (the max_len over-read is the
+    price of the zero-recompile fixed shape);
+  * **paged** (PagedKVPool behind a block table, composed in XLA):
+    the gather MATERIALIZES a contiguous copy before attention reads
+    it — pool read + copy write + attention read, ~3x the contiguous
+    traffic. That factor is exactly what the Pallas kernel deletes by
+    reading blocks in place, which is why the achieved-fraction gauge
+    exists: the kernel becomes default only where measurements beat
+    this model's floor.
+"""
+import os
+
+# reference chip when the real device is unknown (CPU smoke runs, new
+# TPU generations before the tables learn them): v5e bf16 peak and HBM
+# bandwidth — the same constants tools/gpt_roofline.py budgets with.
+# Fractions computed against the reference are a machinery exercise,
+# not an absolute claim; report()s flag device_peak=False for them.
+REF_PEAK_FLOPS = 197e12
+REF_HBM_BPS = 819e9
+
+# published per-chip HBM bandwidth (bytes/sec) by PJRT device_kind
+# prefix — the companion of the engine's _PEAK_FLOPS_BY_KIND table
+_HBM_BPS_BY_KIND = (
+    ("tpu v6", 1640e9),
+    ("tpu v5p", 2765e9),
+    ("tpu v5 lite", 819e9),
+    ("tpu v5e", 819e9),
+    ("tpu v4", 1228e9),
+    ("tpu v3", 900e9),
+    ("tpu v2", 700e9),
+)
+
+# XLA-composed paged attention: gather reads the pool, writes a
+# contiguous copy, attention reads the copy back (vs one direct read
+# on the contiguous layout)
+PAGED_GATHER_FACTOR = 3.0
+
+
+def hbm_bps_for(device_kind):
+    """HBM bandwidth (bytes/sec) for a PJRT device_kind; the
+    PADDLE_TPU_HBM_BPS env var covers unknown kinds; None when
+    nothing is known (callers fall back to REF_HBM_BPS and flag it)."""
+    kind = str(device_kind).lower()
+    for prefix, bw in _HBM_BPS_BY_KIND:
+        if kind.startswith(prefix):
+            return bw
+    env = os.environ.get("PADDLE_TPU_HBM_BPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    return None
+
+
+def roofline_floor(flops, bytes_accessed, peak_flops, hbm_bps):
+    """(floor_seconds, bound) — the time one dispatch cannot beat:
+    max of the compute term and the memory term, with ``bound`` naming
+    the binding resource ("flops" | "hbm"). Terms whose inputs are
+    missing/zero drop out; (None, None) when nothing is computable."""
+    t_flops = None
+    if flops and peak_flops:
+        t_flops = float(flops) / float(peak_flops)
+    t_hbm = None
+    if bytes_accessed and hbm_bps:
+        t_hbm = float(bytes_accessed) / float(hbm_bps)
+    if t_flops is None and t_hbm is None:
+        return None, None
+    if t_hbm is None or (t_flops is not None and t_flops >= t_hbm):
+        return t_flops, "flops"
+    return t_hbm, "hbm"
+
+
+def kv_read_bytes_per_token(kv_len, num_layers, num_heads, head_dim,
+                            kv_bytes=2, paged=False):
+    """HBM bytes attention reads to serve ONE decode token: K and V
+    across every layer over ``kv_len`` positions, times the gather
+    materialization factor on the XLA-composed paged layout."""
+    base = 2.0 * num_layers * num_heads * head_dim * kv_len * kv_bytes
+    return base * (PAGED_GATHER_FACTOR if paged else 1.0)
+
+
+def decode_step_model(batch, kv_len, num_layers, num_heads, head_dim,
+                      n_params, param_bytes=2, kv_bytes=2, paged=False,
+                      peak_flops=None, hbm_bps=None):
+    """Analytic cost of ONE pooled decode dispatch (``batch`` slots,
+    one token each, attending over ``kv_len`` cached positions — the
+    engine passes its fixed cache_len, since the fixed-shape program
+    reads the whole pooled cache regardless of live lengths).
+
+    Returns a JSON-safe dict: the traffic decomposition (KV read per
+    token and total, KV append write, parameter read), matmul +
+    attention FLOPs, arithmetic intensity, and — when peak_flops /
+    hbm_bps are given — the roofline floor and its binding resource.
+    """
+    hidden = num_heads * head_dim
+    kv_tok = kv_read_bytes_per_token(kv_len, num_layers, num_heads,
+                                     head_dim, kv_bytes=kv_bytes,
+                                     paged=paged)
+    kv_read = batch * kv_tok
+    # one position appended per layer, K and V
+    kv_write = batch * 2.0 * num_layers * num_heads * head_dim * kv_bytes
+    param_read = float(n_params) * param_bytes
+    bytes_total = kv_read + kv_write + param_read
+    # dense matmuls touch every parameter twice per token; attention
+    # is QK^T + AV, 2 * kv_len * hidden multiply-adds each, per layer
+    flops = batch * (2.0 * n_params
+                     + 4.0 * kv_len * hidden * num_layers)
+    floor_s, bound = roofline_floor(flops, bytes_total, peak_flops,
+                                    hbm_bps)
+    return {
+        "batch": int(batch),
+        "kv_len": int(kv_len),
+        "num_layers": int(num_layers),
+        "num_heads": int(num_heads),
+        "head_dim": int(head_dim),
+        "n_params": int(n_params),
+        "paged": bool(paged),
+        "gather_factor": PAGED_GATHER_FACTOR if paged else 1.0,
+        "kv_read_bytes_per_token": kv_tok,
+        "kv_read_bytes": kv_read,
+        "kv_write_bytes": kv_write,
+        "param_read_bytes": param_read,
+        "bytes_total": bytes_total,
+        "flops": flops,
+        "arithmetic_intensity": flops / bytes_total
+        if bytes_total else None,
+        "peak_flops": peak_flops,
+        "hbm_bps": hbm_bps,
+        "floor_s": floor_s,
+        "floor_ms": round(floor_s * 1e3, 6)
+        if floor_s is not None else None,
+        "bound": bound,
+    }

@@ -596,13 +596,21 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
     ``extra_routes`` maps additional paths to zero-arg callables whose
     JSON-serializable return value is served as application/json — the
     serving engine mounts ``/debug/requests`` and ``/debug/state``
-    this way. Returns a MetricsServerHandle: ``handle.port`` is the
-    bound port (``port=0`` picks a free one), ``handle.close()`` stops
-    it (idempotent; also a context manager)."""
+    this way. ``GET /debug`` serves the route index ({"routes":
+    [every mounted path]}) so operators can discover the surface
+    without reading source (an explicit ``/debug`` extra route
+    overrides the built-in index). Returns a MetricsServerHandle:
+    ``handle.port`` is the bound port (``port=0`` picks a free one),
+    ``handle.close()`` stops it (idempotent; also a context
+    manager)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
     routes = dict(extra_routes or {})
+    if "/debug" not in routes:
+        index = sorted(["/metrics", "/metrics.json", "/debug"]
+                       + list(routes))
+        routes["/debug"] = lambda: {"routes": index}
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):

@@ -177,7 +177,7 @@ class ServingConfig:
                  chaos=None, max_dispatch_retries=0,
                  retry_backoff_s=0.0, quarantine_after=3,
                  supervisor=None, supervisor_max_restarts=8,
-                 supervisor_cooldown_s=1.0):
+                 supervisor_cooldown_s=1.0, perf=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -320,6 +320,14 @@ class ServingConfig:
         self.supervisor = supervisor
         self.supervisor_max_restarts = int(supervisor_max_restarts)
         self.supervisor_cooldown_s = float(supervisor_cooldown_s)
+        # performance observatory (observability.perf): per-program
+        # dispatch/sync attribution + roofline fractions, ON by
+        # default (two perf_counter reads and one histogram observe
+        # per dispatch — probe-measured in the bench artifact);
+        # PADDLE_PERF=0 opts out, True/False forces.
+        if perf is None:
+            perf = os.environ.get("PADDLE_PERF", "1") != "0"
+        self.perf = bool(perf)
 
 
 class ServingEngine:
@@ -419,7 +427,9 @@ class ServingEngine:
         self.metrics = ServingMetrics(
             slo_ttft_ms=config.slo_ttft_ms,
             slo_tpot_ms=config.slo_tpot_ms,
-            slo_window_s=config.slo_window_s)
+            slo_window_s=config.slo_window_s,
+            perf=config.perf)
+        self._perf_on = config.perf
         self.metrics.set_scheduler_info(
             self._policy.name, self.chunk_len,
             self.prefill_token_budget)
@@ -532,13 +542,38 @@ class ServingEngine:
         # doesn't — the gauges simply aren't registered there)
         dev = jax.devices()[0]
         self._device = dev
-        self.metrics.set_peak_flops(
-            config.peak_flops or _peak_flops_for(dev.device_kind))
+        peak = config.peak_flops or _peak_flops_for(dev.device_kind)
+        self.metrics.set_peak_flops(peak)
         if device_memory_stats(dev) is not None:
             self.metrics.enable_device_memory(
                 lambda: device_memory_stats(dev))
         if self.paged:
             self.metrics.set_prefix_pool(self.pool.stats)
+        if self._perf_on:
+            # price the per-program roofline (unknown devices fall
+            # back to the v5e reference constants, flagged
+            # device_peak/device_hbm=false in the report) and attach
+            # the analytic decode-step HBM model: the fixed-shape
+            # pooled decode reads the WHOLE cache_len layout every
+            # step, so kv_len is the per-slot capacity, not the live
+            # lengths — exactly the over-read the model prices
+            from ..observability import hbm_bps_for
+            from ..observability.perf import build_decode_model
+            P = self.metrics.perf
+            P.set_device(dev.platform, dev.device_kind,
+                         peak_flops=peak,
+                         hbm_bps=hbm_bps_for(dev.device_kind))
+            leaves = jax.tree_util.tree_leaves(self.params)
+            n_params = sum(int(np.prod(l.shape)) for l in leaves)
+            P.set_decode_model(build_decode_model(
+                batch=config.num_slots, kv_len=cache_len,
+                num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                head_dim=cfg.hidden_size // cfg.num_heads,
+                n_params=n_params,
+                param_bytes=leaves[0].dtype.itemsize if leaves else 4,
+                kv_bytes=self.pool.kc.dtype.itemsize,
+                paged=self.paged, peak_flops=P.peak_flops,
+                hbm_bps=P.hbm_bps))
 
     # ---------------------------------------------------------- requests
 
@@ -631,7 +666,25 @@ class ServingEngine:
             if key == ("decode",) and cost:
                 self.metrics.set_decode_cost(
                     cost.get("flops"), cost.get("bytes_accessed"))
+            if cost:
+                # the same cost_analysis prices this program's
+                # roofline floor in snapshot()["perf"] (no-op with
+                # perf off)
+                self.metrics.perf.bind_cost(key, cost)
         return ex
+
+    def _timed_call(self, key, ex, args):
+        """Dispatch one compiled executable, attributing its measured
+        wall seconds to its program key (the perf observatory's
+        dispatch leg; harvest attributes the sync leg). With perf off
+        this is a bare call — no clock reads."""
+        if not self._perf_on:
+            return ex(*args)
+        t0 = time.perf_counter()
+        out = ex(*args)
+        self.metrics.perf.record_dispatch(
+            key, time.perf_counter() - t0)
+        return out
 
     def declare_warmup(self):
         """Declare warmup complete: the compiled-executable inventory
@@ -648,19 +701,22 @@ class ServingEngine:
     def serve_metrics(self, port=0, addr="127.0.0.1"):
         """Expose this engine's metrics registry over HTTP: GET
         /metrics (Prometheus text), /metrics.json (the snapshot
-        schema), /debug/requests (flight-recorder traces),
-        /debug/state (live engine state) and — with the health
-        observatory on — /debug/health ({healthy, detectors,
-        last_incident}: the per-replica router signal) and
-        /debug/ledger (the per-step ring). Returns a
-        MetricsServerHandle — ``handle.port`` is the bound port,
-        ``handle.close()`` stops it (idempotent); every handle is also
-        closed by ``engine.close()`` so the server thread shuts down
-        with the engine."""
+        schema), /debug (the route index — every mounted path, so the
+        surface is discoverable without reading source),
+        /debug/requests (flight-recorder traces), /debug/state (live
+        engine state), /debug/perf (per-program attribution +
+        roofline fractions) and — with the health observatory on —
+        /debug/health ({healthy, detectors, last_incident}: the
+        per-replica router signal) and /debug/ledger (the per-step
+        ring). Returns a MetricsServerHandle — ``handle.port`` is the
+        bound port, ``handle.close()`` stops it (idempotent); every
+        handle is also closed by ``engine.close()`` so the server
+        thread shuts down with the engine."""
         from ..observability import start_metrics_server
         routes = {
             "/debug/requests": self.flight.debug_requests,
             "/debug/state": self.debug_state,
+            "/debug/perf": self.metrics.perf_report,
         }
         if self.health is not None:
             routes["/debug/health"] = self.health.report
@@ -932,8 +988,18 @@ class ServingEngine:
         callbacks and retirement overlap device compute."""
         M = self.metrics
         for entry in pending:
-            with M.span("serving/sync"):
-                vals = self._read_back(entry[1])
+            if self._perf_on:
+                t0 = time.perf_counter()
+                with M.span("serving/sync"):
+                    vals = self._read_back(entry[1])
+                # entry[3] is the program key the dispatch leg used —
+                # the sync leg lands on the same program, so a step's
+                # cost decomposes into named programs end to end
+                M.perf.record_sync(entry[3],
+                                   time.perf_counter() - t0)
+            else:
+                with M.span("serving/sync"):
+                    vals = self._read_back(entry[1])
             if entry[0] == "prefill":
                 for (req, slot), tok in zip(entry[2], vals):
                     req.inflight -= 1
@@ -1074,7 +1140,8 @@ class ServingEngine:
                 ex = self._compiled(("decode",), self._decode_fn, args,
                                     donate=donate)
                 with M.span("serving/decode_dispatch"):
-                    nxt, self._pos, kc, vc = ex(*args)
+                    nxt, self._pos, kc, vc = self._timed_call(
+                        ("decode",), ex, args)
                 ok = True
             except BaseException as e:
                 # the dispatch never ran (chaos injects BEFORE the
@@ -1091,10 +1158,11 @@ class ServingEngine:
                 self._toks = nxt
                 M.decode_steps += 1
                 self._decode_fail_streak = 0
+                entry = ("decode", nxt, snapshot, ("decode",))
                 if sync:
-                    self._harvest([("decode", nxt, snapshot)])
+                    self._harvest([entry])
                 else:
-                    self._pending.append(("decode", nxt, snapshot))
+                    self._pending.append(entry)
 
         if epoch == self._restart_epoch:
             with M.span("serving/harvest"):
@@ -1270,7 +1338,9 @@ class ServingEngine:
                 with M.span("serving/prefill_dispatch"):
                     for req, _slot in group:
                         self.flight.prefill_dispatched(req, bucket, G)
-                    first, self._toks, self._pos, kc, vc = ex(*args)
+                    first, self._toks, self._pos, kc, vc = \
+                        self._timed_call(("prefill", bucket, G), ex,
+                                         args)
             except BaseException as e:
                 for req, _slot in group:
                     req.inflight -= 1
@@ -1290,10 +1360,11 @@ class ServingEngine:
             M.prefill_requests += G
             M.record_prefill_group(G)
             M.record_prefill_tokens(int(lengths.sum()))
+            entry = ("prefill", first, group, ("prefill", bucket, G))
             if sync:
-                self._harvest([("prefill", first, group)])
+                self._harvest([entry])
             else:
-                self._pending.append(("prefill", first, group))
+                self._pending.append(entry)
 
     def _paged_prefills(self, sync):
         """Prefix-aware admission + tail-only prefill over the paged
@@ -1347,7 +1418,9 @@ class ServingEngine:
                     if start:
                         self.flight.prefix_hit(req, start, tail)
                     self.flight.prefill_dispatched(req, bucket, 1)
-                    first, self._toks, self._pos, kc, vc = ex(*args)
+                    first, self._toks, self._pos, kc, vc = \
+                        self._timed_call(("paged_prefill", bucket),
+                                         ex, args)
             except BaseException as e:
                 req.inflight -= 1
                 sch.rollback_admission([req], pool)
@@ -1363,11 +1436,12 @@ class ServingEngine:
             M.prefill_requests += 1
             M.record_prefill_group(1)
             M.record_prefix_reuse(start, tail)
+            entry = ("prefill", first, [(req, alloc.slot)],
+                     ("paged_prefill", bucket))
             if sync:
-                self._harvest([("prefill", first, [(req, alloc.slot)])])
+                self._harvest([entry])
             else:
-                self._pending.append(
-                    ("prefill", first, [(req, alloc.slot)]))
+                self._pending.append(entry)
 
     # ---------------------------------------------- chunked prefill
 
@@ -1447,7 +1521,8 @@ class ServingEngine:
                                               clen, final)
                     if final:
                         self.flight.prefill_dispatched(req, C, 1)
-                    first, self._toks, self._pos, kc, vc = ex(*args)
+                    first, self._toks, self._pos, kc, vc = \
+                        self._timed_call(key, ex, args)
             except BaseException as e:
                 if final:
                     req.inflight -= 1
@@ -1472,7 +1547,7 @@ class ServingEngine:
                 M.requests_admitted += 1
                 M.prefill_requests += 1
                 M.record_chunked_request()
-                entry = ("prefill", first, [(req, plan.slot)])
+                entry = ("prefill", first, [(req, plan.slot)], key)
                 if sync:
                     self._harvest([entry])
                 else:

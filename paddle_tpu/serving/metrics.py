@@ -25,7 +25,8 @@ timeline — one scope, three sinks.
 import time
 
 from .. import profiler as _profiler
-from ..observability import MetricsRegistry, Reservoir, SLOTracker
+from ..observability import (MetricsRegistry, ProgramPerf, Reservoir,
+                             SLOTracker)
 
 # serving latencies are sub-ms (CPU smoke) to tens of seconds (deep
 # queues on big models) — the default time buckets cover that span
@@ -62,13 +63,17 @@ class ServingMetrics:
     RESERVOIR_SIZE = 1024
 
     def __init__(self, registry=None, slo_ttft_ms=None,
-                 slo_tpot_ms=None, slo_window_s=60.0):
+                 slo_tpot_ms=None, slo_window_s=60.0, perf=True):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         r = self.registry
         self.slo = SLOTracker(r, slo_ttft_ms=slo_ttft_ms,
                               slo_tpot_ms=slo_tpot_ms,
                               window_s=slo_window_s)
+        # per-program perf attribution (observability.perf): the
+        # engine records measured dispatch/sync wall per AOT-table key
+        # through this; snapshot()["perf"] / /debug/perf report it
+        self.perf = ProgramPerf(r, enabled=perf)
         self._peak_flops = None
         self._g_decode_flops = r.gauge(
             "serving_decode_flops_per_step",
@@ -558,6 +563,14 @@ class ServingMetrics:
             out[name] = entry
         return out
 
+    def perf_report(self):
+        """The ``snapshot()["perf"]`` / ``/debug/perf`` body:
+        per-program measured time + roofline fractions, with the
+        accrued ``serving/step`` span seconds as the attribution
+        denominator."""
+        return self.perf.report(
+            step_total_s=self.span_s.get("serving/step"))
+
     def prometheus_text(self):
         """This engine's registry in Prometheus text exposition format
         (also served over HTTP by ServingEngine.serve_metrics())."""
@@ -595,4 +608,5 @@ class ServingMetrics:
             "scheduler": self.scheduler_report(),
             "health": self.health_report(),
             "resilience": self.resilience_report(),
+            "perf": self.perf_report(),
         }

@@ -408,7 +408,7 @@ _SNAPSHOT_KEYS = {
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
-    "scheduler", "health", "resilience",
+    "scheduler", "health", "resilience", "perf",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",
@@ -431,6 +431,17 @@ _RESILIENCE_KEYS = {
     "slots_quarantined_total", "faults_injected",
     "supervisor_restarts", "quarantined_slots", "draining",
     "supervisor", "chaos",
+}
+# the PR-10 performance observatory section: per-program measured
+# time + roofline fractions (same key set whether perf is on or off)
+_PERF_KEYS = {
+    "enabled", "device", "programs", "attributed_s", "step_total_s",
+    "attributed_fraction", "decode_roofline",
+}
+_PERF_PROGRAM_KEYS = {
+    "dispatches", "dispatch_s", "syncs", "sync_s", "total_s",
+    "avg_ms", "cost", "roofline_floor_ms", "roofline_fraction",
+    "bound",
 }
 
 
@@ -480,6 +491,26 @@ def test_serving_snapshot_schema_contract():
     off_res = eng_off.metrics.snapshot()["resilience"]
     assert set(off_res) == _RESILIENCE_KEYS
     assert off_res["supervisor"] == {"enabled": False}
+    # the PR-10 perf section: per-program measured time + roofline
+    # fractions, decode always among the attributed programs
+    perf = snap["perf"]
+    assert set(perf) == _PERF_KEYS
+    assert perf["enabled"] is True
+    assert "decode" in perf["programs"]
+    for entry in perf["programs"].values():
+        assert set(entry) == _PERF_PROGRAM_KEYS
+        assert entry["dispatches"] > 0
+        assert entry["total_s"] >= entry["dispatch_s"] >= 0
+    assert perf["programs"]["decode"]["roofline_fraction"] is not None
+    assert perf["decode_roofline"]["achieved_fraction"] is not None
+    assert 0 < perf["attributed_s"] <= perf["step_total_s"]
+    # perf=False keeps the SAME key shape (schema contract holds)
+    eng_noperf = ServingEngine(m, num_slots=2, bucket_min=8,
+                               perf=False)
+    _drive(eng_noperf, np.random.RandomState(1), [(4, 3)])
+    off_perf = eng_noperf.metrics.snapshot()["perf"]
+    assert set(off_perf) == _PERF_KEYS
+    assert off_perf["enabled"] is False and off_perf["programs"] == {}
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():
@@ -579,5 +610,44 @@ def test_engine_serve_metrics_http():
         types, samples = _parse_prometheus(text)
         assert "serving_tokens_generated_total" in types
         assert ("serving_tokens_generated_total", {}, 3.0) in samples
+        # /debug (index): every mounted route listed — the operator's
+        # discovery surface (trailing slash normalizes to the same)
+        idx = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/", timeout=10).read())
+        assert {"/metrics", "/metrics.json", "/debug",
+                "/debug/requests", "/debug/state", "/debug/perf",
+                "/debug/health", "/debug/ledger"} <= set(idx["routes"])
+        assert idx["routes"] == sorted(idx["routes"])
+        # /debug/perf: the per-program attribution body
+        perf = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/perf", timeout=10).read())
+        assert perf["enabled"] is True
+        assert "decode" in perf["programs"]
     finally:
         server.shutdown()
+
+
+def test_metrics_server_debug_index_lists_extra_routes():
+    """The bare start_metrics_server also serves the /debug index:
+    built-ins plus every extra route, sorted; an explicit /debug
+    extra route overrides the built-in index."""
+    reg = MetricsRegistry()
+    server = start_metrics_server(
+        reg, port=0, extra_routes={"/debug/custom": lambda: {"x": 1}})
+    try:
+        port = server.server_address[1]
+        idx = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug", timeout=10).read())
+        assert idx["routes"] == ["/debug", "/debug/custom", "/metrics",
+                                 "/metrics.json"]
+    finally:
+        server.shutdown()
+    override = start_metrics_server(
+        reg, port=0, extra_routes={"/debug": lambda: {"mine": True}})
+    try:
+        port = override.server_address[1]
+        body = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug", timeout=10).read())
+        assert body == {"mine": True}
+    finally:
+        override.shutdown()

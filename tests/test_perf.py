@@ -1,0 +1,412 @@
+"""Performance observatory (paddle_tpu.observability.perf): per-
+program device-time attribution, the decode-step roofline model, the
+cross-run perf ledger, and the tools/perf_diff.py regression gate.
+
+Acceptance criteria pinned here: a two-bucket + chunked + decode
+drain attributes its measured time to distinct program keys whose sum
+is tolerance-pinned against the serving/step span total (on BOTH
+pools); a synthetic ledger with a planted 2x decode slowdown makes
+perf_diff exit 1 naming the (scenario, metric); a clean two-run
+ledger exits 0 (the tier-1 CI self-run, mirroring incident_report /
+chaos_sweep); a single-row ledger is a baseline, exit 0.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import perf as perf_mod
+from paddle_tpu.observability.perf import (
+    PERF_LEDGER_SCHEMA, append_rows, compare, config_digest,
+    decode_step_model, disabled_perf_report, format_program_key,
+    hbm_bps_for, kv_read_bytes_per_token, make_row, read_rows,
+    roofline_floor,
+)
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PERF_DIFF = os.path.join(_ROOT, "tools", "perf_diff.py")
+
+
+def _model(seed=7):
+    paddle.seed(seed)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+# ------------------------------------------------------ roofline model
+
+def test_roofline_floor_bound_switch():
+    # 1e6 flops at 1e6 flop/s = 1s; 10 bytes at 1e6 B/s = trivial
+    t, bound = roofline_floor(1e6, 10, 1e6, 1e6)
+    assert t == pytest.approx(1.0) and bound == "flops"
+    t, bound = roofline_floor(10, 1e6, 1e6, 1e6)
+    assert t == pytest.approx(1.0) and bound == "hbm"
+    # missing terms drop out; nothing known -> (None, None)
+    t, bound = roofline_floor(1e6, None, 1e6, 1e6)
+    assert t == pytest.approx(1.0) and bound == "flops"
+    assert roofline_floor(None, None, 1e6, 1e6) == (None, None)
+    assert roofline_floor(1e6, 1e6, None, None) == (None, None)
+
+
+def test_kv_read_bytes_scales_and_paged_gather_tax():
+    base = kv_read_bytes_per_token(128, 12, 12, 64, kv_bytes=2)
+    assert base == 2 * 12 * 12 * 64 * 128 * 2
+    # linear in kv_len and heads
+    assert kv_read_bytes_per_token(256, 12, 12, 64, kv_bytes=2) \
+        == 2 * base
+    assert kv_read_bytes_per_token(128, 12, 24, 64, kv_bytes=2) \
+        == 2 * base
+    # the XLA-composed paged layout pays the gather materialization
+    paged = kv_read_bytes_per_token(128, 12, 12, 64, kv_bytes=2,
+                                    paged=True)
+    assert paged == perf_mod.PAGED_GATHER_FACTOR * base
+
+
+def test_decode_step_model_accounting():
+    m = decode_step_model(batch=8, kv_len=1024, num_layers=12,
+                          num_heads=12, head_dim=64, n_params=124e6,
+                          param_bytes=2, kv_bytes=2,
+                          peak_flops=197e12, hbm_bps=819e9)
+    assert m["bytes_total"] == pytest.approx(
+        m["kv_read_bytes"] + m["kv_write_bytes"]
+        + m["param_read_bytes"])
+    assert m["kv_read_bytes"] == 8 * m["kv_read_bytes_per_token"]
+    # decode is memory-bound: intensity far below the ~240 flops/byte
+    # ridge of a v5e, so the floor is the HBM term
+    assert m["arithmetic_intensity"] < 10
+    assert m["bound"] == "hbm"
+    assert m["floor_s"] == pytest.approx(m["bytes_total"] / 819e9)
+    paged = decode_step_model(batch=8, kv_len=1024, num_layers=12,
+                              num_heads=12, head_dim=64,
+                              n_params=124e6, param_bytes=2,
+                              kv_bytes=2, paged=True,
+                              peak_flops=197e12, hbm_bps=819e9)
+    assert paged["bytes_total"] > m["bytes_total"]
+    assert paged["floor_s"] > m["floor_s"]
+    # no device facts -> floor unknown, traffic model still reported
+    blind = decode_step_model(batch=8, kv_len=1024, num_layers=12,
+                              num_heads=12, head_dim=64,
+                              n_params=124e6)
+    assert blind["floor_s"] is None and blind["bound"] is None
+    assert blind["bytes_total"] > 0
+
+
+def test_hbm_table_and_env_override(monkeypatch):
+    assert hbm_bps_for("TPU v5e chip") == 819e9
+    assert hbm_bps_for("TPU v4") == 1228e9
+    assert hbm_bps_for("cpu") is None
+    monkeypatch.setenv("PADDLE_TPU_HBM_BPS", "123e9")
+    assert hbm_bps_for("cpu") == 123e9
+
+
+def test_gpt_roofline_cli_decode_mode():
+    """tools/gpt_roofline.py --decode: the ROADMAP direction-#2
+    decode-step HBM model, contiguous vs paged, with the gather tax
+    as a number — and the train-step default output unchanged."""
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "gpt_roofline.py"),
+         "--decode", "8", "1024"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    out = json.loads(res.stdout.strip())
+    assert out["contiguous"]["bound"] == "hbm"
+    assert out["paged_xla"]["kv_read_bytes_per_token"] \
+        > out["contiguous"]["kv_read_bytes_per_token"]
+    assert out["paged_gather_tax"] > 1.5
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools",
+                                      "gpt_roofline.py"), "4", "512"],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    lines = [json.loads(ln) for ln in res.stdout.splitlines()]
+    assert len(lines) == 2
+    assert all("step_floor_ms_unfused_head" in ln for ln in lines)
+
+
+# ------------------------------------------- per-program attribution
+
+def test_format_program_key():
+    assert format_program_key(("decode",)) == "decode"
+    assert format_program_key(("prefill", 16, 4)) == "prefill/b16/g4"
+    assert format_program_key(("paged_prefill", 32)) \
+        == "paged_prefill/b32"
+    assert format_program_key(("chunk_prefill", 8)) \
+        == "chunk_prefill/c8"
+
+
+def _drive(eng, rs, specs):
+    for n, k in specs:
+        eng.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                        max_new_tokens=k)
+    eng.run()
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_program_attribution_sums_to_step_total(paged):
+    """Satellite acceptance: a two-bucket prefill + chunked + decode
+    drain yields DISTINCT program keys whose summed measured time is
+    tolerance-pinned against the serving/step span total, on both
+    pools. Measured over a WARM drain (deltas between reports), so
+    compile time never pollutes the comparison."""
+    m = _model()
+    eng = ServingEngine(m, num_slots=2, bucket_min=8,
+                        prefill_chunk=12, paged=paged)
+    rs = np.random.RandomState(0)
+    # buckets 8 (len 5/6) and 16 (len 9), plus a chunked prompt (20
+    # > prefill_chunk) and enough decode to dominate
+    wave = [(5, 6), (9, 5), (20, 4), (6, 5)]
+    _drive(eng, rs, wave)                  # warmup: compiles
+    eng.declare_warmup()
+    r0 = eng.metrics.perf_report()
+    spans0 = dict(eng.metrics.span_s)
+    _drive(eng, rs, wave)                  # warm, zero-compile drain
+    r1 = eng.metrics.perf_report()
+    spans1 = dict(eng.metrics.span_s)
+
+    progs = r1["programs"]
+    expect = {"decode", "paged_prefill/b8", "paged_prefill/b12",
+              "paged_prefill/b16"} if paged else \
+        {"decode", "prefill/b8/g1", "prefill/b16/g1",
+         "chunk_prefill/c12"}
+    assert expect <= set(progs), progs.keys()
+    for entry in progs.values():
+        assert entry["dispatches"] > 0 and entry["total_s"] > 0
+
+    def delta(key):
+        return spans1.get(key, 0.0) - spans0.get(key, 0.0)
+
+    attributed = r1["attributed_s"] - r0["attributed_s"]
+    step_total = delta("serving/step")
+    span_sum = (sum(delta(k) for k in spans1
+                    if k.endswith("_dispatch"))
+                + delta("serving/sync"))
+    assert attributed > 0
+    # containment: every attributed second was measured inside the
+    # step span (dispatch/sync legs are strict sub-regions)
+    assert attributed <= step_total
+    # correspondence with the span counters that time the same code
+    # regions (the spans additionally cover flight-recorder calls, so
+    # they upper-bound the tighter per-program measurement)
+    assert attributed <= span_sum * 1.05 + 1e-4
+    assert attributed >= span_sum * 0.5
+    # the tolerance pin on "the step decomposes into programs": on a
+    # warm drain the dispatch+sync legs carry the device work, the
+    # rest of the step is host bookkeeping
+    assert attributed >= 0.2 * step_total
+    # the roofline join is live for decode on this pool flavor
+    dec = progs["decode"]
+    assert dec["roofline_fraction"] is not None
+    assert dec["bound"] in ("hbm", "flops")
+    assert r1["decode_roofline"]["model"]["paged"] is paged
+    eng.close()
+
+
+def test_disabled_perf_report_shape():
+    rep = disabled_perf_report()
+    assert rep["enabled"] is False and rep["programs"] == {}
+    assert set(rep) == set(perf_mod.PERF_KEYS)
+
+
+# ------------------------------------------------------- perf ledger
+
+def _row(scenario, metric, value, ts, direction="higher_better",
+         thr=None, digest="cfg0"):
+    return make_row(timestamp=ts, run_id=f"run_{ts}", source="test",
+                    scenario=scenario, metric=metric, value=value,
+                    unit="x", direction=direction,
+                    config_digest=digest, rel_threshold=thr,
+                    device="cpu")
+
+
+def test_make_row_validates():
+    r = _row("s", "m", 1.5, "t0")
+    assert r["schema"] == PERF_LEDGER_SCHEMA and r["value"] == 1.5
+    with pytest.raises(ValueError):
+        _row("s", "m", float("nan"), "t0")
+    with pytest.raises(ValueError):
+        _row("s", "m", 1.0, "t0", direction="sideways_better")
+    with pytest.raises(ValueError):
+        _row("", "m", 1.0, "t0")
+
+
+def test_ledger_roundtrip_tolerates_junk(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    append_rows(path, [_row("s", "m", 1.0, "t0")])
+    append_rows(path, [_row("s", "m", 1.1, "t1")])
+    with open(path, "a") as fh:
+        fh.write("not json at all\n")
+        fh.write('{"schema": "foreign/v9", "value": 3}\n')
+    rows, skipped = read_rows(path)
+    assert [r["value"] for r in rows] == [1.0, 1.1]
+    assert skipped == 2
+    # a row missing required keys is rejected BEFORE anything lands
+    with pytest.raises(ValueError):
+        append_rows(path, [{"schema": PERF_LEDGER_SCHEMA,
+                            "value": 2.0}])
+    assert read_rows(path)[0] == rows
+
+
+def test_config_digest_isolates_configs():
+    a = config_digest({"requests": 72, "specs": [(3, 6)]})
+    b = config_digest({"requests": 96, "specs": [(3, 6)]})
+    assert a != b and a == config_digest(
+        {"specs": [(3, 6)], "requests": 72})
+    # rows under different digests never compare: both stay baselines
+    rows = [_row("s", "m", 1.0, "t0", digest=a),
+            _row("s", "m", 99.0, "t1", digest=b)]
+    results = compare(rows)
+    assert [r["verdict"] for r in results] == ["baseline", "baseline"]
+
+
+def test_compare_verdicts_direction_and_noise():
+    # stable history, current within threshold -> ok
+    rows = [_row("s", "tps", v, f"t{i}")
+            for i, v in enumerate([100.0, 102.0, 98.0, 101.0])]
+    (res,) = compare(rows)
+    assert res["verdict"] == "ok" and res["baseline"] == 100.0
+    # higher_better collapse -> regression
+    (res,) = compare(rows[:-1] + [_row("s", "tps", 40.0, "t9")])
+    assert res["verdict"] == "regression"
+    assert res["worse_by"] == pytest.approx(0.6)
+    # lower_better: the same numeric move flips verdict
+    lrows = [_row("s", "ms", v, f"t{i}", direction="lower_better")
+             for i, v in enumerate([100.0, 102.0, 98.0, 40.0])]
+    (res,) = compare(lrows)
+    assert res["verdict"] == "improvement"
+    (res,) = compare(lrows[:-1] + [_row("s", "ms", 250.0, "t9",
+                                        direction="lower_better")])
+    assert res["verdict"] == "regression"
+    # the MAD noise gate: a wildly-noisy history widens its own gate,
+    # so a move that clears the relative threshold but sits inside
+    # the historical spread does NOT flag
+    noisy = [_row("s", "tps", v, f"t{i}", thr=0.2)
+             for i, v in enumerate([100.0, 40.0, 160.0, 45.0, 155.0])]
+    noisy.append(_row("s", "tps", 70.0, "t9", thr=0.2))
+    (res,) = compare(noisy)
+    assert res["verdict"] == "ok"       # 30% worse, but inside noise
+
+
+# ------------------------------------------------- perf_diff CLI gate
+
+def _run_diff(path, *extra):
+    return subprocess.run(
+        [sys.executable, _PERF_DIFF, path, *extra],
+        capture_output=True, text=True, timeout=60)
+
+
+def test_perf_diff_clean_two_run_ledger_exits_zero(tmp_path):
+    """The tier-1 CI self-run (mirrors incident_report/chaos_sweep):
+    two consecutive runs within noise must NOT false-positive."""
+    path = str(tmp_path / "ledger.jsonl")
+    for ts, jitter in (("t0", 1.0), ("t1", 1.04)):
+        append_rows(path, [
+            _row("headline", "tokens_per_sec", 1200.0 * jitter, ts),
+            _row("overload", "goodput_improvement", 4.2 / jitter, ts),
+            _row("perf", "decode_avg_ms", 0.31 * jitter, ts,
+                 direction="lower_better", thr=0.5),
+        ])
+    res = _run_diff(path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "no regressions" in res.stdout
+    assert "headline" in res.stdout and "tokens_per_sec" in res.stdout
+
+
+def test_perf_diff_planted_decode_slowdown_exits_one(tmp_path):
+    """A planted 2x decode slowdown must exit 1 and NAME the
+    offending (scenario, metric) — while the healthy neighbors stay
+    quiet."""
+    path = str(tmp_path / "ledger.jsonl")
+    for i, ts in enumerate(["t0", "t1", "t2"]):
+        append_rows(path, [
+            _row("headline", "tokens_per_sec", 1200.0 + i, ts),
+            _row("perf", "decode_avg_ms", 0.30 + 0.01 * i, ts,
+                 direction="lower_better", thr=0.5),
+        ])
+    append_rows(path, [
+        _row("headline", "tokens_per_sec", 1201.0, "t3"),
+        _row("perf", "decode_avg_ms", 0.62, "t3",           # 2x slower
+             direction="lower_better", thr=0.5),
+    ])
+    res = _run_diff(path)
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout
+    assert "perf/decode_avg_ms" in res.stdout
+    assert "headline/tokens_per_sec" not in res.stdout.split(
+        "REGRESSION")[1]
+
+
+def test_perf_diff_single_row_is_baseline_exit_zero(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    append_rows(path, [_row("headline", "tokens_per_sec", 1200.0,
+                            "t0")])
+    res = _run_diff(path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "baseline" in res.stdout
+    # an explicitly named missing ledger is an error (exit 2); the
+    # default path missing is not (pre-first-bench builds must pass)
+    res = _run_diff(str(tmp_path / "nope.jsonl"))
+    assert res.returncode == 2
+
+
+# ----------------------------------------------- bench harness pieces
+
+def test_bench_rotate_artifacts(tmp_path):
+    import bench_serving
+
+    d = str(tmp_path)
+    names = [f"serving_smoke_2026080{i}T000000Z.json"
+             for i in range(6)]
+    for n in names:
+        with open(os.path.join(d, n), "w") as fh:
+            fh.write("{}")
+    with open(os.path.join(d, "serving_20260801T000000Z.json"),
+              "w") as fh:
+        fh.write("{}")                     # full artifacts never rotate
+    removed = bench_serving._rotate_artifacts(d, keep=2)
+    assert removed == names[:4]            # oldest pruned, newest kept
+    left = sorted(os.listdir(d))
+    assert names[4] in left and names[5] in left
+    assert "serving_20260801T000000Z.json" in left
+    assert bench_serving._rotate_artifacts(d, keep=0) == []   # off
+
+
+def test_bench_ledger_rows_normalize_evidence():
+    import bench_serving
+
+    evidence = {
+        "timestamp": "2026-08-04T00:00:00Z",
+        "device": {"platform": "cpu"},
+        "tokens_per_sec": 1234.5,
+        "vs_sequential": 4.5,
+        "latency_percentiles": {"ttft": {"p50_ms": 12.0}},
+        "deep_queue": {"vs_pr1_engine": 1.4,
+                       "grouped_tokens_per_sec": 2000.0},
+        "overload": {"goodput_improvement": 4.2,
+                     "slo_feedback": {"goodput_tokens_per_sec": 99.0}},
+        "chaos": {"completion_rate": 1.0},
+        "perf": {"programs": {"decode": {"avg_ms": 0.3}},
+                 "decode_roofline": {"achieved_fraction": 0.4}},
+        # shared_prefix / health sections absent: skipped, not faked
+    }
+    rows = bench_serving._ledger_rows(evidence, "run.json",
+                                      "live-smoke", "digest0")
+    by_key = {(r["scenario"], r["metric"]): r for r in rows}
+    assert by_key[("headline", "tokens_per_sec")]["value"] == 1234.5
+    assert by_key[("perf", "decode_avg_ms")]["direction"] \
+        == "lower_better"
+    assert by_key[("chaos", "completion_rate")]["rel_threshold"] == 0.1
+    assert ("shared_prefix", "ttft_improvement") not in by_key
+    assert ("health", "step_overhead_us") not in by_key
+    assert all(r["config_digest"] == "digest0"
+               and r["run_id"] == "run.json" for r in rows)

@@ -1,4 +1,5 @@
-"""Analytical roofline budget for the GPT-124M single-chip train step.
+"""Analytical roofline budget for the GPT-124M single-chip train step
+AND (--decode) the serving decode step.
 
 Computes, from first principles, where the step time HAS to go on a
 v5e-class chip (197 TFLOP/s bf16 MXU, ~819 GB/s HBM): dense matmul
@@ -7,9 +8,22 @@ unfused), optimizer + parameter HBM traffic, and activation traffic.
 Pairs with tools/mfu_analysis.py's measured perfetto breakdown: the
 measured bucket that most exceeds its roofline line is the next lever.
 
-Usage: python tools/gpt_roofline.py [batch seq] (default 8 1024)
+``--decode`` switches to the serving decode-step HBM model (ROADMAP
+direction #2's "roofline first" step, shared with the engine's
+snapshot()["perf"] via paddle_tpu/observability/perf/roofline.py,
+loaded directly by file so this tool never imports jax): KV-read
+bytes per token as a function of batch, context length, heads and
+paged-vs-contiguous layout, the parameter re-read every step pays,
+and the resulting per-step floor — printed for BOTH layouts so the
+XLA gather-materialization tax the Pallas paged-attention kernel
+would delete is a number, not a vibe.
+
+Usage: python tools/gpt_roofline.py [batch seq]           (train step)
+       python tools/gpt_roofline.py --decode [batch ctx]  (decode step)
 """
+import importlib.util
 import json
+import os
 import sys
 
 PEAK_FLOPS = 197e12        # v5e bf16
@@ -17,6 +31,7 @@ HBM_BPS = 819e9            # v5e HBM bandwidth
 
 # GPT-124M
 L, H, V, HEADS = 12, 768, 50304, 12
+MAX_SEQ = 1024
 
 
 def budget(batch, seq, mxu_eff=1.0, hbm_eff=1.0):
@@ -67,9 +82,58 @@ def budget(batch, seq, mxu_eff=1.0, hbm_eff=1.0):
     }
 
 
+def _load_roofline_module():
+    """Load observability/perf/roofline.py by file path: pure stdlib
+    module, no paddle_tpu (= no jax) import at tool startup."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "paddle_tpu", "observability", "perf",
+                        "roofline.py")
+    spec = importlib.util.spec_from_file_location("_ptpu_roofline",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def decode_budget(batch, ctx):
+    """Decode-step HBM model for GPT-124M at (batch slots, ctx cached
+    positions), contiguous vs XLA-composed paged layout, bf16
+    params/KV on the v5e reference chip."""
+    rf = _load_roofline_module()
+    n_params = L * 12 * H * H + V * H + MAX_SEQ * H
+    out = {"config": {"batch": batch, "ctx": ctx, "model": "gpt-124m",
+                      "peak_flops": PEAK_FLOPS, "hbm_bps": HBM_BPS}}
+    for layout in ("contiguous", "paged_xla"):
+        m = rf.decode_step_model(
+            batch=batch, kv_len=ctx, num_layers=L, num_heads=HEADS,
+            head_dim=H // HEADS, n_params=n_params, param_bytes=2,
+            kv_bytes=2, paged=(layout == "paged_xla"),
+            peak_flops=PEAK_FLOPS, hbm_bps=HBM_BPS)
+        out[layout] = {
+            "kv_read_bytes_per_token": m["kv_read_bytes_per_token"],
+            "bytes_total": m["bytes_total"],
+            "flops": m["flops"],
+            "arithmetic_intensity": round(m["arithmetic_intensity"], 4),
+            "floor_us_per_step": round(m["floor_s"] * 1e6, 3),
+            "tokens_per_sec_at_floor": round(
+                batch / m["floor_s"], 1),
+            "bound": m["bound"],
+        }
+    out["paged_gather_tax"] = round(
+        out["paged_xla"]["floor_us_per_step"]
+        / out["contiguous"]["floor_us_per_step"], 3)
+    return out
+
+
 def main():
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8
-    seq = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    args = [a for a in sys.argv[1:] if a != "--decode"]
+    if "--decode" in sys.argv[1:]:
+        batch = int(args[0]) if args else 8
+        ctx = int(args[1]) if len(args) > 1 else 1024
+        print(json.dumps(decode_budget(batch, ctx)))
+        return
+    batch = int(args[0]) if args else 8
+    seq = int(args[1]) if len(args) > 1 else 1024
     # ideal floor and a realistic-efficiency scenario
     for mxu, hbm in ((1.0, 1.0), (0.6, 0.7)):
         print(json.dumps(budget(batch, seq, mxu, hbm)))
