@@ -61,7 +61,22 @@ wall accumulated per program key — ``snapshot()["perf"]``,
 ``/debug/perf``), a decode-step roofline model joined with
 ``executable_cost`` into ``serving_roofline_fraction{program}``, and
 the cross-run perf ledger + ``tools/perf_diff.py`` regression gate.
+
+PR 11 adds the fleet observatory (fleet/): replica identity
+(``replica_id`` / ``serving_uptime_seconds`` /
+``paddle_tpu_build_info`` on every engine), a resilient
+multi-replica scrape poller (per-replica timeout, backoff, staleness,
+eviction/readmission ``up|stale|down`` verdicts), federated rollups
+whose counters sum and fixed-bucket histograms merge bucket-wise
+(fleet percentiles from merged buckets, never averaged percentiles),
+``scope="fleet"`` detectors (replica_flap / fleet_goodput_collapse /
+load_skew), and a FleetServer exposing ``/fleet/health`` /
+``/fleet/state`` / ``/fleet/metrics`` — the surface the ROADMAP
+direction-#2 router consumes.
 """
+from .fleet import (  # noqa: F401
+    FleetPoller, FleetServer, ReplicaIdentity, default_replica_id,
+)
 from .flight import (  # noqa: F401
     FlightRecorder, RequestTrace,
 )
@@ -77,7 +92,9 @@ from .perf import (  # noqa: F401
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, MetricsServerHandle,
     Reservoir, WindowedReservoir, DEFAULT_TIME_BUCKETS,
-    default_registry, start_metrics_server,
+    default_registry, merge_histogram_snapshots,
+    percentile_from_buckets, prometheus_text_from_snapshots,
+    start_metrics_server,
 )
 from .slo import SLOTracker  # noqa: F401
 from .tracing import (  # noqa: F401

@@ -1,0 +1,48 @@
+"""Fleet observatory: replica identity, resilient multi-replica
+scraping, and federated fleet-level health/perf/SLO rollups.
+
+PRs 8-10 made ONE engine replica fully observable (``/debug/health``,
+``/debug/ledger``, ``/debug/perf``) — but only one replica at a time,
+read by a human. This package is the federation layer over N of them:
+the sensory system the ROADMAP direction-#2 router process will stand
+on.
+
+  * **identity** — every engine carries a stable replica id
+    (``ServingConfig(replica_id=)`` / ``$PADDLE_REPLICA_ID`` /
+    host:pid), ``serving_uptime_seconds``, and a
+    ``paddle_tpu_build_info`` info gauge, stamped into its snapshot,
+    debug routes and incident bundles — fleet views tell replicas and
+    versions apart, and a bundle collected off one replica is
+    attributable after the fact;
+  * **poller.FleetPoller** — scrapes a static replica list
+    (``host:port`` / JSON registry file) on an interval with
+    per-replica timeout, exponential backoff, ``last_seen`` staleness
+    marking, and consecutive-failure eviction / readmission verdicts
+    (``up | stale | down``) — the health-poll replica lifecycle the
+    router spec calls for;
+  * **rollup** — the pinned-schema ``FleetSnapshot``: per-replica
+    posture plus fleet aggregates that merge EXACTLY (counters sum;
+    the fixed-bucket histograms merge bucket-wise, so fleet TTFT /
+    latency percentiles come from the merged distribution, never
+    averaged percentiles), judged by ``scope="fleet"`` detectors
+    (``replica_flap`` / ``fleet_goodput_collapse`` / ``load_skew``)
+    in the PR-8 ``register_detector`` framework;
+  * **server.FleetServer** — ``/fleet/health``, ``/fleet/state``,
+    ``/fleet/metrics`` (Prometheus text with a ``replica`` label on
+    every series).
+
+``tools/fleet_top.py`` renders the fleet table from the same poller
+(one-shot or ``--watch``), exiting 0 iff every replica is up and
+healthy.
+"""
+from . import detectors as _fleet_detectors  # noqa: F401 - registers
+from .identity import ReplicaIdentity, default_replica_id  # noqa: F401
+from .poller import (  # noqa: F401
+    FLEET_ROW_KEYS, FleetPoller, ReplicaState,
+)
+from .rollup import (  # noqa: F401
+    FLEET_AGG_KEYS, FLEET_REPLICA_KEYS, FLEET_SCHEMA,
+    FLEET_SNAPSHOT_KEYS, fleet_aggregate, merged_latency,
+    replica_entry,
+)
+from .server import FleetServer  # noqa: F401

@@ -1,0 +1,154 @@
+"""Fleet-level anomaly detectors over the poller's rollup rows.
+
+Same framework as the PR-8 engine detectors
+(``health.detectors.register_detector``), different scope and row
+vocabulary: these are registered under ``scope="fleet"`` and evaluate
+ONE row per completed poll cycle (see ``poller.FleetPoller._fleet_row``
+— ``step`` is the poll sequence number, so the shared ``Detector``
+base and ledger machinery apply unchanged). Engine detectors judge
+one replica's steps; these judge the fleet's SHAPE:
+
+``replica_flap``
+    a replica's availability verdict changed — ``up``→``down`` (the
+    router must stop dispatching there NOW) or ``down``→``up``
+    (readmission; rapid alternation is the classic flapping replica a
+    human should look at). Fires once per transition, naming the
+    replicas and directions.
+``fleet_goodput_collapse``
+    the FLEET's SLO-met tokens/sec falling off a cliff between
+    adjacent poll windows while work is pending — the every-replica-
+    degraded-at-once signature (shared dependency died, overload
+    breached every replica simultaneously) that no single replica's
+    own goodput_collapse detector can distinguish from its neighbors'.
+``load_skew``
+    sustained queue-depth imbalance across UP replicas (max far above
+    the fleet mean while the mean shows real load): the
+    dispatch-layer-is-broken signature — one replica drowning while
+    its peers idle means routing, not capacity, is the problem.
+"""
+import collections
+
+from ..health.detectors import Detector, register_detector
+
+__all__ = ["ReplicaFlap", "FleetGoodputCollapse", "LoadSkew"]
+
+
+@register_detector("replica_flap", scope="fleet")
+class ReplicaFlap(Detector):
+    """Fires on any availability transition involving ``down``:
+    ``up/stale``→``down`` (lost) and ``down``→``up`` (readmitted).
+    Transitions appear in exactly one poll row, so each change fires
+    exactly once."""
+
+    def observe(self, row, ledger):
+        flaps = [t for t in row.get("transitions", ())
+                 if t["to"] == "down" or t["from"] == "down"]
+        if not flaps:
+            return None
+        names = ", ".join(f"{t['replica']}:{t['from']}->{t['to']}"
+                          for t in flaps)
+        return self._verdict(
+            row, f"replica availability changed: {names}",
+            replicas=[t["replica"] for t in flaps],
+            transitions=[dict(t) for t in flaps],
+            down=int(row.get("down", 0)))
+
+
+@register_detector("fleet_goodput_collapse", scope="fleet")
+class FleetGoodputCollapse(Detector):
+    """Fleet-aggregate SLO-met tokens/sec cliff between adjacent
+    ``window``-poll windows: previous window healthy (>=
+    ``healthy_frac`` of the best windowed rate seen), current window
+    below ``drop_frac`` of it, work still pending somewhere in the
+    fleet. Inert while no replica reports goodput (no SLO targets
+    configured fleet-wide)."""
+
+    def __init__(self, window=8, drop_frac=0.1, healthy_frac=0.5):
+        self.window = int(window)
+        self.drop_frac = float(drop_frac)
+        self.healthy_frac = float(healthy_frac)
+        self._rows = collections.deque(maxlen=2 * self.window)
+        self._peak = 0.0
+
+    @staticmethod
+    def _rate(seg):
+        dt = sum(d for _, d in seg)
+        good = sum(g for g, _ in seg)
+        return good / dt if dt > 0 else 0.0
+
+    def observe(self, row, ledger):
+        self._rows.append((float(row.get("goodput_delta", 0.0)),
+                           float(row.get("dt_s", 0.0))))
+        if len(self._rows) < 2 * self.window:
+            return None
+        rows = list(self._rows)
+        prev = self._rate(rows[:self.window])
+        cur = self._rate(rows[self.window:])
+        if prev > 0:
+            self._peak = max(self._peak, prev)
+        if (row.get("work_pending")
+                and self._peak > 0
+                and prev >= self.healthy_frac * self._peak
+                and cur < self.drop_frac * prev):
+            self._rows.clear()
+            return self._verdict(
+                row,
+                f"fleet goodput {cur:.1f} tok/s collapsed from "
+                f"{prev:.1f} tok/s",
+                window_polls=self.window,
+                previous_rate_tps=round(prev, 3),
+                current_rate_tps=round(cur, 3),
+                peak_rate_tps=round(self._peak, 3))
+        return None
+
+
+@register_detector("load_skew", scope="fleet")
+class LoadSkew(Detector):
+    """Queue-depth imbalance across UP replicas, sustained for
+    ``sustain`` consecutive polls: the worst replica holds >=
+    ``min_depth`` queued requests AND >= ``skew_factor`` x (its
+    PEERS' mean depth + 1). Judging the worst against its peers (not
+    the fleet mean, which the worst itself dominates on small fleets
+    — with N replicas max/mean is bounded by N) makes the
+    one-replica-drowning-while-peers-idle signature detectable at any
+    fleet size >= ``min_replicas``. The absolute ``min_depth`` floor
+    keeps an idle fleet's zero-vs-one jitter quiet. Fires once per
+    episode; re-arms when balance returns."""
+
+    def __init__(self, skew_factor=4.0, min_depth=6, sustain=3,
+                 min_replicas=2):
+        self.skew_factor = float(skew_factor)
+        self.min_depth = int(min_depth)
+        self.sustain = int(sustain)
+        self.min_replicas = int(min_replicas)
+        self._streak = 0
+        self._fired = False
+
+    def observe(self, row, ledger):
+        depths = row.get("queue_depths") or {}
+        if len(depths) < self.min_replicas:
+            self._streak = 0
+            self._fired = False
+            return None
+        worst = max(depths, key=lambda r: depths[r])
+        peers = [v for r, v in depths.items() if r != worst]
+        peer_mean = sum(peers) / len(peers)
+        skewed = (depths[worst] >= self.min_depth
+                  and depths[worst]
+                  >= self.skew_factor * (peer_mean + 1.0))
+        if not skewed:
+            self._streak = 0
+            self._fired = False
+            return None
+        self._streak += 1
+        if self._streak >= self.sustain and not self._fired:
+            self._fired = True
+            return self._verdict(
+                row,
+                f"queue skew: {worst} holds {depths[worst]} queued vs "
+                f"peer mean {peer_mean:.1f}",
+                replica=worst,
+                max_queue_depth=int(depths[worst]),
+                peer_mean_queue_depth=round(peer_mean, 2),
+                polls_skewed=self._streak)
+        return None

@@ -1,0 +1,62 @@
+"""Replica identity: who IS this engine, in a fleet of lookalikes.
+
+Every serving replica needs a stable, human-readable identity before
+any fleet view can exist: scrape results must be attributable to one
+process (two replicas on one host differ only by pid), incident
+bundles collected off a fleet member must name which member, and a
+rolling deploy needs the version visible per replica. The identity is
+
+  * configured — ``ServingConfig(replica_id=...)`` or the
+    ``PADDLE_REPLICA_ID`` env var (the k8s/pod-name case), else
+  * derived — ``<hostname>:<pid>`` (:func:`default_replica_id`):
+    stable for the process lifetime, unique across a host's replicas,
+    and meaningful in logs without a lookup table.
+
+The engine stamps it into ``snapshot()["replica"]``, ``/debug/state``,
+``/debug/health`` and incident bundles, exposes
+``serving_uptime_seconds`` (a restart-detection signal: uptime going
+BACKWARDS between scrapes means the process bounced), and registers a
+``paddle_tpu_build_info{replica, version, jax_version}`` info gauge
+(value 1, Prometheus ``*_info`` convention) so ``/fleet/metrics`` can
+tell replicas and versions apart without a side channel.
+"""
+import os
+import socket
+import time
+
+__all__ = ["default_replica_id", "ReplicaIdentity"]
+
+
+def default_replica_id():
+    """A stable host:pid-derived replica id — unique per process on a
+    host, stable for the process lifetime, readable in a log line."""
+    try:
+        host = socket.gethostname() or "localhost"
+    except OSError:
+        host = "localhost"
+    return f"{host.split('.')[0]}:{os.getpid()}"
+
+
+class ReplicaIdentity:
+    """One replica's identity + uptime clock, shared by every surface
+    that stamps it (snapshot / debug routes / incident bundles)."""
+
+    def __init__(self, replica_id=None, clock=time.perf_counter):
+        self.replica_id = str(replica_id) if replica_id \
+            else default_replica_id()
+        self._clock = clock
+        self._t0 = clock()
+        self.started_at = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                        time.gmtime())
+
+    def uptime_s(self):
+        return self._clock() - self._t0
+
+    def report(self):
+        """The ``snapshot()["replica"]`` / ``/debug/state["replica"]``
+        body."""
+        return {
+            "replica_id": self.replica_id,
+            "uptime_s": round(self.uptime_s(), 3),
+            "started_at": self.started_at,
+        }

@@ -1,0 +1,213 @@
+"""Federated fleet rollups: many replica scrapes, one pinned snapshot.
+
+The poller hands this module each replica's last-known scrape bodies
+(``/metrics.json`` snapshot, ``/debug/health``, ``/debug/state``) plus
+its own availability bookkeeping; this module folds them into the
+``FleetSnapshot`` — the ``/fleet/state`` body and the surface the
+PR-12 router will consume. Two merge rules, applied EXACTLY:
+
+  * **counters sum** — tokens, goodput, completions are additive
+    facts; the fleet total is the sum over replicas' last-known
+    cumulative counters (down replicas keep contributing their last
+    observed totals: a crashed replica's already-served tokens
+    happened);
+  * **histograms merge bucket-wise** — fleet TTFT / request-latency
+    percentiles come from ``registry.merge_histogram_snapshots`` over
+    the per-replica fixed-bucket histograms and
+    ``registry.percentile_from_buckets`` over the MERGED distribution.
+    Averaging per-replica percentiles is statistically meaningless
+    (a p99 of averages is not an average of p99s); merged buckets are
+    the one representation that aggregates exactly.
+
+``FLEET_SNAPSHOT_KEYS`` / ``FLEET_REPLICA_KEYS`` / ``FLEET_AGG_KEYS``
+are the schema contract (tests/test_fleet.py pins them — keys only
+get added, never renamed).
+"""
+from ..registry import merge_histogram_snapshots, percentile_from_buckets
+
+FLEET_SCHEMA = "paddle_tpu.fleet/v1"
+
+# /fleet/state top level
+FLEET_SNAPSHOT_KEYS = (
+    "schema", "t", "polls", "interval_s", "replicas", "fleet",
+    "health",
+)
+
+# one entry per replica (identity + availability + posture + load)
+FLEET_REPLICA_KEYS = (
+    "replica_id",     # self-reported id (configured id until learned)
+    "url",            # scrape base URL
+    "verdict",        # up | stale | down (availability)
+    "healthy",        # the replica's own /debug/health verdict
+    "degraded",       # supervisor-restart replay still draining
+    "draining",       # graceful drain in progress
+    "restarts",       # cumulative supervisor restarts
+    "queue_depth",    # queued requests at last scrape
+    "occupancy",      # live slots / num_slots at last scrape
+    "steps",          # engine steps ever (health ledger)
+    "step_rate",      # steps/sec between the last two scrapes
+    "tokens_generated",
+    "goodput_tokens",
+    "requests_completed",
+    "roofline_fraction",   # decode program, when priced
+    "uptime_s",       # replica-reported process uptime
+    "version",        # paddle_tpu_build_info version label
+    "age_s",          # seconds since the last successful scrape
+    "consecutive_failures",
+    "polls",          # scrape attempts against this replica
+    "failures",       # of those, failed
+    "evictions",      # up->down verdict flips
+    "readmissions",   # down->up verdict flips
+    "scrape_ms",      # last successful scrape round-trip
+    "last_error",     # last scrape failure, abbreviated (None when up)
+)
+
+# the fleet-level aggregate block
+FLEET_AGG_KEYS = (
+    "size", "up", "stale", "down", "healthy", "queue_depth",
+    "occupancy", "step_rate", "tokens_generated", "goodput_tokens",
+    "requests_completed", "latency", "roofline_fraction",
+)
+
+_PCTS = ((50, "p50_ms"), (90, "p90_ms"), (99, "p99_ms"))
+_LATENCY_FAMILIES = (("ttft", "serving_ttft_seconds"),
+                     ("request_latency",
+                      "serving_request_latency_seconds"))
+
+
+def counter_value(snap, name, labels=""):
+    """One series' value out of a registry ``snapshot()`` dict; None
+    when the family or series is absent (an older replica, or a
+    family that never accrued)."""
+    fam = (snap or {}).get(name)
+    if not fam:
+        return None
+    v = fam.get("values", {}).get(labels)
+    return v if isinstance(v, (int, float)) else None
+
+
+def histogram_value(snap, name, labels=""):
+    """One histogram series ({count, sum, buckets}) or None."""
+    fam = (snap or {}).get(name)
+    if not fam or fam.get("type") != "histogram":
+        return None
+    v = fam.get("values", {}).get(labels)
+    return v if isinstance(v, dict) else None
+
+
+def build_info_labels(snap):
+    """The first ``paddle_tpu_build_info`` series' labels as a dict
+    (replica / version / jax_version), {} when absent."""
+    fam = (snap or {}).get("paddle_tpu_build_info")
+    for key in (fam or {}).get("values", {}):
+        out = {}
+        for part in key.split(","):
+            k, _, v = part.partition("=")
+            out[k] = v
+        return out
+    return {}
+
+
+def _sum_known(values):
+    known = [v for v in values if v is not None]
+    return round(sum(known), 3) if known else None
+
+
+def _mean_known(values):
+    known = [v for v in values if v is not None]
+    return round(sum(known) / len(known), 4) if known else None
+
+
+def merged_latency(snapshots):
+    """{"ttft": {count, p50_ms, p90_ms, p99_ms}, "request_latency":
+    {...}} from bucket-wise merged per-replica histograms."""
+    out = {}
+    for name, family in _LATENCY_FAMILIES:
+        entries = [histogram_value(s, family) for s in snapshots]
+        merged = merge_histogram_snapshots(entries)
+        entry = {"count": merged["count"]}
+        for q, key in _PCTS:
+            p = percentile_from_buckets(merged["buckets"], q)
+            entry[key] = None if p is None else round(p * 1000.0, 3)
+        out[name] = entry
+    return out
+
+
+def replica_entry(st, now):
+    """One ``FLEET_REPLICA_KEYS`` row from a poller ReplicaState."""
+    snap, health, state = st.metrics, st.health, st.state
+    hrep = health or {}
+    srep = state or {}
+    replica_sec = srep.get("replica") or {}
+    info = build_info_labels(snap)
+    roofline = counter_value(snap, "serving_roofline_fraction",
+                             "program=decode")
+    return {
+        "replica_id": st.replica_id,
+        "url": st.url,
+        "verdict": st.verdict,
+        "healthy": hrep.get("healthy"),
+        "degraded": hrep.get("degraded"),
+        "draining": hrep.get("draining"),
+        "restarts": hrep.get("restarts"),
+        "queue_depth": srep.get("queue_depth"),
+        "occupancy": srep.get("slot_occupancy"),
+        "steps": (hrep.get("ledger") or {}).get("steps"),
+        "step_rate": round(st.step_rate, 2)
+        if st.step_rate is not None else None,
+        "tokens_generated": counter_value(
+            snap, "serving_tokens_generated_total"),
+        "goodput_tokens": counter_value(
+            snap, "serving_goodput_tokens_total"),
+        "requests_completed": counter_value(
+            snap, "serving_requests_completed_total"),
+        "roofline_fraction": round(roofline, 6)
+        if roofline else None,
+        "uptime_s": replica_sec.get("uptime_s"),
+        "version": info.get("version"),
+        "age_s": round(now - st.last_seen, 3)
+        if st.last_seen is not None else None,
+        "consecutive_failures": st.consecutive_failures,
+        "polls": st.polls,
+        "failures": st.failures,
+        "evictions": st.evictions,
+        "readmissions": st.readmissions,
+        "scrape_ms": round(st.scrape_s * 1000.0, 3)
+        if st.scrape_s is not None else None,
+        "last_error": st.last_error,
+    }
+
+
+def fleet_aggregate(entries, snapshots):
+    """The ``FLEET_AGG_KEYS`` block: availability census + exact
+    counter sums + bucket-wise merged latency percentiles. ``entries``
+    are the per-replica rows; ``snapshots`` the last-known metrics
+    snapshots of every replica that ever scraped (down replicas'
+    already-served work still counts)."""
+    verdicts = [e["verdict"] for e in entries]
+    up = sum(v == "up" for v in verdicts)
+    stale = sum(v == "stale" for v in verdicts)
+    down = len(verdicts) - up - stale
+    healthy = bool(entries) and all(
+        e["verdict"] == "up" and e["healthy"] is True
+        and not e["degraded"] and not e["draining"] for e in entries)
+    live = [e for e in entries if e["verdict"] != "down"]
+    return {
+        "size": len(entries),
+        "up": up,
+        "stale": stale,
+        "down": down,
+        "healthy": healthy,
+        "queue_depth": _sum_known([e["queue_depth"] for e in live]),
+        "occupancy": _mean_known([e["occupancy"] for e in live]),
+        "step_rate": _sum_known([e["step_rate"] for e in live]),
+        "tokens_generated": _sum_known(
+            [e["tokens_generated"] for e in entries]),
+        "goodput_tokens": _sum_known(
+            [e["goodput_tokens"] for e in entries]),
+        "requests_completed": _sum_known(
+            [e["requests_completed"] for e in entries]),
+        "latency": merged_latency(snapshots),
+        "roofline_fraction": _mean_known(
+            [e["roofline_fraction"] for e in live]),
+    }

@@ -43,44 +43,58 @@ bench run must fire NOTHING; a wedge is never subtle):
 """
 import collections
 
+# detector registries by SCOPE: "engine" detectors watch one engine's
+# per-step ledger rows (the PR-8 observatory), "fleet" detectors watch
+# the fleet poller's per-poll rollup rows (observability.fleet) — one
+# framework, two row vocabularies, and a HealthMonitor never
+# instantiates a fleet detector (or vice versa) because build_detectors
+# only reads its own scope
+_SCOPES = {"engine": {}}
+_DETECTORS = _SCOPES["engine"]   # legacy alias (engine scope)
 
-_DETECTORS = {}
+
+def _scope(scope):
+    return _SCOPES.setdefault(scope, {})
 
 
-def register_detector(name):
+def register_detector(name, scope="engine"):
     """Register a detector class/factory under ``name`` (zero-required-
     arg constructible; keyword thresholds only). Re-registering
     replaces — tests stub detectors this way. The instance's ``name``
-    attribute is stamped to match."""
+    attribute is stamped to match. ``scope`` namespaces the registry:
+    engine detectors (default) evaluate per-step ledger rows, fleet
+    detectors (``scope="fleet"``) evaluate per-poll fleet rows."""
     def deco(factory):
         factory.name = name
-        _DETECTORS[name] = factory
+        _scope(scope)[name] = factory
         return factory
     return deco
 
 
-def unregister_detector(name):
+def unregister_detector(name, scope="engine"):
     """Remove a registered detector (test cleanup)."""
-    return _DETECTORS.pop(name, None)
+    return _scope(scope).pop(name, None)
 
 
-def detector_names():
-    """All registered detector names, sorted."""
-    return sorted(_DETECTORS)
+def detector_names(scope="engine"):
+    """All registered detector names in ``scope``, sorted."""
+    return sorted(_scope(scope))
 
 
-def build_detectors(overrides=None, only=None):
-    """Instantiate every registered detector (or the ``only`` subset),
-    passing ``overrides[name]`` as constructor kwargs when present —
-    the ServingConfig(health_detectors=...) plumbing."""
+def build_detectors(overrides=None, only=None, scope="engine"):
+    """Instantiate every detector registered in ``scope`` (or the
+    ``only`` subset), passing ``overrides[name]`` as constructor
+    kwargs when present — the ServingConfig(health_detectors=...) /
+    FleetPoller(detector_config=...) plumbing."""
     overrides = dict(overrides or {})
-    names = detector_names() if only is None else list(only)
+    reg = _scope(scope)
+    names = detector_names(scope) if only is None else list(only)
     out = []
     for n in names:
-        if n not in _DETECTORS:
-            raise ValueError(f"unknown detector {n!r}; registered: "
-                             f"{detector_names()}")
-        out.append(_DETECTORS[n](**overrides.get(n, {})))
+        if n not in reg:
+            raise ValueError(f"unknown detector {n!r}; registered in "
+                             f"scope {scope!r}: {detector_names(scope)}")
+        out.append(reg[n](**overrides.get(n, {})))
     return out
 
 

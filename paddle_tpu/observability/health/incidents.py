@@ -34,11 +34,13 @@ INCIDENT_SCHEMA = "paddle_tpu.health.incident/v1"
 # tools/incident_report.py renders from it). ``chaos`` is the active
 # FaultPlan + fault log when the engine runs under the fault-injection
 # harness (None otherwise) — a chaos-found incident is replayable from
-# the bundle alone.
+# the bundle alone. ``replica`` is the writing engine's identity
+# (replica_id / uptime) — a bundle collected off one member of a
+# fleet stays attributable after the fact.
 INCIDENT_KEYS = (
     "schema", "written_at", "detector", "verdict", "ledger_tail",
     "metrics", "watchdog", "requests", "spans_tail", "health",
-    "chaos",
+    "chaos", "replica",
 )
 
 
@@ -49,7 +51,8 @@ def disabled_health_summary():
     return {"enabled": False, "healthy": True, "anomalies_total": 0,
             "detectors": {}, "incidents_written": 0,
             "last_incident": None, "ledger_steps": 0,
-            "degraded": False, "draining": False, "restarts": 0}
+            "degraded": False, "draining": False, "restarts": 0,
+            "replica_id": None, "uptime_s": None}
 
 
 class IncidentRecorder:
@@ -116,6 +119,7 @@ class IncidentRecorder:
             "spans_tail": self._section(context, "spans_tail"),
             "health": health_report,
             "chaos": self._section(context, "chaos"),
+            "replica": self._section(context, "replica"),
         }
         os.makedirs(self.directory, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
@@ -186,6 +190,7 @@ class HealthMonitor:
         self._state = {}
         self._resolved_total = 0   # anomalies acknowledged-recovered
         self._resilience_fn = None  # engine's degraded/draining state
+        self._identity_fn = None    # engine's replica identity
         self._lock = threading.Lock()
 
     def attach_resilience(self, state_fn):
@@ -194,6 +199,18 @@ class HealthMonitor:
         router the replica's TRUE serving posture, not just its
         anomaly history."""
         self._resilience_fn = state_fn
+
+    def attach_identity(self, identity_fn):
+        """Attach the engine's replica identity report (``{
+        "replica_id", "uptime_s", ...}``) so ``/debug/health`` and
+        ``snapshot()["health"]`` name WHICH replica they describe —
+        the attribution a fleet poller's merged view depends on."""
+        self._identity_fn = identity_fn
+
+    def _identity(self):
+        if self._identity_fn is None:
+            return {"replica_id": None, "uptime_s": None}
+        return self._identity_fn()
 
     def _resilience(self):
         if self._resilience_fn is None:
@@ -298,9 +315,14 @@ class HealthMonitor:
         with self._lock:
             resolved = self._resolved_total
         res = self._resilience()
+        ident = self._identity()
         unresolved = max(0, total - resolved)
         return {
             "healthy": unresolved == 0 and not res["degraded"],
+            # which replica this health body describes (the fleet
+            # poller's merged view keys on it)
+            "replica_id": ident.get("replica_id"),
+            "uptime_s": ident.get("uptime_s"),
             "anomalies_total": total,
             "anomalies_resolved": resolved,
             # the router-facing replica posture: degraded while a
@@ -324,9 +346,12 @@ class HealthMonitor:
         report(): firing counts only, no verdict payloads)."""
         total = self.anomalies_total
         res = self._resilience()
+        ident = self._identity()
         return {
             "enabled": True,
             "healthy": self.healthy,
+            "replica_id": ident.get("replica_id"),
+            "uptime_s": ident.get("uptime_s"),
             "anomalies_total": total,
             "detectors": self.detector_counts(),
             "incidents_written": self.incidents.written

@@ -129,6 +129,39 @@ def read_rows(path):
     return rows, skipped
 
 
+def compact(path, keep_last):
+    """Bound the ledger: rewrite it keeping only the NEWEST
+    ``keep_last`` rows per (scenario, metric, config_digest) series,
+    preserving append order. The ledger grows one row per (scenario,
+    metric) per bench run forever — compaction is the retention knob
+    (``bench_serving.py --ledger-keep N`` / $BENCH_LEDGER_KEEP,
+    default off). The rewrite is atomic (temp file + replace), so a
+    crash mid-compaction never corrupts the ledger; junk lines and
+    foreign schemas are dropped (they were already invisible to
+    ``compare()``). Returns ``(kept, dropped)`` row counts."""
+    keep_last = int(keep_last)
+    if keep_last < 1:
+        raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+    import os
+    rows, skipped = read_rows(path)
+    per_series = {}
+    for row in rows:
+        key = (row["scenario"], row["metric"],
+               row.get("config_digest", ""))
+        per_series.setdefault(key, []).append(row)
+    keep = set()
+    for series in per_series.values():
+        for row in series[-keep_last:]:
+            keep.add(id(row))
+    kept = [r for r in rows if id(r) in keep]
+    tmp = path + ".compact.tmp"
+    with open(tmp, "w") as fh:
+        for row in kept:
+            fh.write(json.dumps(row, sort_keys=True) + "\n")
+    os.replace(tmp, path)
+    return len(kept), len(rows) - len(kept) + skipped
+
+
 def _median(xs):
     s = sorted(xs)
     n = len(s)

@@ -363,6 +363,151 @@ def _interp_percentile(xs, q):
     return xs[lo] * (1.0 - frac) + xs[hi] * frac
 
 
+# ------------------------------------------------ scrape-merge support
+# The federation layer (observability.fleet) aggregates MANY replica
+# registries from their scraped ``snapshot()`` JSON. Merging lives
+# here, next to the exposition format it inverts: counters/gauges sum,
+# histograms merge BUCKET-WISE (every engine histogram is fixed-bucket
+# by construction, so bucket counts are additive and fleet percentiles
+# come from the merged distribution — never from averaged per-replica
+# percentiles, which is statistically meaningless).
+
+def _bucket_bound(le):
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def merge_histogram_snapshots(entries):
+    """Merge snapshot-format histogram dicts (``{count, sum, buckets:
+    {le: cumulative}}``) bucket-wise: counts and sums add, cumulative
+    bucket counts add per ``le`` bound. Entries with different bucket
+    layouts merge over the UNION of bounds (a missing bound inherits
+    the entry's nearest lower cumulative count — exact for the
+    fixed-bucket families this stack emits, conservative otherwise).
+    Returns the same shape; ``None``/empty input merges to a zero
+    histogram."""
+    entries = [e for e in (entries or []) if e]
+    bounds = sorted({b for e in entries for b in e.get("buckets", {})},
+                    key=_bucket_bound)
+    if "+Inf" not in bounds:
+        bounds.append("+Inf")
+    merged = {le: 0 for le in bounds}
+    total_count = 0
+    total_sum = 0.0
+    for e in entries:
+        total_count += int(e.get("count", 0))
+        total_sum += float(e.get("sum", 0.0))
+        ebuckets = sorted(e.get("buckets", {}).items(),
+                          key=lambda kv: _bucket_bound(kv[0]))
+        for le in bounds:
+            cum = 0
+            bound = _bucket_bound(le)
+            for ele, ecum in ebuckets:
+                if _bucket_bound(ele) <= bound:
+                    cum = ecum
+                else:
+                    break
+            if le == "+Inf":
+                cum = int(e.get("count", 0))
+            merged[le] += int(cum)
+    return {"count": total_count, "sum": round(total_sum, 6),
+            "buckets": merged}
+
+
+def percentile_from_buckets(buckets, q):
+    """Percentile estimate from cumulative fixed buckets (``{le:
+    cumulative}``), Prometheus ``histogram_quantile`` style: find the
+    bucket the q-quantile rank lands in and interpolate linearly
+    inside it. The +Inf bucket clamps to the largest finite bound (no
+    invented upper edge). None when empty."""
+    if not buckets:
+        return None
+    items = sorted(buckets.items(), key=lambda kv: _bucket_bound(kv[0]))
+    total = items[-1][1]
+    if not total:
+        return None
+    target = (float(q) / 100.0) * total
+    prev_cum, prev_bound = 0, 0.0
+    largest_finite = max((_bucket_bound(le) for le, _ in items
+                          if le != "+Inf"), default=0.0)
+    for le, cum in items:
+        bound = _bucket_bound(le)
+        if cum >= target:
+            if bound == float("inf"):
+                return largest_finite
+            in_bucket = cum - prev_cum
+            frac = ((target - prev_cum) / in_bucket) if in_bucket else 1.0
+            return prev_bound + frac * (bound - prev_bound)
+        prev_cum, prev_bound = cum, (bound if bound != float("inf")
+                                     else prev_bound)
+    return largest_finite
+
+
+def _parse_series_key(key):
+    """Invert the snapshot series key format ('k=v,k=v', '' for
+    unlabeled) back into label pairs. Exact for every label value this
+    stack emits (program keys, detector names, span scopes, shed
+    reasons — none contain ',' or '='); foreign values containing
+    either would split lossily, which the fleet exposition accepts."""
+    if not key:
+        return []
+    pairs = []
+    for part in key.split(","):
+        k, _, v = part.partition("=")
+        pairs.append((k, v))
+    return pairs
+
+
+def prometheus_text_from_snapshots(labeled_snapshots,
+                                   label="replica"):
+    """Render MANY registry ``snapshot()`` dicts as ONE Prometheus
+    text exposition, stamping each snapshot's series with an extra
+    ``label`` (default ``replica``) — the scrape-merge step of the
+    fleet federation surface (``/fleet/metrics``): per-replica series
+    stay distinct (Prometheus-federation style), and any downstream
+    aggregation can sum/merge them knowing which replica each sample
+    came from. ``labeled_snapshots`` is an iterable of
+    ``(label_value, snapshot_dict)``."""
+    labeled = [(str(lv), snap or {}) for lv, snap in labeled_snapshots]
+    names = sorted({n for _, snap in labeled for n in snap})
+    lines = []
+    for name in names:
+        fams = [(lv, snap[name]) for lv, snap in labeled
+                if name in snap]
+        kind = fams[0][1].get("type", "gauge")
+        help_text = next((f.get("help") for _, f in fams
+                          if f.get("help")), "")
+        if help_text:
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {name} {kind}")
+        for lv, fam in fams:
+            if fam.get("type", kind) != kind:
+                continue      # kind clash across replicas: skip, never 500
+            for key in sorted(fam.get("values", {})):
+                value = fam["values"][key]
+                pairs = [(label, lv)] + _parse_series_key(key)
+                body = ",".join(f'{k}="{_escape_label(v)}"'
+                                for k, v in pairs)
+                if kind == "histogram" and isinstance(value, dict):
+                    buckets = sorted(
+                        value.get("buckets", {}).items(),
+                        key=lambda kv: _bucket_bound(kv[0]))
+                    for le, cum in buckets:
+                        lines.append(
+                            f'{name}_bucket{{{body},le='
+                            f'"{_escape_label(le)}"}} {_fmt(cum)}')
+                    lines.append(f"{name}_sum{{{body}}} "
+                                 f"{_fmt(value.get('sum', 0.0))}")
+                    lines.append(f"{name}_count{{{body}}} "
+                                 f"{_fmt(value.get('count', 0))}")
+                else:
+                    try:
+                        sample = _fmt(value)
+                    except (TypeError, ValueError):
+                        continue
+                    lines.append(f"{name}{{{body}}} {sample}")
+    return "\n".join(lines) + "\n"
+
+
 class WindowedReservoir:
     """Sliding-TIME-window observation buffer: percentiles over the
     last ``window_s`` seconds of traffic instead of process lifetime
@@ -593,16 +738,22 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
                          extra_routes=None):
     """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` (the
     snapshot) on a stdlib HTTP server in a daemon thread.
-    ``extra_routes`` maps additional paths to zero-arg callables whose
-    JSON-serializable return value is served as application/json — the
+    ``extra_routes`` maps additional paths to zero-arg callables: a
+    JSON-serializable return value is served as application/json (the
     serving engine mounts ``/debug/requests`` and ``/debug/state``
-    this way. ``GET /debug`` serves the route index ({"routes":
-    [every mounted path]}) so operators can discover the surface
-    without reading source (an explicit ``/debug`` extra route
-    overrides the built-in index). Returns a MetricsServerHandle:
-    ``handle.port`` is the bound port (``port=0`` picks a free one),
-    ``handle.close()`` stops it (idempotent; also a context
-    manager)."""
+    this way), a ``str`` return value is served as Prometheus-flavored
+    text/plain (the fleet server mounts its merged ``/fleet/metrics``
+    exposition this way). ``GET /debug`` serves the route index
+    ({"routes": [every mounted path]}) so operators can discover the
+    surface without reading source (an explicit ``/debug`` extra
+    route overrides the built-in index). Every route — the built-in
+    /metrics pair included — renders its FULL body before any byte
+    goes on the wire, and a rendering failure turns into a 500, so a
+    scraper racing an engine shutdown reads either a complete
+    response or a clean error, never a truncated half-body. Returns a
+    MetricsServerHandle: ``handle.port`` is the bound port (``port=0``
+    picks a free one), ``handle.close()`` stops it (idempotent; also
+    a context manager)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
@@ -615,28 +766,42 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):
             path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
-            if path == "/metrics":
-                body = reg.prometheus_text().encode("utf-8")
-                ctype = "text/plain; version=0.0.4; charset=utf-8"
-            elif path == "/metrics.json":
-                body = reg.snapshot_json().encode("utf-8")
-                ctype = "application/json"
-            elif path in routes:
-                try:
-                    body = json.dumps(routes[path](),
-                                      sort_keys=True).encode("utf-8")
-                except Exception as e:  # noqa: BLE001
-                    self.send_error(500, f"{type(e).__name__}: {e}")
+            try:
+                if path == "/metrics":
+                    body = reg.prometheus_text().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/metrics.json":
+                    body = reg.snapshot_json().encode("utf-8")
+                    ctype = "application/json"
+                elif path in routes:
+                    payload = routes[path]()
+                    if isinstance(payload, str):
+                        body = payload.encode("utf-8")
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    else:
+                        body = json.dumps(
+                            payload, sort_keys=True).encode("utf-8")
+                        ctype = "application/json"
+                else:
+                    self.send_error(404)
                     return
-                ctype = "application/json"
-            else:
-                self.send_error(404)
+            except Exception as e:  # noqa: BLE001 - 500, never half-body
+                try:
+                    self.send_error(500, f"{type(e).__name__}: {e}")
+                except Exception:   # peer already gone mid-shutdown
+                    pass
                 return
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the scraper hung up (or the server is closing the
+                # socket under us mid-shutdown): nothing to answer
+                pass
 
         def log_message(self, *args):  # silence per-request stderr spam
             pass

@@ -239,6 +239,14 @@ Tuning knobs
 ``peak_flops``  device peak FLOP/s for the estimated-MFU gauge
                 (default: device_kind table / $PADDLE_TPU_PEAK_FLOPS;
                 unknown -> the gauge reads 0).
+``replica_id``  this engine's identity in a fleet (default:
+                ``$PADDLE_REPLICA_ID``, else a stable host:pid id).
+                Stamped into ``snapshot()["replica"]``,
+                ``/debug/state``, ``/debug/health``, incident bundles,
+                and the ``paddle_tpu_build_info`` /
+                ``serving_uptime_seconds`` exposition — what
+                ``observability.fleet.FleetPoller`` and the /fleet/*
+                surface key replicas by.
 ``eos_id``      default stop token (per-request override on
                 add_request).
 

@@ -177,7 +177,8 @@ class ServingConfig:
                  chaos=None, max_dispatch_retries=0,
                  retry_backoff_s=0.0, quarantine_after=3,
                  supervisor=None, supervisor_max_restarts=8,
-                 supervisor_cooldown_s=1.0, perf=None):
+                 supervisor_cooldown_s=1.0, perf=None,
+                 replica_id=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -328,6 +329,14 @@ class ServingConfig:
         if perf is None:
             perf = os.environ.get("PADDLE_PERF", "1") != "0"
         self.perf = bool(perf)
+        # replica identity (observability.fleet): the id a fleet view
+        # knows this engine by — stamped into snapshot()/debug routes/
+        # incident bundles and the paddle_tpu_build_info exposition.
+        # None = $PADDLE_REPLICA_ID (the k8s/pod-name case), else a
+        # stable host:pid-derived id at engine construction.
+        if replica_id is None:
+            replica_id = os.environ.get("PADDLE_REPLICA_ID") or None
+        self.replica_id = replica_id
 
 
 class ServingEngine:
@@ -430,6 +439,16 @@ class ServingEngine:
             slo_window_s=config.slo_window_s,
             perf=config.perf)
         self._perf_on = config.perf
+        # replica identity: who this engine is in a fleet of
+        # lookalikes — uptime + build-info gauges in the exposition,
+        # and a "replica" section on snapshot()/debug/state/incidents
+        import jax as _jax
+        from ..observability.fleet import ReplicaIdentity
+        from ..version import full_version as _pt_version
+        self.identity = ReplicaIdentity(config.replica_id)
+        self.replica_id = self.identity.replica_id
+        self.metrics.set_identity(self.identity, version=_pt_version,
+                                  jax_version=_jax.__version__)
         self.metrics.set_scheduler_info(
             self._policy.name, self.chunk_len,
             self.prefill_token_budget)
@@ -488,6 +507,9 @@ class ServingEngine:
                 "watchdog": self.watchdog.report,
                 "requests": self.flight.debug_requests,
                 "spans_tail": _spans_tail,
+                # replica attribution: a bundle collected off one
+                # member of a fleet must name which member wrote it
+                "replica": self.metrics.identity_report,
             }
             if self.chaos is not None:
                 # a chaos-found incident must be replayable from its
@@ -503,6 +525,7 @@ class ServingEngine:
                 self._health_resilience,
                 lambda: {"degraded": False, "draining": False,
                          "restarts": 0}))
+            self.health.attach_identity(self.metrics.identity_report)
             self.metrics.set_health(self.health.summary)
         else:
             self.health = None
@@ -809,6 +832,7 @@ class ServingEngine:
         sch = self.scheduler
         wd = self.watchdog.report()
         return {
+            "replica": self.metrics.identity_report(),
             "queue_depth": len(sch.queue),
             "queued_rids": [r.rid for r in sch.queue],
             "active_slots": {str(slot): req.rid

@@ -207,6 +207,7 @@ class ServingMetrics:
                             "prefill_token_budget": None}
         self._prefix_pool_stats = None
         self._health_fn = None
+        self._identity = None
         # plain-int mirror of the labeled shed counter: the health
         # tick reads a shed total on EVERY engine step, and iterating
         # the labeled series per step is measurable overhead there
@@ -331,6 +332,39 @@ class ServingMetrics:
             "pool": self._prefix_pool_stats()
             if self._prefix_pool_stats is not None else None,
         }
+
+    def set_identity(self, identity, version=None, jax_version=None):
+        """Stamp this engine's replica identity
+        (observability.fleet.ReplicaIdentity) into the registry:
+        ``serving_uptime_seconds`` (a pull gauge — uptime moving
+        BACKWARDS between two fleet scrapes means the process
+        bounced) and the ``paddle_tpu_build_info{replica, version,
+        jax_version}`` info gauge (value 1, Prometheus ``*_info``
+        convention) every fleet view uses to tell replicas and
+        versions apart."""
+        self._identity = identity
+        self.registry.gauge(
+            "serving_uptime_seconds",
+            "seconds since this engine replica was constructed "
+            "(restart detection: uptime going backwards between "
+            "scrapes means the process bounced)"
+        ).set_function(identity.uptime_s)
+        self.registry.gauge(
+            "paddle_tpu_build_info",
+            "replica identity + build info (value is always 1; the "
+            "labels are the payload)",
+            labelnames=("replica", "version", "jax_version"),
+        ).labels(identity.replica_id, str(version or "unknown"),
+                 str(jax_version or "unknown")).set(1)
+
+    def identity_report(self):
+        """The ``snapshot()["replica"]`` section (also stamped into
+        ``/debug/state`` and incident bundles): same key shape with
+        None values before ``set_identity`` wires a real identity."""
+        if self._identity is None:
+            return {"replica_id": None, "uptime_s": None,
+                    "started_at": None}
+        return self._identity.report()
 
     def set_health(self, summary_fn):
         """Attach the health monitor's ``summary()`` as the pull
@@ -609,4 +643,5 @@ class ServingMetrics:
             "health": self.health_report(),
             "resilience": self.resilience_report(),
             "perf": self.perf_report(),
+            "replica": self.identity_report(),
         }

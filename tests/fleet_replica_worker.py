@@ -1,0 +1,58 @@
+"""One serving-engine replica process for the fleet multi-process
+integration test (tests/test_fleet.py).
+
+Builds a tiny GPT, starts ``serve_metrics()`` on $FLEET_PORT (0 picks
+a free port), prints ONE JSON ready-line ``{"port": ..., "replica_id":
+...}`` to stdout, then serves light traffic forever (a request wave +
+drain per loop) until killed — the parent kills it with SIGKILL
+mid-poll to prove the poller's eviction verdict, then respawns it on
+the same port to prove readmission."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.serving import ServingEngine  # noqa: E402
+from paddle_tpu.text.models import (  # noqa: E402
+    GPTForCausalLM, TransformerLMConfig,
+)
+
+
+def main():
+    paddle.seed(7)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    eng = ServingEngine(
+        m, num_slots=2, bucket_min=8,
+        replica_id=os.environ.get("FLEET_REPLICA_ID"),
+        slo_ttft_ms=10000.0)
+    handle = eng.serve_metrics(port=int(os.environ.get("FLEET_PORT",
+                                                       "0")))
+    rs = np.random.RandomState(int(os.environ.get("FLEET_SEED", "0")))
+    # warm the compile inventory before declaring ready, so the parent
+    # scrapes a steadily-stepping replica
+    for _ in range(3):
+        eng.add_request(rs.randint(0, 97, (5,)).astype(np.int64),
+                        max_new_tokens=3)
+    eng.run()
+    print(json.dumps({"port": handle.port,
+                      "replica_id": eng.replica_id}), flush=True)
+    while True:
+        for _ in range(2):
+            eng.add_request(rs.randint(0, 97, (6,)).astype(np.int64),
+                            max_new_tokens=4)
+        eng.run()
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    main()

@@ -261,6 +261,31 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         # the headline snapshot carries the same health rollup
         assert snap["health"]["enabled"] is True
         assert snap["health"]["anomalies_total"] == 0
+        # PR 11 fleet observatory: three in-process replicas under a
+        # live FleetPoller — all up and healthy, zero fleet anomalies,
+        # bucket-wise merged percentiles populated, and the probe-
+        # measured scrape-side + engine-side poll costs under the
+        # same <2%-of-step bar as the health tick (<5% with runner
+        # slack)
+        fp = evidence["fleet_poll"]
+        assert set(fp) >= {"replicas", "interval_s", "polls",
+                           "verdicts", "fleet", "latency",
+                           "anomalies_total", "detectors", "overhead"}
+        assert fp["replicas"] == 3 and fp["polls"] > 0
+        assert fp["fleet"]["up"] == 3 and fp["fleet"]["down"] == 0
+        assert fp["fleet"]["healthy"] is True
+        assert all(v == "up" for v in fp["verdicts"].values())
+        assert fp["anomalies_total"] == 0, fp["detectors"]
+        assert fp["fleet"]["tokens_generated"] > 0
+        lat = fp["latency"]["ttft"]
+        assert lat["count"] > 0 and lat["p50_ms"] <= lat["p99_ms"]
+        fohd = fp["overhead"]
+        assert fohd["scrape_side_per_poll_ms"] > 0
+        assert fohd["engine_side_per_poll_us"] > 0
+        assert fohd["overhead_frac"] < 0.05, fohd
+        # the headline snapshot carries the replica identity section
+        assert snap["replica"]["replica_id"]
+        assert snap["replica"]["uptime_s"] > 0
         # heartbeat wedge attribution: beats name the last ledger step
         # and the phase-relative step rate
         beats = [ln for ln in res.stderr.splitlines()

@@ -408,7 +408,7 @@ _SNAPSHOT_KEYS = {
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
-    "scheduler", "health", "resilience", "perf",
+    "scheduler", "health", "resilience", "perf", "replica",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",
@@ -422,7 +422,12 @@ _HEALTH_KEYS = {
     "enabled", "healthy", "anomalies_total", "detectors",
     "incidents_written", "last_incident", "ledger_steps",
     "degraded", "draining", "restarts",
+    # PR 11 replica attribution: which replica this health body is
+    "replica_id", "uptime_s",
 }
+# the PR-11 replica identity section (snapshot()["replica"], also on
+# /debug/state and incident bundles)
+_REPLICA_KEYS = {"replica_id", "uptime_s", "started_at"}
 # the PR-9 resilience section: failure/retry/timeout/abort counters +
 # quarantine, supervisor and chaos state (same key set hardened or not)
 _RESILIENCE_KEYS = {
@@ -511,6 +516,14 @@ def test_serving_snapshot_schema_contract():
     off_perf = eng_noperf.metrics.snapshot()["perf"]
     assert set(off_perf) == _PERF_KEYS
     assert off_perf["enabled"] is False and off_perf["programs"] == {}
+    # the PR-11 replica identity: a stable host:pid default id, a
+    # live uptime clock, and the same facts on the health section
+    rep = snap["replica"]
+    assert set(rep) == _REPLICA_KEYS
+    assert rep["replica_id"] and ":" in rep["replica_id"]
+    assert rep["uptime_s"] > 0
+    assert health["replica_id"] == rep["replica_id"]
+    assert health["uptime_s"] > 0
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():

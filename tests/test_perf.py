@@ -297,6 +297,48 @@ def test_compare_verdicts_direction_and_noise():
     assert res["verdict"] == "ok"       # 30% worse, but inside noise
 
 
+def test_compact_bounds_series_and_preserves_verdicts(tmp_path):
+    """ISSUE 11 satellite: --ledger-keep compaction keeps the newest
+    N rows per (scenario, metric, config_digest) series, drops junk,
+    rewrites atomically — and compare() verdicts are unchanged."""
+    from paddle_tpu.observability.perf import compact
+
+    path = str(tmp_path / "ledger.jsonl")
+    # a stable series with a regressed head, an ok series, and a
+    # second config digest that must stay isolated
+    stable = [_row("s", "tps", v, f"t{i}")
+              for i, v in enumerate([100.0, 101.0, 99.0, 100.0,
+                                     102.0, 98.0, 100.0])]
+    regressed = stable + [_row("s", "tps", 40.0, "t9")]
+    other = [_row("o", "ms", v, f"t{i}", direction="lower_better")
+             for i, v in enumerate([10.0, 11.0, 10.5, 10.2])]
+    foreign = [_row("s", "tps", 77.0, "t5", digest="cfgX")]
+    append_rows(path, regressed + other + foreign)
+    with open(path, "a") as fh:
+        fh.write("junk line\n")
+    before = {(r["scenario"], r["metric"], r["config_digest"]):
+              r["verdict"] for r in compare(read_rows(path)[0])}
+    kept, dropped = compact(path, keep_last=4)
+    rows, skipped = read_rows(path)
+    assert skipped == 0                       # junk gone for good
+    assert kept == len(rows) == 4 + 4 + 1     # capped per series
+    assert dropped == (len(regressed) - 4) + 1  # overflow + junk
+    # every series keeps its NEWEST rows in append order
+    s_rows = [r["value"] for r in rows
+              if r["scenario"] == "s" and r["config_digest"] == "cfg0"]
+    assert s_rows == [102.0, 98.0, 100.0, 40.0]
+    after = {(r["scenario"], r["metric"], r["config_digest"]):
+             r["verdict"] for r in compare(rows)}
+    assert after == before                     # verdicts unchanged
+    assert after[("s", "tps", "cfg0")] == "regression"
+    assert after[("o", "ms", "cfg0")] == "ok"
+    assert after[("s", "tps", "cfgX")] == "baseline"
+    # a second compaction at the same keep is a no-op
+    assert compact(path, keep_last=4) == (9, 0)
+    with pytest.raises(ValueError):
+        compact(path, keep_last=0)
+
+
 # ------------------------------------------------- perf_diff CLI gate
 
 def _run_diff(path, *extra):

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""fleet_top — the fleet table, one shot or watched.
+
+Polls N serving replicas (their ``serve_metrics()`` surfaces) through
+``paddle_tpu.observability.fleet.FleetPoller`` and renders one row per
+replica: availability verdict, health posture, queue depth, step
+rate, goodput tokens, decode roofline fraction, staleness — plus the
+fleet rollup line (census, bucket-wise-merged latency percentiles,
+fleet-detector firings).
+
+    python tools/fleet_top.py 127.0.0.1:9100 127.0.0.1:9101
+    python tools/fleet_top.py --registry fleet.json --watch 2
+
+Exit code: 0 iff EVERY replica is up and healthy (the scriptable
+all-clear a deploy gate wants); 1 otherwise, naming the offending
+replicas on stderr. ``--json`` dumps the pinned-schema FleetSnapshot
+instead of the table. Tier-1 self-runs this against two in-process
+engines (tests/test_fleet.py), the same discipline as
+incident_report / chaos_sweep / perf_diff.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_COLS = (
+    ("REPLICA", 18), ("VERDICT", 8), ("POSTURE", 9), ("RESTARTS", 9),
+    ("QUEUE", 6), ("STEP/S", 8), ("GOODPUT", 9), ("ROOFLINE", 9),
+    ("AGE_S", 7), ("UPTIME_S", 9),
+)
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _posture(e):
+    if e["verdict"] != "up":
+        return e["verdict"]
+    if e["draining"]:
+        return "draining"
+    if e["degraded"]:
+        return "degraded"
+    if e["healthy"] is False:
+        return "unhealthy"
+    return "healthy" if e["healthy"] else "?"
+
+
+def render(snap, out=sys.stdout):
+    line = "  ".join(f"{name:<{w}}" for name, w in _COLS)
+    print(line, file=out)
+    print("-" * len(line), file=out)
+    for rid, e in sorted(snap["replicas"].items()):
+        cells = (
+            rid[:18], e["verdict"], _posture(e),
+            _fmt(e["restarts"]), _fmt(e["queue_depth"]),
+            _fmt(e["step_rate"]), _fmt(e["goodput_tokens"], 0),
+            _fmt(e["roofline_fraction"], 3), _fmt(e["age_s"]),
+            _fmt(e["uptime_s"]),
+        )
+        print("  ".join(f"{str(c):<{w}}" for c, (_, w)
+                        in zip(cells, _COLS)), file=out)
+    f = snap["fleet"]
+    lat = f["latency"]["ttft"]
+    print(f"fleet: {f['up']}/{f['size']} up ({f['stale']} stale, "
+          f"{f['down']} down)  queue={_fmt(f['queue_depth'], 0)}  "
+          f"step_rate={_fmt(f['step_rate'])}/s  "
+          f"goodput_tokens={_fmt(f['goodput_tokens'], 0)}  "
+          f"ttft_p50={_fmt(lat['p50_ms'])}ms "
+          f"p99={_fmt(lat['p99_ms'])}ms  "
+          f"anomalies={snap['health']['anomalies_total']}", file=out)
+
+
+def verdict_exit(snap, out=sys.stderr):
+    """0 iff all replicas up and healthy; else 1, naming offenders."""
+    bad = {rid: e for rid, e in snap["replicas"].items()
+           if e["verdict"] != "up" or e["healthy"] is not True
+           or e["degraded"] or e["draining"]}
+    if not bad and snap["fleet"]["healthy"]:
+        return 0
+    for rid, e in sorted(bad.items()):
+        print(f"UNHEALTHY: {rid} verdict={e['verdict']} "
+              f"posture={_posture(e)} "
+              f"last_error={e['last_error'] or '-'}", file=out)
+    if not bad:
+        print("UNHEALTHY: fleet-level verdict false", file=out)
+    return 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="render the serving-fleet table; exit 0 iff all "
+                    "replicas are up and healthy")
+    parser.add_argument("targets", nargs="*",
+                        help="replica scrape targets (host:port or "
+                             "http://host:port)")
+    parser.add_argument("--registry", default=None,
+                        help="JSON registry file ({'replicas': "
+                             "[{'id','url'}|'host:port', ...]})")
+    parser.add_argument("--interval", type=float, default=1.0,
+                        help="poll interval seconds (watch mode; also "
+                             "spaces the two one-shot polls)")
+    parser.add_argument("--timeout", type=float, default=1.0,
+                        help="per-replica scrape timeout seconds")
+    parser.add_argument("--down-after", type=int, default=1,
+                        help="consecutive failures before a replica "
+                             "is marked down (one-shot default 1: an "
+                             "unreachable replica IS down)")
+    parser.add_argument("--polls", type=int, default=2,
+                        help="one-shot poll count (>=2 gives step "
+                             "rates)")
+    parser.add_argument("--watch", type=float, default=None,
+                        metavar="SECS",
+                        help="keep polling and re-rendering every "
+                             "SECS until interrupted")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the FleetSnapshot JSON instead of "
+                             "the table")
+    args = parser.parse_args(argv)
+    if not args.targets and not args.registry:
+        parser.error("give targets or --registry")
+
+    from paddle_tpu.observability.fleet import FleetPoller
+    kw = dict(interval_s=args.interval, timeout_s=args.timeout,
+              down_after=args.down_after)
+    poller = FleetPoller.from_registry(args.registry, **kw) \
+        if args.registry else FleetPoller(args.targets, **kw)
+
+    if args.watch:
+        try:
+            while True:
+                poller.poll_once()
+                snap = poller.snapshot()
+                print(f"\n== fleet_top {time.strftime('%H:%M:%S')} ==")
+                render(snap)
+                time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return verdict_exit(poller.snapshot())
+
+    for i in range(max(1, args.polls)):
+        if i:
+            time.sleep(min(args.interval, 0.5))
+        poller.poll_once()
+    snap = poller.snapshot()
+    if args.json:
+        print(json.dumps(snap, indent=1, sort_keys=True, default=str))
+    else:
+        render(snap)
+    return verdict_exit(snap)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
