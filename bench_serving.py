@@ -126,6 +126,10 @@ _LEDGER_SPECS = (
      0.35, ("shared_prefix", "ttft_improvement")),
     ("shared_prefix", "goodput_improvement", "ratio", "higher_better",
      0.35, ("shared_prefix", "goodput_improvement")),
+    ("shared_prefix", "cache_hit_rate", "fraction", "higher_better",
+     0.35, ("shared_prefix", "cache", "hit_rate")),
+    ("shared_prefix", "cache_saved_ttft_ms", "ms", "higher_better",
+     0.5, ("shared_prefix", "cache", "savings", "saved_ttft_ms")),
     ("overload", "goodput_improvement", "ratio", "higher_better",
      0.35, ("overload", "goodput_improvement")),
     ("overload", "slo_feedback_goodput_tps", "tokens/sec",
@@ -349,6 +353,16 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     overload_sec = _measure_overload(overload)
     chaos_sec = _measure_chaos(chaos_cfg)
     health_sec = _health_section(m_eng, num_slots)
+    # quote the cache probe against the SAME representative step wall
+    # every observatory probe uses (shared_prefix ran before the
+    # health probe existed, so the fraction lands here)
+    cache_over = shared_prefix["cache"]["overhead"]
+    step_wall_us = (health_sec.get("overhead") or {}).get(
+        "step_wall_us")
+    cache_over["step_wall_us"] = step_wall_us
+    cache_over["overhead_frac"] = round(
+        cache_over["per_step_overhead_us"] / step_wall_us, 6) \
+        if step_wall_us else None
     perf_sec = _perf_section(eng, health_sec)
     fleet_sec = _measure_fleet_poll(m_eng, num_slots, health_sec)
 
@@ -728,9 +742,15 @@ def _measure_shared_prefix(sp):
 
     def drain(phase, paged):
         _set_phase(f"shared-prefix-{phase}-warmup")
+        # cache_sample_rate 0.5: the smoke workload has only ~a dozen
+        # distinct block paths, so the production default of 1-in-8
+        # spatial sampling could legitimately sample none of them;
+        # 1-in-2 keeps the MRC populated while still exercising the
+        # sampled (scaled-distance) estimator path
         eng = ServingEngine(model, num_slots=sp["num_slots"],
                             bucket_min=8, paged=paged,
                             block_size=sp["block_size"],
+                            cache_sample_rate=0.5,
                             incident_dir=_INCIDENT_DIR)
         _watch_engine(eng)
         for p in prompts:                  # warmup: compiles + (paged)
@@ -770,10 +790,87 @@ def _measure_shared_prefix(sp):
         # invariant under paging (warmup declared before the timed
         # wave: any compile in it would be an attributed violation)
         "prefix_cache": snap["prefix_cache"],
+        # PR 13 cache observatory: measured hit rate vs the MRC's
+        # prediction at current capacity, hot-prefix digest, savings
+        # attribution, churn + the probe-measured admission-hook cost
+        "cache": _shared_cache_section(eng_paged, snap, prompts[0]),
         "prefill_accounting": eng_paged.cost_model()[
             "prefill_accounting"],
         "steady_state_new_compiles": wd["steady_state_compiles"],
         "watchdog": wd,
+    }
+
+
+def _shared_cache_section(eng, snap, prompt):
+    """The shared_prefix artifact's ``cache`` section (ISSUE 13): the
+    paged engine's cache-observatory report distilled — measured hit
+    rate, the MRC at 0.5x/1x/2x/4x capacity, the MRC's agreement with
+    the live measured rate at current capacity (the estimator's
+    acceptance check on real traffic), hot-prefix digest, savings
+    attribution, eviction churn — plus the probe-measured admission-
+    hook overhead.
+
+    The probe mirrors ``_perf_section``'s discipline: the hook cost
+    (fingerprint walk + SHARDS sampler + heat bump) is micro-timed on
+    SCRATCH structures seeded with the run's real shared prompt
+    (never the live engine's — fake admissions would corrupt the
+    sampler and heat stats just captured), scaled by the run's
+    measured admissions-per-step. ``overhead_frac`` is filled in by
+    the caller once ``_health_section`` has produced the
+    representative step wall (the same denominator every observatory
+    probe quotes against)."""
+    import time as _time
+
+    from paddle_tpu.observability import (CacheObservatory,
+                                          MetricsRegistry)
+    from paddle_tpu.serving.paged.radix import RadixPrefixIndex
+
+    report = snap["cache"]
+    measured = report.get("hit_rate")
+    predicted = None
+    for pt in report.get("mrc") or ():
+        if pt.get("factor") == 1.0:
+            predicted = pt.get("est_hit_rate")
+
+    _set_phase("cache-overhead")
+    bs = eng.pool.index.block_size
+    scratch_idx = RadixPrefixIndex(bs)
+    scratch_idx.insert(prompt, list(range(len(prompt) // bs + 1)))
+    matched = scratch_idx.match(prompt)
+    obs = CacheObservatory(MetricsRegistry())
+    reps = 2000
+    t0 = _time.perf_counter()
+    for _ in range(reps):
+        fps = scratch_idx.access_fingerprints(prompt)
+        obs.on_admission(fps, len(matched))
+        scratch_idx.note_hits(matched)
+    per_admission_us = (_time.perf_counter() - t0) / reps * 1e6
+    steps = eng.health.ledger.steps if eng.health is not None else 0
+    admissions = eng.metrics.requests_admitted
+    per_step = admissions / steps if steps else 1.0
+    churn = report.get("churn") or {}
+    return {
+        "hit_rate": measured,
+        "mrc": report.get("mrc"),
+        "predicted_hit_rate_at_capacity": predicted,
+        "predicted_vs_measured_abs_err":
+            round(abs(predicted - measured), 4)
+            if predicted is not None and measured is not None
+            else None,
+        "heat_top": (report.get("heat") or {}).get("top"),
+        "savings": report.get("savings"),
+        "evictions": churn.get("evictions"),
+        "thrash_reinserts": churn.get("thrash_reinserts"),
+        "sampled": report.get("sampled"),
+        "overhead": {
+            "per_admission_us": round(per_admission_us, 3),
+            "admissions_per_step": round(per_step, 4),
+            "per_step_overhead_us":
+                round(per_admission_us * per_step, 3),
+            # denominator filled in from _health_section by the caller
+            "step_wall_us": None,
+            "overhead_frac": None,
+        },
     }
 
 

@@ -62,6 +62,17 @@ wall accumulated per program key — ``snapshot()["perf"]``,
 ``executable_cost`` into ``serving_roofline_fraction{program}``, and
 the cross-run perf ledger + ``tools/perf_diff.py`` regression gate.
 
+PR 13 adds the cache observatory (cache/): SHARDS-style sampled
+reuse-distance / miss-ratio-curve estimation over the paged KV block
+economy ("what would hit-rate be at 2x capacity" — the ROADMAP-#5
+spill-tier sizing tool), the top-K hot-prefix heat digest (the
+ROADMAP-#2 router affinity signal), per-request cache-savings
+attribution (cached tokens x measured per-token prefill cost ->
+estimated TTFT ms saved), and eviction-churn telemetry (block
+lifetimes + the radix thrash counter feeding the ``cache_thrash``
+detector) — rolled up at ``snapshot()["cache"]`` / ``/debug/cache``
+and merged exactly into the fleet view.
+
 PR 11 adds the fleet observatory (fleet/): replica identity
 (``replica_id`` / ``serving_uptime_seconds`` /
 ``paddle_tpu_build_info`` on every engine), a resilient
@@ -74,6 +85,11 @@ load_skew), and a FleetServer exposing ``/fleet/health`` /
 ``/fleet/state`` / ``/fleet/metrics`` — the surface the ROADMAP
 direction-#2 router consumes.
 """
+from .cache import (  # noqa: F401
+    CACHE_KEYS, CacheObservatory, ReuseDistanceSampler,
+    disabled_cache_report, exact_mrc, merge_heat_digests,
+    merge_mrc_points, top_prefix_digest,
+)
 from .fleet import (  # noqa: F401
     FleetPoller, FleetServer, ReplicaIdentity, default_replica_id,
 )

@@ -42,7 +42,7 @@ from .poller import (  # noqa: F401
 )
 from .rollup import (  # noqa: F401
     FLEET_AGG_KEYS, FLEET_REPLICA_KEYS, FLEET_SCHEMA,
-    FLEET_SNAPSHOT_KEYS, fleet_aggregate, merged_latency,
-    replica_entry,
+    FLEET_SNAPSHOT_KEYS, fleet_aggregate, fleet_cache,
+    merged_latency, replica_entry,
 )
 from .server import FleetServer  # noqa: F401

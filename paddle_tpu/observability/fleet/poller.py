@@ -461,6 +461,8 @@ class FleetPoller:
                        for st in self.replicas]
             snapshots = [st.metrics for st in self.replicas
                          if st.metrics is not None]
+            states = [st.state for st in self.replicas
+                      if st.state is not None]
             polls = self._polls
         replicas = {}
         for e in entries:
@@ -474,7 +476,8 @@ class FleetPoller:
             "polls": polls,
             "interval_s": self.interval_s,
             "replicas": replicas,
-            "fleet": rollup.fleet_aggregate(entries, snapshots),
+            "fleet": rollup.fleet_aggregate(entries, snapshots,
+                                            states),
             "health": self._health_block(),
         }
 

@@ -23,6 +23,7 @@ PR-12 router will consume. Two merge rules, applied EXACTLY:
 are the schema contract (tests/test_fleet.py pins them — keys only
 get added, never renamed).
 """
+from ..cache import merge_heat_digests, merge_mrc_points
 from ..registry import merge_histogram_snapshots, percentile_from_buckets
 
 FLEET_SCHEMA = "paddle_tpu.fleet/v1"
@@ -50,6 +51,9 @@ FLEET_REPLICA_KEYS = (
     "goodput_tokens",
     "requests_completed",
     "roofline_fraction",   # decode program, when priced
+    "cache_hit_rate",      # block-granular prefix-cache hit rate
+    "cache_saved_ttft_ms",  # estimated TTFT ms saved by cache hits
+    "cache_thrash",        # evict-then-reinsert events (cumulative)
     "uptime_s",       # replica-reported process uptime
     "version",        # paddle_tpu_build_info version label
     "age_s",          # seconds since the last successful scrape
@@ -66,7 +70,7 @@ FLEET_REPLICA_KEYS = (
 FLEET_AGG_KEYS = (
     "size", "up", "stale", "down", "healthy", "queue_depth",
     "occupancy", "step_rate", "tokens_generated", "goodput_tokens",
-    "requests_completed", "latency", "roofline_fraction",
+    "requests_completed", "latency", "roofline_fraction", "cache",
 )
 
 _PCTS = ((50, "p50_ms"), (90, "p90_ms"), (99, "p99_ms"))
@@ -142,6 +146,13 @@ def replica_entry(st, now):
     info = build_info_labels(snap)
     roofline = counter_value(snap, "serving_roofline_fraction",
                              "program=decode")
+    c_hits = counter_value(snap, "serving_cache_block_hits_total")
+    c_accesses = counter_value(snap,
+                               "serving_cache_block_accesses_total")
+    c_saved_ms = counter_value(snap,
+                               "serving_cache_saved_ttft_ms_total")
+    c_thrash = counter_value(snap,
+                             "serving_cache_thrash_reinserts_total")
     return {
         "replica_id": st.replica_id,
         "url": st.url,
@@ -163,6 +174,12 @@ def replica_entry(st, now):
             snap, "serving_requests_completed_total"),
         "roofline_fraction": round(roofline, 6)
         if roofline else None,
+        "cache_hit_rate": round((c_hits or 0.0) / c_accesses, 4)
+        if c_accesses else None,
+        "cache_saved_ttft_ms": round(c_saved_ms, 3)
+        if c_saved_ms is not None else None,
+        "cache_thrash": int(c_thrash) if c_thrash is not None
+        else None,
         "uptime_s": replica_sec.get("uptime_s"),
         "version": info.get("version"),
         "age_s": round(now - st.last_seen, 3)
@@ -178,12 +195,60 @@ def replica_entry(st, now):
     }
 
 
-def fleet_aggregate(entries, snapshots):
+def fleet_cache(snapshots, states):
+    """The fleet-level ``cache`` block: counters sum exactly (hits /
+    accesses summed BEFORE dividing — the fleet hit rate is the true
+    pooled rate, not a mean of per-replica rates), the MRC merges as
+    the sampled-access-weighted mean per capacity (algebraically the
+    pooled-histogram estimate), and the heat digest merges by stable
+    fingerprint then re-ranks. ``states`` are the replicas' last-known
+    ``/debug/state`` bodies (the MRC curve and heat digest live
+    there). None when no replica reports a cache section."""
+    accesses = _sum_known([counter_value(
+        s, "serving_cache_block_accesses_total") for s in snapshots])
+    if accesses is None:
+        return None
+    hits = _sum_known([counter_value(
+        s, "serving_cache_block_hits_total") for s in snapshots])
+    point_lists, weights, digests = [], [], []
+    for state in states:
+        cache = (state or {}).get("cache") or {}
+        if not cache.get("enabled"):
+            continue
+        if cache.get("mrc"):
+            point_lists.append(cache["mrc"])
+            weights.append(
+                (cache.get("sampled") or {}).get("accesses") or 0)
+        top = (cache.get("heat") or {}).get("top")
+        if top:
+            digests.append(top)
+    return {
+        "accesses": accesses,
+        "hits": hits,
+        "hit_rate": round((hits or 0.0) / accesses, 4)
+        if accesses else None,
+        "saved_tokens": _sum_known([counter_value(
+            s, "serving_cache_saved_tokens_total")
+            for s in snapshots]),
+        "saved_ttft_ms": _sum_known([counter_value(
+            s, "serving_cache_saved_ttft_ms_total")
+            for s in snapshots]),
+        "thrash_reinserts": _sum_known([counter_value(
+            s, "serving_cache_thrash_reinserts_total")
+            for s in snapshots]),
+        "mrc": merge_mrc_points(point_lists, weights)
+        if point_lists else None,
+        "heat_top": merge_heat_digests(digests) if digests else None,
+    }
+
+
+def fleet_aggregate(entries, snapshots, states=()):
     """The ``FLEET_AGG_KEYS`` block: availability census + exact
     counter sums + bucket-wise merged latency percentiles. ``entries``
     are the per-replica rows; ``snapshots`` the last-known metrics
     snapshots of every replica that ever scraped (down replicas'
-    already-served work still counts)."""
+    already-served work still counts); ``states`` the last-known
+    ``/debug/state`` bodies (the cache MRC/heat merge sources)."""
     verdicts = [e["verdict"] for e in entries]
     up = sum(v == "up" for v in verdicts)
     stale = sum(v == "stale" for v in verdicts)
@@ -210,4 +275,5 @@ def fleet_aggregate(entries, snapshots):
         "latency": merged_latency(snapshots),
         "roofline_fraction": _mean_known(
             [e["roofline_fraction"] for e in live]),
+        "cache": fleet_cache(snapshots, states),
     }

@@ -162,15 +162,21 @@ class FlightRecorder:
         ones."""
         self._event(req.rid, ADMISSION_ROLLED_BACK, "t", {})
 
-    def prefix_hit(self, req, cached_tokens, tail_tokens):
+    def prefix_hit(self, req, cached_tokens, tail_tokens,
+                   saved_ms=None):
         """The request's admission reused ``cached_tokens`` prompt
         tokens straight from the paged pool's radix prefix cache, so
         the prefill that follows dispatches only the ``tail_tokens``
         tail (emitted between ``admitted`` and ``prefill_dispatched``;
-        absent = the prompt missed the cache entirely)."""
-        self._event(req.rid, PREFIX_HIT, "t",
-                    {"cached_tokens": int(cached_tokens),
-                     "tail_tokens": int(tail_tokens)})
+        absent = the prompt missed the cache entirely). ``saved_ms``
+        is the cache observatory's estimated TTFT saving for this
+        admission (cached tokens x measured per-token prefill cost;
+        None until prefill measurements exist)."""
+        attrs = {"cached_tokens": int(cached_tokens),
+                 "tail_tokens": int(tail_tokens)}
+        if saved_ms is not None:
+            attrs["saved_ms"] = round(float(saved_ms), 3)
+        self._event(req.rid, PREFIX_HIT, "t", attrs)
 
     def prefill_dispatched(self, req, bucket, group_size):
         self._event(req.rid, PREFILL_DISPATCHED, "t",

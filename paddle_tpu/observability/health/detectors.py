@@ -40,6 +40,11 @@ bench run must fire NOTHING; a wedge is never subtle):
     any executable built after ``declare_warmup()`` — the compile
     watchdog's violation surfaced as a first-class anomaly instead of
     a flag a human must go read.
+``cache_thrash``
+    sustained prefix-cache evict-then-reinsert churn (the PR-13 cache
+    observatory's thrash counter, per-step deltas summed over a
+    rolling window) — the KV pool is smaller than the live prefix
+    working set; ``/debug/cache``'s MRC says what more capacity buys.
 """
 import collections
 
@@ -312,6 +317,49 @@ class KVBlockLeak(Detector):
                     evictable_blocks=int(row["pool_evictable_blocks"]))
         elif idle:
             self._armed = True
+        return None
+
+
+@register_detector("cache_thrash")
+class CacheThrash(Detector):
+    """Sustained prefix-cache thrash: the radix index keeps evicting
+    paths and immediately recomputing them (the PR-13 cache
+    observatory's evict-then-reinsert counter, surfaced per step as
+    the ledger's ``cache_thrash`` delta). A rolling ``window``-step
+    sum >= ``min_thrash`` means the pool is materially smaller than
+    the live working set — the operator answer is the MRC in
+    ``/debug/cache`` ("what would 2x capacity buy"). Conservative on
+    purpose: occasional churn under admission pressure is the block
+    economy WORKING; a clean bench run must fire nothing. Fires once
+    per episode, re-arming after a thrash-free window. Inert on
+    legacy-pool engines (field is None)."""
+
+    def __init__(self, window=64, min_thrash=24):
+        self.window = int(window)
+        self.min_thrash = int(min_thrash)
+        self._hist = collections.deque(maxlen=self.window)
+        self._fired = False
+
+    def observe(self, row, ledger):
+        thrash = row.get("cache_thrash")
+        if thrash is None:
+            return None
+        self._hist.append(int(thrash))
+        total = sum(self._hist)
+        if total >= self.min_thrash:
+            if not self._fired:
+                self._fired = True
+                return self._verdict(
+                    row,
+                    f"{total} evict-then-reinsert event(s) over the "
+                    f"last {len(self._hist)} steps — KV pool smaller "
+                    f"than the live prefix working set",
+                    thrash_events=int(total),
+                    window_steps=len(self._hist),
+                    evictable_blocks=row.get("pool_evictable_blocks"),
+                    free_blocks=row.get("pool_free_blocks"))
+        elif total == 0:
+            self._fired = False
         return None
 
 

@@ -46,6 +46,8 @@ LEDGER_ROW_KEYS = (
     "pool_live_blocks",
     "conservation_ok",    # periodic audit verdict (None = not audited)
     "conservation_error",
+    "cache_thrash",       # radix evict-then-reinsert events this step
+    "pool_evictable_delta",  # evictable-block count change this step
 )
 
 

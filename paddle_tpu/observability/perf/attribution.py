@@ -181,6 +181,22 @@ class ProgramPerf:
                     self._programs[key] = p
         return p
 
+    def prefill_seconds(self):
+        """Measured wall seconds accrued by the prefill-family
+        programs (bucketed/grouped, paged, chunked) — dispatch + sync.
+        The cache observatory divides this by prefill-computed tokens
+        for its per-token savings attribution."""
+        if not self.enabled:
+            return 0.0
+        with self._lock:
+            items = list(self._programs.items())
+        total = 0.0
+        for key, prog in items:
+            kind = key if isinstance(key, str) else key[0]
+            if kind in ("prefill", "paged_prefill", "chunk_prefill"):
+                total += prog.h_dispatch.sum + prog.h_sync.sum
+        return total
+
     def record_dispatch(self, key, dt):
         if not self.enabled:
             return

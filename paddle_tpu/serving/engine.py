@@ -178,6 +178,7 @@ class ServingConfig:
                  retry_backoff_s=0.0, quarantine_after=3,
                  supervisor=None, supervisor_max_restarts=8,
                  supervisor_cooldown_s=1.0, perf=None,
+                 cache_observatory=None, cache_sample_rate=0.125,
                  replica_id=None):
         self.num_slots = int(num_slots)
         self.max_len = max_len
@@ -329,6 +330,18 @@ class ServingConfig:
         if perf is None:
             perf = os.environ.get("PADDLE_PERF", "1") != "0"
         self.perf = bool(perf)
+        # cache observatory (observability.cache): reuse-distance/MRC
+        # sampling, prefix heat, savings attribution and churn
+        # telemetry over the paged pool, ON by default (a few dict/int
+        # ops per admission, probe-measured in the bench artifact's
+        # shared_prefix.cache.overhead section); PADDLE_CACHE_OBS=0
+        # opts out, True/False forces. Engines without a paged pool
+        # report the disabled shape regardless.
+        if cache_observatory is None:
+            cache_observatory = os.environ.get(
+                "PADDLE_CACHE_OBS", "1") != "0"
+        self.cache_observatory = bool(cache_observatory)
+        self.cache_sample_rate = float(cache_sample_rate)
         # replica identity (observability.fleet): the id a fleet view
         # knows this engine by — stamped into snapshot()/debug routes/
         # incident bundles and the paddle_tpu_build_info exposition.
@@ -437,7 +450,9 @@ class ServingEngine:
             slo_ttft_ms=config.slo_ttft_ms,
             slo_tpot_ms=config.slo_tpot_ms,
             slo_window_s=config.slo_window_s,
-            perf=config.perf)
+            perf=config.perf,
+            cache=config.cache_observatory,
+            cache_sample_rate=config.cache_sample_rate)
         self._perf_on = config.perf
         # replica identity: who this engine is in a fleet of
         # lookalikes — uptime + build-info gauges in the exposition,
@@ -572,6 +587,7 @@ class ServingEngine:
                 lambda: device_memory_stats(dev))
         if self.paged:
             self.metrics.set_prefix_pool(self.pool.stats)
+            self.metrics.cache.attach_pool(self.pool)
         if self._perf_on:
             # price the per-program roofline (unknown devices fall
             # back to the v5e reference constants, flagged
@@ -728,7 +744,8 @@ class ServingEngine:
         surface is discoverable without reading source),
         /debug/requests (flight-recorder traces), /debug/state (live
         engine state), /debug/perf (per-program attribution +
-        roofline fractions) and — with the health observatory on —
+        roofline fractions), /debug/cache (MRC, prefix heat, savings
+        attribution, churn) and — with the health observatory on —
         /debug/health ({healthy, detectors, last_incident}: the
         per-replica router signal) and /debug/ledger (the per-step
         ring). Returns a MetricsServerHandle — ``handle.port`` is the
@@ -740,6 +757,7 @@ class ServingEngine:
             "/debug/requests": self.flight.debug_requests,
             "/debug/state": self.debug_state,
             "/debug/perf": self.metrics.perf_report,
+            "/debug/cache": self.metrics.cache_report,
         }
         if self.health is not None:
             routes["/debug/health"] = self.health.report
@@ -849,6 +867,7 @@ class ServingEngine:
             "slo": self.metrics.slo.report(),
             "paged": self.paged,
             "prefix_cache": self.metrics.prefix_cache_report(),
+            "cache": self.metrics.cache_report(),
             "scheduler": dict(
                 self.metrics.scheduler_report(),
                 chunked_inflight=len(self._chunk_q)),
@@ -1244,10 +1263,15 @@ class ServingEngine:
         # raw child-slot reads (not the .value property): counters are
         # plain floats behind __slots__, and 14 property hops per step
         # are real money on a sub-ms step
+        pool = self.pool
         cur = (k[0]._value, k[1]._value, k[2]._value, k[3]._value,
                k[4]._value, k[5]._value, k[6]._value, k[7]._value,
                k[8]._value + k[9]._value + k[10]._value, k[11]._value,
-               M.shed_count)
+               M.shed_count,
+               # cache-pressure facts (plain attr reads; 0 on legacy
+               # pools so the tuple shape is branch-free downstream)
+               pool.index.thrash_count if self.paged else 0,
+               pool.evictable_blocks if self.paged else 0)
         prev = self._hprev
         self._hprev = cur
         if prev is None:
@@ -1288,6 +1312,14 @@ class ServingEngine:
             "pool_evictable_blocks": self.pool.evictable_blocks
             if self.paged else None,
             "pool_live_blocks": self.pool.live_blocks
+            if self.paged else None,
+            # per-step cache-pressure deltas (PR 13): thrash deltas
+            # are clamped at 0 because a supervisor pool swap resets
+            # the radix counter mid-stream; the evictable delta is
+            # signed (pinning legitimately shrinks the supply)
+            "cache_thrash": max(0, int(cur[11] - prev[11]))
+            if self.paged else None,
+            "pool_evictable_delta": int(cur[12] - prev[12])
             if self.paged else None,
             "conservation_ok": conservation_ok,
             "conservation_error": conservation_error,
@@ -1440,7 +1472,9 @@ class ServingEngine:
                                     donate=(8, 9, 10))
                 with M.span("serving/prefill_dispatch"):
                     if start:
-                        self.flight.prefix_hit(req, start, tail)
+                        self.flight.prefix_hit(
+                            req, start, tail,
+                            saved_ms=M.cache.estimate_saved_ms(start))
                     self.flight.prefill_dispatched(req, bucket, 1)
                     first, self._toks, self._pos, kc, vc = \
                         self._timed_call(("paged_prefill", bucket),
@@ -1540,7 +1574,9 @@ class ServingEngine:
                     if plan.next == 0 and plan.start0:
                         self.flight.prefix_hit(
                             req, plan.start0,
-                            len(plan.ids) - plan.start0)
+                            len(plan.ids) - plan.start0,
+                            saved_ms=M.cache.estimate_saved_ms(
+                                plan.start0))
                     self.flight.prefill_chunk(req, plan.next, start,
                                               clen, final)
                     if final:
@@ -1726,6 +1762,7 @@ class ServingEngine:
             self.pool = self._pool_factory()
             if self.paged:
                 M.set_prefix_pool(self.pool.stats)
+                M.cache.attach_pool(self.pool)
             import jax.numpy as jnp
             self._toks = jnp.zeros((self.config.num_slots,), jnp.int32)
             self._pos = jnp.zeros((self.config.num_slots,), jnp.int32)

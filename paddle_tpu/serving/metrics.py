@@ -25,8 +25,9 @@ timeline — one scope, three sinks.
 import time
 
 from .. import profiler as _profiler
-from ..observability import (MetricsRegistry, ProgramPerf, Reservoir,
-                             SLOTracker)
+from ..observability import (CacheObservatory, MetricsRegistry,
+                             ProgramPerf, Reservoir, SLOTracker,
+                             WindowedReservoir)
 
 # serving latencies are sub-ms (CPU smoke) to tens of seconds (deep
 # queues on big models) — the default time buckets cover that span
@@ -62,8 +63,11 @@ class ServingMetrics:
 
     RESERVOIR_SIZE = 1024
 
+    PREFIX_WINDOW_S = 60.0
+
     def __init__(self, registry=None, slo_ttft_ms=None,
-                 slo_tpot_ms=None, slo_window_s=60.0, perf=True):
+                 slo_tpot_ms=None, slo_window_s=60.0, perf=True,
+                 cache=True, cache_sample_rate=0.125):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         r = self.registry
@@ -74,6 +78,13 @@ class ServingMetrics:
         # engine records measured dispatch/sync wall per AOT-table key
         # through this; snapshot()["perf"] / /debug/perf report it
         self.perf = ProgramPerf(r, enabled=perf)
+        # cache observatory (observability.cache): MRC estimation,
+        # prefix heat, savings attribution, churn telemetry. Reports
+        # the disabled shape until the engine attaches a paged pool.
+        self.cache = CacheObservatory(r, enabled=cache,
+                                      sample_rate=cache_sample_rate)
+        self.cache.bind_cost_source(
+            self.perf, lambda: self._c_prefill_tokens.value)
         self._peak_flops = None
         self._g_decode_flops = r.gauge(
             "serving_decode_flops_per_step",
@@ -144,6 +155,25 @@ class ServingMetrics:
             "serving_prefill_tokens_computed_total",
             "prompt tokens actually computed by prefill dispatches "
             "(excludes prefix-cache hits and bucket padding)")
+        # sliding-window prefix-cache effectiveness (a router reading
+        # lifetime counters sees the historical average, not what the
+        # cache is doing NOW): per-admission hit indicator + cached
+        # token counts over the last PREFIX_WINDOW_S seconds
+        self._w_prefix_hits = WindowedReservoir(
+            window_s=self.PREFIX_WINDOW_S, capacity=4096)
+        self._w_prefix_cached = WindowedReservoir(
+            window_s=self.PREFIX_WINDOW_S, capacity=4096)
+        r.gauge(
+            "serving_prefix_cache_windowed_hit_rate",
+            "prefix-cache hit rate over the sliding window "
+            "(admissions with a cached prefix / admissions; 0 when "
+            "the window is empty)"
+        ).set_function(self.windowed_prefix_hit_rate)
+        r.gauge(
+            "serving_prefix_cached_tokens_per_sec",
+            "prompt tokens served from the prefix cache per second, "
+            "sliding window"
+        ).set_function(self.windowed_cached_tokens_per_sec)
         # scheduling-subsystem accounting (serving.sched): load-shed /
         # deferred admissions and chunked-prefill dispatches, plus a
         # scheduler_policy info label on the serving family so a
@@ -294,7 +324,10 @@ class ServingMetrics:
         came straight from the radix-matched blocks (a hit when > 0),
         ``computed_tokens`` is the uncached tail the prefill actually
         ran. The cached/computed split is what keeps engine.cost_model
-        honest — cached spans must not be credited as prefill compute."""
+        honest — cached spans must not be credited as prefill compute.
+        Returns the estimated TTFT ms this admission saved (None until
+        the cache observatory's perf join has prefill measurements) so
+        the engine can stamp it onto the flight-recorder detail."""
         if cached_tokens > 0:
             self._c_prefix_hits.inc()
         else:
@@ -303,6 +336,9 @@ class ServingMetrics:
             self._c_prefix_cached_tokens.inc(int(cached_tokens))
         if computed_tokens:
             self._c_prefill_tokens.inc(int(computed_tokens))
+        self._w_prefix_hits.add(1.0 if cached_tokens > 0 else 0.0)
+        self._w_prefix_cached.add(float(cached_tokens or 0))
+        return self.cache.note_reuse(int(cached_tokens or 0))
 
     def record_prefill_tokens(self, computed_tokens):
         """Legacy-pool prefill accounting: every prompt token is
@@ -315,12 +351,21 @@ class ServingMetrics:
         snapshot()["prefix_cache"]["pool"] (None on legacy engines)."""
         self._prefix_pool_stats = stats_fn
 
+    def windowed_prefix_hit_rate(self):
+        vals = self._w_prefix_hits.values()
+        return sum(vals) / len(vals) if vals else 0.0
+
+    def windowed_cached_tokens_per_sec(self):
+        return sum(self._w_prefix_cached.values()) \
+            / self.PREFIX_WINDOW_S
+
     def prefix_cache_report(self):
         hits = int(self._c_prefix_hits.value)
         misses = int(self._c_prefix_misses.value)
         cached = int(self._c_prefix_cached_tokens.value)
         computed = int(self._c_prefill_tokens.value)
         total = hits + misses
+        w_admissions = self._w_prefix_hits.count()
         return {
             "hits": hits,
             "misses": misses,
@@ -329,6 +374,14 @@ class ServingMetrics:
             "computed_tokens": computed,
             "cached_fraction": round(cached / (cached + computed), 4)
             if (cached + computed) else None,
+            "windowed": {
+                "window_s": self.PREFIX_WINDOW_S,
+                "admissions": w_admissions,
+                "hit_rate": round(self.windowed_prefix_hit_rate(), 4)
+                if w_admissions else None,
+                "cached_tokens_per_s": round(
+                    self.windowed_cached_tokens_per_sec(), 3),
+            },
             "pool": self._prefix_pool_stats()
             if self._prefix_pool_stats is not None else None,
         }
@@ -597,6 +650,14 @@ class ServingMetrics:
             out[name] = entry
         return out
 
+    def cache_report(self):
+        """The ``snapshot()["cache"]`` / ``/debug/cache`` body: MRC,
+        heat digest, savings attribution and churn telemetry from the
+        cache observatory (the disabled shape until a paged pool is
+        attached — same key set, the snapshot schema contract holds
+        either way)."""
+        return self.cache.report()
+
     def perf_report(self):
         """The ``snapshot()["perf"]`` / ``/debug/perf`` body:
         per-program measured time + roofline fractions, with the
@@ -643,5 +704,6 @@ class ServingMetrics:
             "health": self.health_report(),
             "resilience": self.resilience_report(),
             "perf": self.perf_report(),
+            "cache": self.cache_report(),
             "replica": self.identity_report(),
         }

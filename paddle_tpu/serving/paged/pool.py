@@ -94,6 +94,11 @@ class PagedKVPool:
         # scan there would be per-step overhead; check_conservation
         # validates this counter against the full scan)
         self.evictions = 0
+        # optional cache-observatory hook (observability.cache.
+        # CacheObservatory.attach_pool sets itself here): notified on
+        # block alloc/free and once per successful admission. None
+        # keeps every hot-path branch a single attribute test.
+        self.observer = None
         # slot state (mirrors SlotKVPool's deterministic allocator)
         self._free_slots = list(range(self.num_slots))
         self._owner = {}
@@ -168,6 +173,7 @@ class PagedKVPool:
         leaf-only eviction cannot reach while live descendants pin the
         path, so running dry here is a legitimate wait-for-retirement
         condition, not a bug — acquire() rolls back and returns None."""
+        obs = self.observer
         if self._free_blocks:
             b = heapq.heappop(self._free_blocks)
         else:
@@ -177,8 +183,14 @@ class PagedKVPool:
                 return None
             self.evictions += 1
             self._evictable -= 1
+            if obs is not None:
+                # the evicted block's cached life ends here, before
+                # its rebirth below as a fresh private block
+                obs.on_block_free(b, evicted=True)
         self._ref[b] = 1
         self._live += 1
+        if obs is not None:
+            obs.on_block_alloc(b)
         return b
 
     def _deref(self, b):
@@ -194,6 +206,8 @@ class PagedKVPool:
             else:
                 del self._ref[b]
                 heapq.heappush(self._free_blocks, b)
+                if self.observer is not None:
+                    self.observer.on_block_free(b, evicted=False)
 
     def match_prefix(self, prompt):
         """Longest cached prefix of ``prompt`` in TOKENS (always a
@@ -237,7 +251,8 @@ class PagedKVPool:
                 f"total_tokens {total_tokens} must exceed the pinned "
                 f"prefix ({prefix_tokens} tokens): the row's last "
                 f"block must be private, never a shared prefix block")
-        prefix_blocks = self.index.match(prompt)[:n_prefix]
+        matched = self.index.match(prompt)
+        prefix_blocks = matched[:n_prefix]
         if len(prefix_blocks) < n_prefix:
             raise ValueError(
                 f"prefix_tokens {prefix_tokens} exceeds the cached "
@@ -283,6 +298,17 @@ class PagedKVPool:
         self.block_tables[slot, :] = TRASH_BLOCK
         self.block_tables[slot, :len(row)] = row
         self._dirty = True
+        obs = self.observer
+        if obs is not None:
+            # one admission = one cache reference per full prompt
+            # block (counted on SUCCESS only: the scheduler re-probes
+            # refused requests, and double-counting retries would
+            # skew the reuse-distance trace). Heat lands on the
+            # blocks actually pinned; the hit count vs the full match
+            # judges cache CONTENT, independent of pin truncation.
+            obs.on_admission(self.index.access_fingerprints(prompt),
+                             len(matched))
+            self.index.note_hits(prefix_blocks)
         return PagedAllocation(slot, prefix_tokens, prefix_blocks,
                                new_blocks)
 
@@ -367,6 +393,7 @@ class PagedKVPool:
             "indexed_blocks": len(self.index),
             "radix_depth": self.index.stats()["depth"],
             "evictions": self.evictions,
+            "thrash_reinserts": self.index.thrash_count,
         }
 
     def audit(self):

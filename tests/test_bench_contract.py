@@ -152,6 +152,35 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         assert sp["steady_state_new_compiles"] == 0
         assert sp["watchdog"]["warmed"] is True
         assert last["shared_prefix_ttft_x"] == sp["ttft_improvement"]
+        # PR 13 cache observatory section: measured hit rate, the MRC
+        # with its predicted-vs-measured agreement at current capacity
+        # (the estimator's live acceptance check), hot-prefix digest,
+        # savings attribution, and the probe-measured admission cost
+        cache = sp["cache"]
+        assert set(cache) >= {"hit_rate", "mrc",
+                              "predicted_hit_rate_at_capacity",
+                              "predicted_vs_measured_abs_err",
+                              "heat_top", "savings", "evictions",
+                              "thrash_reinserts", "sampled",
+                              "overhead"}
+        assert cache["hit_rate"] > 0.5   # shared prefix = mostly hits
+        assert [p["factor"] for p in cache["mrc"]] == \
+            [0.5, 1.0, 2.0, 4.0]
+        # the MRC estimate at CURRENT capacity must agree with the
+        # live measured hit rate (tolerance covers the spatial
+        # sampler's small-population noise on the smoke workload)
+        assert cache["predicted_vs_measured_abs_err"] is not None
+        assert cache["predicted_vs_measured_abs_err"] <= 0.15, cache
+        assert cache["heat_top"], "the shared prefix must rank hot"
+        assert cache["heat_top"][0]["tokens_saved"] > 0
+        assert cache["savings"]["saved_tokens"] > 0
+        assert cache["savings"]["saved_ttft_ms"] > 0
+        cache_over = cache["overhead"]
+        assert cache_over["per_admission_us"] > 0
+        assert cache_over["overhead_frac"] is not None
+        assert cache_over["overhead_frac"] < 0.05   # the contract bar
+        # healthy drain: no eviction-then-reinsert churn
+        assert cache["thrash_reinserts"] == 0
         # PR 7 overload scenario: identical oversubscribed traffic
         # (chunked long prompts + sampled fraction) under FIFO vs the
         # SLO-feedback load-shedding policy — the acceptance bars are

@@ -30,7 +30,7 @@ from paddle_tpu.observability import MetricsRegistry
 from paddle_tpu.observability.fleet import (
     FLEET_AGG_KEYS, FLEET_REPLICA_KEYS, FLEET_ROW_KEYS, FLEET_SCHEMA,
     FLEET_SNAPSHOT_KEYS, FleetPoller, FleetServer, ReplicaIdentity,
-    default_replica_id,
+    default_replica_id, fleet_cache,
 )
 from paddle_tpu.observability.fleet.detectors import (
     FleetGoodputCollapse, LoadSkew, ReplicaFlap,
@@ -160,7 +160,8 @@ def test_prometheus_text_from_snapshots_stamps_replica_label():
 
 class _FakeReplica:
     def __init__(self, rid, tokens=100.0, goodput=80.0, completed=5,
-                 queue=0, occupancy=0.5, steps=10, healthy=True):
+                 queue=0, occupancy=0.5, steps=10, healthy=True,
+                 cache=None):
         self.rid = rid
         self.url = f"http://{rid}"
         self.alive = True
@@ -171,10 +172,37 @@ class _FakeReplica:
         self.occupancy = occupancy
         self.steps = steps
         self.healthy = healthy
+        # PR 13: optional cache telemetry, {"accesses", "hits",
+        # "saved_tokens", "saved_ms", "thrash", "mrc", "heat_top",
+        # "sampled_accesses"}
+        self.cache = cache
 
     def metrics(self):
         h = _hist({"0.1": self.completed, "+Inf": self.completed},
                   0.05 * self.completed)
+        out = self._base_metrics(h)
+        if self.cache:
+            c = self.cache
+
+            def _g(v):
+                return {"type": "gauge", "help": "", "values": {"": v}}
+
+            out.update({
+                "serving_cache_block_accesses_total":
+                    _g(c["accesses"]),
+                "serving_cache_block_hits_total": _g(c["hits"]),
+                "serving_cache_saved_tokens_total": {
+                    "type": "counter", "help": "",
+                    "values": {"": c["saved_tokens"]}},
+                "serving_cache_saved_ttft_ms_total": {
+                    "type": "counter", "help": "",
+                    "values": {"": c["saved_ms"]}},
+                "serving_cache_thrash_reinserts_total":
+                    _g(c["thrash"]),
+            })
+        return out
+
+    def _base_metrics(self, h):
         return {
             "serving_tokens_generated_total": {
                 "type": "counter", "help": "",
@@ -206,10 +234,19 @@ class _FakeReplica:
                            "last_step": self.steps}}
 
     def state(self):
-        return {"queue_depth": self.queue,
+        body = {"queue_depth": self.queue,
                 "slot_occupancy": self.occupancy,
                 "replica": {"replica_id": self.rid, "uptime_s": 5.0,
                             "started_at": "t0"}}
+        if self.cache:
+            c = self.cache
+            body["cache"] = {
+                "enabled": True,
+                "sampled": {"accesses": c["sampled_accesses"]},
+                "mrc": c["mrc"],
+                "heat": {"top": c["heat_top"]},
+            }
+        return body
 
 
 def _fake_fetch(replicas):
@@ -272,6 +309,61 @@ def test_fleet_snapshot_schema_pins():
     assert 'serving_tokens_generated_total{replica="ra"} 100' \
         in text.splitlines()
     assert 'replica="rb"' in text
+    # no replica reports cache telemetry -> the fleet block is None
+    # (older replicas degrade the rollup gracefully, never KeyError)
+    assert f["cache"] is None
+    assert snap["replicas"]["ra"]["cache_hit_rate"] is None
+
+
+def test_fleet_cache_rollup_merges_exactly():
+    """PR-13 fleet cache rollup: hits/accesses sum BEFORE dividing
+    (pooled rate, not mean-of-rates), the MRC merges as the sampled-
+    access-weighted mean per common capacity, and heat digests merge
+    by stable fingerprint with hits/tokens summed."""
+    ca = {"accesses": 100, "hits": 90, "saved_tokens": 900,
+          "saved_ms": 50.0, "thrash": 0, "sampled_accesses": 100,
+          "mrc": [{"blocks": 8, "est_hit_rate": 0.5, "factor": 1.0},
+                  {"blocks": 16, "est_hit_rate": 0.8, "factor": 2.0}],
+          "heat_top": [{"fp": "0000aaaa", "depth": 1, "hits": 10,
+                        "last_tick": 5, "tokens_saved": 160}]}
+    cb = {"accesses": 300, "hits": 30, "saved_tokens": 300,
+          "saved_ms": 10.0, "thrash": 7, "sampled_accesses": 300,
+          "mrc": [{"blocks": 8, "est_hit_rate": 0.1, "factor": 1.0},
+                  {"blocks": 16, "est_hit_rate": 0.2, "factor": 2.0}],
+          "heat_top": [{"fp": "0000aaaa", "depth": 1, "hits": 2,
+                        "last_tick": 9, "tokens_saved": 32},
+                       {"fp": "0000bbbb", "depth": 2, "hits": 1,
+                        "last_tick": 3, "tokens_saved": 16}]}
+    reps = [_FakeReplica("ra", cache=ca), _FakeReplica("rb", cache=cb)]
+    poller = _fake_poller(reps, {"t": 0.0})
+    poller.poll_once()
+    snap = poller.snapshot()
+    # per-replica attribution columns
+    assert snap["replicas"]["ra"]["cache_hit_rate"] == 0.9
+    assert snap["replicas"]["rb"]["cache_hit_rate"] == 0.1
+    assert snap["replicas"]["rb"]["cache_thrash"] == 7
+    assert snap["replicas"]["ra"]["cache_saved_ttft_ms"] == 50.0
+    fc = snap["fleet"]["cache"]
+    assert fc["accesses"] == 400 and fc["hits"] == 120
+    assert fc["hit_rate"] == 0.3        # pooled, NOT (0.9 + 0.1) / 2
+    assert fc["saved_tokens"] == 1200
+    assert fc["saved_ttft_ms"] == 60.0
+    assert fc["thrash_reinserts"] == 7
+    # weighted MRC: (0.5*100 + 0.1*300) / 400 = 0.2 at 8 blocks
+    assert [p["blocks"] for p in fc["mrc"]] == [8, 16]
+    assert fc["mrc"][0]["est_hit_rate"] == pytest.approx(0.2)
+    assert fc["mrc"][1]["est_hit_rate"] == pytest.approx(0.35)
+    # heat digest merged by fingerprint: hits/tokens sum, ranked
+    top = fc["heat_top"]
+    assert top[0]["fp"] == "0000aaaa"
+    assert top[0]["hits"] == 12 and top[0]["tokens_saved"] == 192
+    assert top[0]["last_tick"] == 9
+    assert top[1]["fp"] == "0000bbbb"
+    # the pure-function form agrees with the poller path
+    direct = fleet_cache([r.metrics() for r in reps],
+                         [r.state() for r in reps])
+    assert direct == fc
+    json.dumps(snap)
 
 
 def test_poller_eviction_backoff_staleness_readmission():

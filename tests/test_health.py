@@ -25,17 +25,17 @@ from paddle_tpu.observability.health import (
     register_detector, unregister_detector,
 )
 from paddle_tpu.observability.health.detectors import (
-    GoodputCollapse, KVBlockLeak, QueueStall, SteadyStateCompileAnomaly,
-    StepTimeSpike,
+    CacheThrash, GoodputCollapse, KVBlockLeak, QueueStall,
+    SteadyStateCompileAnomaly, StepTimeSpike,
 )
 from paddle_tpu.serving import ServingEngine
 from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-_DEFAULT_DETECTORS = {"goodput_collapse", "kv_block_leak",
-                      "queue_stall", "steady_state_compile",
-                      "step_time_spike"}
+_DEFAULT_DETECTORS = {"cache_thrash", "goodput_collapse",
+                      "kv_block_leak", "queue_stall",
+                      "steady_state_compile", "step_time_spike"}
 
 
 def _model(seed=7):
@@ -60,7 +60,8 @@ def _row(step, **kw):
         "steady_compiles": 0, "slo_on": False, "prefix_hit_rate": None,
         "pool_free_blocks": None, "pool_evictable_blocks": None,
         "pool_live_blocks": None, "conservation_ok": None,
-        "conservation_error": None,
+        "conservation_error": None, "cache_thrash": None,
+        "pool_evictable_delta": None,
     }
     assert set(base) == set(LEDGER_ROW_KEYS)
     base.update(kw)
@@ -241,6 +242,32 @@ def test_kv_block_leak_fires_on_audit_failure_and_idle_refs():
     ok = [_row(1, occupied_slots=0, tokens=0, pool_live_blocks=0,
                pool_free_blocks=8, pool_evictable_blocks=2),
           _row(2, occupied_slots=0, tokens=0)]
+    assert _feed(det3, ok) == []
+
+
+def test_cache_thrash_fires_on_sustained_reinserts_and_rearms():
+    """PR-13: evict-then-reinsert volume over the window means the
+    pool is smaller than the live prefix working set. Fires once per
+    episode, re-arms after a quiet window, and legacy rows (None) are
+    inert."""
+    det = CacheThrash(window=8, min_thrash=12)
+    rows = [_row(i + 1, cache_thrash=2) for i in range(8)]
+    fired = _feed(det, rows)
+    assert len(fired) == 1                     # once per episode
+    assert fired[0]["detector"] == "cache_thrash"
+    assert fired[0]["thrash_events"] >= 12
+    assert "working set" in fired[0]["reason"]
+    # quiet window re-arms, a second burst fires again
+    det2 = CacheThrash(window=4, min_thrash=6)
+    burst = [_row(i + 1, cache_thrash=3) for i in range(4)]
+    quiet = [_row(i + 5, cache_thrash=0) for i in range(4)]
+    again = [_row(i + 9, cache_thrash=3) for i in range(4)]
+    assert len(_feed(det2, burst + quiet + again)) == 2
+
+    # healthy churn (sparse reinserts) and legacy None rows: nothing
+    det3 = CacheThrash(window=8, min_thrash=12)
+    ok = [_row(i + 1, cache_thrash=(1 if i % 4 == 0 else 0))
+          for i in range(16)] + [_row(17)]
     assert _feed(det3, ok) == []
 
 

@@ -408,7 +408,7 @@ _SNAPSHOT_KEYS = {
     "decode_steps", "speculative_masked", "kv_donation", "compiles",
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
-    "scheduler", "health", "resilience", "perf", "replica",
+    "scheduler", "health", "resilience", "perf", "replica", "cache",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",
@@ -448,6 +448,12 @@ _PERF_PROGRAM_KEYS = {
     "avg_ms", "cost", "roofline_floor_ms", "roofline_fraction",
     "bound",
 }
+# the PR-13 cache observatory section: MRC + heat + savings + churn
+# (same key set whether the observatory has a paged pool or not)
+_CACHE_KEYS = {
+    "enabled", "accesses", "hits", "hit_rate", "capacity_blocks",
+    "sampled", "mrc", "heat", "savings", "churn",
+}
 
 
 def test_serving_snapshot_schema_contract():
@@ -471,8 +477,8 @@ def test_serving_snapshot_schema_contract():
     assert health["enabled"] is True and health["healthy"] is True
     assert health["anomalies_total"] == 0
     assert set(health["detectors"]) == {
-        "goodput_collapse", "kv_block_leak", "queue_stall",
-        "steady_state_compile", "step_time_spike"}
+        "cache_thrash", "goodput_collapse", "kv_block_leak",
+        "queue_stall", "steady_state_compile", "step_time_spike"}
     assert health["ledger_steps"] > 0
     # the PR-9 resilience section: schema + clean-run zeros + the
     # supervisor enabled by default alongside the observatory
@@ -524,6 +530,30 @@ def test_serving_snapshot_schema_contract():
     assert rep["uptime_s"] > 0
     assert health["replica_id"] == rep["replica_id"]
     assert health["uptime_s"] > 0
+    # the PR-13 cache observatory section: a legacy (non-paged) pool
+    # has no block economy to observe -> the disabled shape, same keys
+    cache = snap["cache"]
+    assert set(cache) == _CACHE_KEYS
+    assert cache["enabled"] is False and cache["mrc"] is None
+    # a paged engine reports live: schema, factor-stamped MRC, and
+    # cache_observatory=False degrades to the same disabled shape
+    eng_paged = ServingEngine(m, num_slots=2, bucket_min=8, paged=True,
+                              block_size=8)
+    _drive(eng_paged, np.random.RandomState(1), [(9, 3), (9, 3)])
+    live = eng_paged.metrics.snapshot()["cache"]
+    assert set(live) == _CACHE_KEYS
+    assert live["enabled"] is True
+    assert live["accesses"] > 0 and live["capacity_blocks"] > 0
+    assert [p["factor"] for p in live["mrc"]] == [0.5, 1.0, 2.0, 4.0]
+    assert set(live["churn"]) == {"evictions", "thrash_reinserts",
+                                  "block_lifetime_ms"}
+    eng_nocache = ServingEngine(m, num_slots=2, bucket_min=8,
+                                paged=True, block_size=8,
+                                cache_observatory=False)
+    _drive(eng_nocache, np.random.RandomState(1), [(9, 3)])
+    off_cache = eng_nocache.metrics.snapshot()["cache"]
+    assert set(off_cache) == _CACHE_KEYS
+    assert off_cache["enabled"] is False
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():
@@ -629,13 +659,19 @@ def test_engine_serve_metrics_http():
             f"http://127.0.0.1:{port}/debug/", timeout=10).read())
         assert {"/metrics", "/metrics.json", "/debug",
                 "/debug/requests", "/debug/state", "/debug/perf",
-                "/debug/health", "/debug/ledger"} <= set(idx["routes"])
+                "/debug/health", "/debug/ledger",
+                "/debug/cache"} <= set(idx["routes"])
         assert idx["routes"] == sorted(idx["routes"])
         # /debug/perf: the per-program attribution body
         perf = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{port}/debug/perf", timeout=10).read())
         assert perf["enabled"] is True
         assert "decode" in perf["programs"]
+        # /debug/cache: the cache observatory body (disabled shape on
+        # this legacy-pool engine, but the route and schema hold)
+        cache = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/cache", timeout=10).read())
+        assert cache["enabled"] is False and "churn" in cache
     finally:
         server.shutdown()
 

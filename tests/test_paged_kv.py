@@ -264,14 +264,28 @@ def test_fuzz_lookup_is_true_longest_prefix_and_refs_conserved():
     after every operation the pool's refcounts equal the recount from
     live slot rows, every match equals the mirror-trie oracle's
     longest cached prefix, and the free/live/evictable partition
-    holds."""
+    holds. PR 13 rides the same oracle: a live CacheObservatory is
+    attached, and per-node heat counts, LRU-tick monotonicity and the
+    evict-then-reinsert (thrash) counter are cross-checked against
+    mirror bookkeeping after every op."""
+    from paddle_tpu.observability import (CacheObservatory,
+                                          MetricsRegistry)
+
     rs = np.random.RandomState(42)
     BS = 4
     pool = _pool(num_slots=3, max_len=24, block_size=BS, num_blocks=13)
+    obs = CacheObservatory(MetricsRegistry(), sample_rate=1.0)
+    obs.attach_pool(pool)
     mirror = _MirrorTrie(BS)
     bases = [rs.randint(0, 9, (8,)) for _ in range(3)]   # shared stems
     live = {}    # slot -> prompt
     rid = 0
+    # PR 13 mirrors: per-block admission heat, each indexed block's
+    # root path (as a key tuple), the evicted-path set, thrash count
+    mirror_hits = {}
+    path_of = {}
+    mirror_evicted = set()
+    mirror_thrash = 0
 
     def audit():
         pool.check_conservation()
@@ -282,6 +296,15 @@ def test_fuzz_lookup_is_true_longest_prefix_and_refs_conserved():
                 counts[b] = counts.get(b, 0) + 1
         for b, r in pool._ref.items():
             assert counts.get(b, 0) == r, (b, r, counts)
+        # heat / tick / thrash accounting matches the mirrors
+        assert pool.index.thrash_count == mirror_thrash
+        root = pool.index._root
+        for b, node in pool.index._by_block.items():
+            assert node.hits == mirror_hits.get(b, 0), (b, node.hits)
+            if node.parent is not root:
+                # a child is never fresher than its parent: every
+                # match/insert touch walks root-down
+                assert node.tick <= node.parent.tick
 
     for step in range(400):
         if live and (rs.rand() < 0.4 or pool.free_count == 0):
@@ -305,6 +328,9 @@ def test_fuzz_lookup_is_true_longest_prefix_and_refs_conserved():
             if alloc is None:
                 audit()
                 continue
+            # acquire heats exactly the pinned prefix blocks, once
+            for b in alloc.prefix_blocks:
+                mirror_hits[b] = mirror_hits.get(b, 0) + 1
             # mirror any evictions acquire performed (the pool evicts
             # leaves first, so peel stale blocks leaf-inward)
             if pool.evictions > evicted_before:
@@ -315,12 +341,23 @@ def test_fuzz_lookup_is_true_longest_prefix_and_refs_conserved():
                     for b in list(stale):
                         if mirror_is_leaf(mirror.root, b):
                             mirror.remove(b)
+                            mirror_evicted.add(path_of.pop(b))
+                            mirror_hits.pop(b, None)
                             stale.discard(b)
                     assert len(stale) < n_before, "stale interior block"
-            pool.commit_prefix(alloc.slot, prompt)
-            mirror.insert(prompt,
-                          pool._slot_blocks[alloc.slot][
-                              :len(prompt) // BS])
+            created = pool.commit_prefix(alloc.slot, prompt)
+            # a created block whose root path was evicted earlier is a
+            # thrash re-insert; the pool credits each eviction once
+            keys = mirror._keys(prompt)
+            row = pool._slot_blocks[alloc.slot]
+            for b in created:
+                path = tuple(keys[:row.index(b) + 1])
+                if path in mirror_evicted:
+                    mirror_evicted.discard(path)
+                    mirror_thrash += 1
+                path_of[b] = path
+                mirror_hits.setdefault(b, 0)
+            mirror.insert(prompt, row[:len(prompt) // BS])
             live[alloc.slot] = prompt
             rid += 1
         audit()

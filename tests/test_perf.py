@@ -439,7 +439,12 @@ def test_bench_ledger_rows_normalize_evidence():
         "chaos": {"completion_rate": 1.0},
         "perf": {"programs": {"decode": {"avg_ms": 0.3}},
                  "decode_roofline": {"achieved_fraction": 0.4}},
-        # shared_prefix / health sections absent: skipped, not faked
+        # a cache-only shared_prefix section (PR 13): the cache rows
+        # normalize, the absent ttft_improvement is skipped, not faked
+        "shared_prefix": {"cache": {
+            "hit_rate": 0.91,
+            "savings": {"saved_ttft_ms": 88.5}}},
+        # health section absent: skipped, not faked
     }
     rows = bench_serving._ledger_rows(evidence, "run.json",
                                       "live-smoke", "digest0")
@@ -448,6 +453,11 @@ def test_bench_ledger_rows_normalize_evidence():
     assert by_key[("perf", "decode_avg_ms")]["direction"] \
         == "lower_better"
     assert by_key[("chaos", "completion_rate")]["rel_threshold"] == 0.1
+    assert by_key[("shared_prefix", "cache_hit_rate")]["value"] == 0.91
+    assert by_key[("shared_prefix", "cache_hit_rate")]["direction"] \
+        == "higher_better"
+    assert by_key[("shared_prefix", "cache_saved_ttft_ms")]["value"] \
+        == 88.5
     assert ("shared_prefix", "ttft_improvement") not in by_key
     assert ("health", "step_overhead_us") not in by_key
     assert all(r["config_digest"] == "digest0"

@@ -42,6 +42,8 @@ _TAIL_COLS = (
     ("done", "completed", "{:d}"),
     ("shed", "shed", "{:d}"),
     ("compile", "new_compiles", "{:d}"),
+    ("thrash", "cache_thrash", "{:d}"),      # cache pressure (PR 13):
+    ("evict_d", "pool_evictable_delta", "{:d}"),  # None -> "-" (legacy)
 )
 
 
