@@ -147,6 +147,10 @@ _LEDGER_SPECS = (
      1.0, ("fleet_poll", "overhead", "scrape_side_per_poll_ms")),
     ("fleet_poll", "engine_side_per_poll_us", "us", "lower_better",
      1.0, ("fleet_poll", "overhead", "engine_side_per_poll_us")),
+    ("router", "goodput_x", "ratio", "higher_better", 0.5,
+     ("router", "goodput_x")),
+    ("router", "failover_completion", "fraction", "higher_better",
+     0.1, ("router", "failover", "completion")),
 )
 
 
@@ -365,6 +369,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         if step_wall_us else None
     perf_sec = _perf_section(eng, health_sec)
     fleet_sec = _measure_fleet_poll(m_eng, num_slots, health_sec)
+    router_sec = _measure_router(m_eng, num_slots)
 
     import jax
     dev = jax.devices()[0]
@@ -425,6 +430,12 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         # + the probe-measured scrape-side and engine-side poll cost
         # (same <2%-of-step discipline as the health tick)
         "fleet_poll": fleet_sec,
+        # PR 14 fleet router: goodput scaling across 1/2/3 in-process
+        # replicas, the kill-a-replica drill (routed = 100% completion
+        # + greedy parity; no-failover baseline loses the dead
+        # replica's in-flight work), and the probe-measured router
+        # dispatch overhead (<5% of routed wall is the contract bar)
+        "router": router_sec,
     }
 
 
@@ -704,6 +715,155 @@ def _measure_fleet_poll(model, num_slots, health_sec):
             # < 5% contract-tested with runner slack)
             "overhead_frac": round(engine_side_us / 1e6 / interval_s,
                                    6),
+        },
+    }
+
+
+def _router_counter(registry, name):
+    fam = registry.snapshot().get(name)
+    return sum(fam["values"].values()) if fam else 0.0
+
+
+def _measure_router(model, num_slots):
+    """The artifact's ``router`` section (ISSUE 14): three in-process
+    replicas (EngineGateway driver threads) behind the fleet router.
+
+      * **goodput scaling** — the same request wave routed over 1, 2
+        and 3 replicas; ``goodput_x`` is the 3-replica/1-replica
+        tokens-per-second ratio (in-process replicas share one CPU,
+        so this measures routing correctness under concurrency more
+        than linear speedup — the ledger row tracks the trajectory);
+      * **kill drill, routed** — one replica killed mid-wave; the
+        journal replays prompt+tokens-so-far onto survivors, so
+        completion must be 1.0 with streams bit-exact vs the
+        1-replica reference (greedy parity);
+      * **kill drill, no-failover baseline** — identical kill against
+        a ``max_retries=0`` router: the dead replica's in-flight
+        requests are lost, demonstrating what the failover machinery
+        buys;
+      * **dispatch overhead** — the router's own bookkeeping
+        (admission, placement, journal, commit) is self-timed into
+        ``router_overhead_seconds_total``; quoted against the routed
+        wave's wall. <5% is the contract bar.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.router import (EngineGateway,
+                                           InProcessTransport, Router,
+                                           RouterConfig)
+
+    _set_phase("router")
+    requests, new_tokens = 8, 6
+    kill_tokens = 16            # kill waves run longer requests so
+    # the SIGKILL window comfortably contains in-flight work
+    rs = np.random.RandomState(14)
+    prompts = [rs.randint(0, model.cfg.vocab_size,
+                          (int(rs.randint(3, 10)),))
+               .astype(int).tolist() for _ in range(requests)]
+
+    def gateway(rid):
+        eng = ServingEngine(model, num_slots=num_slots, bucket_min=8,
+                            replica_id=rid, slo_ttft_ms=60000.0)
+        gw = EngineGateway(eng)
+        warm = gw.submit(np.asarray(prompts[0], dtype=np.int64),
+                         max_new_tokens=2)
+        gw.wait(warm, timeout=120.0)     # compiles out of the way
+        return gw
+
+    gws = [gateway(f"router-r{i}") for i in range(3)]
+
+    def cfg(retries):
+        return RouterConfig(max_retries=retries, refresh_s=0.05,
+                            backoff_base_s=0.01, backoff_max_s=0.1,
+                            seed=14, affinity=False)
+
+    def wave(active, retries, tokens_each, kill=None):
+        router = Router([InProcessTransport(g) for g in active],
+                        config=cfg(retries))
+        t0 = _time.perf_counter()
+        tickets = [router.submit(p, tokens_each) for p in prompts]
+        if kill is not None:
+            deadline = _time.monotonic() + 10.0
+            while not kill.engine.pending \
+                    and _time.monotonic() < deadline:
+                _time.sleep(0.001)
+            kill.kill()
+        results = [t.result(timeout=120.0) for t in tickets]
+        wall = _time.perf_counter() - t0
+        over_s = _router_counter(router.registry,
+                                 "router_overhead_seconds_total")
+        over_ops = _router_counter(router.registry,
+                                   "router_overhead_ops_total")
+        stats = dict(router._stats)
+        router.close()
+        return results, wall, (over_s, over_ops), stats
+
+    goodput, reference, over3 = {}, None, (0.0, 0.0)
+    for n in (1, 2, 3):
+        results, wall, over, _ = wave(gws[:n], retries=2,
+                                      tokens_each=new_tokens)
+        tokens = sum(len(r["tokens"]) for r in results if r["ok"])
+        goodput[str(n)] = round(tokens / wall, 2)
+        if n == 1:
+            reference = [r["tokens"] for r in results]
+        if n == 3:
+            over3, wall3 = over, wall
+
+    # longer-request reference for the kill waves' parity check
+    kill_ref, _, _, _ = wave(gws[:1], retries=2,
+                             tokens_each=kill_tokens)
+    kill_ref = [r["tokens"] for r in kill_ref]
+
+    # routed kill: victim dies mid-wave, survivors finish everything
+    results, _, _, stats = wave(gws, retries=4, tokens_each=kill_tokens,
+                                kill=gws[2])
+    ok = [r for r in results if r["ok"]]
+    failover = {
+        "killed": gws[2].replica_id,
+        "completion": round(len(ok) / requests, 3),
+        "lost": [r["rid"] for r in results
+                 if not r["ok"] and not r.get("shed")],
+        "parity_ok": [r["tokens"] for r in results] == kill_ref,
+        "failovers": stats["failovers"],
+        "retries": stats["retries"],
+    }
+
+    # identical kill, failover disabled: in-flight work is LOST
+    results, _, _, _ = wave(gws[:2], retries=0,
+                            tokens_each=kill_tokens, kill=gws[1])
+    base_ok = sum(1 for r in results if r["ok"])
+    baseline = {
+        "killed": gws[1].replica_id,
+        "completion": round(base_ok / requests, 3),
+        "lost": requests - base_ok
+        - sum(1 for r in results if r.get("shed")),
+    }
+
+    for gw in gws:
+        gw.close()
+    over_s, over_ops = over3
+    return {
+        "replicas": 3,
+        "requests": requests,
+        "new_tokens": new_tokens,
+        "goodput_tokens_per_sec": goodput,
+        "goodput_x": round(goodput["3"] / goodput["1"], 3)
+        if goodput["1"] else None,
+        "failover": failover,
+        "no_failover_baseline": baseline,
+        "overhead": {
+            "seconds_total": round(over_s, 6),
+            "ops": over_ops,
+            "per_op_us": round(over_s / over_ops * 1e6, 2)
+            if over_ops else None,
+            "wave_wall_s": round(wall3, 3),
+            # router bookkeeping as a fraction of the routed wave's
+            # wall clock (<5% contract bar)
+            "overhead_frac": round(over_s / wall3, 6)
+            if wall3 else None,
         },
     }
 
@@ -1527,6 +1687,8 @@ def main():
         "overload_goodput_x": evidence["overload"][
             "goodput_improvement"],
         "chaos_completion_rate": evidence["chaos"]["completion_rate"],
+        "router_failover_completion": evidence["router"]["failover"][
+            "completion"],
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })

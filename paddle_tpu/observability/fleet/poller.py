@@ -8,9 +8,14 @@ replicas die mid-scrape as a matter of course:
 
   * **per-replica timeout** — one wedged replica delays its own
     scrape, never the cycle (replicas scrape in parallel threads);
-  * **exponential backoff** — a failing replica is re-probed at
-    ``backoff_base_s * 2^(failures-1)`` (capped), so a dead host
-    doesn't eat a timeout per cycle forever;
+  * **exponential backoff with deterministic jitter** — a failing
+    replica is re-probed at ``backoff_base_s * 2^(failures-1)``
+    stretched by up to ``backoff_jitter`` (capped), so a dead host
+    doesn't eat a timeout per cycle forever. The jitter fraction is
+    a pure function of ``(jitter_seed, replica, failure count)`` —
+    no global ``random`` state — so N pollers watching a bounced
+    fleet de-synchronize their re-probes (different seeds spread
+    out) while any single poller stays exactly reproducible;
   * **staleness marking** — every replica carries ``last_seen``; an
     ``up`` replica not successfully scraped within ``stale_after_s``
     is marked ``stale`` (distrust the numbers, don't evict yet);
@@ -33,11 +38,23 @@ Targets are a static replica list — ``host:port`` strings, dicts
 (``fetch=``) so tests drive the whole lifecycle without sockets.
 """
 import json
+import random
 import threading
 import time
 import urllib.request
 
 from ..health.detectors import build_detectors
+
+
+def backoff_jitter_unit(seed, who, attempt):
+    """Deterministic unit-interval jitter fraction for backoff
+    spreading: a pure function of ``(seed, who, attempt)`` via a
+    local ``random.Random`` stream — the global ``random`` state is
+    never touched (PR-9 discipline), so jittered backoff is exactly
+    reproducible per poller and de-correlated across pollers with
+    different seeds. The serving router reuses this for its retry
+    backoff."""
+    return random.Random(f"{seed}:{who}:{attempt}").random()
 from ..health.ledger import StepLedger
 from ..registry import MetricsRegistry, prometheus_text_from_snapshots
 from ..tracing import default_recorder
@@ -124,7 +141,8 @@ class FleetPoller:
 
     def __init__(self, targets, interval_s=2.0, timeout_s=1.0,
                  stale_after_s=None, down_after=3, backoff_base_s=None,
-                 backoff_max_s=None, ledger_keep=512, registry=None,
+                 backoff_max_s=None, backoff_jitter=0.25,
+                 jitter_seed=0, ledger_keep=512, registry=None,
                  detector_config=None, fetch=None,
                  clock=time.monotonic):
         self.interval_s = float(interval_s)
@@ -138,6 +156,12 @@ class FleetPoller:
             if backoff_base_s is not None else self.interval_s
         self.backoff_max_s = float(backoff_max_s) \
             if backoff_max_s is not None else 8.0 * self.interval_s
+        self.backoff_jitter = float(backoff_jitter)
+        if not 0.0 <= self.backoff_jitter <= 1.0:
+            raise ValueError(
+                f"backoff_jitter must be in [0, 1], "
+                f"got {backoff_jitter}")
+        self.jitter_seed = jitter_seed
         self._clock = clock
         self._fetch = fetch if fetch is not None else _default_fetch
         self.replicas = []
@@ -267,9 +291,17 @@ class FleetPoller:
         st.failures += 1
         st.consecutive_failures += 1
         st.last_error = f"{type(exc).__name__}: {exc}"[:160]
+        # exponential backoff stretched by deterministic seeded jitter
+        # (a pure function of seed/replica/failure-count — N pollers
+        # watching the same bounced fleet re-probe spread out instead
+        # of in lockstep, yet each poller is exactly reproducible)
+        stretch = 1.0 + self.backoff_jitter * backoff_jitter_unit(
+            self.jitter_seed, st.replica_id or st.url,
+            st.consecutive_failures)
         backoff = min(self.backoff_max_s,
                       self.backoff_base_s
-                      * (2 ** (st.consecutive_failures - 1)))
+                      * (2 ** (st.consecutive_failures - 1))
+                      * stretch)
         st.backoff_until = now + backoff
         self._c_scrapes.labels("error").inc()
         if st.consecutive_failures >= self.down_after:

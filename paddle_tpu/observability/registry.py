@@ -735,7 +735,8 @@ class MetricsServerHandle:
 
 
 def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
-                         extra_routes=None):
+                         extra_routes=None, post_routes=None,
+                         max_body_bytes=1 << 20):
     """Serve ``/metrics`` (Prometheus text) and ``/metrics.json`` (the
     snapshot) on a stdlib HTTP server in a daemon thread.
     ``extra_routes`` maps additional paths to zero-arg callables: a
@@ -750,20 +751,82 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
     /metrics pair included — renders its FULL body before any byte
     goes on the wire, and a rendering failure turns into a 500, so a
     scraper racing an engine shutdown reads either a complete
-    response or a clean error, never a truncated half-body. Returns a
-    MetricsServerHandle: ``handle.port`` is the bound port (``port=0``
-    picks a free one), ``handle.close()`` stops it (idempotent; also
-    a context manager)."""
+    response or a clean error, never a truncated half-body.
+
+    ``post_routes`` maps paths to one-arg callables receiving the
+    request's parsed-JSON body (the serving gateway mounts
+    ``POST /v1/generate`` this way). The callable returns either a
+    payload (served as 200 application/json) or a ``(status,
+    payload)`` tuple for explicit status codes (e.g. 503 while
+    draining). The wire contract is defensive by construction: a body
+    over ``max_body_bytes`` is refused with 413 before it is read, a
+    missing/oversized-or-absent Content-Length is a 411/413, malformed
+    JSON (or a non-object body) is a 400 with a JSON error envelope —
+    never a traceback — and a handler exception is a clean 500.
+
+    Returns a MetricsServerHandle: ``handle.port`` is the bound port
+    (``port=0`` picks a free one), ``handle.close()`` stops it
+    (idempotent; also a context manager)."""
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     reg = registry if registry is not None else default_registry()
     routes = dict(extra_routes or {})
+    posts = dict(post_routes or {})
     if "/debug" not in routes:
         index = sorted(["/metrics", "/metrics.json", "/debug"]
-                       + list(routes))
+                       + list(routes) + list(posts))
         routes["/debug"] = lambda: {"routes": index}
 
     class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status, payload):
+            body = json.dumps(payload, sort_keys=True).encode("utf-8")
+            try:
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0].rstrip("/")
+            handler = posts.get(path)
+            if handler is None:
+                self.send_error(405 if path in routes else 404)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", ""))
+            except ValueError:
+                self._reply(411, {"error": "Content-Length required"})
+                return
+            if length > max_body_bytes:
+                self._reply(413, {
+                    "error": "body too large",
+                    "max_body_bytes": max_body_bytes})
+                return
+            raw = self.rfile.read(max(0, length))
+            try:
+                body = json.loads(raw.decode("utf-8"))
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except Exception as e:  # noqa: BLE001 - 400, no traceback
+                self._reply(400, {
+                    "error": "malformed JSON body",
+                    "detail": f"{type(e).__name__}: {e}"[:200]})
+                return
+            try:
+                out = handler(body)
+            except Exception as e:  # noqa: BLE001 - 500, no traceback
+                self._reply(500, {
+                    "error": f"{type(e).__name__}: {e}"[:200]})
+                return
+            if (isinstance(out, tuple) and len(out) == 2
+                    and isinstance(out[0], int)):
+                self._reply(out[0], out[1])
+            else:
+                self._reply(200, out)
+
         def do_GET(self):
             path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
             try:

@@ -119,6 +119,21 @@ math into a multi-tenant server:
     ``/debug/health`` reports ``{degraded, draining, restarts}``
     truthfully throughout (``snapshot()["resilience"]`` carries the
     counters; ``tools/chaos_sweep.py`` is the CI fault matrix);
+  * **fleet router** (serving.router, PR 14 — ROADMAP direction #2's
+    request path) — the client-facing front-end over N replicas:
+    ``EngineGateway`` gives every engine a ``POST /v1/generate`` wire
+    surface (and an in-process transport for tests/benches), and
+    ``Router`` dispatches over the fleet with load+prefix-affinity
+    placement fed by the PR-11 poller verdicts and PR-13
+    ``cache.heat_top`` fingerprints, per-replica circuit breakers,
+    bounded retry/failover with deterministic jittered backoff, a
+    prompt+tokens-so-far journal for bit-exact greedy continuation
+    after replica death, remaining-deadline propagation, and optional
+    p99-derived first-wins hedging (OFF by default). Explicit shed
+    verdicts, ``/router/state`` on its own registry (rendered by
+    ``tools/fleet_top.py --router``), and a kill-a-replica drill
+    (``tools/router_drill.py``) that proves 100% completion + parity
+    + zero leaks where a no-failover baseline loses in-flight work;
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program
@@ -262,6 +277,11 @@ from .paged import PagedKVPool, RadixPrefixIndex  # noqa: F401
 from .resilience import (  # noqa: F401
     EngineSupervisor, FaultInjector, FaultPlan, FaultSpec,
     InjectedFault,
+)
+from .router import (  # noqa: F401
+    CircuitBreaker, EngineGateway, HTTPTransport, InProcessTransport,
+    RequestJournal, Router, RouterConfig, TransportError,
+    TransportRefused,
 )
 from .sched import (  # noqa: F401
     ChunkPlan, FIFOPolicy, SchedulingPolicy, SLOFeedbackPolicy,

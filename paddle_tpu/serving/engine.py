@@ -737,7 +737,8 @@ class ServingEngine:
         self.watchdog.declare_warmup_complete()
         self._policy.reset_service()
 
-    def serve_metrics(self, port=0, addr="127.0.0.1"):
+    def serve_metrics(self, port=0, addr="127.0.0.1",
+                      post_routes=None):
         """Expose this engine's metrics registry over HTTP: GET
         /metrics (Prometheus text), /metrics.json (the snapshot
         schema), /debug (the route index — every mounted path, so the
@@ -748,7 +749,10 @@ class ServingEngine:
         attribution, churn) and — with the health observatory on —
         /debug/health ({healthy, detectors, last_incident}: the
         per-replica router signal) and /debug/ledger (the per-step
-        ring). Returns a MetricsServerHandle — ``handle.port`` is the
+        ring). ``post_routes`` mounts POST handlers alongside (the
+        router's EngineGateway mounts ``POST /v1/generate`` this way —
+        see start_metrics_server for the body-parsing contract).
+        Returns a MetricsServerHandle — ``handle.port`` is the
         bound port, ``handle.close()`` stops it (idempotent); every
         handle is also closed by ``engine.close()`` so the server
         thread shuts down with the engine."""
@@ -764,9 +768,18 @@ class ServingEngine:
             routes["/debug/ledger"] = self.health.debug_ledger
         handle = start_metrics_server(
             self.metrics.registry, port=port, addr=addr,
-            extra_routes=routes)
+            extra_routes=routes, post_routes=post_routes)
         self._metric_servers.append(handle)
         return handle
+
+    def start_draining(self):
+        """Flip the drain flag WITHOUT stepping: new ``add_request``
+        calls raise immediately and ``/debug/health`` reports
+        ``draining: true``, while whoever owns the step loop (e.g. a
+        router EngineGateway driver thread) keeps stepping the
+        already-submitted work to completion. ``drain()`` is the
+        synchronous flavor that also runs the steps and closes."""
+        self._draining = True
 
     def drain(self):
         """Graceful drain: stop accepting NEW requests (add_request
@@ -775,7 +788,7 @@ class ServingEngine:
         ``draining: true`` for the duration, so a router stops
         routing to this replica while it finishes its commitments.
         Returns the completed requests (submission order)."""
-        self._draining = True
+        self.start_draining()
         while self.step():
             pass
         done = sorted(self.scheduler.completed, key=lambda r: r.rid)

@@ -39,11 +39,14 @@ identical token streams) is itself pinned by tests.
 import os
 import random
 
-# every seam the engine exposes to the injector, in documentation order
+# every seam the engine exposes to the injector, in documentation
+# order; ``router_dispatch`` is the fleet router's seam (a dispatch to
+# a replica fails before it leaves the router — the retry/failover/
+# breaker path's chaos input), checked by Router, not the engine
 FAULT_SITES = (
     "prefill_dispatch", "chunk_dispatch", "decode_dispatch",
     "transfer", "step_latency", "block_exhaustion", "compile_storm",
-    "callback",
+    "callback", "router_dispatch",
 )
 
 # the PADDLE_CHAOS default plan: dispatch/transfer/callback faults at
@@ -59,6 +62,9 @@ DEFAULT_RATES = {
     "block_exhaustion": 0.02,
     "compile_storm": 0.0,
     "callback": 0.05,
+    # router-level faults stay OPT-IN: the default env plan targets
+    # one engine; arming the router seam is the router drill's call
+    "router_dispatch": 0.0,
 }
 
 

@@ -1,0 +1,50 @@
+"""Fault-tolerant fleet router (ROADMAP direction #2's request path).
+
+The client-facing front-end over N serving-engine replicas::
+
+      client ──► Router ──┬─► EngineGateway(engine A)   (in-process)
+        submit/generate   ├─► POST /v1/generate ► replica B  (wire)
+                          └─► POST /v1/generate ► replica C  (wire)
+                   ▲ posture: FleetPoller verdicts + /fleet/state
+                   ▲ affinity: cache.heat_top path fingerprints
+
+Pieces:
+
+  * :class:`EngineGateway` (transport.py) — owns one engine's step
+    loop + the ``POST /v1/generate`` wire surface;
+  * :class:`InProcessTransport` / :class:`HTTPTransport` — how the
+    router reaches a replica (same interface, sockets optional);
+  * :class:`CircuitBreaker` (breaker.py) — per-replica
+    closed→open→half-open distrust, driven by dispatch outcomes AND
+    poller verdicts;
+  * :class:`RequestJournal` (journal.py) — prompt + tokens-so-far
+    per in-flight request (the supervisor's ``prefill_ids`` replay
+    discipline across replicas): replica death → re-dispatch with
+    bit-exact greedy continuation;
+  * :class:`Router` (core.py) — admission (bounded queue, explicit
+    shed verdicts, down/stale/draining/degraded refused), load+
+    affinity placement, bounded retry/failover with deterministic
+    jittered backoff, optional first-wins hedging (OFF by default),
+    ``/router/state`` + its own metrics registry.
+
+Proven by ``tools/router_drill.py``: SIGKILL a replica mid-traffic —
+every admitted, non-shed request still completes with greedy parity
+and zero slot/block leaks on the survivors, where a no-failover
+baseline loses everything in flight on the dead replica.
+"""
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from .core import (ROUTER_STATE_KEYS, Router, RouterConfig,
+                   RouterTicket, prompt_fingerprints)
+from .journal import JournalEntry, RequestJournal
+from .transport import (EngineGateway, HTTPTransport,
+                        InProcessTransport, TransportError,
+                        TransportRefused)
+
+__all__ = [
+    "Router", "RouterConfig", "RouterTicket", "ROUTER_STATE_KEYS",
+    "prompt_fingerprints",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+    "RequestJournal", "JournalEntry",
+    "EngineGateway", "InProcessTransport", "HTTPTransport",
+    "TransportError", "TransportRefused",
+]

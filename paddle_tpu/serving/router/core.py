@@ -1,0 +1,823 @@
+"""RouterCore: fault-tolerant dispatch across N engine replicas.
+
+The request-path front-end ROADMAP direction #2 calls for, consuming
+the PR-11/13 signals as-is:
+
+  * **admission** — bounded router queue (``max_queue`` — never
+    unbounded buffering); a request is refused with an explicit shed
+    verdict when the queue is full or no replica is admissible.
+    ``down``/``stale`` (poller verdicts), draining, degraded and
+    unhealthy replicas never receive NEW requests;
+  * **placement** — least-loaded by ``queue_depth`` (from
+    ``/fleet/state`` via an attached FleetPoller, or probed directly
+    off in-process transports) plus the router's own in-flight count,
+    with prefix affinity: prompts are fingerprinted with the SAME
+    stable ``path_fingerprint`` chain the radix cache stamps into its
+    heat digest, and a replica whose ``cache.heat_top`` (or the
+    router's own sticky placement memory) matches keeps the prefix —
+    unless it is overloaded past ``affinity_spill``, because a cache
+    hit is not worth queueing behind a hot spot;
+  * **robustness** — per-replica circuit breakers (dispatch failures
+    AND poller verdicts), bounded retry/failover with exponential
+    backoff + deterministic seeded jitter (the poller's
+    ``backoff_jitter_unit``), an in-flight journal mirroring the
+    supervisor's ``prefill_ids`` replay discipline (replica death →
+    re-dispatch ``prompt + tokens_so_far`` to a healthy peer,
+    bit-exact under greedy decoding), remaining-deadline propagation
+    into engine ``add_request(deadline_ms=)``, and optional
+    tail-latency hedging (OFF by default): a second dispatch after a
+    p99-derived delay, first result wins, the loser is cancelled
+    (in-process) or abandoned (wire) and both outcomes counted.
+
+Router state — breaker states, per-replica dispatch/failure counters,
+journal depth, shed/retry/failover/hedge totals — lives on the
+router's own MetricsRegistry and the ``/router/state`` route
+(``router.serve()``); ``tools/fleet_top.py --router`` renders it next
+to the fleet table.
+"""
+import itertools
+import os
+import threading
+import time
+
+from ...observability import MetricsRegistry, start_metrics_server
+from ...observability.fleet.poller import backoff_jitter_unit
+from ..paged.radix import path_fingerprint
+from ..resilience.chaos import InjectedFault, resolve_chaos
+from .breaker import CircuitBreaker
+from .journal import RequestJournal
+from .transport import TransportError, TransportRefused
+
+__all__ = ["RouterConfig", "Router", "RouterTicket",
+           "prompt_fingerprints", "ROUTER_STATE_KEYS"]
+
+_tag_seq = itertools.count()
+
+# /router/state top-level schema (pinned by tests/test_router.py)
+ROUTER_STATE_KEYS = (
+    "config", "counters", "hedge", "journal", "journal_depth",
+    "replicas",
+)
+
+
+def prompt_fingerprints(prompt, block_size):
+    """The prompt's root->block fingerprint chain — the same stable
+    crc32 path fingerprints the radix index stamps into the heat
+    digest, computed router-side without ever shipping raw tokens.
+    Only whole blocks fingerprint (the cache shares whole blocks)."""
+    fps = []
+    fp = 0
+    prompt = [int(t) for t in prompt]
+    for i in range(0, (len(prompt) // block_size) * block_size,
+                   block_size):
+        fp = path_fingerprint(fp, tuple(prompt[i:i + block_size]))
+        fps.append(fp)
+    return fps
+
+
+class RouterConfig:
+    """Router policy knobs, ServingConfig-style: env-gated defaults,
+    eager validation."""
+
+    def __init__(self, max_queue=64, max_retries=None,
+                 backoff_base_s=0.05, backoff_max_s=2.0,
+                 backoff_jitter=0.5, seed=0,
+                 breaker_threshold=3, breaker_reset_s=1.0,
+                 refresh_s=0.25, affinity=True, affinity_block=16,
+                 affinity_spill=4, hedge=None, hedge_factor=1.5,
+                 hedge_min_s=0.05, default_deadline_ms=None):
+        # retry/failover budget: attempts = 1 + max_retries
+        if max_retries is None:
+            max_retries = int(os.environ.get(
+                "PADDLE_ROUTER_MAX_RETRIES", "2"))
+        self.max_retries = int(max_retries)
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {max_retries}")
+        self.max_queue = int(max_queue)
+        if self.max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1, got {max_queue}")
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        if not 0.0 <= float(backoff_jitter) <= 1.0:
+            raise ValueError(
+                f"backoff_jitter must be in [0, 1], "
+                f"got {backoff_jitter}")
+        self.backoff_jitter = float(backoff_jitter)
+        self.seed = seed
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self.refresh_s = float(refresh_s)
+        if self.refresh_s <= 0:
+            raise ValueError(
+                f"refresh_s must be > 0, got {refresh_s}")
+        self.affinity = bool(affinity)
+        self.affinity_block = int(affinity_block)
+        if self.affinity_block < 1:
+            raise ValueError(
+                f"affinity_block must be >= 1, got {affinity_block}")
+        self.affinity_spill = int(affinity_spill)
+        # tail-latency hedging: OFF by default (a second dispatch is
+        # real capacity spent; opt in per router or via env)
+        if hedge is None:
+            hedge = os.environ.get("PADDLE_ROUTER_HEDGE", "0") == "1"
+        self.hedge = bool(hedge)
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_min_s = float(hedge_min_s)
+        if self.hedge_min_s < 0:
+            raise ValueError(
+                f"hedge_min_s must be >= 0, got {hedge_min_s}")
+        self.default_deadline_ms = default_deadline_ms
+
+    def describe(self):
+        return {
+            "max_queue": self.max_queue,
+            "max_retries": self.max_retries,
+            "backoff_base_s": self.backoff_base_s,
+            "backoff_jitter": self.backoff_jitter,
+            "breaker_threshold": self.breaker_threshold,
+            "breaker_reset_s": self.breaker_reset_s,
+            "refresh_s": self.refresh_s,
+            "affinity": self.affinity,
+            "affinity_block": self.affinity_block,
+            "hedge": self.hedge,
+        }
+
+
+class RouterTicket:
+    """Handle for one routed request: ``result(timeout)`` blocks for
+    the RouterResult dict ({ok, shed, reason, tokens, replica_id,
+    attempts, failovers, hedged, ...})."""
+
+    def __init__(self, rid):
+        self.rid = rid
+        self._done = threading.Event()
+        self._result = None
+
+    def _finish(self, result):
+        self._result = result
+        self._done.set()
+
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"routed request {self.rid} still in flight")
+        return self._result
+
+
+class Router:
+    def __init__(self, transports, poller=None, config=None,
+                 registry=None, chaos=False, clock=time.monotonic):
+        self.config = config if config is not None else RouterConfig()
+        self._clock = clock
+        self.poller = poller
+        # seeded PR-9 fault plans at the router's own seam
+        # (``router_dispatch``): an armed injector fails dispatches
+        # deterministically BEFORE they reach a replica — the chaos
+        # input the retry/failover/breaker machinery is drilled with.
+        # False = off (the router never consults PADDLE_CHAOS; that
+        # env var arms engines).
+        self.chaos = resolve_chaos(chaos) if chaos is not False \
+            else None
+        self.transports = {}
+        for i, t in enumerate(transports):
+            rid = getattr(t, "replica_id", None) or f"r{i}"
+            if rid in self.transports:
+                raise ValueError(f"duplicate replica_id {rid!r}")
+            self.transports[rid] = t
+        if not self.transports:
+            raise ValueError("Router needs at least one transport")
+        self.breakers = {
+            rid: CircuitBreaker(
+                threshold=self.config.breaker_threshold,
+                reset_s=self.config.breaker_reset_s)
+            for rid in self.transports}
+        self.journal = RequestJournal()
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        r = self.registry
+        self._c_requests = r.counter(
+            "router_requests_total", "routed requests by outcome",
+            labelnames=("outcome",))
+        self._c_shed = r.counter(
+            "router_shed_total",
+            "requests refused at admission, by shed verdict",
+            labelnames=("reason",))
+        self._c_dispatch = r.counter(
+            "router_dispatches_total", "dispatch attempts per replica",
+            labelnames=("replica",))
+        self._c_dispatch_fail = r.counter(
+            "router_dispatch_failures_total",
+            "failed dispatch attempts per replica by kind "
+            "(error charges the breaker, refused does not)",
+            labelnames=("replica", "kind"))
+        self._c_retries = r.counter(
+            "router_retries_total", "dispatch retries (backoff slept)")
+        self._c_failovers = r.counter(
+            "router_failovers_total",
+            "re-dispatches that moved a request to a different "
+            "replica")
+        self._c_hedges = r.counter(
+            "router_hedges_total", "hedge dispatches launched")
+        self._c_hedge_wins = r.counter(
+            "router_hedge_wins_total", "hedged races by winner",
+            labelnames=("winner",))
+        self._c_hedge_losers = r.counter(
+            "router_hedge_losers_total",
+            "hedge losers by disposition (cancelled: replica freed; "
+            "abandoned: result discarded, replica ran to completion)",
+            labelnames=("disposition",))
+        self._c_breaker_trans = r.counter(
+            "router_breaker_transitions_total",
+            "circuit-breaker state entries per replica",
+            labelnames=("replica", "to"))
+        self._g_journal = r.gauge(
+            "router_journal_depth",
+            "in-flight routed requests (journal entries)")
+        self._g_breaker = r.gauge(
+            "router_breaker_state",
+            "breaker state per replica (0 closed, 1 half-open, "
+            "2 open)", labelnames=("replica",))
+        self._h_latency = r.histogram(
+            "router_request_latency_seconds",
+            "end-to-end routed request latency")
+        self._c_overhead_s = r.counter(
+            "router_overhead_seconds_total",
+            "wall seconds spent in router bookkeeping (admission, "
+            "placement, journal, commit) — excludes waiting on "
+            "replicas; the bench's dispatch-overhead probe")
+        self._c_overhead_ops = r.counter(
+            "router_overhead_ops_total",
+            "bookkeeping sections timed into "
+            "router_overhead_seconds_total")
+        from ...observability.registry import Reservoir
+        self._latencies = Reservoir(capacity=512, seed=self.config.seed
+                                    if isinstance(self.config.seed,
+                                                  int) else 0)
+        self._lock = threading.RLock()
+        self._posture = {}
+        self._last_refresh = None
+        self._inflight = {rid: 0 for rid in self.transports}
+        self._sticky = {}          # fingerprint -> replica_id
+        self._stats = {"ok": 0, "error": 0, "shed": 0, "retries": 0,
+                       "failovers": 0, "hedges": 0, "hedge_wins": 0}
+        self._closed = False
+        self._threads = []
+        self._servers = []
+
+    # ---------------------------------------------------- posture
+    def refresh(self, force=False):
+        """Refresh the per-replica posture map (verdict, draining,
+        degraded, healthy, queue_depth, heat table), TTL-cached at
+        ``refresh_s`` — the router's "one poll interval". Feeds every
+        breaker its replica's poller verdict."""
+        now = self._clock()
+        with self._lock:
+            if (not force and self._last_refresh is not None
+                    and now - self._last_refresh < self.config.refresh_s):
+                return
+            self._last_refresh = now
+            by_replica = {}
+            if self.poller is not None:
+                for st in self.poller.replicas:
+                    by_replica[st.replica_id] = st
+                    by_replica[st.url] = st
+            for rid, t in self.transports.items():
+                st = by_replica.get(rid) \
+                    or by_replica.get(getattr(t, "url", None))
+                if st is not None:
+                    self._posture[rid] = self._posture_from_poller(st)
+                else:
+                    self._posture[rid] = self._probe(t)
+                verdict = self._posture[rid].get("verdict")
+                if verdict:
+                    self.breakers[rid].note_verdict(verdict, now)
+                self._export_breaker(rid)
+
+    @staticmethod
+    def _posture_from_poller(st):
+        health = st.health or {}
+        state = st.state or {}
+        heat = ((state.get("cache") or {}).get("heat")
+                or {}).get("top") or []
+        return {
+            "verdict": st.verdict,
+            "draining": bool(health.get("draining")),
+            "degraded": bool(health.get("degraded")),
+            "healthy": health.get("healthy"),
+            "queue_depth": state.get("queue_depth") or 0,
+            "heat": {e["fp"]: e.get("tokens_saved", 0)
+                     for e in heat},
+        }
+
+    @staticmethod
+    def _probe(t):
+        try:
+            health = t.health() or {}
+            state = t.state() or {}
+        except TransportError as e:
+            return {"verdict": "down", "error": str(e)[:160],
+                    "queue_depth": 0, "heat": {}}
+        heat = ((state.get("cache") or {}).get("heat")
+                or {}).get("top") or []
+        return {
+            "verdict": "up",
+            "draining": bool(health.get("draining")),
+            "degraded": bool(health.get("degraded")),
+            "healthy": health.get("healthy"),
+            "queue_depth": state.get("queue_depth") or 0,
+            "heat": {e["fp"]: e.get("tokens_saved", 0)
+                     for e in heat},
+        }
+
+    @staticmethod
+    def _admissible(posture):
+        if posture.get("verdict") in ("down", "stale"):
+            return False
+        if posture.get("draining") or posture.get("degraded"):
+            return False
+        if posture.get("healthy") is False:
+            return False
+        return True
+
+    def _export_breaker(self, rid):
+        br = self.breakers[rid]
+        level = {"closed": 0, "half_open": 1, "open": 2}[br.state]
+        self._g_breaker.labels(rid).set(level)
+
+    # --------------------------------------------------- placement
+    def _select(self, fps, excluded, now):
+        """One placement decision: admissible (posture + breaker)
+        candidates, failover preference (``excluded`` last), affinity
+        first unless the affinity replica is overloaded, else least
+        loaded. Returns a replica id or None."""
+        with self._lock:
+            cands = []
+            for rid in self.transports:
+                posture = self._posture.get(rid) or {}
+                if not self._admissible(posture):
+                    continue
+                if not self.breakers[rid].allow(now):
+                    continue
+                cands.append(rid)
+            if not cands:
+                return None
+            fresh = [r for r in cands if r not in excluded]
+            pool = fresh or cands   # single-replica fleets may retry
+            load = {r: ((self._posture.get(r) or {})
+                        .get("queue_depth") or 0)
+                    + self._inflight[r] for r in pool}
+            floor = min(load.values())
+            choice = None
+            if self.config.affinity and fps:
+                scores = {}
+                for r in pool:
+                    heat = (self._posture.get(r) or {}).get("heat") \
+                        or {}
+                    s = sum(heat.get(fp, 0) for fp in fps)
+                    for depth, fp in enumerate(fps):
+                        if self._sticky.get(fp) == r:
+                            s += depth + 1
+                    if s > 0:
+                        scores[r] = s
+                if scores:
+                    best = max(sorted(scores), key=lambda r: scores[r])
+                    if load[best] <= floor + self.config.affinity_spill:
+                        choice = best
+            if choice is None:
+                choice = min(sorted(pool), key=lambda r: load[r])
+            self.breakers[choice].claim(now)
+            self._inflight[choice] += 1
+            return choice
+
+    def _release(self, rid):
+        with self._lock:
+            self._inflight[rid] = max(0, self._inflight[rid] - 1)
+
+    def _note_sticky(self, fps, rid):
+        with self._lock:
+            for fp in fps:
+                self._sticky[fp] = rid
+            while len(self._sticky) > 4096:
+                self._sticky.pop(next(iter(self._sticky)))
+
+    # --------------------------------------------------- breaker IO
+    def _breaker_failure(self, rid):
+        now = self._clock()
+        with self._lock:
+            br = self.breakers[rid]
+            before = br.state
+            br.record_failure(now)
+            if br.state != before:
+                self._c_breaker_trans.labels(rid, br.state).inc()
+            self._export_breaker(rid)
+
+    def _breaker_success(self, rid):
+        with self._lock:
+            br = self.breakers[rid]
+            before = br.state
+            br.record_success()
+            if br.state != before:
+                self._c_breaker_trans.labels(rid, br.state).inc()
+            self._export_breaker(rid)
+
+    # ---------------------------------------------------- admission
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               deadline_ms=None, tag=None):
+        """Admit and route one request; returns a RouterTicket
+        immediately (the dispatch runs on a worker thread). A shed
+        verdict resolves the ticket synchronously with
+        ``{"shed": True, "reason": ...}`` — the caller always gets an
+        explicit answer, never silent buffering."""
+        t0 = time.perf_counter()
+        if deadline_ms is None:
+            deadline_ms = self.config.default_deadline_ms
+        tag = tag if tag is not None else f"q{next(_tag_seq)}"
+        ticket = RouterTicket(tag)
+        if self._closed:
+            return self._shed(ticket, "router_closed", t0)
+        if self.journal.depth >= self.config.max_queue:
+            return self._shed(ticket, "queue_full", t0)
+        self.refresh()
+        now = self._clock()
+        with self._lock:
+            any_admissible = any(
+                self._admissible(self._posture.get(rid) or {})
+                and self.breakers[rid].allow(now)
+                for rid in self.transports)
+        if not any_admissible:
+            return self._shed(ticket, "no_admissible_replica", t0)
+        entry = self.journal.admit(tag, [int(t) for t in prompt],
+                                   max_new_tokens, eos_id,
+                                   deadline_ms, now)
+        self._g_journal.set(self.journal.depth)
+        self._account_overhead(t0)
+        worker = threading.Thread(
+            target=self._drive, args=(entry, ticket), daemon=True,
+            name=f"router-{tag}")
+        with self._lock:
+            self._threads.append(worker)
+            del self._threads[:-256]
+        worker.start()
+        return ticket
+
+    def generate(self, prompt, max_new_tokens, eos_id=None,
+                 deadline_ms=None, timeout=None):
+        """Blocking convenience: submit + result."""
+        return self.submit(prompt, max_new_tokens, eos_id=eos_id,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    def _shed(self, ticket, reason, t0):
+        self._c_shed.labels(reason).inc()
+        self._c_requests.labels("shed").inc()
+        with self._lock:
+            self._stats["shed"] += 1
+        self._account_overhead(t0)
+        ticket._finish({"rid": ticket.rid, "ok": False, "shed": True,
+                        "reason": reason, "tokens": [],
+                        "replica_id": None, "attempts": 0,
+                        "failovers": 0, "hedged": False})
+        return ticket
+
+    def _account_overhead(self, t0):
+        self._c_overhead_s.inc(time.perf_counter() - t0)
+        self._c_overhead_ops.inc()
+
+    # ------------------------------------------------------ dispatch
+    def _remaining_ms(self, entry):
+        if entry.deadline_ms is None:
+            return None
+        elapsed = (self._clock() - entry.t_admitted) * 1000.0
+        return entry.deadline_ms - elapsed
+
+    def _drive(self, entry, ticket):
+        t_start = time.perf_counter()
+        fps = prompt_fingerprints(entry.prompt,
+                                  self.config.affinity_block) \
+            if self.config.affinity else []
+        excluded = set()
+        failures = 0
+        failovers = 0
+        hedged = False
+        hedge_winner = None
+        last_error = "no_healthy_replica"
+        while True:
+            remaining = self._remaining_ms(entry)
+            if remaining is not None and remaining <= 0:
+                return self._finish_error(entry, ticket, "deadline",
+                                          failures, failovers, hedged,
+                                          t_start)
+            t_bk = time.perf_counter()
+            now = self._clock()
+            self.refresh()
+            rid = self._select(fps, excluded, now)
+            self._account_overhead(t_bk)
+            if rid is None:
+                failures += 1
+                last_error = "no_healthy_replica"
+                if failures > self.config.max_retries:
+                    return self._finish_error(
+                        entry, ticket, last_error, failures,
+                        failovers, hedged, t_start)
+                self._c_retries.inc()
+                with self._lock:
+                    self._stats["retries"] += 1
+                self._backoff(entry.rid, failures)
+                self.refresh(force=True)
+                continue
+            # a failover is counted by what actually happened: this
+            # dispatch goes to a DIFFERENT replica than the previous
+            # attempt (refused / errored / died / shed — the cause
+            # has its own counter)
+            if entry.replica is not None and entry.replica != rid:
+                failovers += 1
+                self._c_failovers.inc()
+                with self._lock:
+                    self._stats["failovers"] += 1
+            entry.replica = rid
+            entry.attempts += 1
+            base = len(entry.tokens)
+            self._c_dispatch.labels(rid).inc()
+            calls = []
+            try:
+                calls.append(self._begin(rid, entry, remaining))
+            except TransportRefused as e:
+                self._release(rid)
+                self._c_dispatch_fail.labels(rid, "refused").inc()
+                excluded.add(rid)
+                last_error = f"refused: {e}"[:160]
+                continue
+            except TransportError as e:
+                self._release(rid)
+                self._c_dispatch_fail.labels(rid, "error").inc()
+                self._breaker_failure(rid)
+                excluded.add(rid)
+                failures += 1
+                last_error = str(e)[:160]
+                if failures > self.config.max_retries:
+                    return self._finish_error(
+                        entry, ticket, last_error, failures,
+                        failovers, hedged, t_start)
+                self._c_retries.inc()
+                with self._lock:
+                    self._stats["retries"] += 1
+                self._backoff(entry.rid, failures)
+                self.refresh(force=True)
+                continue
+            # optional tail-latency hedge: one extra dispatch to a
+            # different replica once the primary overstays the
+            # p99-derived delay; first result wins
+            if self.config.hedge and not hedged:
+                self._maybe_hedge(entry, remaining, excluded, calls)
+                hedged = len(calls) > 1
+            outcome = self._await_first(entry, calls, remaining)
+            for _rid_l, call_l, _buf_l in calls:
+                self._release(_rid_l)
+            if outcome is None:           # every call failed
+                for rid_f, _call_f, buf_f in calls:
+                    excluded.add(rid_f)
+                    if buf_f:   # partial greedy prefix is committed —
+                        # the failover continues, never regenerates
+                        self.journal.commit(entry, base, buf_f)
+                failures += 1
+                last_error = "dispatch_failed"
+                if failures > self.config.max_retries:
+                    return self._finish_error(
+                        entry, ticket, last_error, failures,
+                        failovers, hedged, t_start)
+                self._c_retries.inc()
+                with self._lock:
+                    self._stats["retries"] += 1
+                self._backoff(entry.rid, failures)
+                self.refresh(force=True)
+                continue
+            rid_won, res, buf = outcome
+            if hedged:
+                hedge_winner = "hedge" if rid_won != rid else "primary"
+                self._c_hedge_wins.labels(hedge_winner).inc()
+                with self._lock:
+                    self._stats["hedge_wins"] += 1
+                for rid_l, call_l, _buf_l in calls:
+                    if call_l.done and rid_l == rid_won:
+                        continue
+                    disposition = "cancelled" if call_l.cancel() \
+                        else "abandoned"
+                    self._c_hedge_losers.labels(disposition).inc()
+            if res.get("shed_reason"):
+                # the REPLICA shed it (zero tokens, clean verdict):
+                # not a transport failure — fail over without
+                # charging the breaker
+                excluded.add(rid_won)
+                last_error = f"replica_shed: {res['shed_reason']}"
+                if len(excluded) >= len(self.transports):
+                    return self._finish_error(
+                        entry, ticket, last_error, failures,
+                        failovers, hedged, t_start)
+                continue
+            t_bk = time.perf_counter()
+            tokens = res.get("tokens") or []
+            commit = tokens if len(tokens) >= len(buf) else buf
+            self.journal.commit(entry, base, commit)
+            self._breaker_success(rid_won)
+            if fps:
+                self._note_sticky(fps, rid_won)
+            self._account_overhead(t_bk)
+            return self._finish_ok(entry, ticket, rid_won, failures,
+                                   failovers, hedged, hedge_winner,
+                                   t_start)
+
+    def _begin(self, rid, entry, remaining_ms):
+        """One dispatch: prefill_ids continuation + remaining token
+        budget + remaining deadline, tokens streamed into a
+        per-dispatch buffer (committed only when this dispatch is
+        the one the router keeps)."""
+        if self.chaos is not None:
+            try:
+                self.chaos.maybe_raise("router_dispatch",
+                                       replica=rid, rid=entry.rid)
+            except InjectedFault as e:
+                raise TransportError(str(e)) from e
+        buf = []
+        call = self.transports[rid].begin(
+            entry.prefill_ids, max(1, entry.remaining_tokens),
+            eos_id=entry.eos_id, deadline_ms=remaining_ms,
+            on_token=buf.append)
+        return (rid, call, buf)
+
+    def _maybe_hedge(self, entry, remaining_ms, excluded, calls):
+        delay = self.hedge_delay_s()
+        deadline = time.monotonic() + delay
+        rid0, call0, _ = calls[0]
+        while time.monotonic() < deadline:
+            if call0.done:
+                return
+            time.sleep(0.001)
+        now = self._clock()
+        rid_h = self._select([], excluded | {rid0}, now)
+        if rid_h is None or rid_h == rid0:
+            if rid_h is not None:
+                self._release(rid_h)
+            return
+        try:
+            calls.append(self._begin(rid_h, entry, remaining_ms))
+            self._c_hedges.inc()
+            self._c_dispatch.labels(rid_h).inc()
+            with self._lock:
+                self._stats["hedges"] += 1
+        except (TransportError, TransportRefused):
+            self._release(rid_h)
+
+    def _await_first(self, entry, calls, remaining_ms):
+        """First completed call wins. Returns (rid, result, buffer)
+        or None when every call failed (TransportError / refusal /
+        timeout)."""
+        timeout_at = None
+        if remaining_ms is not None:
+            timeout_at = time.monotonic() + remaining_ms / 1000.0 + 5.0
+        live = list(calls)
+        while live:
+            for item in list(live):
+                rid, call, buf = item
+                if not call.done:
+                    continue
+                try:
+                    return (rid, call.result(timeout=5.0), buf)
+                except (TransportError, TransportRefused) as e:
+                    kind = "refused" \
+                        if isinstance(e, TransportRefused) else "error"
+                    self._c_dispatch_fail.labels(rid, kind).inc()
+                    if kind == "error":
+                        self._breaker_failure(rid)
+                    live.remove(item)
+            if not live:
+                return None
+            if timeout_at is not None \
+                    and time.monotonic() > timeout_at:
+                for rid, call, _buf in live:
+                    self._c_dispatch_fail.labels(rid, "error").inc()
+                    self._breaker_failure(rid)
+                    call.cancel()
+                return None
+            time.sleep(0.001)
+        return None
+
+    def _backoff(self, who, attempt):
+        base = min(self.config.backoff_max_s,
+                   self.config.backoff_base_s * (2 ** (attempt - 1)))
+        stretch = 1.0 + self.config.backoff_jitter \
+            * backoff_jitter_unit(self.config.seed, who, attempt)
+        time.sleep(min(self.config.backoff_max_s, base * stretch))
+
+    # ------------------------------------------------------- results
+    def _finish_ok(self, entry, ticket, rid, failures, failovers,
+                   hedged, hedge_winner, t_start):
+        self.journal.complete(entry.rid)
+        self._g_journal.set(self.journal.depth)
+        latency = time.perf_counter() - t_start
+        self._h_latency.observe(latency)
+        self._latencies.add(latency)
+        self._c_requests.labels("ok").inc()
+        with self._lock:
+            self._stats["ok"] += 1
+        remaining = self._remaining_ms(entry)
+        ticket._finish({
+            "rid": entry.rid, "ok": True, "shed": False,
+            "reason": "deadline" if remaining is not None
+            and remaining <= 0 else "ok",
+            "tokens": list(entry.tokens), "replica_id": rid,
+            "attempts": entry.attempts, "failures": failures,
+            "failovers": failovers, "hedged": hedged,
+            "hedge_winner": hedge_winner,
+            "latency_s": round(latency, 6)})
+
+    def _finish_error(self, entry, ticket, reason, failures,
+                      failovers, hedged, t_start):
+        self.journal.complete(entry.rid)
+        self._g_journal.set(self.journal.depth)
+        latency = time.perf_counter() - t_start
+        self._h_latency.observe(latency)
+        self._c_requests.labels("error").inc()
+        with self._lock:
+            self._stats["error"] += 1
+        ticket._finish({
+            "rid": entry.rid, "ok": False, "shed": False,
+            "reason": reason, "tokens": list(entry.tokens),
+            "replica_id": entry.replica,
+            "attempts": entry.attempts, "failures": failures,
+            "failovers": failovers, "hedged": hedged,
+            "hedge_winner": None,
+            "latency_s": round(latency, 6)})
+
+    # -------------------------------------------------------- hedging
+    def hedge_delay_s(self):
+        """The hedge trigger: p99 of observed routed latency scaled
+        by ``hedge_factor``, floored at ``hedge_min_s`` (cold start:
+        the floor)."""
+        p99 = self._latencies.percentile(99)
+        if p99 is None:
+            return self.config.hedge_min_s
+        return max(self.config.hedge_min_s,
+                   p99 * self.config.hedge_factor)
+
+    # ----------------------------------------------------- telemetry
+    def state(self):
+        """The ``/router/state`` body (ROUTER_STATE_KEYS pinned)."""
+        now = self._clock()
+        with self._lock:
+            replicas = []
+            for rid in sorted(self.transports):
+                posture = dict(self._posture.get(rid) or {})
+                posture.pop("heat", None)
+                replicas.append({
+                    "replica_id": rid,
+                    "posture": posture,
+                    "admissible": self._admissible(
+                        self._posture.get(rid) or {}),
+                    "breaker": self.breakers[rid].describe(now),
+                    "inflight": self._inflight[rid],
+                })
+            counters = dict(self._stats)
+        return {
+            "config": self.config.describe(),
+            "counters": counters,
+            "hedge": {"enabled": self.config.hedge,
+                      "delay_s": round(self.hedge_delay_s(), 6)},
+            "journal": self.journal.snapshot(),
+            "journal_depth": self.journal.depth,
+            "replicas": replicas,
+        }
+
+    def serve(self, port=0, addr="127.0.0.1"):
+        """Expose the router's own registry + ``/router/state``."""
+        handle = start_metrics_server(
+            self.registry, port=port, addr=addr,
+            extra_routes={"/router/state": self.state})
+        self._servers.append(handle)
+        return handle
+
+    # ----------------------------------------------------- lifecycle
+    def close(self, timeout=10.0):
+        """Refuse new work, wait for in-flight dispatches, stop the
+        state servers. Transports/replicas are NOT closed (the router
+        does not own them)."""
+        self._closed = True
+        with self._lock:
+            threads = list(self._threads)
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        servers, self._servers = self._servers, []
+        for h in servers:
+            h.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,396 @@
+"""Replica transports: how the router reaches an engine.
+
+Two flavors behind one surface (``begin`` / ``health`` / ``state`` /
+``replica_id``):
+
+  * :class:`InProcessTransport` — wraps an :class:`EngineGateway`
+    (an engine plus the driver thread that steps it), zero sockets.
+    The fast path for tests and the in-process bench fleet; token
+    streams flow through ``on_token`` into the router journal, and
+    ``cancel`` really cancels (hedged losers release their slot).
+  * :class:`HTTPTransport` — POSTs ``/v1/generate`` on a replica's
+    metrics server (the gateway mounts it via
+    ``serve_metrics(post_routes=)``). The over-the-wire path the
+    kill-a-replica drill SIGKILLs mid-request.
+
+Failure taxonomy — the distinction the circuit breaker feeds on:
+
+  * :class:`TransportError` — the replica is unreachable or died
+    mid-request (connection refused/reset, gateway killed, timeout).
+    Trips the breaker, triggers failover.
+  * :class:`TransportRefused` — the replica answered and said no
+    (draining/closed → HTTP 503). A clean verdict, NOT a failure:
+    the router fails over without charging the breaker.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["TransportError", "TransportRefused", "EngineGateway",
+           "InProcessTransport", "HTTPTransport"]
+
+
+class TransportError(RuntimeError):
+    """Replica unreachable / died mid-dispatch: breaker-charging."""
+
+
+class TransportRefused(RuntimeError):
+    """Replica explicitly refused (draining/closed): clean verdict."""
+
+
+# --------------------------------------------------------------- gateway
+class EngineGateway:
+    """Owns ONE engine's step loop and submission surface.
+
+    The engine itself is single-threaded by design; the gateway adds
+    the one lock + driver thread that lets HTTP handler threads (and
+    the in-process router) submit concurrently while steps run.
+    ``serve()`` mounts ``POST /v1/generate`` next to the engine's
+    existing GET debug surface. ``kill()`` simulates SIGKILL for
+    in-process chaos: the driver stops mid-work, every outstanding
+    wait raises :class:`TransportError`, nothing is drained.
+    """
+
+    def __init__(self, engine, idle_sleep_s=0.002,
+                 generate_timeout_s=120.0):
+        self.engine = engine
+        self._idle_sleep_s = float(idle_sleep_s)
+        self.generate_timeout_s = float(generate_timeout_s)
+        self._lock = threading.RLock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._dead = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._drive, daemon=True,
+            name=f"gateway-{engine.replica_id}")
+        self._thread.start()
+
+    @property
+    def replica_id(self):
+        return self.engine.replica_id
+
+    @property
+    def dead(self):
+        return self._dead
+
+    def _drive(self):
+        while not self._stop.is_set():
+            worked = False
+            with self._lock:
+                if not self.engine._closed and self.engine.pending:
+                    worked = bool(self.engine.step())
+            if not worked:
+                self._wake.wait(self._idle_sleep_s)
+                self._wake.clear()
+
+    # --------------------------------------------------- submission
+    def submit(self, prompt, max_new_tokens, eos_id=None,
+               deadline_ms=None, on_token=None):
+        """Enqueue on the engine; returns the Request handle. Raises
+        TransportRefused when the engine is draining/closed (a clean
+        verdict), TransportError when the gateway was killed."""
+        if self._dead:
+            raise TransportError(
+                f"replica {self.replica_id} is dead")
+        with self._lock:
+            try:
+                req = self.engine.add_request(
+                    prompt, max_new_tokens, eos_id=eos_id,
+                    deadline_ms=deadline_ms, on_token=on_token)
+            except RuntimeError as e:   # draining/closed
+                raise TransportRefused(str(e)) from e
+        self._wake.set()
+        return req
+
+    def wait(self, req, timeout=None):
+        """Block until ``req`` is done. TransportError if the gateway
+        dies while waiting; returns False on timeout (request still
+        running), True when done."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while not req.done:
+            if self._dead:
+                raise TransportError(
+                    f"replica {self.replica_id} died mid-request "
+                    f"(rid {req.rid})")
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.001)
+        return True
+
+    def cancel(self, req):
+        """Cancel an in-flight request: clamp its token budget so the
+        very next harvest retires it (slot/blocks released through the
+        normal stop path — no special-case teardown to leak). The
+        hedging loser path."""
+        with self._lock:
+            if not req.done:
+                req.max_new_tokens = max(1, len(req.generated))
+        self._wake.set()
+        return True
+
+    # ---------------------------------------------------- lifecycle
+    def drain(self, wait=True, timeout=30.0):
+        """Flip the engine's drain flag (new submissions refused with
+        503/TransportRefused) while the driver thread finishes the
+        already-admitted work."""
+        with self._lock:
+            self.engine.start_draining()
+        self._wake.set()
+        if wait:
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self.engine.pending:
+                        return True
+                time.sleep(0.005)
+            return False
+        return True
+
+    def kill(self):
+        """In-process SIGKILL: stop the driver abruptly, fail every
+        outstanding wait. The engine is then closed only for resource
+        hygiene (a real SIGKILL frees memory the hard way too)."""
+        self._dead = True
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10.0)
+        try:
+            self.engine.close()
+        except Exception:   # noqa: BLE001 - hygiene only, dead anyway
+            pass
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=10.0)
+        if not self._dead:
+            self.engine.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -------------------------------------------------- wire surface
+    def serve(self, port=0, addr="127.0.0.1"):
+        """Expose the engine's full debug surface plus
+        ``POST /v1/generate`` — the replica is now reachable over the
+        wire by an :class:`HTTPTransport`."""
+        return self.engine.serve_metrics(
+            port=port, addr=addr,
+            post_routes={"/v1/generate": self.handle_generate})
+
+    def handle_generate(self, body):
+        """The ``POST /v1/generate`` handler: validate, submit, block
+        until done, answer the full token stream. Returns ``(status,
+        payload)`` tuples on refusal/invalid input — the metrics
+        server renders them as clean JSON errors."""
+        prompt = body.get("prompt")
+        max_new = body.get("max_new_tokens")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return (400, {"error": "prompt must be a non-empty list "
+                                   "of token ids"})
+        if not isinstance(max_new, int) or max_new < 1:
+            return (400, {"error": "max_new_tokens must be an "
+                                   "int >= 1"})
+        deadline_ms = body.get("deadline_ms")
+        try:
+            req = self.submit(prompt, max_new,
+                              eos_id=body.get("eos_id"),
+                              deadline_ms=deadline_ms)
+        except TransportRefused as e:
+            return (503, {"error": "refused", "detail": str(e)[:200],
+                          "draining": True})
+        except (TypeError, ValueError) as e:
+            return (400, {"error": f"{type(e).__name__}: {e}"[:200]})
+        timeout = self.generate_timeout_s
+        if deadline_ms is not None:
+            timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        if not self.wait(req, timeout=timeout):
+            return (504, {"error": "generate timed out",
+                          "rid": req.rid})
+        return {
+            "rid": req.rid,
+            "replica_id": self.replica_id,
+            "tokens": [int(t) for t in req.generated],
+            "shed_reason": req.shed_reason,
+        }
+
+
+# --------------------------------------------------------- in-process
+class _InProcessCall:
+    def __init__(self, gateway, req):
+        self._gw = gateway
+        self._req = req
+        self.abandoned = False
+
+    @property
+    def done(self):
+        return self._req.done or self._gw.dead
+
+    def result(self, timeout=None):
+        if not self._gw.wait(self._req, timeout=timeout):
+            raise TransportError(
+                f"in-process generate timed out "
+                f"(rid {self._req.rid})")
+        return {
+            "rid": self._req.rid,
+            "replica_id": self._gw.replica_id,
+            "tokens": [int(t) for t in self._req.generated],
+            "shed_reason": self._req.shed_reason,
+        }
+
+    def cancel(self):
+        self.abandoned = True
+        if self._gw.dead:
+            return False
+        return self._gw.cancel(self._req)
+
+
+class InProcessTransport:
+    """Router-side view of a same-process replica (engine+gateway).
+    Token streams reach the router live via ``on_token`` — exactly
+    what the journal needs for mid-stream failover."""
+
+    def __init__(self, gateway, replica_id=None):
+        self.gateway = gateway
+        self.replica_id = replica_id or gateway.replica_id
+
+    def begin(self, prompt, max_new_tokens, eos_id=None,
+              deadline_ms=None, on_token=None):
+        cb = None
+        if on_token is not None:
+            cb = lambda _req, tok: on_token(int(tok))  # noqa: E731
+        req = self.gateway.submit(prompt, max_new_tokens,
+                                  eos_id=eos_id,
+                                  deadline_ms=deadline_ms,
+                                  on_token=cb)
+        return _InProcessCall(self.gateway, req)
+
+    def health(self):
+        eng = self.gateway.engine
+        if self.gateway.dead:
+            raise TransportError(
+                f"replica {self.replica_id} is dead")
+        if eng.health is not None:
+            return eng.health.report()
+        return {"healthy": True, "draining": eng._draining,
+                "degraded": False}
+
+    def state(self):
+        if self.gateway.dead:
+            raise TransportError(
+                f"replica {self.replica_id} is dead")
+        return self.gateway.engine.debug_state()
+
+    def close(self):
+        self.gateway.close()
+
+
+# --------------------------------------------------------------- HTTP
+class _HTTPCall:
+    def __init__(self, url, payload, timeout_s):
+        self._outcome = None    # ("ok", dict) | ("err", exc)
+        self.abandoned = False
+
+        def run():
+            data = json.dumps(payload).encode("utf-8")
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=timeout_s) as resp:
+                    body = json.loads(resp.read().decode("utf-8"))
+                self._outcome = ("ok", body)
+            except Exception as e:   # noqa: BLE001 - classified below
+                self._outcome = ("err", e)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="router-http-call")
+        self._thread.start()
+
+    @property
+    def done(self):
+        return self._outcome is not None
+
+    def result(self, timeout=None):
+        self._thread.join(timeout)
+        if self._outcome is None:
+            raise TransportError("HTTP generate timed out")
+        kind, val = self._outcome
+        if kind == "ok":
+            return val
+        if isinstance(val, urllib.error.HTTPError):
+            if val.code == 503:
+                raise TransportRefused(
+                    f"replica refused (503)") from val
+            raise TransportError(
+                f"HTTP {val.code} from replica") from val
+        raise TransportError(
+            f"{type(val).__name__}: {val}"[:200]) from val
+
+    def cancel(self):
+        # no server-side cancel on the wire protocol: the loser runs
+        # to completion on the replica, the router just abandons the
+        # result (counted distinctly from a true cancel)
+        self.abandoned = True
+        return False
+
+
+class HTTPTransport:
+    """Router-side view of a replica across the wire. ``on_token`` is
+    accepted but unused (the wire protocol is request/response, not
+    streaming) — mid-stream failover degrades to full re-dispatch,
+    which greedy determinism still makes bit-exact."""
+
+    def __init__(self, url, replica_id=None, timeout_s=60.0,
+                 probe_timeout_s=2.0):
+        self.url = url.rstrip("/")
+        if "://" not in self.url:
+            self.url = "http://" + self.url
+        self.replica_id = replica_id or self.url
+        self.timeout_s = float(timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+
+    def begin(self, prompt, max_new_tokens, eos_id=None,
+              deadline_ms=None, on_token=None):
+        payload = {"prompt": [int(t) for t in prompt],
+                   "max_new_tokens": int(max_new_tokens)}
+        if eos_id is not None:
+            payload["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        timeout = self.timeout_s
+        if deadline_ms is not None:
+            timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        return _HTTPCall(self.url + "/v1/generate", payload, timeout)
+
+    def _get(self, path):
+        try:
+            with urllib.request.urlopen(
+                    self.url + path,
+                    timeout=self.probe_timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except Exception as e:   # noqa: BLE001 - posture probe
+            raise TransportError(
+                f"{type(e).__name__}: {e}"[:200]) from e
+
+    def health(self):
+        return self._get("/debug/health")
+
+    def state(self):
+        return self._get("/debug/state")
+
+    def close(self):
+        pass

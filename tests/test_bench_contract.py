@@ -315,6 +315,38 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         # the headline snapshot carries the replica identity section
         assert snap["replica"]["replica_id"]
         assert snap["replica"]["uptime_s"] > 0
+        # PR 14 fleet router: goodput over 1/2/3 in-process replicas,
+        # the kill-a-replica drill (routed journal-replay failover =
+        # 100% completion with greedy parity; the max_retries=0
+        # baseline records what the dead replica's in-flight work
+        # cost), and the self-timed dispatch overhead under the same
+        # <5%-with-runner-slack bar as every observatory probe
+        rt = evidence["router"]
+        assert set(rt) >= {"replicas", "requests",
+                           "goodput_tokens_per_sec", "goodput_x",
+                           "failover", "no_failover_baseline",
+                           "overhead"}
+        assert rt["replicas"] == 3
+        assert set(rt["goodput_tokens_per_sec"]) == {"1", "2", "3"}
+        assert all(v > 0 for v in
+                   rt["goodput_tokens_per_sec"].values())
+        # in-process replicas share one CPU: the bar is sanity (the
+        # router must not DESTROY throughput), not linear scaling
+        assert rt["goodput_x"] > 0.5, rt
+        fo = rt["failover"]
+        assert fo["completion"] == 1.0, fo   # nothing lost, ever
+        assert fo["lost"] == []
+        assert fo["parity_ok"] is True       # bit-exact continuation
+        assert fo["failovers"] >= 1          # the kill actually moved
+        assert fo["killed"]
+        base = rt["no_failover_baseline"]
+        assert 0.0 <= base["completion"] <= 1.0
+        assert base["completion"] <= fo["completion"]
+        rohd = rt["overhead"]
+        assert rohd["seconds_total"] >= 0 and rohd["ops"] > 0
+        assert rohd["overhead_frac"] is not None
+        assert rohd["overhead_frac"] < 0.05, rohd
+        assert last["router_failover_completion"] == fo["completion"]
         # heartbeat wedge attribution: beats name the last ledger step
         # and the phase-relative step rate
         beats = [ln for ln in res.stderr.splitlines()

@@ -370,7 +370,8 @@ def test_poller_eviction_backoff_staleness_readmission():
     reps = [_FakeReplica("ra")]
     clock = {"t": 0.0}
     poller = _fake_poller(reps, clock, down_after=2,
-                          backoff_base_s=1.0, stale_after_s=1.0)
+                          backoff_base_s=1.0, stale_after_s=1.0,
+                          backoff_jitter=0.0)
     poller.poll_once()
     st = poller.replicas[0]
     assert st.verdict == "up" and st.consecutive_failures == 0

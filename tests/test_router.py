@@ -1,0 +1,805 @@
+"""Fleet router tests (PR 14): circuit breakers (firing AND
+non-firing), deterministic seeded backoff jitter, the POST wire
+contract, shed verdicts, drain/down-aware admission, retry/failover
+with journal-replay parity, affinity, hedging (default OFF, losers
+cancelled and counted), chaos determinism at the router seam, and the
+kill-a-replica drill's --fast self-run."""
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.observability import MetricsRegistry
+from paddle_tpu.observability.fleet.poller import (FleetPoller,
+                                                   backoff_jitter_unit)
+from paddle_tpu.observability.registry import start_metrics_server
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.resilience.chaos import FaultPlan, FaultSpec
+from paddle_tpu.serving.router import (CLOSED, HALF_OPEN, OPEN,
+                                       ROUTER_STATE_KEYS,
+                                       CircuitBreaker, EngineGateway,
+                                       InProcessTransport, Router,
+                                       RouterConfig, TransportError,
+                                       TransportRefused,
+                                       prompt_fingerprints)
+from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DRILL = os.path.join(_ROOT, "tools", "router_drill.py")
+
+
+# ------------------------------------------------------ circuit breaker
+
+def test_breaker_stays_closed_below_threshold():
+    """The NON-firing side: a failure streak shorter than the
+    threshold (or broken by a success) never opens the breaker."""
+    br = CircuitBreaker(threshold=3, reset_s=1.0)
+    br.record_failure(0.0)
+    br.record_failure(0.1)
+    assert br.state == CLOSED and br.allow(0.2)
+    br.record_success()                    # streak broken
+    assert br.consecutive_failures == 0
+    br.record_failure(0.3)
+    br.record_failure(0.4)
+    assert br.state == CLOSED and br.allow(0.5)
+    # clean refusals are routed around WITHOUT record_failure — the
+    # breaker only ever sees transport errors (router-level test below)
+
+
+def test_breaker_trips_probes_and_recovers():
+    br = CircuitBreaker(threshold=3, reset_s=1.0)
+    for t in (0.0, 0.1, 0.2):
+        br.record_failure(t)
+    assert br.state == OPEN
+    assert not br.allow(0.5)               # reset_s not elapsed
+    assert br.allow(1.3)                   # probe available
+    # allow() is non-mutating: asking for many candidates does not
+    # consume the probe slot
+    for _ in range(5):
+        assert br.allow(1.3)
+    assert br.state == OPEN
+    br.claim(1.3)
+    assert br.state == HALF_OPEN
+    assert not br.allow(1.31)              # one probe in flight, max
+    br.record_success()
+    assert br.state == CLOSED and br.allow(1.4)
+
+
+def test_breaker_half_open_failure_reopens():
+    br = CircuitBreaker(threshold=2, reset_s=1.0)
+    br.record_failure(0.0)
+    br.record_failure(0.1)
+    assert br.state == OPEN
+    br.claim(1.2)
+    assert br.state == HALF_OPEN
+    br.record_failure(1.25)                # probe failed
+    assert br.state == OPEN
+    assert not br.allow(1.3)               # fresh reset_s wait
+    assert br.allow(2.3)
+
+
+def test_breaker_poller_verdicts():
+    br = CircuitBreaker(threshold=3, reset_s=10.0)
+    br.note_verdict("down", 5.0)           # force-open, no streak
+    assert br.state == OPEN and not br.allow(6.0)
+    br.note_verdict("up", 7.0)             # backdated: probe NOW
+    assert br.allow(7.0)
+    br.claim(7.0)
+    br.record_success()
+    assert br.state == CLOSED
+    br.note_verdict("stale", 8.0)          # stale changes nothing
+    assert br.state == CLOSED
+    with pytest.raises(ValueError):
+        CircuitBreaker(threshold=0)
+
+
+# -------------------------------------------- deterministic backoff jitter
+
+def test_backoff_jitter_unit_deterministic_and_isolated():
+    a = backoff_jitter_unit(7, "replica-a", 3)
+    assert a == backoff_jitter_unit(7, "replica-a", 3)
+    assert 0.0 <= a < 1.0
+    assert a != backoff_jitter_unit(8, "replica-a", 3)
+    assert a != backoff_jitter_unit(7, "replica-b", 3)
+    assert a != backoff_jitter_unit(7, "replica-a", 4)
+    # the global random stream is NEVER touched (PR-9 discipline)
+    random.seed(123)
+    expect = random.random()
+    random.seed(123)
+    backoff_jitter_unit(7, "replica-a", 3)
+    assert random.random() == expect
+
+
+def _failing_poller(clock, **kw):
+    def fetch(url, timeout):
+        raise ConnectionError("connection refused")
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("timeout_s", 0.5)
+    kw.setdefault("backoff_base_s", 2.0)
+    kw.setdefault("backoff_max_s", 60.0)
+    return FleetPoller([{"id": "ra", "url": "http://x:1"}],
+                       fetch=fetch, clock=lambda: clock["t"], **kw)
+
+
+def test_poller_backoff_jitter_seeded_pin():
+    """Same seed => identical jittered backoff schedule; different
+    seed => different; jitter=0 => the exact unjittered formula."""
+    def schedule(jitter, seed):
+        clock = {"t": 0.0}
+        p = _failing_poller(clock, backoff_jitter=jitter,
+                            jitter_seed=seed)
+        outs = []
+        for _ in range(3):
+            st = p.replicas[0]
+            clock["t"] = st.backoff_until      # re-probe exactly on due
+            p.poll_once()
+            outs.append(st.backoff_until - clock["t"])
+        return outs
+
+    assert schedule(0.25, seed=5) == schedule(0.25, seed=5)
+    assert schedule(0.25, seed=5) != schedule(0.25, seed=6)
+    assert schedule(0.0, seed=5) == [2.0, 4.0, 8.0]  # 2.0 * 2^(n-1)
+    # jittered backoff only ever STRETCHES (up to 1+jitter), never
+    # shortens below the exponential base
+    for base, got in zip([2.0, 4.0, 8.0], schedule(0.25, seed=5)):
+        assert base <= got <= base * 1.25
+    with pytest.raises(ValueError):
+        _failing_poller({"t": 0.0}, backoff_jitter=1.5)
+
+
+# ------------------------------------------------------ POST wire contract
+
+def _post_raw(port, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    try:
+        with urllib.request.urlopen(req, timeout=5.0) as resp:
+            raw = resp.read().decode()
+            status = resp.status
+    except urllib.error.HTTPError as e:
+        raw, status = e.read().decode(), e.code
+    try:
+        return status, json.loads(raw)
+    except json.JSONDecodeError:
+        return status, {"_raw": raw}
+
+
+def test_metrics_server_post_contract():
+    reg = MetricsRegistry()
+    seen = []
+
+    def echo(body):
+        seen.append(body)
+        if body.get("boom"):
+            raise RuntimeError("handler exploded")
+        if body.get("teapot"):
+            return (418, {"short": "stout"})
+        return {"ok": True, "n": body.get("n")}
+
+    handle = start_metrics_server(reg, post_routes={"/echo": echo},
+                                  max_body_bytes=4096)
+    try:
+        port = handle.port
+        # happy path: JSON in, JSON out, 200
+        st, out = _post_raw(port, "/echo", b'{"n": 3}')
+        assert (st, out) == (200, {"ok": True, "n": 3})
+        # (status, payload) tuples pass the status through
+        st, out = _post_raw(port, "/echo", b'{"teapot": 1}')
+        assert st == 418 and out == {"short": "stout"}
+        # malformed JSON -> 400 with a clean JSON envelope, NEVER a
+        # traceback page
+        st, out = _post_raw(port, "/echo", b'{"n": oops')
+        assert st == 400 and out["error"] == "malformed JSON body"
+        # a JSON body that isn't an object is malformed too
+        st, out = _post_raw(port, "/echo", b'[1, 2]')
+        assert st == 400 and out["error"] == "malformed JSON body"
+        # oversized body -> 413, bounded by max_body_bytes
+        st, out = _post_raw(port, "/echo", b'{"pad": "' +
+                            b"x" * 8192 + b'"}')
+        assert st == 413 and "body too large" in out["error"]
+        # handler exception -> 500 JSON error, server stays up
+        st, out = _post_raw(port, "/echo", b'{"boom": 1}')
+        assert st == 500 and "RuntimeError" in out["error"]
+        # unknown POST path -> 404
+        st, _ = _post_raw(port, "/nope", b"{}")
+        assert st == 404
+        # missing Content-Length -> 411 (chunked/absent both refused)
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=5.0)
+        conn.putrequest("POST", "/echo", skip_accept_encoding=True)
+        conn.putheader("Content-Type", "application/json")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 411
+        resp.read()
+        conn.close()
+        # the GET surface is unharmed
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json",
+                timeout=5.0) as resp:
+            assert resp.status == 200
+        assert seen and seen[0] == {"n": 3}
+    finally:
+        handle.close()
+
+
+# ---------------------------------------------------- fake transports
+
+def _greedy(prefill, n):
+    """A stand-in for greedy decoding: each token is a pure function
+    of the sequence so far, so continuation from prompt+k committed
+    tokens agrees with the unfaulted stream — the property the real
+    engines provide via shared seeded weights."""
+    seq = [int(t) for t in prefill]
+    out = []
+    for _ in range(n):
+        t = (sum(seq) * 31 + 7) % 97
+        seq.append(t)
+        out.append(t)
+    return out
+
+
+class _FakeCall:
+    def __init__(self, payload, error=None, delay_s=0.0):
+        self._payload = payload
+        self._error = error
+        self._done_at = time.monotonic() + delay_s
+        self.cancelled = False
+
+    @property
+    def done(self):
+        return self.cancelled or time.monotonic() >= self._done_at
+
+    def result(self, timeout=None):
+        if self._error is not None:
+            raise self._error
+        return self._payload
+
+    def cancel(self):
+        self.cancelled = True
+        return True
+
+
+class _FakeTransport:
+    """Scripted replica: ``script`` is a list of per-begin behaviors
+    ("ok", "error", "refuse", ("mid_error", k) = stream k tokens then
+    die, ("shed", reason)); the last entry repeats forever."""
+
+    def __init__(self, rid, script=("ok",), draining=False,
+                 healthy=True, degraded=False, queue_depth=0,
+                 heat=(), delay_s=0.0, dead=False):
+        self.replica_id = rid
+        self.script = list(script)
+        self.draining = draining
+        self.healthy = healthy
+        self.degraded = degraded
+        self.queue_depth = queue_depth
+        self.heat = list(heat)
+        self.delay_s = delay_s
+        self.dead = dead
+        self.begins = []
+        self.calls = []
+
+    def _behavior(self):
+        i = min(len(self.begins), len(self.script) - 1)
+        return self.script[i]
+
+    def begin(self, prompt, max_new_tokens, eos_id=None,
+              deadline_ms=None, on_token=None):
+        behavior = self._behavior()
+        self.begins.append({"prompt": list(prompt),
+                            "max_new_tokens": max_new_tokens,
+                            "deadline_ms": deadline_ms})
+        if behavior == "error":
+            raise TransportError(f"{self.replica_id} unreachable")
+        if behavior == "refuse":
+            raise TransportRefused(f"{self.replica_id} draining")
+        shed = None
+        tokens = _greedy(prompt, max_new_tokens)
+        error = None
+        if isinstance(behavior, tuple) and behavior[0] == "mid_error":
+            tokens = tokens[:behavior[1]]
+            error = TransportError(
+                f"{self.replica_id} died mid-request")
+        elif isinstance(behavior, tuple) and behavior[0] == "shed":
+            tokens, shed = [], behavior[1]
+        if on_token is not None:
+            for t in tokens:
+                on_token(t)
+        call = _FakeCall({"rid": "fr", "replica_id": self.replica_id,
+                          "tokens": tokens, "shed_reason": shed},
+                         error=error, delay_s=self.delay_s)
+        self.calls.append(call)
+        return call
+
+    def health(self):
+        if self.dead:
+            raise TransportError(f"{self.replica_id} is dead")
+        return {"healthy": self.healthy, "draining": self.draining,
+                "degraded": self.degraded}
+
+    def state(self):
+        if self.dead:
+            raise TransportError(f"{self.replica_id} is dead")
+        return {"queue_depth": self.queue_depth,
+                "cache": {"heat": {"top": self.heat}}}
+
+
+def _cfg(**kw):
+    kw.setdefault("max_retries", 2)
+    kw.setdefault("backoff_base_s", 0.001)
+    kw.setdefault("backoff_max_s", 0.01)
+    kw.setdefault("refresh_s", 0.02)
+    kw.setdefault("hedge", False)
+    return RouterConfig(**kw)
+
+
+# ------------------------------------------------- routing + admission
+
+def test_router_routes_least_loaded_and_pins_state_schema():
+    a = _FakeTransport("a", queue_depth=5)
+    b = _FakeTransport("b", queue_depth=0)
+    router = Router([a, b], config=_cfg())
+    res = router.generate([1, 2, 3], 4, timeout=10.0)
+    assert res["ok"] and res["replica_id"] == "b"
+    assert res["tokens"] == _greedy([1, 2, 3], 4)
+    state = router.state()
+    assert tuple(sorted(state)) == tuple(sorted(ROUTER_STATE_KEYS))
+    json.dumps(state)                       # wire-serializable
+    assert state["counters"]["ok"] == 1
+    assert state["journal_depth"] == 0      # completed -> popped
+    by_id = {r["replica_id"]: r for r in state["replicas"]}
+    assert by_id["a"]["breaker"]["state"] == CLOSED
+    assert by_id["b"]["admissible"] is True
+    assert state["hedge"]["enabled"] is False
+    router.close()
+    # duplicate replica ids are a construction error
+    with pytest.raises(ValueError):
+        Router([_FakeTransport("x"), _FakeTransport("x")])
+
+
+def test_router_shed_verdicts_are_explicit():
+    a = _FakeTransport("a", delay_s=0.5)
+    router = Router([a], config=_cfg(max_queue=1))
+    t1 = router.submit([1, 2], 3)
+    t2 = router.submit([3, 4], 3)           # journal full -> shed NOW
+    r2 = t2.result(timeout=1.0)
+    assert r2["shed"] and r2["reason"] == "queue_full"
+    assert not r2["ok"] and r2["tokens"] == []
+    assert t1.result(timeout=10.0)["ok"]
+    router.close()
+    # every replica inadmissible -> no_admissible_replica
+    router = Router([_FakeTransport("a", draining=True)],
+                    config=_cfg())
+    r = router.generate([1], 2, timeout=1.0)
+    assert r["shed"] and r["reason"] == "no_admissible_replica"
+    state = router.state()
+    assert state["replicas"][0]["admissible"] is False
+    router.close()
+    r = router.generate([1], 2, timeout=1.0)   # closed router sheds
+    assert r["shed"] and r["reason"] == "router_closed"
+
+
+def test_router_never_places_on_down_or_draining_replica():
+    """Verdict honoring: a draining/down replica stops receiving NEW
+    requests within one poll interval (refresh_s) of the posture
+    change."""
+    a = _FakeTransport("a")
+    b = _FakeTransport("b")
+    router = Router([a, b], config=_cfg(refresh_s=0.01, affinity=False))
+    for _ in range(4):
+        # load ties break deterministically: everything lands on "a"
+        assert router.generate([5, 6], 2,
+                               timeout=10.0)["replica_id"] == "a"
+    a.draining = True
+    time.sleep(0.02)                        # > one poll interval
+    n_a = len(a.begins)
+    for _ in range(4):
+        res = router.generate([5, 6], 2, timeout=10.0)
+        assert res["ok"] and res["replica_id"] == "b"
+    assert len(a.begins) == n_a             # not one more dispatch
+    router.close()
+
+
+def test_router_honors_poller_down_verdict():
+    """With an attached FleetPoller the router trusts its verdicts:
+    a 'down' replica is inadmissible even though its transport would
+    happily accept — and its breaker force-opens."""
+    a = _FakeTransport("a")
+    b = _FakeTransport("b")
+    poller = SimpleNamespace(replicas=[
+        SimpleNamespace(replica_id="a", url="http://a", verdict="down",
+                        health={}, state={}),
+        SimpleNamespace(replica_id="b", url="http://b", verdict="up",
+                        health={"healthy": True}, state={}),
+    ])
+    router = Router([a, b], poller=poller, config=_cfg())
+    for _ in range(3):
+        res = router.generate([7, 8], 2, timeout=10.0)
+        assert res["ok"] and res["replica_id"] == "b"
+    assert a.begins == []
+    assert router.breakers["a"].state == OPEN   # verdict force-open
+    router.close()
+
+
+# -------------------------------------------------- retry / failover
+
+def test_router_retries_and_fails_over_on_transport_error():
+    a = _FakeTransport("a", script=("error",), queue_depth=0)
+    b = _FakeTransport("b", queue_depth=1)  # a is preferred first
+    router = Router([a, b], config=_cfg(max_retries=2,
+                                        affinity=False))
+    res = router.generate([9, 9], 3, timeout=10.0)
+    assert res["ok"] and res["replica_id"] == "b"
+    assert res["failures"] >= 1 and res["failovers"] >= 1
+    assert router.breakers["a"].consecutive_failures >= 1  # charged
+    assert router._stats["retries"] >= 1
+    router.close()
+    # retry budget exhausted -> explicit error result, never a hang
+    a = _FakeTransport("a", script=("error",))
+    router = Router([a], config=_cfg(max_retries=1))
+    res = router.generate([1], 2, timeout=10.0)
+    assert not res["ok"] and not res["shed"]
+    assert res["failures"] == 2             # 1 + max_retries attempts
+    router.close()
+
+
+def test_router_refusal_fails_over_without_charging_breaker():
+    """TransportRefused (draining 503) is a clean verdict: the router
+    moves on, the breaker stays untouched — the NON-firing side."""
+    a = _FakeTransport("a", script=("refuse",), queue_depth=0)
+    b = _FakeTransport("b", queue_depth=1)
+    router = Router([a, b], config=_cfg(affinity=False))
+    res = router.generate([2, 2], 3, timeout=10.0)
+    assert res["ok"] and res["replica_id"] == "b"
+    assert res["failovers"] == 1
+    assert res["failures"] == 0             # refusal burns no retry
+    assert router.breakers["a"].consecutive_failures == 0
+    assert router.breakers["a"].state == CLOSED
+    router.close()
+
+
+def test_router_replica_shed_fails_over_cleanly():
+    a = _FakeTransport("a", script=(("shed", "deadline_infeasible"),),
+                       queue_depth=0)
+    b = _FakeTransport("b", queue_depth=1)
+    router = Router([a, b], config=_cfg(affinity=False))
+    res = router.generate([3, 3], 3, timeout=10.0)
+    assert res["ok"] and res["replica_id"] == "b"
+    assert router.breakers["a"].consecutive_failures == 0
+    router.close()
+
+
+def test_router_breaker_opens_blocks_then_probe_recovers():
+    a = _FakeTransport("a", script=("error", "error", "error", "ok"))
+    router = Router([a], config=_cfg(max_retries=0,
+                                     breaker_threshold=3,
+                                     breaker_reset_s=0.05))
+    for _ in range(3):
+        assert not router.generate([1], 2, timeout=10.0)["ok"]
+    assert router.breakers["a"].state == OPEN
+    # while open (reset_s not elapsed) the replica is inadmissible
+    res = router.generate([1], 2, timeout=1.0)
+    assert res["shed"] and res["reason"] == "no_admissible_replica"
+    time.sleep(0.06)
+    res = router.generate([1], 2, timeout=10.0)  # half-open probe
+    assert res["ok"]
+    assert router.breakers["a"].state == CLOSED
+    # transitions were observable on the router's own registry
+    state = router.state()
+    assert state["replicas"][0]["breaker"]["state"] == CLOSED
+    router.close()
+
+
+def test_router_mid_stream_failover_replays_journal():
+    """Replica dies after streaming 3 tokens: the next dispatch sends
+    prompt + committed tokens with a reduced budget, and the final
+    stream is bit-exact vs the unfaulted one."""
+    prompt = [4, 8, 15, 16]
+    a = _FakeTransport("a", script=(("mid_error", 3),), queue_depth=0)
+    b = _FakeTransport("b", queue_depth=1)
+    router = Router([a, b], config=_cfg(affinity=False))
+    res = router.generate(prompt, 8, timeout=10.0)
+    full = _greedy(prompt, 8)
+    assert res["ok"] and res["replica_id"] == "b"
+    assert res["tokens"] == full
+    assert res["failovers"] == 1
+    # the replay dispatch continued, it did NOT regenerate
+    assert b.begins[0]["prompt"] == prompt + full[:3]
+    assert b.begins[0]["max_new_tokens"] == 5
+    router.close()
+
+
+def test_router_deadline_propagates_and_expires():
+    a = _FakeTransport("a")
+    router = Router([a], config=_cfg())
+    res = router.generate([1, 2], 3, deadline_ms=60000.0,
+                          timeout=10.0)
+    assert res["ok"]
+    got = a.begins[0]["deadline_ms"]
+    assert got is not None and 0 < got <= 60000.0
+    # an already-expired deadline fails fast with the explicit reason
+    res = router.generate([1, 2], 3, deadline_ms=0.0, timeout=10.0)
+    assert not res["ok"] and not res["shed"]
+    assert res["reason"] == "deadline"
+    assert len(a.begins) == 1               # never dispatched
+    router.close()
+
+
+# ------------------------------------------------------------ affinity
+
+def test_router_affinity_follows_heat_until_overloaded():
+    prompt = list(range(8))
+    fps = prompt_fingerprints(prompt, 4)
+    assert len(fps) == 2 and fps[0] != fps[1]
+    heat = [{"fp": fp, "tokens_saved": 64} for fp in fps]
+    # b advertises the prefix in its heat digest; a is idle
+    a = _FakeTransport("a", queue_depth=0)
+    b = _FakeTransport("b", queue_depth=1, heat=heat)
+    router = Router([a, b], config=_cfg(affinity_block=4,
+                                        affinity_spill=4))
+    res = router.generate(prompt, 3, timeout=10.0)
+    assert res["replica_id"] == "b"         # cache hit beats idleness
+    router.close()
+    # ...but not past the spill bound: a hot-spot queue loses the tie
+    b2 = _FakeTransport("b", queue_depth=20, heat=heat)
+    router = Router([_FakeTransport("a"), b2],
+                    config=_cfg(affinity_block=4, affinity_spill=4))
+    res = router.generate(prompt, 3, timeout=10.0)
+    assert res["replica_id"] == "a"
+    router.close()
+
+
+def test_router_sticky_placement_without_heat():
+    """The router's own placements feed affinity too: the same prefix
+    keeps landing on the replica that served it first (load ties)."""
+    prompt = list(range(16))
+    a = _FakeTransport("a")
+    b = _FakeTransport("b")
+    router = Router([a, b], config=_cfg(affinity_block=4))
+    first = router.generate(prompt, 2, timeout=10.0)["replica_id"]
+    for _ in range(3):
+        res = router.generate(prompt, 2, timeout=10.0)
+        assert res["replica_id"] == first
+    router.close()
+
+
+# ------------------------------------------------------------- hedging
+
+def test_router_hedging_off_by_default(monkeypatch):
+    monkeypatch.delenv("PADDLE_ROUTER_HEDGE", raising=False)
+    assert RouterConfig().hedge is False
+    monkeypatch.setenv("PADDLE_ROUTER_HEDGE", "1")
+    assert RouterConfig().hedge is True
+    a = _FakeTransport("a", delay_s=0.05)
+    b = _FakeTransport("b")
+    router = Router([a, b], config=_cfg())  # hedge=False
+    res = router.generate([1, 2], 3, timeout=10.0)
+    assert res["ok"] and not res["hedged"]
+    assert router._stats["hedges"] == 0
+    assert len(a.begins) + len(b.begins) == 1   # exactly one dispatch
+    router.close()
+
+
+def test_router_hedge_fires_loser_cancelled_and_counted():
+    a = _FakeTransport("a", delay_s=1.0, queue_depth=0)  # slow primary
+    b = _FakeTransport("b", queue_depth=1)
+    router = Router([a, b], config=_cfg(hedge=True, hedge_min_s=0.02,
+                                        affinity=False))
+    res = router.generate([6, 6], 4, timeout=10.0)
+    assert res["ok"] and res["hedged"]
+    assert res["replica_id"] == "b" and res["hedge_winner"] == "hedge"
+    assert res["tokens"] == _greedy([6, 6], 4)   # winner's stream
+    assert len(a.begins) == 1 and len(b.begins) == 1
+    assert a.calls[0].cancelled             # loser released its slot
+    assert router._stats["hedges"] == 1
+    assert router._stats["hedge_wins"] == 1
+    state = router.state()
+    assert state["hedge"]["enabled"] and state["hedge"]["delay_s"] > 0
+    router.close()
+
+
+# ------------------------------------------------------- chaos at the seam
+
+def test_router_chaos_absorbed_and_deterministic():
+    plan = {"seed": 11,
+            "faults": {"router_dispatch": {"rate": 0.5}}}
+
+    def run():
+        a = _FakeTransport("a")
+        b = _FakeTransport("b")
+        router = Router([a, b], chaos=FaultPlan(**plan),
+                        config=_cfg(max_retries=6, affinity=False))
+        results = []
+        for i in range(8):
+            results.append(router.submit([1, 2, i], 3,
+                                         tag=f"t{i}").result(10.0))
+        log = [(e["site"], e["check"], e["rid"])
+               for e in router.chaos.fault_log()]
+        router.close()
+        return results, log
+
+    res1, log1 = run()
+    res2, log2 = run()
+    assert all(r["ok"] for r in res1)       # retries absorb the chaos
+    assert log1                             # rate 0.5 over >=8 checks
+    assert log1 == log2                     # seeded => replayable
+    assert [r["tokens"] for r in res1] == [r["tokens"] for r in res2]
+    # chaos is OFF by default: no injector unless armed explicitly
+    router = Router([_FakeTransport("a")])
+    assert router.chaos is None
+    router.close()
+
+
+# --------------------------------------------- in-process engine fleet
+
+def _model(seed=7):
+    paddle.seed(seed)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                              num_layers=2, num_heads=4,
+                              max_seq_len=64, dropout=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _gateway(rid):
+    eng = ServingEngine(_model(), num_slots=2, bucket_min=8,
+                        replica_id=rid, slo_ttft_ms=60000.0)
+    return EngineGateway(eng)
+
+
+def _reference_streams(prompts, max_new):
+    eng = ServingEngine(_model(), num_slots=2, bucket_min=8,
+                        replica_id="ref")
+    reqs = [eng.add_request(np.asarray(p, dtype=np.int64),
+                            max_new_tokens=max_new) for p in prompts]
+    eng.run()
+    out = [[int(t) for t in r.generated] for r in reqs]
+    eng.close()
+    return out
+
+
+def test_router_drain_aware_admission_two_replicas():
+    """The drain satellite: flip one replica to draining mid-traffic;
+    within one poll interval the router places NEW requests only on
+    the other, while the draining replica's in-flight work completes
+    normally."""
+    ga, gb = _gateway("ra"), _gateway("rb")
+    ta, tb = InProcessTransport(ga), InProcessTransport(gb)
+    router = Router([ta, tb], config=_cfg(refresh_s=0.05,
+                                          affinity=False))
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, 97, (5,)).astype(int).tolist()
+               for _ in range(6)]
+    try:
+        # occupy BOTH replicas, then drain ra while its work runs
+        warm = [router.submit(p, 24) for p in prompts[:4]]
+        deadline = time.monotonic() + 10.0
+        while not ga.engine.pending and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert ga.engine.pending            # ra holds in-flight work
+        ga.drain(wait=False)
+        time.sleep(0.06)                    # > one poll interval
+        for p in prompts[4:]:
+            res = router.submit(p, 4).result(timeout=30.0)
+            assert res["ok"] and res["replica_id"] == "rb"
+        for t in warm:                      # in-flight all completed
+            assert t.result(timeout=30.0)["ok"]
+        assert not ga.engine.pending        # drained clean, no leak
+        state = router.state()
+        by_id = {r["replica_id"]: r for r in state["replicas"]}
+        assert by_id["ra"]["admissible"] is False
+        assert by_id["ra"]["posture"]["draining"] is True
+    finally:
+        router.close()
+        ga.close()
+        gb.close()
+
+
+@pytest.mark.slow
+def test_router_inprocess_kill_failover_parity():
+    """The tentpole proof, in-process: kill a gateway mid-request;
+    every admitted request still completes, bit-exact vs a single
+    unfaulted reference engine, and the death is visible only in the
+    failover counters."""
+    rs = np.random.RandomState(5)
+    prompts = [rs.randint(0, 97, (int(rs.randint(4, 8)),))
+               .astype(int).tolist() for _ in range(6)]
+    ref = _reference_streams(prompts, 16)
+    ga, gb = _gateway("ka"), _gateway("kb")
+    router = Router([InProcessTransport(ga), InProcessTransport(gb)],
+                    config=_cfg(max_retries=4, refresh_s=0.05,
+                                affinity=False))
+    try:
+        tickets = [router.submit(p, 16) for p in prompts]
+        deadline = time.monotonic() + 15.0
+        while not ga.engine.pending and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert ga.engine.pending            # victim holds work
+        ga.kill()
+        results = [t.result(timeout=60.0) for t in tickets]
+        assert all(r["ok"] for r in results)
+        assert [r["tokens"] for r in results] == ref
+        assert all(r["replica_id"] == "kb" for r in results
+                   if r["failovers"])
+        assert router._stats["failovers"] >= 1
+        assert router.breakers["ka"].state == OPEN
+        # survivor ends clean: no stuck queue, no occupied slots
+        st = gb.engine.debug_state()
+        assert st["queue_depth"] == 0 and st["slot_occupancy"] == 0
+    finally:
+        router.close()
+        gb.close()
+
+
+# ---------------------------------------------------- state over the wire
+
+def test_router_state_served_and_fleet_top_renders_it():
+    a = _FakeTransport("a")
+    router = Router([a], config=_cfg())
+    assert router.generate([1, 2], 3, timeout=10.0)["ok"]
+    handle = router.serve(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{handle.port}/router/state",
+                timeout=5.0) as resp:
+            body = json.loads(resp.read().decode())
+        assert tuple(sorted(body)) == tuple(sorted(ROUTER_STATE_KEYS))
+        sys.path.insert(0, os.path.join(_ROOT, "tools"))
+        try:
+            import fleet_top
+        finally:
+            sys.path.pop(0)
+        state = fleet_top.fetch_router_state(
+            f"127.0.0.1:{handle.port}")
+        assert state is not None
+        import io
+        buf = io.StringIO()
+        fleet_top.render_router(state, out=buf)
+        line = buf.getvalue()
+        assert line.startswith("router: journal=0")
+        assert "ok=1" in line and "a=closed" in line
+        # unreachable routers degrade, never crash the fleet table
+        assert fleet_top.fetch_router_state("127.0.0.1:9") is None
+        buf2 = io.StringIO()
+        fleet_top.render_router(None, out=buf2)
+        assert "unreachable" in buf2.getvalue()
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------- the drill
+
+def test_router_drill_fast_subprocess_self_run():
+    """tools/router_drill.py --fast is the PR's gate: 3 replicas over
+    the wire, SIGKILL mid-traffic, exit 0 iff 100% completion +
+    greedy parity + zero leaks + the no-failover baseline losing."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, _DRILL, "--fast", "--requests", "6",
+         "--max-new", "10"],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, \
+        f"drill failed:\n{proc.stdout}\n{proc.stderr}"
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip()]
+    waves = {e.get("wave"): e for e in lines if "wave" in e}
+    result = lines[-1]
+    assert result["result"] == "PASS"
+    assert waves["failover"]["lost"] == []
+    assert waves["failover"]["parity_mismatch"] == []
+    assert waves["baseline_no_failover"]["lost"]   # kill HURT there

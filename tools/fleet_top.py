@@ -14,7 +14,10 @@ fleet-detector firings).
 Exit code: 0 iff EVERY replica is up and healthy (the scriptable
 all-clear a deploy gate wants); 1 otherwise, naming the offending
 replicas on stderr. ``--json`` dumps the pinned-schema FleetSnapshot
-instead of the table. Tier-1 self-runs this against two in-process
+instead of the table. ``--router URL`` additionally scrapes a serving
+router's ``/router/state`` and stamps a router line under the fleet
+line (journal depth, shed/retry/failover/hedge totals, per-replica
+breaker states). Tier-1 self-runs this against two in-process
 engines (tests/test_fleet.py), the same discipline as
 incident_report / chaos_sweep / perf_diff.
 """
@@ -79,6 +82,35 @@ def render(snap, out=sys.stdout):
           f"anomalies={snap['health']['anomalies_total']}", file=out)
 
 
+def fetch_router_state(url, timeout=2.0):
+    """GET ``/router/state`` off a router's metrics server; None when
+    unreachable (the fleet table still renders)."""
+    import urllib.request
+    url = url.rstrip("/")
+    if "://" not in url:
+        url = "http://" + url
+    try:
+        with urllib.request.urlopen(url + "/router/state",
+                                    timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except Exception:   # noqa: BLE001 - best-effort stamp
+        return None
+
+
+def render_router(state, out=sys.stdout):
+    if state is None:
+        print("router: unreachable", file=out)
+        return
+    c = state["counters"]
+    breakers = ", ".join(
+        f"{r['replica_id']}={r['breaker']['state']}"
+        for r in state["replicas"])
+    print(f"router: journal={state['journal_depth']}  "
+          f"ok={c['ok']} err={c['error']} shed={c['shed']}  "
+          f"retries={c['retries']} failovers={c['failovers']} "
+          f"hedges={c['hedges']}  breakers[{breakers}]", file=out)
+
+
 def verdict_exit(snap, out=sys.stderr):
     """0 iff all replicas up and healthy; else 1, naming offenders."""
     bad = {rid: e for rid, e in snap["replicas"].items()
@@ -124,6 +156,10 @@ def main(argv=None):
     parser.add_argument("--json", action="store_true",
                         help="dump the FleetSnapshot JSON instead of "
                              "the table")
+    parser.add_argument("--router", default=None, metavar="URL",
+                        help="also scrape a router's /router/state "
+                             "and stamp its line (journal, breaker "
+                             "states, dispatch counters)")
     args = parser.parse_args(argv)
     if not args.targets and not args.registry:
         parser.error("give targets or --registry")
@@ -141,6 +177,8 @@ def main(argv=None):
                 snap = poller.snapshot()
                 print(f"\n== fleet_top {time.strftime('%H:%M:%S')} ==")
                 render(snap)
+                if args.router:
+                    render_router(fetch_router_state(args.router))
                 time.sleep(args.watch)
         except KeyboardInterrupt:
             return verdict_exit(poller.snapshot())
@@ -150,10 +188,16 @@ def main(argv=None):
             time.sleep(min(args.interval, 0.5))
         poller.poll_once()
     snap = poller.snapshot()
+    router_state = fetch_router_state(args.router) \
+        if args.router else None
     if args.json:
+        if args.router:
+            snap = dict(snap, router=router_state)
         print(json.dumps(snap, indent=1, sort_keys=True, default=str))
     else:
         render(snap)
+        if args.router:
+            render_router(router_state)
     return verdict_exit(snap)
 
 
