@@ -151,6 +151,17 @@ _LEDGER_SPECS = (
      ("router", "goodput_x")),
     ("router", "failover_completion", "fraction", "higher_better",
      0.1, ("router", "failover", "completion")),
+    # decode-kernel A/B probe (ISSUE 15): XLA paged gather vs the
+    # Pallas paged-attention kernel on identical traffic. On the CPU
+    # smoke runner the kernel runs in interpret mode, so speedup_x is
+    # a machinery exercise there (generous threshold), not a perf
+    # claim — the ledger's config digest carries the gate + backend so
+    # runs on real TPUs never cross-compare with CPU baselines.
+    ("decode_kernel", "decode_kernel_speedup_x", "ratio",
+     "higher_better", 0.5, ("decode_kernel", "speedup_x")),
+    ("decode_kernel", "pallas_roofline_fraction", "fraction",
+     "higher_better", 0.5,
+     ("decode_kernel", "pallas", "roofline_fraction")),
 )
 
 
@@ -370,6 +381,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     perf_sec = _perf_section(eng, health_sec)
     fleet_sec = _measure_fleet_poll(m_eng, num_slots, health_sec)
     router_sec = _measure_router(m_eng, num_slots)
+    decode_kernel_sec = _measure_decode_kernel(m_eng, num_slots)
 
     import jax
     dev = jax.devices()[0]
@@ -436,6 +448,11 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         # replica's in-flight work), and the probe-measured router
         # dispatch overhead (<5% of routed wall is the contract bar)
         "router": router_sec,
+        # PR 15 decode-kernel A/B: XLA paged gather vs the Pallas
+        # paged-attention kernel on identical traffic — bit-exact
+        # greedy parity between the arms, per-arm decode avg_ms +
+        # roofline fraction, and the speedup ratio the ledger tracks
+        "decode_kernel": decode_kernel_sec,
     }
 
 
@@ -606,6 +623,85 @@ def _perf_section(eng, health_sec):
         "overhead_frac": round(per_step_us / step_wall_us, 6)
         if step_wall_us else None,
     })
+
+
+def _measure_decode_kernel(model, num_slots):
+    """The artifact's ``decode_kernel`` section (ISSUE 15): an A/B
+    probe of the paged decode program — the XLA gather composition vs
+    the Pallas paged-attention kernel — on IDENTICAL greedy traffic.
+
+    Each arm builds its own paged engine (the gate is resolved at
+    build time; the AOT decode program embeds one path or the other),
+    drains the same request set twice (cold then warm; the warm drain
+    is the measured one), and reports its decode ``avg_ms`` +
+    per-program roofline fraction from the perf observatory.
+    ``speedup_x`` is XLA-arm decode avg over Pallas-arm decode avg;
+    ``parity_ok`` pins the bit-exact greedy token-stream contract
+    between the two arms. On CPU the kernel runs in interpret mode
+    (forced for the Pallas arm only), so speedup_x < 1 there is
+    expected and honest — the number that matters on the smoke runner
+    is parity; the measured win is a TPU-run number."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.ops import paged_attention as paged_attn
+    from paddle_tpu.serving import ServingEngine
+
+    _set_phase("decode-kernel-ab")
+    rs = np.random.RandomState(23)
+    specs = [(int(n), 6) for n in rs.randint(3, 12, 6)]
+    prompts = [rs.randint(0, model.cfg.vocab_size, (n,))
+               .astype(np.int64) for n, _ in specs]
+    on_cpu = jax.default_backend() == "cpu"
+
+    def drive(gate):
+        eng = ServingEngine(model, num_slots=num_slots, bucket_min=8,
+                            paged=True, block_size=8, paged_attn=gate,
+                            watchdog_mode="raise")
+        wall = None
+        for run in range(2):      # cold, then the measured warm drain
+            t0 = _time.perf_counter()
+            reqs = [eng.add_request(p, max_new_tokens=k)
+                    for p, (_, k) in zip(prompts, specs)]
+            eng.run()
+            wall = _time.perf_counter() - t0
+            if run == 0:
+                eng.declare_warmup()
+        streams = [list(r.generated) for r in reqs]
+        rep = eng.metrics.perf_report()
+        prog = rep["programs"].get("decode") or {}
+        droof = rep["decode_roofline"] or {}
+        return {
+            "layout": eng.decode_layout,
+            "decode_avg_ms": prog.get("avg_ms"),
+            "roofline_fraction": droof.get("achieved_fraction"),
+            "model_gather_factor": (droof.get("model") or {})
+            .get("gather_factor"),
+            "warm_wall_s": round(wall, 4),
+        }, streams
+
+    xla, streams_xla = drive(False)
+    if on_cpu:
+        paged_attn._FORCE_INTERPRET[0] = True
+    try:
+        pallas, streams_pallas = drive(True)
+    finally:
+        if on_cpu:
+            paged_attn._FORCE_INTERPRET[0] = False
+    speedup = None
+    if xla["decode_avg_ms"] and pallas["decode_avg_ms"]:
+        speedup = round(xla["decode_avg_ms"]
+                        / pallas["decode_avg_ms"], 3)
+    return {
+        "interpret": bool(on_cpu),
+        "requests": len(specs),
+        "parity_ok": streams_xla == streams_pallas,
+        "xla": xla,
+        "pallas": pallas,
+        "speedup_x": speedup,
+    }
 
 
 def _measure_fleet_poll(model, num_slots, health_sec):
@@ -1654,9 +1750,17 @@ def main():
     try:
         from paddle_tpu.observability.perf import (append_rows,
                                                    config_digest)
+        # the digest carries the decode-kernel gate + backend: a
+        # kernel-on run starts its own baseline series instead of
+        # cross-comparing against gather-path (or CPU-interpret) rows
+        digest_cfg = dict(
+            cfg,
+            paged_attn_gate=os.environ.get("PADDLE_PAGED_ATTN", "0"),
+            decode_kernel_interpret=evidence.get(
+                "decode_kernel", {}).get("interpret"))
         n = append_rows(_PERF_LEDGER,
                         _ledger_rows(evidence, fname, source,
-                                     config_digest(cfg)))
+                                     config_digest(digest_cfg)))
         print(f"# perf-ledger +{n} rows -> "
               f"bench_artifacts/perf_ledger.jsonl", file=sys.stderr,
               flush=True)
@@ -1689,6 +1793,8 @@ def main():
         "chaos_completion_rate": evidence["chaos"]["completion_rate"],
         "router_failover_completion": evidence["router"]["failover"][
             "completion"],
+        "decode_kernel_speedup_x": evidence["decode_kernel"][
+            "speedup_x"],
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })

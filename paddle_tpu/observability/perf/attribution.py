@@ -305,11 +305,14 @@ class ProgramPerf:
 
 def build_decode_model(batch, kv_len, num_layers, num_heads, head_dim,
                        n_params, param_bytes, kv_bytes, paged,
-                       peak_flops, hbm_bps):
+                       peak_flops, hbm_bps, layout=None):
     """Thin convenience wrapper the engine uses (keeps its import
-    surface to this package)."""
+    surface to this package). ``layout`` names the attention path the
+    engine actually resolved ("contiguous" | "paged_xla" |
+    "paged_pallas") so serving_roofline_fraction prices the path that
+    is running; the bool ``paged`` alone means the XLA gather."""
     return decode_step_model(
         batch=batch, kv_len=kv_len, num_layers=num_layers,
         num_heads=num_heads, head_dim=head_dim, n_params=n_params,
         param_bytes=param_bytes, kv_bytes=kv_bytes, paged=paged,
-        peak_flops=peak_flops, hbm_bps=hbm_bps)
+        layout=layout, peak_flops=peak_flops, hbm_bps=hbm_bps)

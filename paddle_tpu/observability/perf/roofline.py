@@ -23,13 +23,23 @@ Layout model (why paged costs more under plain XLA):
     ``[slots, heads, cache_len, head_dim]`` K/V directly — one read of
     the full fixed-shape cache per step (the max_len over-read is the
     price of the zero-recompile fixed shape);
-  * **paged** (PagedKVPool behind a block table, composed in XLA):
-    the gather MATERIALIZES a contiguous copy before attention reads
-    it — pool read + copy write + attention read, ~3x the contiguous
-    traffic. That factor is exactly what the Pallas kernel deletes by
-    reading blocks in place, which is why the achieved-fraction gauge
-    exists: the kernel becomes default only where measurements beat
-    this model's floor.
+  * **paged_xla** (PagedKVPool behind a block table, composed in
+    XLA): the gather MATERIALIZES a contiguous copy before attention
+    reads it — pool read + copy write + attention read, ~3x the
+    contiguous traffic. That factor is exactly what the Pallas kernel
+    deletes by reading blocks in place, which is why the
+    achieved-fraction gauge exists: the kernel becomes default only
+    where measurements beat this model's floor;
+  * **paged_pallas** (ops.paged_attention, PADDLE_PAGED_ATTN): the
+    Pallas kernel streams blocks through VMEM straight from the pool
+    — gather factor 1.0, and no max-len over-read: its index-map
+    clamp stops the DMA at each slot's last LIVE block, so the read
+    length is the live ``kv_len`` (callers may pass
+    ``live_kv_len``), not the fixed cache capacity.
+
+The boolean ``paged=`` argument is kept for callers predating the
+three-way split (``paged=True`` means ``layout="paged_xla"``);
+``layout=`` wins when both are given.
 """
 import os
 
@@ -57,6 +67,25 @@ _HBM_BPS_BY_KIND = (
 # contiguous copy, attention reads the copy back (vs one direct read
 # on the contiguous layout)
 PAGED_GATHER_FACTOR = 3.0
+
+# the decode K/V layouts the model prices; per-layout gather
+# materialization factor on the KV-read term
+LAYOUTS = ("contiguous", "paged_xla", "paged_pallas")
+_GATHER_FACTORS = {
+    "contiguous": 1.0,
+    "paged_xla": PAGED_GATHER_FACTOR,
+    "paged_pallas": 1.0,
+}
+
+
+def resolve_layout(paged=False, layout=None):
+    """Back-compat shim: the pre-kernel API was ``paged: bool``."""
+    if layout is None:
+        return "paged_xla" if paged else "contiguous"
+    if layout not in _GATHER_FACTORS:
+        raise ValueError(f"unknown KV layout {layout!r}; "
+                         f"expected one of {LAYOUTS}")
+    return layout
 
 
 def hbm_bps_for(device_kind):
@@ -95,31 +124,42 @@ def roofline_floor(flops, bytes_accessed, peak_flops, hbm_bps):
 
 
 def kv_read_bytes_per_token(kv_len, num_layers, num_heads, head_dim,
-                            kv_bytes=2, paged=False):
+                            kv_bytes=2, paged=False, layout=None):
     """HBM bytes attention reads to serve ONE decode token: K and V
     across every layer over ``kv_len`` positions, times the gather
-    materialization factor on the XLA-composed paged layout."""
+    materialization factor on the XLA-composed paged layout (the
+    Pallas in-place layout pays factor 1.0)."""
     base = 2.0 * num_layers * num_heads * head_dim * kv_len * kv_bytes
-    return base * (PAGED_GATHER_FACTOR if paged else 1.0)
+    return base * _GATHER_FACTORS[resolve_layout(paged, layout)]
 
 
 def decode_step_model(batch, kv_len, num_layers, num_heads, head_dim,
                       n_params, param_bytes=2, kv_bytes=2, paged=False,
+                      layout=None, live_kv_len=None,
                       peak_flops=None, hbm_bps=None):
     """Analytic cost of ONE pooled decode dispatch (``batch`` slots,
     one token each, attending over ``kv_len`` cached positions — the
     engine passes its fixed cache_len, since the fixed-shape program
     reads the whole pooled cache regardless of live lengths).
 
+    On the ``paged_pallas`` layout the kernel stops reading at each
+    slot's live length, so the KV-read term uses ``live_kv_len`` when
+    given (the other layouts always read the fixed ``kv_len`` — the
+    over-read is part of their price).
+
     Returns a JSON-safe dict: the traffic decomposition (KV read per
     token and total, KV append write, parameter read), matmul +
     attention FLOPs, arithmetic intensity, and — when peak_flops /
     hbm_bps are given — the roofline floor and its binding resource.
     """
+    layout = resolve_layout(paged, layout)
     hidden = num_heads * head_dim
-    kv_tok = kv_read_bytes_per_token(kv_len, num_layers, num_heads,
-                                     head_dim, kv_bytes=kv_bytes,
-                                     paged=paged)
+    kv_len_read = kv_len
+    if layout == "paged_pallas" and live_kv_len is not None:
+        kv_len_read = min(int(live_kv_len), int(kv_len))
+    kv_tok = kv_read_bytes_per_token(kv_len_read, num_layers,
+                                     num_heads, head_dim,
+                                     kv_bytes=kv_bytes, layout=layout)
     kv_read = batch * kv_tok
     # one position appended per layer, K and V
     kv_write = batch * 2.0 * num_layers * num_heads * head_dim * kv_bytes
@@ -138,8 +178,12 @@ def decode_step_model(batch, kv_len, num_layers, num_heads, head_dim,
         "num_heads": int(num_heads),
         "head_dim": int(head_dim),
         "n_params": int(n_params),
-        "paged": bool(paged),
-        "gather_factor": PAGED_GATHER_FACTOR if paged else 1.0,
+        # "paged" keeps the pre-kernel bool meaning (is the POOL
+        # paged); "layout" names the attention path actually priced
+        "paged": layout != "contiguous",
+        "layout": layout,
+        "gather_factor": _GATHER_FACTORS[layout],
+        "kv_len_read": int(kv_len_read),
         "kv_read_bytes_per_token": kv_tok,
         "kv_read_bytes": kv_read,
         "kv_write_bytes": kv_write,

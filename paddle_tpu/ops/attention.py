@@ -392,13 +392,16 @@ def cached_slot_attention(q, k_cache, v_cache, lengths):
     vectorized over slots."""
     hd = q.shape[-1]
     cache_len = k_cache.shape[2]
-    s = jnp.einsum("shd,shkd->shk", q, k_cache) / jnp.sqrt(
+    # f32 score accumulation (the _dot_f32 discipline): bf16 caches
+    # keep full MXU rate but never sum scores in bf16; a no-op for f32
+    s = jnp.einsum("shd,shkd->shk", q, k_cache,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
         jnp.float32(hd))
     kpos = jnp.arange(cache_len)[None, None, :]
     s = jnp.where(kpos < lengths[:, None, None], s,
                   jnp.float32(-1e30))
     return jnp.einsum("shk,shkd->shd", jax.nn.softmax(s, axis=-1),
-                      v_cache)
+                      v_cache, preferred_element_type=jnp.float32)
 
 
 def cached_paged_attention(q, k_cache, v_cache, block_tables, lengths):
@@ -421,8 +424,10 @@ def cached_paged_attention(q, k_cache, v_cache, block_tables, lengths):
     row a padding entry gathered, get -1e30 before the f32 softmax and
     carry exactly-zero weight. For block tables describing the same
     live prefixes this computes bit-for-bit what the slot-contiguous
-    path computes; it is the XLA-composed gather baseline the Pallas
-    paged decode kernel (ROADMAP direction #2) exists to beat."""
+    path computes; it is the XLA-composed gather baseline — and the
+    parity oracle / fallback — for the Pallas paged decode kernel
+    (ops.paged_attention, PADDLE_PAGED_ATTN) that reads the blocks in
+    place instead."""
     S, nh, hd = q.shape
     k = jnp.take(k_cache, block_tables, axis=0)  # [S, MB, nh, BS, hd]
     v = jnp.take(v_cache, block_tables, axis=0)

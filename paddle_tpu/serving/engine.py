@@ -169,6 +169,7 @@ class ServingConfig:
                  completed_keep=4096, trace_keep=256,
                  trace_decode_window=32, peak_flops=None,
                  paged=None, block_size=16, num_blocks=None,
+                 paged_attn=None,
                  prefill_chunk=None, prefill_token_budget=None,
                  policy=None, sampling=False, health=None,
                  health_audit_every=64, health_ledger_keep=512,
@@ -232,6 +233,14 @@ class ServingConfig:
         self.paged = bool(paged)
         self.block_size = int(block_size)
         self.num_blocks = num_blocks
+        # Pallas paged decode-attention kernel (ops.paged_attention):
+        # None = the PADDLE_PAGED_ATTN env gate (default off — the
+        # XLA gather composition stays the measured fallback, same
+        # playbook). Only meaningful with paged=True; the engine still
+        # applies the kernel_viable shape/dtype/backend guard, so the
+        # resolved path is exposed as engine.decode_layout.
+        from ..ops.paged_attention import kernel_requested
+        self.paged_attn = kernel_requested(paged_attn)
         # chunked prefill (serving.sched): prompts longer than
         # prefill_chunk split into fixed-width chunks interleaved with
         # decode steps under prefill_token_budget chunk tokens per
@@ -412,13 +421,24 @@ class ServingEngine:
 
             self._pool_factory = _pool_factory
             self.pool = _pool_factory()
+            # resolve the decode-attention path ONCE at build time:
+            # gate (config/env) AND the kernel_viable guard over the
+            # static shapes/dtype/backend — a trace-time branch inside
+            # the one compiled decode program, so signatures, AOT keys
+            # and the zero-steady-state-compile contract are unchanged
+            from ..ops.paged_attention import kernel_viable
+            self.paged_attn = bool(config.paged_attn) and kernel_viable(
+                cfg.num_heads, cfg.hidden_size // cfg.num_heads,
+                self.pool.block_size, self.pool.kc.dtype)
             self._prefill_fn, self._decode_fn = \
                 model.build_paged_serving_fns(
                     config.num_slots, self.pool.block_size,
                     self.pool.num_blocks, self.pool.blocks_per_slot,
-                    sampling=self.sampling)
+                    sampling=self.sampling,
+                    attn_kernel=self.paged_attn)
             self._chunk_fn = None   # chunks reuse the paged prefill
         else:
+            self.paged_attn = False
             self._prefill_fn, self._decode_fn = model.build_serving_fns(
                 config.num_slots, cache_len, sampling=self.sampling)
             self._chunk_fn = model.build_chunk_prefill_fn(
@@ -432,6 +452,10 @@ class ServingEngine:
 
             self._pool_factory = _pool_factory
             self.pool = _pool_factory()
+        # the attention path the decode program actually runs — what
+        # the roofline prices (observability.perf.roofline.LAYOUTS)
+        self.decode_layout = "paged_pallas" if self.paged_attn \
+            else ("paged_xla" if self.paged else "contiguous")
         from .sched import ChunkPlan, SlotSampler, resolve_policy
         self._ChunkPlan = ChunkPlan
         self._sampler = SlotSampler(config.num_slots) \
@@ -611,7 +635,8 @@ class ServingEngine:
                 n_params=n_params,
                 param_bytes=leaves[0].dtype.itemsize if leaves else 4,
                 kv_bytes=self.pool.kc.dtype.itemsize,
-                paged=self.paged, peak_flops=P.peak_flops,
+                paged=self.paged, layout=self.decode_layout,
+                peak_flops=P.peak_flops,
                 hbm_bps=P.hbm_bps))
 
     # ---------------------------------------------------------- requests
@@ -879,6 +904,8 @@ class ServingEngine:
             "flight": self.flight.state(),
             "slo": self.metrics.slo.report(),
             "paged": self.paged,
+            "paged_attn": self.paged_attn,
+            "decode_layout": self.decode_layout,
             "prefix_cache": self.metrics.prefix_cache_report(),
             "cache": self.metrics.cache_report(),
             "scheduler": dict(

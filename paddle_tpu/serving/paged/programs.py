@@ -49,11 +49,19 @@ same recycled-slot invariant the legacy pool pins, at block granularity.
 ``sampling=True`` threads per-slot sampling parameters (seeds / temps
 / top-k / top-p — serving.sched.sampling) through both programs; the
 greedy path is the default and keeps the original signatures.
+
+``attn_kernel=True`` swaps the decode program's attention for the
+Pallas paged kernel (ops.paged_attention) that reads K/V blocks in
+place via scalar-prefetched table indices instead of materializing
+the gathered view — a trace-time branch, so the program key, its
+signature and the zero-steady-state-compile contract are unchanged;
+the ``use_paged_kernel`` guard still falls back to the XLA gather on
+unsupported operands.
 """
 
 
 def build_paged_fns(cfg, num_slots, block_size, num_blocks,
-                    blocks_per_slot, sampling=False):
+                    blocks_per_slot, sampling=False, attn_kernel=False):
     """(paged_prefill, paged_decode) for a GPT decode config. Pure and
     shape-stable; the engine AOT-compiles them (decode once, prefill
     once per tail bucket)."""
@@ -62,6 +70,7 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
     from jax import lax
 
     from ...ops import attention as attn_ops
+    from ...ops import paged_attention as paged_attn_ops
     from ...text.models import _decode_forward_builder
     from ..sched.sampling import build_sampling_head
 
@@ -158,8 +167,12 @@ def build_paged_fns(cfg, num_slots, block_size, num_blocks,
             # block: advanced indexing [S],:,[S] scatters [S, nh, hd]
             kcl = kcl.at[bidx, :, off].set(k)
             vcl = vcl.at[bidx, :, off].set(v)
-            o = attn_ops.cached_paged_attention(
-                q, kcl, vcl, tables, pos + 1)
+            if attn_kernel and paged_attn_ops.use_paged_kernel(q, kcl):
+                o = paged_attn_ops.paged_decode_attention(
+                    q, kcl, vcl, tables, pos + 1)
+            else:
+                o = attn_ops.cached_paged_attention(
+                    q, kcl, vcl, tables, pos + 1)
             o = o.reshape(S, hidden)                  # concat heads
             x = x + (o @ p["out_w"] + p["out_b"])
             h2 = ln(x, p["ln2_w"], p["ln2_b"])

@@ -535,7 +535,8 @@ class GPTForCausalLM(nn.Layer):
         return prefill, decode_step
 
     def build_paged_serving_fns(self, num_slots, block_size, num_blocks,
-                                blocks_per_slot, sampling=False):
+                                blocks_per_slot, sampling=False,
+                                attn_kernel=False):
         """Paged-cache analogues of build_serving_fns for the
         block-granular KV pool (serving.paged): same decode math via
         the shared _decode_forward_builder, cache addressed through a
@@ -554,11 +555,14 @@ class GPTForCausalLM(nn.Layer):
         prefix AND chunk variety costs zero compiles); the engine
         AOT-compiles them (decode once, prefill once per tail bucket).
         ``sampling=True`` appends per-slot sampling parameters to both
-        signatures (serving.sched.sampling)."""
+        signatures (serving.sched.sampling); ``attn_kernel=True``
+        swaps the decode attention for the Pallas paged kernel
+        (ops.paged_attention) without changing either signature."""
         from ..serving.paged.programs import build_paged_fns
         return build_paged_fns(self.cfg, num_slots, block_size,
                                num_blocks, blocks_per_slot,
-                               sampling=sampling)
+                               sampling=sampling,
+                               attn_kernel=attn_kernel)
 
     def build_chunk_prefill_fn(self, cache_len, sampling=False):
         """The chunked-prefill program over the slot-contiguous pool

@@ -440,6 +440,7 @@ def test_lint_graft_self_lints_repo_clean():
     assert report["ok"] is True
     assert report["counts"]["error"] == 0
     assert set(report["targets"]) == {"serving_decode", "paged_decode",
+                                      "paged_decode_pallas",
                                       "chunked_prefill",
                                       "hapi_train_step",
                                       "to_static_sample"}

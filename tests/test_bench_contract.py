@@ -347,6 +347,24 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         assert rohd["overhead_frac"] is not None
         assert rohd["overhead_frac"] < 0.05, rohd
         assert last["router_failover_completion"] == fo["completion"]
+        # PR 15 decode-kernel A/B probe: the paged_xla arm vs the
+        # Pallas paged-attention arm on identical traffic — streams
+        # bit-exact (the greedy contract; on CPU the kernel runs in
+        # interpret mode, so speed is not pinned, parity is), both
+        # arms report their honest roofline layout, and the headline
+        # line carries the speedup ratio
+        dk = evidence["decode_kernel"]
+        assert set(dk) >= {"interpret", "requests", "parity_ok",
+                           "xla", "pallas", "speedup_x"}
+        assert dk["parity_ok"] is True
+        assert dk["requests"] > 0 and dk["speedup_x"] > 0
+        assert dk["xla"]["layout"] == "paged_xla"
+        assert dk["pallas"]["layout"] == "paged_pallas"
+        assert dk["pallas"]["model_gather_factor"] == 1.0
+        for arm in (dk["xla"], dk["pallas"]):
+            assert arm["decode_avg_ms"] > 0
+            assert arm["roofline_fraction"] is not None
+        assert last["decode_kernel_speedup_x"] == dk["speedup_x"]
         # heartbeat wedge attribution: beats name the last ledger step
         # and the phase-relative step rate
         beats = [ln for ln in res.stderr.splitlines()

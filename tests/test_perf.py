@@ -124,6 +124,12 @@ def test_gpt_roofline_cli_decode_mode():
     assert out["paged_xla"]["kv_read_bytes_per_token"] \
         > out["contiguous"]["kv_read_bytes_per_token"]
     assert out["paged_gather_tax"] > 1.5
+    # the Pallas paged-kernel column: gather tax gone, reads priced
+    # identically to contiguous, the modelled win is the whole tax
+    assert out["paged_pallas"]["kv_read_bytes_per_token"] \
+        == out["contiguous"]["kv_read_bytes_per_token"]
+    assert out["paged_pallas"]["gather_factor"] == 1.0
+    assert out["pallas_vs_paged_xla_x"] > 1.5
     res = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools",
                                       "gpt_roofline.py"), "4", "512"],

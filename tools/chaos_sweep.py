@@ -65,11 +65,13 @@ def _workload(n_requests=16):
              int(rs.randint(3, 8))) for n in lengths]
 
 
-def _drain(model, specs, paged, chaos=None, chunk=None):
+def _drain(model, specs, paged, chaos=None, chunk=None,
+           paged_attn=False):
     """One engine drain; returns (streams, engine, steps, fault_log)."""
     from paddle_tpu.serving import ServingEngine
     eng = ServingEngine(
         model, num_slots=4, bucket_min=8, paged=paged,
+        paged_attn=paged_attn,
         prefill_chunk=chunk, chaos=chaos, max_dispatch_retries=3,
         supervisor_cooldown_s=0.0, health_audit_every=8)
     reqs = [eng.add_request(p, max_new_tokens=k,
@@ -85,7 +87,8 @@ def _drain(model, specs, paged, chaos=None, chunk=None):
     return streams, eng, steps, log
 
 
-def _check_cell(site, seed, model, specs, reference, paged, chunk):
+def _check_cell(site, seed, model, specs, reference, paged, chunk,
+                paged_attn=False):
     """Run one (site, seed) cell twice; returns a result dict with
     ok=False and a reason on any contract break."""
     from paddle_tpu.serving.resilience import FaultPlan
@@ -95,9 +98,11 @@ def _check_cell(site, seed, model, specs, reference, paged, chunk):
     def plan():
         return FaultPlan(seed=seed, faults=faults)
 
-    out = {"site": site, "seed": seed, "paged": paged, "ok": True}
+    out = {"site": site, "seed": seed, "paged": paged,
+           "paged_attn": paged_attn, "ok": True}
     streams, eng, steps, log = _drain(model, specs, paged,
-                                      chaos=plan(), chunk=chunk)
+                                      chaos=plan(), chunk=chunk,
+                                      paged_attn=paged_attn)
     out["steps"] = steps
     if streams is None:
         return dict(out, ok=False, reason=f"hang: > {_MAX_STEPS} steps")
@@ -131,7 +136,7 @@ def _check_cell(site, seed, model, specs, reference, paged, chunk):
                     reason=f"{incomplete}/{len(specs)} incomplete")
     # determinism: same seed => identical fault log and streams
     streams2, _, _, log2 = _drain(model, specs, paged, chaos=plan(),
-                                  chunk=chunk)
+                                  chunk=chunk, paged_attn=paged_attn)
     if log2 != log:
         return dict(out, ok=False, reason="fault log not deterministic")
     if streams2 != streams:
@@ -182,6 +187,26 @@ def main(argv=None):
                 print(json.dumps(result), flush=True)
                 if not result["ok"]:
                     failures += 1
+    # one decode-faulted cell per seed with the Pallas paged decode
+    # kernel gate on (interpret mode on CPU): retry/restart replay
+    # must stay bit-exact through the kernel path too, against a
+    # kernel-enabled unfaulted reference
+    from paddle_tpu.ops import paged_attention as paged_attn_mod
+    paged_attn_mod._FORCE_INTERPRET[0] = True
+    try:
+        reference, _, _, _ = _drain(model, specs, True, chunk=chunk,
+                                    paged_attn=True)
+        assert reference is not None, "pallas reference drain hung"
+        for seed in seeds:
+            cells += 1
+            result = _check_cell("decode_dispatch", seed, model,
+                                 specs, reference, True, chunk,
+                                 paged_attn=True)
+            print(json.dumps(result), flush=True)
+            if not result["ok"]:
+                failures += 1
+    finally:
+        paged_attn_mod._FORCE_INTERPRET[0] = False
     print(json.dumps({"summary": True, "cells": cells,
                       "failures": failures}), flush=True)
     return 1 if failures else 0

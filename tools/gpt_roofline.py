@@ -13,10 +13,11 @@ direction #2's "roofline first" step, shared with the engine's
 snapshot()["perf"] via paddle_tpu/observability/perf/roofline.py,
 loaded directly by file so this tool never imports jax): KV-read
 bytes per token as a function of batch, context length, heads and
-paged-vs-contiguous layout, the parameter re-read every step pays,
-and the resulting per-step floor — printed for BOTH layouts so the
-XLA gather-materialization tax the Pallas paged-attention kernel
-would delete is a number, not a vibe.
+layout (contiguous / paged_xla / paged_pallas), the parameter re-read
+every step pays, and the resulting per-step floor — printed for all
+THREE layouts so the XLA gather-materialization tax, and what the
+Pallas paged-attention kernel (PADDLE_PAGED_ATTN) buys back by
+deleting it, are numbers, not vibes.
 
 Usage: python tools/gpt_roofline.py [batch seq]           (train step)
        python tools/gpt_roofline.py --decode [batch ctx]  (decode step)
@@ -97,19 +98,21 @@ def _load_roofline_module():
 
 def decode_budget(batch, ctx):
     """Decode-step HBM model for GPT-124M at (batch slots, ctx cached
-    positions), contiguous vs XLA-composed paged layout, bf16
-    params/KV on the v5e reference chip."""
+    positions), all three KV layouts — contiguous, XLA-composed paged
+    gather, and the in-place Pallas paged kernel — bf16 params/KV on
+    the v5e reference chip."""
     rf = _load_roofline_module()
     n_params = L * 12 * H * H + V * H + MAX_SEQ * H
     out = {"config": {"batch": batch, "ctx": ctx, "model": "gpt-124m",
                       "peak_flops": PEAK_FLOPS, "hbm_bps": HBM_BPS}}
-    for layout in ("contiguous", "paged_xla"):
+    for layout in rf.LAYOUTS:
         m = rf.decode_step_model(
             batch=batch, kv_len=ctx, num_layers=L, num_heads=HEADS,
             head_dim=H // HEADS, n_params=n_params, param_bytes=2,
-            kv_bytes=2, paged=(layout == "paged_xla"),
+            kv_bytes=2, layout=layout, live_kv_len=ctx,
             peak_flops=PEAK_FLOPS, hbm_bps=HBM_BPS)
         out[layout] = {
+            "gather_factor": m["gather_factor"],
             "kv_read_bytes_per_token": m["kv_read_bytes_per_token"],
             "bytes_total": m["bytes_total"],
             "flops": m["flops"],
@@ -122,6 +125,10 @@ def decode_budget(batch, ctx):
     out["paged_gather_tax"] = round(
         out["paged_xla"]["floor_us_per_step"]
         / out["contiguous"]["floor_us_per_step"], 3)
+    # what the Pallas kernel buys back at the floor: the whole tax
+    out["pallas_vs_paged_xla_x"] = round(
+        out["paged_xla"]["floor_us_per_step"]
+        / out["paged_pallas"]["floor_us_per_step"], 3)
     return out
 
 

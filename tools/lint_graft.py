@@ -13,6 +13,13 @@ Builds the three kinds of compiled programs this framework ships —
     that argument (the table is small and host-authored — donating it
     would be noise, and the donation pass's size floor keeps it
     silent);
+  * ``paged_decode_pallas`` — the paged engine again with the Pallas
+    paged decode-attention kernel enabled (``paged_attn=True``,
+    interpret mode forced so the kernel traces on this CPU lint run):
+    the decode jaxpr now embeds the ``pallas_call`` and the f64-upcast
+    + donation passes must stay clean across its boundary (the kernel
+    traces in 32-bit mode — pallas_compat — so an f64 leak here is a
+    real finding, not noise);
   * ``chunked_prefill``  — a chunked-prefill + per-slot-sampling
     engine (``prefill_chunk=``, ``sampling=True``): the chunk program
     (traced start/len/slot/final scalars + sampling params) and the
@@ -86,6 +93,36 @@ def lint_paged_decode():
     assert engine.metrics.snapshot()["prefix_cache"]["hits"] >= 1, \
         "paged lint target never exercised the prefix cache"
     return engine.lint()
+
+
+def lint_paged_decode_pallas():
+    import paddle_tpu as paddle
+    from paddle_tpu.ops import paged_attention as paged_attn
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    paddle.seed(7)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    # force interpret so the kernel_viable guard admits the kernel on
+    # this CPU run and the decode program embeds the real pallas_call
+    paged_attn._FORCE_INTERPRET[0] = True
+    try:
+        engine = ServingEngine(model, num_slots=4, paged=True,
+                               block_size=8, paged_attn=True)
+        rs = np.random.RandomState(0)
+        for n in (5, 9):
+            engine.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                               max_new_tokens=4)
+        engine.run()
+        engine.declare_warmup()
+        assert engine.decode_layout == "paged_pallas", \
+            "pallas lint target fell back to the XLA gather path"
+        return engine.lint()
+    finally:
+        paged_attn._FORCE_INTERPRET[0] = False
 
 
 def lint_chunked_prefill():
@@ -165,6 +202,7 @@ def lint_to_static_sample():
 TARGETS = {
     "serving_decode": lint_serving_decode,
     "paged_decode": lint_paged_decode,
+    "paged_decode_pallas": lint_paged_decode_pallas,
     "chunked_prefill": lint_chunked_prefill,
     "hapi_train_step": lint_hapi_train_step,
     "to_static_sample": lint_to_static_sample,
