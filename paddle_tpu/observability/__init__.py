@@ -102,8 +102,9 @@ from .health import (  # noqa: F401
     register_detector, unregister_detector,
 )
 from .perf import (  # noqa: F401
-    PERF_KEYS, PERF_PROGRAM_KEYS, ProgramPerf, disabled_perf_report,
-    format_program_key, hbm_bps_for,
+    PERF_KEYS, PERF_PROGRAM_KEYS, PERF_SPEC_KEYS, ProgramPerf,
+    disabled_perf_report, disabled_spec_report, format_program_key,
+    hbm_bps_for,
 )
 from .registry import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, MetricsServerHandle,

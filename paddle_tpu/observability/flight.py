@@ -51,6 +51,8 @@ CALLBACK_ERROR = "callback_error"
 DEADLINE_EXCEEDED = "deadline_exceeded"
 FIRST_TOKEN = "first_token"
 DECODE_WINDOW = "decode_window"
+DRAFT_ACCEPTED = "draft_accepted"
+DRAFT_REJECTED = "draft_rejected"
 RETIRED = "retired"
 
 
@@ -256,6 +258,22 @@ class FlightRecorder:
             self._event(req.rid, FIRST_TOKEN, "t", {})
         elif n % self.decode_window == 0:
             self._event(req.rid, DECODE_WINDOW, "t", {"tokens": n})
+
+    def draft_accepted(self, req, accepted, drafted):
+        """One verify dispatch kept ``accepted`` of this request's
+        ``drafted`` speculative tokens (plus the bonus token the
+        verify step always yields — counted by token_emitted)."""
+        self._event(req.rid, DRAFT_ACCEPTED, "t",
+                    {"accepted": int(accepted),
+                     "drafted": int(drafted)})
+
+    def draft_rejected(self, req, rejected, drafted):
+        """One verify dispatch discarded ``rejected`` of this
+        request's ``drafted`` speculative tokens (the tail after the
+        first mismatch with the model's greedy choice)."""
+        self._event(req.rid, DRAFT_REJECTED, "t",
+                    {"rejected": int(rejected),
+                     "drafted": int(drafted)})
 
     def retired(self, req, reason, **attrs):
         """Close the request's trace (reason: "eos" / "max_tokens" /

@@ -25,8 +25,9 @@ tools load them via importlib without importing paddle_tpu (no jax at
 tool startup).
 """
 from .attribution import (  # noqa: F401
-    PERF_KEYS, PERF_PROGRAM_KEYS, ProgramPerf, build_decode_model,
-    disabled_perf_report, format_program_key,
+    PERF_KEYS, PERF_PROGRAM_KEYS, PERF_SPEC_KEYS, ProgramPerf,
+    build_decode_model, disabled_perf_report, disabled_spec_report,
+    format_program_key,
 )
 from .ledger import (  # noqa: F401
     LEDGER_ROW_KEYS, PERF_LEDGER_SCHEMA, append_rows, compact,

@@ -34,12 +34,22 @@ from .roofline import (REF_HBM_BPS, REF_PEAK_FLOPS, decode_step_model,
                        roofline_floor)
 
 __all__ = ["ProgramPerf", "disabled_perf_report",
-           "format_program_key", "PERF_KEYS", "PERF_PROGRAM_KEYS"]
+           "disabled_spec_report", "format_program_key", "PERF_KEYS",
+           "PERF_PROGRAM_KEYS", "PERF_SPEC_KEYS"]
 
 # snapshot()["perf"] schema contract (additions only, never renames)
 PERF_KEYS = (
     "enabled", "device", "programs", "attributed_s", "step_total_s",
-    "attributed_fraction", "decode_roofline",
+    "attributed_fraction", "decode_roofline", "spec",
+)
+# the "spec" sub-section (speculative-decoding economy; the serving
+# metrics facade fills it from its counters, this module only pins the
+# disabled shape so the schema contract holds on bare reports)
+PERF_SPEC_KEYS = (
+    "enabled", "k", "drafted_tokens", "accepted_tokens",
+    "rejected_tokens", "emitted_tokens", "verify_steps", "slot_steps",
+    "fallback_steps", "acceptance_rate",
+    "effective_tokens_per_dispatch",
 )
 # per-program entry schema inside "programs"
 PERF_PROGRAM_KEYS = (
@@ -66,13 +76,25 @@ def format_program_key(key):
     return "/".join(str(p) for p in key)
 
 
+def disabled_spec_report():
+    """The ``perf["spec"]`` section when speculative decoding is off
+    (or the report is produced outside a serving engine) — same key
+    set as the live section the serving metrics facade fills."""
+    return {"enabled": False, "k": None, "drafted_tokens": 0,
+            "accepted_tokens": 0, "rejected_tokens": 0,
+            "emitted_tokens": 0, "verify_steps": 0, "slot_steps": 0,
+            "fallback_steps": 0, "acceptance_rate": None,
+            "effective_tokens_per_dispatch": None}
+
+
 def disabled_perf_report():
     """The ``snapshot()["perf"]`` section of an engine built with
     perf=False — same key set as a live report, so the snapshot
     schema contract holds either way."""
     return {"enabled": False, "device": None, "programs": {},
             "attributed_s": 0.0, "step_total_s": None,
-            "attributed_fraction": None, "decode_roofline": None}
+            "attributed_fraction": None, "decode_roofline": None,
+            "spec": disabled_spec_report()}
 
 
 class _Program:
@@ -300,6 +322,9 @@ class ProgramPerf:
             "attributed_fraction": round(attributed / step_total_s, 4)
             if step_total_s else None,
             "decode_roofline": decode_roofline,
+            # overwritten by the serving metrics facade with the live
+            # speculation economy; the key exists on every report
+            "spec": disabled_spec_report(),
         }
 
 

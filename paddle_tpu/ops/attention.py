@@ -436,6 +436,56 @@ def cached_paged_attention(q, k_cache, v_cache, block_tables, lengths):
     return cached_slot_attention(q, k, v, lengths)
 
 
+def cached_slot_block_attention(q, k_cache, v_cache, qpos):
+    """Multi-query decode attention over a slot-pooled static cache:
+    the t-token generalization of cached_slot_attention, used by the
+    speculative k-token verify program (serving.spec.programs) where
+    every slot scores t = k+1 candidate positions in one dispatch.
+
+    q [S, nh, t, hd] — t new-token queries per slot (the slot's last
+    accepted token plus its k drafted continuations);
+    k_cache/v_cache [S, nh, C, hd] — each slot's full static cache,
+    INCLUDING the t candidate rows this dispatch just wrote;
+    qpos [S, t] int — the cache position of each query.
+
+    Per-query causal masking ``kpos <= qpos[s, i]`` makes query i see
+    exactly the slot's live prefix plus candidates 0..i — so logits at
+    position i are conditioned only on the (accepted-by-construction)
+    prefix of the draft, which is what makes longest-accepted-prefix
+    harvest bit-exact with one-token-at-a-time greedy decode. For
+    t = 1 and qpos = lengths - 1 this IS cached_slot_attention's mask;
+    stale rows beyond qpos (a recycled slot's previous occupant, or a
+    rejected draft tail from a previous verify step) carry exactly-zero
+    softmax weight."""
+    hd = q.shape[-1]
+    cache_len = k_cache.shape[2]
+    s = jnp.einsum("shtd,shkd->shtk", q, k_cache,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+        jnp.float32(hd))
+    kpos = jnp.arange(cache_len)[None, None, None, :]
+    s = jnp.where(kpos <= qpos[:, None, :, None], s,
+                  jnp.float32(-1e30))
+    return jnp.einsum("shtk,shkd->shtd", jax.nn.softmax(s, axis=-1),
+                      v_cache, preferred_element_type=jnp.float32)
+
+
+def cached_paged_block_attention(q, k_cache, v_cache, block_tables,
+                                 qpos):
+    """Multi-query decode attention over a PAGED cache: the t-token
+    generalization of cached_paged_attention for the speculative
+    verify program on the paged pool. Same gather-to-contiguous
+    baseline (view index block*BS + offset IS the cache position),
+    then cached_slot_block_attention's per-query causal mask — trash-
+    block rows a padding table entry gathered sit beyond every qpos
+    and carry exactly-zero weight."""
+    S, nh, t, hd = q.shape
+    k = jnp.take(k_cache, block_tables, axis=0)  # [S, MB, nh, BS, hd]
+    v = jnp.take(v_cache, block_tables, axis=0)
+    k = k.transpose(0, 2, 1, 3, 4).reshape(S, nh, -1, hd)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(S, nh, -1, hd)
+    return cached_slot_block_attention(q, k, v, qpos)
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, name=None):
     out = scaled_dot_product_attention(query, key, value, is_causal=causal)

@@ -134,6 +134,26 @@ math into a multi-tenant server:
     ``tools/fleet_top.py --router``), and a kill-a-replica drill
     (``tools/router_drill.py``) that proves 100% completion + parity
     + zero leaks where a no-failover baseline loses in-flight work;
+  * **self-drafting speculative decoding** (serving.spec, PR 16 —
+    default-off: ``speculative=True`` / ``PADDLE_SPEC_DECODE=1``) —
+    an n-gram/prompt-lookup drafter over each slot's own context (no
+    second model; bounded, incremental, radix-aware: shared prompts
+    share draft statistics) proposes up to ``spec_k`` tokens per
+    slot, and ONE extra AOT program flavor per pool
+    (``spec_verify`` / ``paged_spec_verify``) verifies all k+1
+    positions in a single fixed-shape dispatch — amortizing the
+    HBM-bound parameter + KV read plain decode pays per token.
+    Greedy streams stay bit-exact with ``generate()`` by construction
+    (per-query causal masking + longest-accepted-prefix harvest);
+    per-request EWMA acceptance below ``spec_min_accept`` falls that
+    request back to plain decode, and a step where nobody drafts
+    dispatches the plain decode program (both flavors warm at the
+    first decode, so the steady state never compiles).
+    ``snapshot()["perf"]["spec"]`` carries the economy (acceptance
+    rate, effective tokens per slot-dispatch, drafted / accepted /
+    rejected counters); the flight recorder logs ``draft_accepted`` /
+    ``draft_rejected`` per verify; greedy-only (speculation x
+    sampling is rejected at config time);
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program
@@ -262,6 +282,16 @@ Tuning knobs
                 ``serving_uptime_seconds`` exposition — what
                 ``observability.fleet.FleetPoller`` and the /fleet/*
                 surface key replicas by.
+``speculative`` / ``spec_k`` / ``spec_min_accept``
+                self-drafting speculative decoding (serving.spec):
+                None (default) consults ``PADDLE_SPEC_DECODE``;
+                ``spec_k`` (default 4, must be >= 1) is the draft
+                width — each verify dispatch runs ``spec_k + 1``
+                positions per slot and emits 1..spec_k+1 tokens;
+                ``spec_min_accept`` (default 0.35) is the per-request
+                EWMA acceptance floor below which the request falls
+                back to plain decode. Greedy-only: combining with
+                ``sampling=True`` raises at config time.
 ``eos_id``      default stop token (per-request override on
                 add_request).
 
@@ -288,3 +318,4 @@ from .sched import (  # noqa: F401
     SlotSampler, plan_chunks,
 )
 from .scheduler import Request, StepScheduler  # noqa: F401
+from .spec import NGramDrafter, SpecDecoder  # noqa: F401

@@ -180,7 +180,8 @@ class ServingConfig:
                  supervisor=None, supervisor_max_restarts=8,
                  supervisor_cooldown_s=1.0, perf=None,
                  cache_observatory=None, cache_sample_rate=0.125,
-                 replica_id=None):
+                 replica_id=None, speculative=None, spec_k=4,
+                 spec_min_accept=0.35):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -359,6 +360,33 @@ class ServingConfig:
         if replica_id is None:
             replica_id = os.environ.get("PADDLE_REPLICA_ID") or None
         self.replica_id = replica_id
+        # self-drafting speculative decoding (serving.spec): None =
+        # the PADDLE_SPEC_DECODE env gate (default off — plain
+        # one-token decode stays the measured fallback, same playbook
+        # as PADDLE_PAGED_KV). spec_k is the draft width: the verify
+        # program runs [slots, spec_k + 1] positions per dispatch and
+        # emits 1..spec_k+1 tokens. spec_min_accept is the per-request
+        # EWMA acceptance floor below which a request falls back to
+        # plain decode (its slot stops drafting). Greedy-only: the
+        # acceptance rule compares drafts against argmax, which is
+        # exact for greedy but would bias sampled streams, so
+        # speculation x sampling is rejected outright.
+        if speculative is None:
+            speculative = os.environ.get("PADDLE_SPEC_DECODE", "0") == "1"
+        self.speculative = bool(speculative)
+        self.spec_k = int(spec_k)
+        self.spec_min_accept = float(spec_min_accept)
+        if self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        if not 0.0 <= self.spec_min_accept <= 1.0:
+            raise ValueError(
+                f"spec_min_accept must be in [0, 1], got "
+                f"{spec_min_accept}")
+        if self.speculative and self.sampling:
+            raise ValueError(
+                "speculative decoding is greedy-only (draft acceptance "
+                "compares against argmax); drop sampling=True or "
+                "speculative=True")
 
 
 class ServingEngine:
@@ -456,6 +484,36 @@ class ServingEngine:
         # the roofline prices (observability.perf.roofline.LAYOUTS)
         self.decode_layout = "paged_pallas" if self.paged_attn \
             else ("paged_xla" if self.paged else "contiguous")
+        # speculative decoding (serving.spec): ONE extra verify program
+        # flavor per pool + the host-side drafter/acceptance gate. The
+        # plain decode program stays built either way — it is the
+        # per-step fallback whenever no slot drafts, so BOTH programs
+        # warm at the first decode-capable dispatch (zero steady-state
+        # compiles regardless of which one a later step needs).
+        self.speculative = bool(config.speculative)
+        self.spec_k = int(config.spec_k)
+        if self.speculative:
+            if self.spec_k + 1 > cache_len:
+                raise ValueError(
+                    f"spec_k + 1 ({self.spec_k + 1}) exceeds the "
+                    f"per-slot cache capacity {cache_len}")
+            from .spec import SpecDecoder
+            if self.paged:
+                self._verify_fn = model.build_paged_spec_verify_fn(
+                    config.num_slots, self.pool.block_size,
+                    self.pool.num_blocks, self.pool.blocks_per_slot,
+                    self.spec_k)
+                self._verify_key = ("paged_spec_verify",)
+            else:
+                self._verify_fn = model.build_spec_verify_fn(
+                    config.num_slots, cache_len, self.spec_k)
+                self._verify_key = ("spec_verify",)
+            self._spec = SpecDecoder(config.num_slots, self.spec_k,
+                                     config.spec_min_accept)
+        else:
+            self._verify_fn = None
+            self._verify_key = None
+            self._spec = None
         from .sched import ChunkPlan, SlotSampler, resolve_policy
         self._ChunkPlan = ChunkPlan
         self._sampler = SlotSampler(config.num_slots) \
@@ -478,6 +536,7 @@ class ServingEngine:
             cache=config.cache_observatory,
             cache_sample_rate=config.cache_sample_rate)
         self._perf_on = config.perf
+        self.metrics.set_spec(self.speculative, self.spec_k)
         # replica identity: who this engine is in a fleet of
         # lookalikes — uptime + build-info gauges in the exposition,
         # and a "replica" section on snapshot()/debug/state/incidents
@@ -906,6 +965,8 @@ class ServingEngine:
             "paged": self.paged,
             "paged_attn": self.paged_attn,
             "decode_layout": self.decode_layout,
+            "speculative": self.speculative,
+            "spec_k": self.spec_k,
             "prefix_cache": self.metrics.prefix_cache_report(),
             "cache": self.metrics.cache_report(),
             "scheduler": dict(
@@ -922,9 +983,10 @@ class ServingEngine:
         runs through the ``f64-upcast`` / ``host-callback`` / ``donation``
         passes, and the engine's compile watchdog feeds
         ``dynamic-shape-risk``. ``program`` picks the jaxpr:
-        "decode" (default) or "chunk" (the chunked-prefill program —
+        "decode" (default), "chunk" (the chunked-prefill program —
         legacy pool only; the paged flavor's chunks ARE its prefill
-        program). The donation metadata mirrors the real AOT build:
+        program) or "spec_verify" (the speculative k-token verify
+        flavor of whichever pool this engine runs). The donation metadata mirrors the real AOT build:
         kc/vc/pos donated iff ``self._donate``
         (``metrics.kv_donation["enabled"]``), aliasing iff the backend
         aliases donated buffers (``kv_donation["effective"]`` on) — so
@@ -949,6 +1011,24 @@ class ServingEngine:
                                np.int32(0), np.float32(1.0))
             fn = self._chunk_fn
             donate = (7, 8, 9) if self._donate else ()
+        elif program == "spec_verify":
+            if self._verify_fn is None:
+                raise ValueError(
+                    "no verify program on this engine "
+                    "(ServingConfig(speculative=True) builds one)")
+            S = self.config.num_slots
+            drafts = np.zeros((S, self.spec_k), np.int32)
+            dlen = np.zeros((S,), np.int32)
+            if self.paged:
+                args = (self.params, self._toks, self._pos, drafts,
+                        dlen, self.pool.device_tables(), self.pool.kc,
+                        self.pool.vc)
+                donate = (2, 6, 7) if self._donate else ()
+            else:
+                args = (self.params, self._toks, self._pos, drafts,
+                        dlen, self.pool.kc, self.pool.vc)
+                donate = (2, 5, 6) if self._donate else ()
+            fn = self._verify_fn
         elif self.paged:
             args = (self.params, self._toks, self._pos,
                     self.pool.device_tables(), self.pool.kc,
@@ -999,7 +1079,10 @@ class ServingEngine:
             "decode_flops_per_step": decode_flops,
             "decode_bytes_per_step": decode_bytes,
             "peak_flops": peak,
-            "estimated_mfu": round(mfu, 6) if mfu else None,
+            # significant figures, not decimal places: toy/CPU probe
+            # models run MFU in the 1e-7 range, which a round(_, 6)
+            # would collapse to 0.0
+            "estimated_mfu": float(f"{mfu:.4g}") if mfu else None,
             "device_memory": device_memory_stats(self._device),
             # prefill compute accounting: prefix-cache hits are SERVED
             # tokens, never prefill flops — only tokens_computed may
@@ -1087,6 +1170,48 @@ class ServingEngine:
                 for (req, slot), tok in zip(entry[2], vals):
                     req.inflight -= 1
                     self._emit(req, int(tok))
+            elif entry[0] == "spec":
+                out, acc = vals
+                drafted = entry[4]
+                for slot, req in entry[2].items():
+                    n_draft = drafted.get(slot, 0)
+                    if req.state != RUNNING:
+                        # retired after dispatch (EOS on a prior
+                        # token): the whole candidate block is
+                        # speculative — masked, exactly like the
+                        # plain-decode case, plus its drafts count as
+                        # rejected
+                        M.speculative_masked += 1
+                        if n_draft:
+                            M.spec_drafted += n_draft
+                            M.spec_rejected += n_draft
+                        continue
+                    req.inflight -= 1
+                    M.spec_slot_steps += 1
+                    n_acc = int(acc[slot])
+                    # longest-accepted-prefix harvest: the n_acc
+                    # accepted drafts plus the model's bonus token at
+                    # out[slot, n_acc]; _emit's stop check runs per
+                    # token, so an EOS inside the block retires the
+                    # request mid-block and the tail never surfaces
+                    emitted = 0
+                    for i in range(n_acc + 1):
+                        self._emit(req, int(out[slot, i]))
+                        emitted += 1
+                        if req.state != RUNNING:
+                            break
+                    M.spec_tokens_emitted += emitted
+                    if n_draft:
+                        M.spec_drafted += n_draft
+                        M.spec_accepted += n_acc
+                        M.spec_rejected += n_draft - n_acc
+                        self._spec.observe(req.rid, n_draft, n_acc)
+                        if n_acc:
+                            self.flight.draft_accepted(req, n_acc,
+                                                       n_draft)
+                        if n_draft > n_acc:
+                            self.flight.draft_rejected(
+                                req, n_draft - n_acc, n_draft)
             else:
                 for slot, req in entry[2].items():
                     if req.state != RUNNING:
@@ -1111,6 +1236,9 @@ class ServingEngine:
             try:
                 if self.chaos is not None:
                     self.chaos.maybe_raise("transfer")
+                if isinstance(device_vals, tuple):
+                    # spec entries read back (out, accepted) together
+                    return tuple(np.asarray(v) for v in device_vals)
                 return np.asarray(device_vals)
             except Exception as e:  # noqa: BLE001 - gated below
                 self.metrics.record_dispatch_failure("transfer")
@@ -1119,6 +1247,37 @@ class ServingEngine:
                     raise
                 attempt += 1
                 self.metrics.record_retry()
+
+    def _decode_dispatch_args(self, pool):
+        """(args, donate_argnums) for the plain pooled decode program
+        — one place, shared by the hot path and the warm-both-flavors
+        discipline of the speculative schedule."""
+        if self.paged:
+            args = (self.params, self._toks, self._pos,
+                    pool.device_tables(), pool.kc, pool.vc)
+            donate = (2, 4, 5)
+        else:
+            args = (self.params, self._toks, self._pos, pool.kc,
+                    pool.vc)
+            donate = (2, 3, 4)
+        if self.sampling:
+            args = args + self._sampler.device_arrays()
+        return args, donate
+
+    def _verify_dispatch_args(self, pool, drafts, dlen):
+        """(args, donate_argnums) for the k-token verify flavor.
+        drafts/dlen are fixed-shape host arrays ([S, k] / [S]); the
+        cache and pos donate exactly like plain decode (the two extra
+        leading host inputs shift the argnums)."""
+        if self.paged:
+            args = (self.params, self._toks, self._pos, drafts, dlen,
+                    pool.device_tables(), pool.kc, pool.vc)
+            donate = (2, 6, 7)
+        else:
+            args = (self.params, self._toks, self._pos, drafts, dlen,
+                    pool.kc, pool.vc)
+            donate = (2, 5, 6)
+        return args, donate
 
     def step(self):
         """One engine iteration of the pipelined hot path:
@@ -1169,6 +1328,17 @@ class ServingEngine:
         prev, self._pending = self._pending, []
         epoch = self._restart_epoch
 
+        if self._spec is not None and prev:
+            # speculative schedule: drafts extend the request's last
+            # HARVESTED token, so the previous step's in-flight results
+            # are consumed BEFORE proposing. The verify dispatch still
+            # overlaps all of this step's host bookkeeping — the
+            # pipeline depth is unchanged, only the harvest moves from
+            # the tail of the step to its head.
+            with M.span("serving/harvest"):
+                self._harvest(prev)
+            prev = []
+
         if self.chaos is not None \
                 and self.chaos.fires("step_latency",
                                      step=self._step_id + 1):
@@ -1203,18 +1373,25 @@ class ServingEngine:
                     if not sch.saturated(req)
                     and slot not in self._prefilling}
         if snapshot:
+            spec = self._spec
+            drafted = None
+            if spec is not None:
+                with M.span("serving/draft"):
+                    drafts, dlen, drafted = spec.propose(snapshot)
+                if not drafted:
+                    # nobody drafted this step — dispatch the plain
+                    # decode program outright (per-slot fallbacks with
+                    # dlen=0 still ride the verify program whenever at
+                    # least one slot drafts)
+                    drafted = None
+            use_spec = drafted is not None
             for req in snapshot.values():
                 req.inflight += 1
-            if self.paged:
-                args = (self.params, self._toks, self._pos,
-                        pool.device_tables(), pool.kc, pool.vc)
-                donate = (2, 4, 5)
-            else:
-                args = (self.params, self._toks, self._pos, pool.kc,
-                        pool.vc)
-                donate = (2, 3, 4)
-            if self.sampling:
-                args = args + self._sampler.device_arrays()
+            args, donate = self._decode_dispatch_args(pool)
+            if spec is not None:
+                v_args, v_donate = self._verify_dispatch_args(
+                    pool, drafts, dlen)
+            key = self._verify_key if use_spec else ("decode",)
             ok = False
             try:
                 if self.chaos is not None:
@@ -1222,9 +1399,22 @@ class ServingEngine:
                                            step=self._step_id + 1)
                 ex = self._compiled(("decode",), self._decode_fn, args,
                                     donate=donate)
-                with M.span("serving/decode_dispatch"):
-                    nxt, self._pos, kc, vc = self._timed_call(
-                        ("decode",), ex, args)
+                if spec is not None:
+                    # BOTH flavors warm up-front regardless of which
+                    # one this step needs: a later acceptance-collapse
+                    # fallback (plain decode) or first n-gram hit
+                    # (verify) must never compile in steady state
+                    ex_v = self._compiled(self._verify_key,
+                                          self._verify_fn, v_args,
+                                          donate=v_donate)
+                if use_spec:
+                    with M.span("serving/decode_dispatch"):
+                        out, acc, nxt, self._pos, kc, vc = \
+                            self._timed_call(key, ex_v, v_args)
+                else:
+                    with M.span("serving/decode_dispatch"):
+                        nxt, self._pos, kc, vc = self._timed_call(
+                            ("decode",), ex, args)
                 ok = True
             except BaseException as e:
                 # the dispatch never ran (chaos injects BEFORE the
@@ -1241,7 +1431,13 @@ class ServingEngine:
                 self._toks = nxt
                 M.decode_steps += 1
                 self._decode_fail_streak = 0
-                entry = ("decode", nxt, snapshot, ("decode",))
+                if use_spec:
+                    M.spec_verify_steps += 1
+                    entry = ("spec", (out, acc), snapshot, key, drafted)
+                else:
+                    if spec is not None:
+                        M.spec_fallback_steps += 1
+                    entry = ("decode", nxt, snapshot, ("decode",))
                 if sync:
                     self._harvest([entry])
                 else:
@@ -1812,6 +2008,13 @@ class ServingEngine:
             # outside supervisor restarts" stays a checkable invariant
             self._exec = {}
             self.watchdog.reopen_warmup()
+            if self._spec is not None:
+                # slot bindings and draft indices describe the
+                # pre-restart schedule; replay re-syncs them from each
+                # request's journaled prompt + generated tokens (and
+                # parity never depends on draft content, so the
+                # rebuilt drafter proposing differently is harmless)
+                self._spec.reset()
             self._slot_failures.clear()
             self._decode_fail_streak = 0
             self._retry_at = 0.0

@@ -232,6 +232,36 @@ class ServingMetrics:
             "supervisor_restarts_total",
             "in-process supervisor recoveries (AOT tables rebuilt, "
             "pools reset, in-flight requests replayed)")
+        # speculative-decoding economy (serving.spec): what the
+        # drafter shipped, what the verify program kept, and how many
+        # tokens each verify dispatch actually yielded
+        self._c_spec_drafted = r.counter(
+            "serving_spec_drafted_tokens_total",
+            "draft tokens shipped to verify dispatches")
+        self._c_spec_accepted = r.counter(
+            "serving_spec_accepted_tokens_total",
+            "draft tokens accepted (longest-accepted-prefix)")
+        self._c_spec_rejected = r.counter(
+            "serving_spec_rejected_tokens_total",
+            "draft tokens rejected at verify (including drafts masked "
+            "with a retired request)")
+        self._c_spec_emitted = r.counter(
+            "serving_spec_emitted_tokens_total",
+            "tokens emitted by verify dispatches (accepted drafts "
+            "plus the bonus token, after stop masking)")
+        self._c_spec_verify_steps = r.counter(
+            "serving_spec_verify_steps_total",
+            "k-token verify dispatches")
+        self._c_spec_slot_steps = r.counter(
+            "serving_spec_slot_steps_total",
+            "per-slot verify legs harvested (one slot in one verify "
+            "dispatch; a plain decode leg emits exactly 1 token, so "
+            "emitted/slot_steps is the per-slot amortization factor)")
+        self._c_spec_fallback_steps = r.counter(
+            "serving_spec_fallback_steps_total",
+            "decode-capable steps on a speculative engine dispatched "
+            "on the plain decode program (no slot drafted)")
+        self._spec_info = {"enabled": False, "k": None}
         self._resilience_fn = None
         self._sched_info = {"policy": "fifo", "prefill_chunk": None,
                             "prefill_token_budget": None}
@@ -260,6 +290,13 @@ class ServingMetrics:
     speculative_masked = _counter_property("_c_spec_masked")
     requests_admitted = _counter_property("_c_admitted")
     requests_completed = _counter_property("_c_completed")
+    spec_drafted = _counter_property("_c_spec_drafted")
+    spec_accepted = _counter_property("_c_spec_accepted")
+    spec_rejected = _counter_property("_c_spec_rejected")
+    spec_tokens_emitted = _counter_property("_c_spec_emitted")
+    spec_verify_steps = _counter_property("_c_spec_verify_steps")
+    spec_slot_steps = _counter_property("_c_spec_slot_steps")
+    spec_fallback_steps = _counter_property("_c_spec_fallback_steps")
 
     @property
     def queue_depth(self):
@@ -658,13 +695,51 @@ class ServingMetrics:
         either way)."""
         return self.cache.report()
 
+    def set_spec(self, enabled, k):
+        """Engine wiring: record whether speculative decoding is on
+        (and its draft width) so perf_report's ``spec`` section can
+        tell "off" apart from "on but nothing drafted yet"."""
+        self._spec_info = {"enabled": bool(enabled),
+                           "k": int(k) if enabled else None}
+
+    def spec_report(self):
+        """The ``perf["spec"]`` section: speculation economy from the
+        live counters (observability.perf.PERF_SPEC_KEYS pins the key
+        set; the disabled shape keeps it when speculation is off)."""
+        drafted = self.spec_drafted
+        slot_steps = self.spec_slot_steps
+        return {
+            "enabled": self._spec_info["enabled"],
+            "k": self._spec_info["k"],
+            "drafted_tokens": drafted,
+            "accepted_tokens": self.spec_accepted,
+            "rejected_tokens": self.spec_rejected,
+            "emitted_tokens": self.spec_tokens_emitted,
+            "verify_steps": self.spec_verify_steps,
+            "slot_steps": slot_steps,
+            "fallback_steps": self.spec_fallback_steps,
+            "acceptance_rate":
+                round(self.spec_accepted / drafted, 4) if drafted
+                else None,
+            # tokens one slot yields from one verify leg: a plain
+            # decode leg is exactly 1.0, so this IS the per-slot
+            # HBM-read amortization factor
+            "effective_tokens_per_dispatch":
+                round(self.spec_tokens_emitted / slot_steps, 4)
+                if slot_steps else None,
+        }
+
     def perf_report(self):
         """The ``snapshot()["perf"]`` / ``/debug/perf`` body:
         per-program measured time + roofline fractions, with the
         accrued ``serving/step`` span seconds as the attribution
-        denominator."""
-        return self.perf.report(
+        denominator — plus the speculation economy under ``spec``
+        (the one perf section fed by engine counters rather than
+        dispatch timing, so it lives here, not in ProgramPerf)."""
+        report = self.perf.report(
             step_total_s=self.span_s.get("serving/step"))
+        report["spec"] = self.spec_report()
+        return report
 
     def prometheus_text(self):
         """This engine's registry in Prometheus text exposition format

@@ -1,0 +1,101 @@
+"""Host-side speculation coordinator: owns the drafter, the per-slot
+fixed-shape draft buffers the AOT verify program consumes, and the
+EWMA acceptance gate that falls a request back to plain decode when
+its drafts stop landing (the PR-7 policy-feedback pattern: observe a
+live signal, gate a scheduling decision on its smoothed value).
+
+The engine calls ``propose(snapshot)`` once per decode-capable step
+(AFTER harvesting any in-flight results — speculation drafts from the
+request's last HARVESTED token, so the engine's spec schedule
+harvests before proposing; device toks/pos still chain device-side)
+and ``observe(...)`` once per verified slot at harvest.
+
+Gating, per request:
+
+  * draft width is capped at ``min(k, remaining - 1)`` — never draft
+    past ``max_new_tokens`` (the +1 is the verify step's guaranteed
+    bonus token), so a finishing request degrades to plain decode for
+    free instead of shipping tokens the harvest would discard;
+  * an EWMA of per-verify acceptance (accepted / drafted, seeded
+    optimistically at 1.0) below ``min_accept`` stops proposals for
+    that request — the verify program treats a zero-length draft as a
+    plain decode for that slot, and a step where NO slot drafts is
+    dispatched on the plain decode program outright;
+  * EWMA state is a bounded LRU (finished requests age out; no
+    per-request cleanup hook needed).
+"""
+from collections import OrderedDict
+
+import numpy as np
+
+from .drafter import NGramDrafter
+
+_EWMA_KEEP = 4096
+
+
+class SpecDecoder:
+    def __init__(self, num_slots, k, min_accept, ewma_alpha=0.3,
+                 drafter=None):
+        self.num_slots = int(num_slots)
+        self.k = int(k)
+        self.min_accept = float(min_accept)
+        self.alpha = float(ewma_alpha)
+        self.drafter = drafter if drafter is not None \
+            else NGramDrafter(k)
+        self._ewma = OrderedDict()        # rid -> smoothed acceptance
+
+    # -- per-step proposal -----------------------------------------
+
+    def propose(self, snapshot):
+        """snapshot: {slot: Request} (decode-eligible slots only).
+        Returns (drafts [S, k] int32, dlen [S] int32, drafted) with
+        drafted = {slot: n} for slots given a non-empty draft — empty
+        means the engine should dispatch the plain decode program."""
+        drafts = np.zeros((self.num_slots, self.k), np.int32)
+        dlen = np.zeros((self.num_slots,), np.int32)
+        drafted = {}
+        for slot, req in snapshot.items():
+            ids = req.prefill_ids
+            self.drafter.sync(slot, req.rid, ids)
+            if req.inflight or not req.generated:
+                # the device-side chained token is not in prefill_ids
+                # yet (freshly prefilled slot, or a result still in
+                # flight) — a draft here would extend the wrong token
+                continue
+            width = req.max_new_tokens - len(req.generated) - 1
+            if width < 1:
+                continue
+            if self._ewma.get(req.rid, 1.0) < self.min_accept:
+                continue
+            prop = self.drafter.propose(slot, width=width)
+            if not prop:
+                continue
+            n = len(prop)
+            drafts[slot, :n] = prop
+            dlen[slot] = n
+            drafted[slot] = n
+        return drafts, dlen, drafted
+
+    # -- harvest feedback ------------------------------------------
+
+    def observe(self, rid, drafted, accepted):
+        """Fold one verify outcome into the request's acceptance EWMA
+        (only meaningful when it actually drafted)."""
+        if drafted <= 0:
+            return
+        rate = accepted / drafted
+        old = self._ewma.pop(rid, 1.0)
+        self._ewma[rid] = self.alpha * rate + (1 - self.alpha) * old
+        while len(self._ewma) > _EWMA_KEEP:
+            self._ewma.popitem(last=False)
+
+    def acceptance_ewma(self, rid):
+        return self._ewma.get(rid, 1.0)
+
+    def reset(self):
+        """Supervisor restart: drop all draft state (replay rebuilds
+        context from the journaled prefill_ids bit-exactly)."""
+        self.drafter = NGramDrafter(
+            self.k, max_entries=self.drafter.max_entries,
+            shared_entries=self.drafter.shared_entries)
+        self._ewma.clear()

@@ -564,6 +564,29 @@ class GPTForCausalLM(nn.Layer):
                                sampling=sampling,
                                attn_kernel=attn_kernel)
 
+    def build_spec_verify_fn(self, num_slots, cache_len, spec_k):
+        """The speculative k-token verify program over the
+        slot-contiguous pool (serving.spec.programs): one fixed-shape
+        ``[S, k+1]``-position dispatch verifying each slot's k drafted
+        continuations against the model's own greedy choices —
+        longest-accepted-prefix on device, bit-exact with plain
+        decode by construction (ServingConfig(speculative=True))."""
+        from ..serving.spec.programs import build_spec_verify_fn
+        return build_spec_verify_fn(self.cfg, num_slots, cache_len,
+                                    spec_k)
+
+    def build_paged_spec_verify_fn(self, num_slots, block_size,
+                                   num_blocks, blocks_per_slot,
+                                   spec_k):
+        """Paged-pool analogue of build_spec_verify_fn: candidate K/V
+        rows scatter straight into each slot's privately-owned blocks
+        under PR 7's whole-position clamp (overflow rows trash-routed),
+        attention through the gathered block-table view."""
+        from ..serving.spec.programs import build_paged_spec_verify_fn
+        return build_paged_spec_verify_fn(
+            self.cfg, num_slots, block_size, num_blocks,
+            blocks_per_slot, spec_k)
+
     def build_chunk_prefill_fn(self, cache_len, sampling=False):
         """The chunked-prefill program over the slot-contiguous pool
         (serving.sched.programs.build_chunk_fns): one fixed-width

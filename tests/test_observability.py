@@ -438,10 +438,17 @@ _RESILIENCE_KEYS = {
     "supervisor", "chaos",
 }
 # the PR-10 performance observatory section: per-program measured
-# time + roofline fractions (same key set whether perf is on or off)
+# time + roofline fractions (same key set whether perf is on or off);
+# PR 16 adds the speculative-decoding economy under "spec"
 _PERF_KEYS = {
     "enabled", "device", "programs", "attributed_s", "step_total_s",
-    "attributed_fraction", "decode_roofline",
+    "attributed_fraction", "decode_roofline", "spec",
+}
+_PERF_SPEC_KEYS = {
+    "enabled", "k", "drafted_tokens", "accepted_tokens",
+    "rejected_tokens", "emitted_tokens", "verify_steps", "slot_steps",
+    "fallback_steps", "acceptance_rate",
+    "effective_tokens_per_dispatch",
 }
 _PERF_PROGRAM_KEYS = {
     "dispatches", "dispatch_s", "syncs", "sync_s", "total_s",
@@ -507,6 +514,9 @@ def test_serving_snapshot_schema_contract():
     perf = snap["perf"]
     assert set(perf) == _PERF_KEYS
     assert perf["enabled"] is True
+    # the spec sub-section keeps its shape with speculation off
+    assert set(perf["spec"]) == _PERF_SPEC_KEYS
+    assert perf["spec"]["enabled"] is False
     assert "decode" in perf["programs"]
     for entry in perf["programs"].values():
         assert set(entry) == _PERF_PROGRAM_KEYS
@@ -522,6 +532,7 @@ def test_serving_snapshot_schema_contract():
     off_perf = eng_noperf.metrics.snapshot()["perf"]
     assert set(off_perf) == _PERF_KEYS
     assert off_perf["enabled"] is False and off_perf["programs"] == {}
+    assert set(off_perf["spec"]) == _PERF_SPEC_KEYS
     # the PR-11 replica identity: a stable host:pid default id, a
     # live uptime clock, and the same facts on the health section
     rep = snap["replica"]

@@ -66,12 +66,12 @@ def _workload(n_requests=16):
 
 
 def _drain(model, specs, paged, chaos=None, chunk=None,
-           paged_attn=False):
+           paged_attn=False, spec=False):
     """One engine drain; returns (streams, engine, steps, fault_log)."""
     from paddle_tpu.serving import ServingEngine
     eng = ServingEngine(
         model, num_slots=4, bucket_min=8, paged=paged,
-        paged_attn=paged_attn,
+        paged_attn=paged_attn, speculative=spec,
         prefill_chunk=chunk, chaos=chaos, max_dispatch_retries=3,
         supervisor_cooldown_s=0.0, health_audit_every=8)
     reqs = [eng.add_request(p, max_new_tokens=k,
@@ -88,7 +88,7 @@ def _drain(model, specs, paged, chaos=None, chunk=None,
 
 
 def _check_cell(site, seed, model, specs, reference, paged, chunk,
-                paged_attn=False):
+                paged_attn=False, spec=False):
     """Run one (site, seed) cell twice; returns a result dict with
     ok=False and a reason on any contract break."""
     from paddle_tpu.serving.resilience import FaultPlan
@@ -99,10 +99,10 @@ def _check_cell(site, seed, model, specs, reference, paged, chunk,
         return FaultPlan(seed=seed, faults=faults)
 
     out = {"site": site, "seed": seed, "paged": paged,
-           "paged_attn": paged_attn, "ok": True}
+           "paged_attn": paged_attn, "spec": spec, "ok": True}
     streams, eng, steps, log = _drain(model, specs, paged,
                                       chaos=plan(), chunk=chunk,
-                                      paged_attn=paged_attn)
+                                      paged_attn=paged_attn, spec=spec)
     out["steps"] = steps
     if streams is None:
         return dict(out, ok=False, reason=f"hang: > {_MAX_STEPS} steps")
@@ -136,7 +136,8 @@ def _check_cell(site, seed, model, specs, reference, paged, chunk,
                     reason=f"{incomplete}/{len(specs)} incomplete")
     # determinism: same seed => identical fault log and streams
     streams2, _, _, log2 = _drain(model, specs, paged, chaos=plan(),
-                                  chunk=chunk, paged_attn=paged_attn)
+                                  chunk=chunk, paged_attn=paged_attn,
+                                  spec=spec)
     if log2 != log:
         return dict(out, ok=False, reason="fault log not deterministic")
     if streams2 != streams:
@@ -207,6 +208,27 @@ def main(argv=None):
                 failures += 1
     finally:
         paged_attn_mod._FORCE_INTERPRET[0] = False
+    # speculation-enabled cells per seed, both pools: decode faults now
+    # hit k-token verify dispatches too (same "decode_dispatch" site),
+    # and retry / supervisor-restart replay must stay bit-exact against
+    # a SPEC-ENABLED unfaulted reference (which itself is bit-exact
+    # with the plain reference by the acceptance construction — both
+    # invariants break loudly here if either drifts). Longer
+    # generations so the n-gram drafter actually proposes and verify
+    # dispatches really carry drafts when the faults land.
+    spec_specs = [(p, k + 8) for p, k in specs]
+    for paged in pools:
+        reference, _, _, _ = _drain(model, spec_specs, paged,
+                                    chunk=chunk, spec=True)
+        assert reference is not None, "spec reference drain hung"
+        for seed in seeds:
+            cells += 1
+            result = _check_cell("decode_dispatch", seed, model,
+                                 spec_specs, reference, paged, chunk,
+                                 spec=True)
+            print(json.dumps(result), flush=True)
+            if not result["ok"]:
+                failures += 1
     print(json.dumps({"summary": True, "cells": cells,
                       "failures": failures}), flush=True)
     return 1 if failures else 0

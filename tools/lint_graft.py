@@ -25,6 +25,12 @@ Builds the three kinds of compiled programs this framework ships —
     (traced start/len/slot/final scalars + sampling params) and the
     sampling decode linted via ``engine.lint(program="chunk")`` /
     ``engine.lint()`` — both must stay f64/donation clean;
+  * ``spec_verify``      — speculative-decoding engines on BOTH pools
+    (``speculative=True``): the k-token verify program
+    (``engine.lint(program="spec_verify")``) and the plain decode it
+    falls back to must all stay f64/donation clean — the verify
+    flavor donates kc/vc/pos exactly like decode, shifted past the
+    drafts/dlen host inputs;
   * ``hapi_train_step``  — a hapi.Model static-adapter train step
     (forward + loss + backward + optimizer captured as ONE to_static
     program), linted via ``TracedFunction.lint()``;
@@ -153,6 +159,38 @@ def lint_chunked_prefill():
     return engine.lint(program="chunk") + engine.lint()
 
 
+def lint_spec_verify():
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    paddle.seed(7)
+    cfg = TransformerLMConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                              num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    findings = []
+    for paged in (False, True):
+        engine = ServingEngine(model, num_slots=4, paged=paged,
+                               block_size=8, speculative=True, spec_k=4)
+        rs = np.random.RandomState(0)
+        for n in (5, 9, 17):
+            # greedy tiny-model decoding locks into cycles within a
+            # few tokens — 16 new tokens reliably gives the n-gram
+            # drafter self-matches, so verify steps actually dispatch
+            engine.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                               max_new_tokens=16)
+        engine.run()
+        engine.declare_warmup()
+        spec = engine.metrics.snapshot()["perf"]["spec"]
+        assert spec["verify_steps"] >= 1, \
+            "spec lint target never dispatched a verify step"
+        # the verify flavor AND the plain-decode fallback it shares the
+        # steady state with must both stay f64/donation clean
+        findings += engine.lint(program="spec_verify") + engine.lint()
+    return findings
+
+
 def lint_hapi_train_step():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -204,6 +242,7 @@ TARGETS = {
     "paged_decode": lint_paged_decode,
     "paged_decode_pallas": lint_paged_decode_pallas,
     "chunked_prefill": lint_chunked_prefill,
+    "spec_verify": lint_spec_verify,
     "hapi_train_step": lint_hapi_train_step,
     "to_static_sample": lint_to_static_sample,
 }
