@@ -174,6 +174,19 @@ _LEDGER_SPECS = (
      ("speculative", "effective_tokens_per_dispatch")),
     ("speculative", "spec_goodput_x", "ratio", "higher_better", 0.5,
      ("speculative", "goodput_x")),
+    # prefill/decode disaggregation (ISSUE 17): TTFT p99 under the
+    # 1P+2D topology (raw CPU ms on the smoke runner, hence the wide
+    # threshold), decode goodput of the disagg arm over 3 monolithic
+    # replicas on identical traffic (same-run ratio, stabler), and
+    # the KV wire unit's price — bytes moved per prefill token, a
+    # shape-determined constant that should only move when the wire
+    # format or the model geometry does
+    ("disagg", "disagg_ttft_p99_ms", "ms", "lower_better", 1.0,
+     ("disagg", "ttft", "disagg_p99_ms")),
+    ("disagg", "disagg_decode_goodput_x", "ratio", "higher_better",
+     0.5, ("disagg", "decode_goodput_x")),
+    ("disagg", "kv_wire_bytes_per_token", "bytes/token",
+     "lower_better", 0.35, ("disagg", "wire", "bytes_per_token")),
 )
 
 
@@ -394,6 +407,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     perf_sec = _perf_section(eng, health_sec)
     fleet_sec = _measure_fleet_poll(m_eng, num_slots, health_sec)
     router_sec = _measure_router(m_eng, num_slots)
+    disagg_sec = _measure_disagg(m_eng, num_slots)
     decode_kernel_sec = _measure_decode_kernel(m_eng, num_slots)
     speculative_sec = _measure_speculative(spec_cfg)
 
@@ -462,6 +476,12 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         # replica's in-flight work), and the probe-measured router
         # dispatch overhead (<5% of routed wall is the contract bar)
         "router": router_sec,
+        # PR 17 prefill/decode disaggregation: the same long-prompt/
+        # short-decode wave through 1P+2D (KV-block streaming over
+        # the router's two-hop path) vs 3 monolithic replicas — TTFT
+        # p99 + decode goodput must BOTH beat the monolithic arm, and
+        # the KV wire unit is priced in bytes per prefill token
+        "disagg": disagg_sec,
         # PR 15 decode-kernel A/B: XLA paged gather vs the Pallas
         # paged-attention kernel on identical traffic — bit-exact
         # greedy parity between the arms, per-arm decode avg_ms +
@@ -1104,6 +1124,128 @@ def _measure_router(model, num_slots):
             # wall clock (<5% contract bar)
             "overhead_frac": round(over_s / wall3, 6)
             if wall3 else None,
+        },
+    }
+
+
+def _measure_disagg(model, num_slots):
+    """The artifact's ``disagg`` section (ISSUE 17): prefill/decode
+    disaggregation over the router. The SAME long-prompt/short-decode
+    wave runs through two in-process arms —
+
+      * **monolithic baseline** — 3 monolithic paged replicas: every
+        replica interleaves 40-token prefills with its decode steps,
+        so a queued prefill waits behind other requests' decode
+        dispatches (and vice versa);
+      * **disaggregated** — 1 prefill-role + 2 decode-role replicas:
+        the router runs hop 1 (prefill + KV export) on the prefill
+        tier and hop 2 (KV import + decode) on a decode owner, so
+        prefills never contend with decodes for a step loop.
+
+    Each arm drives a warmup wave first (group-size/bucket compiles
+    land there), then the MEASURED warm wave. TTFT p99 is computed
+    from the engines' own reservoir samples pooled per arm (in the
+    disagg arm the prefill tier owns TTFT — the decode hop starts
+    after the first token); decode goodput counts post-first-token
+    decode output per second of wave wall. The KV wire unit is priced
+    from the router's disagg counters (bytes per prefill token moved).
+    """
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.router import (EngineGateway,
+                                           InProcessTransport, Router,
+                                           RouterConfig)
+
+    _set_phase("disagg")
+    requests, new_tokens, prompt_len = 9, 5, 40
+    rs = np.random.RandomState(17)
+    prompts = [rs.randint(0, model.cfg.vocab_size,
+                          (prompt_len - int(rs.randint(0, 4)),))
+               .astype(int).tolist() for _ in range(requests)]
+
+    def gateway(rid, role):
+        eng = ServingEngine(model, num_slots=num_slots, bucket_min=8,
+                            paged=True, block_size=8, replica_id=rid,
+                            role=role, slo_ttft_ms=60000.0)
+        gw = EngineGateway(eng)
+        warm = gw.submit(np.asarray(prompts[0], dtype=np.int64),
+                         max_new_tokens=2)
+        gw.wait(warm, timeout=120.0)
+        with gw._lock:
+            eng.warmup_kv_handoff()
+        return gw
+
+    def cfg():
+        return RouterConfig(max_retries=2, refresh_s=0.05,
+                            backoff_base_s=0.01, backoff_max_s=0.1,
+                            seed=17)
+
+    def wave(gws):
+        router = Router([InProcessTransport(g) for g in gws],
+                        config=cfg())
+        t0 = _time.perf_counter()
+        tickets = [router.submit(p, new_tokens) for p in prompts]
+        results = [t.result(timeout=120.0) for t in tickets]
+        wall = _time.perf_counter() - t0
+        state = router.state()
+        router.close()
+        assert all(r["ok"] for r in results), \
+            f"disagg bench wave dropped requests: {results}"
+        return results, wall, state
+
+    def arm(roles, ttft_owners):
+        gws = [gateway(f"dz-{role or 'mono'}{i}", role)
+               for i, role in enumerate(roles)]
+        wave(gws)                           # warm wave: compiles land
+        pre = [len(gws[i].engine.metrics.ttft_s) for i in ttft_owners]
+        results, wall, state = wave(gws)    # the measured warm wave
+        samples = [s for n0, i in zip(pre, ttft_owners)
+                   for s in gws[i].engine.metrics.ttft_s[n0:]]
+        ttft_p99 = float(np.percentile(np.asarray(samples) * 1000.0,
+                                       99)) if samples else None
+        decode_tokens = sum(len(r["tokens"]) - 1 for r in results)
+        for g in gws:
+            g.close()
+        return {
+            "wall_s": round(wall, 3),
+            "ttft_p99_ms": round(ttft_p99, 3),
+            "decode_goodput_tps": round(decode_tokens / wall, 2),
+        }, state
+
+    mono, _ = arm([None, None, None], ttft_owners=(0, 1, 2))
+    disagg, state = arm(["prefill", "decode", "decode"],
+                        ttft_owners=(0,))
+    dz = state["disagg"]
+    assert dz["handoffs"] >= requests, \
+        f"disagg arm bypassed the two-hop path: {dz}"
+    wire_tokens = dz["wire_tokens"]
+    return {
+        "topology": {"prefill": 1, "decode": 2,
+                     "monolithic_baseline": 3},
+        "requests": requests,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "monolithic": mono,
+        "disagg": disagg,
+        "ttft": {
+            "mono_p99_ms": mono["ttft_p99_ms"],
+            "disagg_p99_ms": disagg["ttft_p99_ms"],
+            "improvement_x": round(
+                mono["ttft_p99_ms"] / disagg["ttft_p99_ms"], 3)
+            if disagg["ttft_p99_ms"] else None,
+        },
+        "decode_goodput_x": round(
+            disagg["decode_goodput_tps"] / mono["decode_goodput_tps"],
+            3) if mono["decode_goodput_tps"] else None,
+        "wire": {
+            "handoffs": dz["handoffs"],
+            "bytes_total": dz["wire_bytes"],
+            "tokens": wire_tokens,
+            "bytes_per_token": round(dz["wire_bytes"] / wire_tokens, 1)
+            if wire_tokens else None,
         },
     }
 
@@ -1983,6 +2125,8 @@ def main():
         "decode_kernel_speedup_x": evidence["decode_kernel"][
             "speedup_x"],
         "spec_goodput_x": evidence["speculative"]["goodput_x"],
+        "disagg_decode_goodput_x": evidence["disagg"][
+            "decode_goodput_x"],
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })

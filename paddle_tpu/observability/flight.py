@@ -53,6 +53,8 @@ FIRST_TOKEN = "first_token"
 DECODE_WINDOW = "decode_window"
 DRAFT_ACCEPTED = "draft_accepted"
 DRAFT_REJECTED = "draft_rejected"
+KV_EXPORTED = "kv_exported"
+KV_IMPORTED = "kv_imported"
 RETIRED = "retired"
 
 
@@ -274,6 +276,31 @@ class FlightRecorder:
         self._event(req.rid, DRAFT_REJECTED, "t",
                     {"rejected": int(rejected),
                      "drafted": int(drafted)})
+
+    def kv_exported(self, req, blocks, wire_bytes):
+        """The prefill tier serialized this request's KV blocks for a
+        disaggregated handoff. Fires AFTER retirement (the slot was
+        parked through it), so the event appends to the completed
+        trace in the ring instead of reopening an active one."""
+        t = self._clock()
+        with self._lock:
+            trace = self._done.get(req.rid) or self._active.get(req.rid)
+            if trace is not None:
+                trace.events.append(
+                    {"event": KV_EXPORTED, "t": t,
+                     "blocks": int(blocks),
+                     "wire_bytes": int(wire_bytes)})
+        self._recorder.record(
+            f"request/{KV_EXPORTED}", t, 0.0,
+            {"rid": req.rid, "blocks": int(blocks),
+             "wire_bytes": int(wire_bytes)})
+
+    def kv_imported(self, req, blocks, wire_bytes):
+        """The decode tier bound this request's streamed KV blocks
+        into its pool (the disaggregated admission moment)."""
+        self._event(req.rid, KV_IMPORTED, "t",
+                    {"blocks": int(blocks),
+                     "wire_bytes": int(wire_bytes)})
 
     def retired(self, req, reason, **attrs):
         """Close the request's trace (reason: "eos" / "max_tokens" /

@@ -154,6 +154,25 @@ math into a multi-tenant server:
     rejected counters); the flight recorder logs ``draft_accepted`` /
     ``draft_rejected`` per verify; greedy-only (speculation x
     sampling is rejected at config time);
+  * **disaggregated prefill/decode** (serving.kv_wire + the
+    ``role="prefill"|"decode"|"monolithic"`` config, PR 17 — ROADMAP
+    direction #1) — dedicated prefill replicas compute KV and stream
+    it to decode replicas as digest-checked paged blocks:
+    ``export_kv(rid)`` serializes ``[heads, block_size, head_dim]``
+    tiles + the block-table row (a held-export parks the source
+    blocks until the payload is handed off), ``import_kv(payload)``
+    validates everything up front (corruption raises ``KVWireError``
+    before the pool is touched) and binds the blocks via
+    ``SlotKVPool.rebind`` + block-table splice, resuming at the first
+    decode step with no prefill recompute;
+    ``warmup_kv_handoff()`` pre-builds the import path so BOTH tiers
+    keep the zero-compile steady state. Role is routing posture, not
+    capability — every engine can still serve anything, so router
+    failover replays on any survivor (``router_drill.py --kill
+    prefill`` proves bit-exact journal replay after prefill-replica
+    SIGKILL). The router runs the two-hop 1P+ND flow with
+    deterministic affinity tie-break, two-hop deadline propagation
+    and a congestion fallback to monolithic dispatch;
   * zero-recompile steady state BY CONSTRUCTION — and ATTRIBUTED
     (engine.ServingEngine): all device work runs ahead-of-time
     compiled executables, the whole-lifetime compiled-program

@@ -68,6 +68,7 @@ from ..observability import (CompileWatchdog, FlightRecorder,
                              executable_cost)
 from .kv_pool import SlotKVPool
 from .metrics import ServingMetrics
+from .paged.pool import TRASH_BLOCK
 from .scheduler import QUEUED, RUNNING, Request, StepScheduler
 
 # published per-chip peak FLOP/s (bf16) by PJRT device_kind prefix —
@@ -181,7 +182,7 @@ class ServingConfig:
                  supervisor_cooldown_s=1.0, perf=None,
                  cache_observatory=None, cache_sample_rate=0.125,
                  replica_id=None, speculative=None, spec_k=4,
-                 spec_min_accept=0.35):
+                 spec_min_accept=0.35, role="monolithic"):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -387,6 +388,25 @@ class ServingConfig:
                 "speculative decoding is greedy-only (draft acceptance "
                 "compares against argmax); drop sampling=True or "
                 "speculative=True")
+        # replica role in a disaggregated fleet (None = env override):
+        # "monolithic" (default) serves prefill+decode like every
+        # prior PR; "prefill" replicas compute KV for admitted
+        # requests and export it over the wire (serving.kv_wire);
+        # "decode" replicas import streamed KV and own the decode
+        # span. The role is ROUTING POSTURE, not capability — every
+        # role keeps the full engine (failover replays a dead prefill
+        # tier's work on whoever survives), but prefill/decode roles
+        # require the paged pool (the refcounted block is the wire
+        # unit).
+        if role is None:
+            role = os.environ.get("PADDLE_SERVING_ROLE") \
+                or "monolithic"
+        role = str(role)
+        if role not in ("prefill", "decode", "monolithic"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'monolithic', "
+                f"got {role!r}")
+        self.role = role
 
 
 class ServingEngine:
@@ -484,6 +504,42 @@ class ServingEngine:
         # the roofline prices (observability.perf.roofline.LAYOUTS)
         self.decode_layout = "paged_pallas" if self.paged_attn \
             else ("paged_xla" if self.paged else "contiguous")
+        # disaggregated-serving role + KV wire programs (serving.
+        # kv_wire): export gathers one slot's prompt blocks into
+        # [layers, blocks_per_slot, ...] tiles (a bounded per-slot
+        # read, NEVER a full-pool device_get), import scatters
+        # received tiles into freshly bound blocks and splices the
+        # slot's token/position lanes — both fixed-shape, so each
+        # compiles exactly once (warmup_kv_handoff) and the steady
+        # state stays zero-recompile across any number of handoffs.
+        self.role = config.role
+        self._held_exports = {}   # rid -> retired Request holding KV
+        if self.role != "monolithic" and not self.paged:
+            raise ValueError(
+                f"role={self.role!r} requires the paged pool "
+                f"(paged=True): the refcounted block is the KV wire "
+                f"unit")
+        if self.paged:
+            def _kv_export_fn(kc, vc, idx):
+                return kc[:, idx], vc[:, idx]
+
+            def _kv_import_fn(kc, vc, idx, ktiles, vtiles, toks, pos,
+                              slot, first_tok, plen):
+                # unused idx lanes point at the trash block — the
+                # scatter scribbles garbage no reader sees, exactly
+                # the released-slot stale-write discipline
+                kc = kc.at[:, idx].set(ktiles)
+                vc = vc.at[:, idx].set(vtiles)
+                # toks/pos are RETURNED, not donated: a pending decode
+                # harvest still reads the pre-import token array
+                toks = toks.at[slot].set(first_tok)
+                pos = pos.at[slot].set(plen)
+                return toks, pos, kc, vc
+
+            self._kv_export_fn = _kv_export_fn
+            self._kv_import_fn = _kv_import_fn
+        else:
+            self._kv_export_fn = self._kv_import_fn = None
         # speculative decoding (serving.spec): ONE extra verify program
         # flavor per pool + the host-side drafter/acceptance gate. The
         # plain decode program stays built either way — it is the
@@ -702,7 +758,8 @@ class ServingEngine:
 
     def add_request(self, prompt, max_new_tokens, eos_id=None,
                     on_token=None, temperature=0.0, top_k=0,
-                    top_p=1.0, seed=None, deadline_ms=None):
+                    top_p=1.0, seed=None, deadline_ms=None,
+                    hold_kv=False):
         """Enqueue a prompt; returns the Request handle immediately.
         Tokens stream through on_token(request, token) as steps run
         (with async_depth=1 a token surfaces one engine step after the
@@ -718,17 +775,28 @@ class ServingEngine:
         ``t_arrival + deadline_ms`` the engine retires it (queued or
         mid-decode) with stop reason "deadline", counted in
         ``serving_requests_timed_out_total`` and SLO-judged as a
-        violation. None (default) = no deadline."""
+        violation. None (default) = no deadline.
+
+        ``hold_kv=True`` (paged pools only) parks the request's slot —
+        blocks still live — when it retires instead of releasing it,
+        so ``export_kv(rid)`` can serialize the prompt's KV blocks
+        for a disaggregated handoff; the export (or abort/close)
+        releases the slot. The prefill tier submits its work this way
+        with ``max_new_tokens=1``."""
         if self._draining or self._closed:
             raise RuntimeError(
                 "engine is draining/closed: no new requests (drain() "
                 "finishes already-submitted work, close() aborts it)")
+        if hold_kv and not self.paged:
+            raise ValueError(
+                "hold_kv requires the paged pool (paged=True): the "
+                "KV wire unit is the paged block")
         req = Request(prompt, max_new_tokens,
                       eos_id=self.config.eos_id if eos_id is None
                       else eos_id,
                       on_token=on_token, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed,
-                      deadline_ms=deadline_ms)
+                      deadline_ms=deadline_ms, hold_kv=hold_kv)
         if req.sampled and not self.sampling:
             raise ValueError(
                 "sampled request on a greedy engine: build the engine "
@@ -865,6 +933,200 @@ class ServingEngine:
         synchronous flavor that also runs the steps and closes."""
         self._draining = True
 
+    # ------------------------------------------- disaggregated handoff
+
+    def export_kv(self, rid):
+        """Serialize a retired ``hold_kv`` request's prompt KV blocks
+        into a wire payload (see serving.kv_wire) and release its
+        parked slot. One fixed-shape compiled gather — the
+        ``("kv_export",)`` program over a trash-padded
+        ``[blocks_per_slot]`` index row — pulls the tiles off the
+        pool; everything after the single host read-back is pure numpy,
+        so the transfer loop never traces. The slot is released even
+        when serialization fails: a prefill tier never leaks blocks."""
+        if not self.paged:
+            raise RuntimeError(
+                "export_kv requires the paged pool (paged=True)")
+        req = self._held_exports.pop(rid, None)
+        if req is None:
+            raise KeyError(
+                f"no held KV export for rid {rid}: submit with "
+                f"hold_kv=True and let the request retire first")
+        from . import kv_wire
+        pool = self.pool
+        slot = req.slot
+        try:
+            n = kv_wire.blocks_for_prompt(len(req.prompt),
+                                          pool.block_size)
+            row = pool._slot_blocks[slot][:n]
+            idx = np.full((pool.blocks_per_slot,),
+                          TRASH_BLOCK, np.int32)
+            idx[:n] = row
+            args = (pool.kc, pool.vc, idx)
+            ex = self._compiled(("kv_export",), self._kv_export_fn,
+                                args)
+            with self.metrics.span("serving/kv_export"):
+                k_dev, v_dev = self._timed_call(("kv_export",), ex,
+                                                args)
+                # the ONLY device read on this path: 2 * n_blocks
+                # tiles, never a full pool
+                k = np.asarray(k_dev)[:, :n]
+                v = np.asarray(v_dev)[:, :n]
+            payload = kv_wire.serialize_handoff(
+                k, v, req.prompt, req.generated[0])
+        finally:
+            if req.slot is not None:
+                pool.release(req.slot)
+                req.slot = None
+        self.flight.kv_exported(req, n,
+                                kv_wire.payload_wire_bytes(payload))
+        return payload
+
+    def import_kv(self, payload, max_new_tokens, eos_id=None,
+                  on_token=None, deadline_ms=None):
+        """Bind a streamed KV handoff into this engine's pool and
+        resume the stream at the FIRST DECODE STEP — no recompute:
+        the prompt's K/V arrives on the wire, the prefill program
+        never runs here. ``max_new_tokens`` counts ALL new tokens
+        including the already-produced first one (so it matches what
+        the client asked the fleet for); the remaining
+        ``max_new_tokens - 1`` decode normally.
+
+        The payload is fully verified (structure + per-frame digests
+        + shape/dtype against this pool) BEFORE any pool mutation — a
+        corrupt frame raises KVWireError and the pool is bit-identical
+        to never having seen it. The splice itself is the one
+        fixed-shape compiled ``("kv_import",)`` scatter (kc/vc donated;
+        toks/pos returned as copies — a pending decode harvest still
+        reads the pre-import token array). commit_prefix() then shares
+        the imported prompt's full blocks through the radix index, so
+        later local admissions hit them and the fleet heat map sees
+        this replica as the prefix's owner. Returns the live Request."""
+        if not self.paged:
+            raise RuntimeError(
+                "import_kv requires the paged pool (paged=True)")
+        if self._draining or self._closed:
+            raise RuntimeError(
+                "engine is draining/closed: no new requests (drain() "
+                "finishes already-submitted work, close() aborts it)")
+        from . import kv_wire
+        handoff = kv_wire.deserialize_handoff(payload)
+        pool, sch = self.pool, self.scheduler
+        layers, _, heads, bs, hd = pool.kc.shape
+        if handoff.block_size != pool.block_size:
+            raise kv_wire.KVWireError(
+                f"block_size drift: payload {handoff.block_size}, "
+                f"pool {pool.block_size}")
+        if (handoff.k.shape[0] != layers
+                or handoff.k.shape[2:] != (heads, bs, hd)):
+            raise kv_wire.KVWireError(
+                f"tile shape drift: payload {handoff.k.shape}, pool "
+                f"tiles [{layers}, ., {heads}, {bs}, {hd}]")
+        if handoff.k.dtype != pool.kc.dtype:
+            raise kv_wire.KVWireError(
+                f"tile dtype drift: payload {handoff.k.dtype}, pool "
+                f"{pool.kc.dtype}")
+        req = Request(handoff.prompt, max_new_tokens,
+                      eos_id=self.config.eos_id if eos_id is None
+                      else eos_id,
+                      on_token=on_token, deadline_ms=deadline_ms)
+        ids = req.prompt
+        alloc = pool.acquire(req.rid, ids, req.cache_tokens, 0)
+        if alloc is None:
+            raise RuntimeError(
+                "kv import refused: pool at capacity (the router "
+                "retries another decode replica)")
+        slot = alloc.slot
+        n = handoff.n_blocks
+        bps = pool.blocks_per_slot
+        idx = np.full((bps,), TRASH_BLOCK, np.int32)
+        idx[:n] = pool._slot_blocks[slot][:n]
+        ktiles = np.zeros((layers, bps, heads, bs, hd),
+                          pool.kc.dtype)
+        vtiles = np.zeros_like(ktiles)
+        ktiles[:, :n] = handoff.k
+        vtiles[:, :n] = handoff.v
+        args = (pool.kc, pool.vc, idx, ktiles, vtiles, self._toks,
+                self._pos, np.int32(slot),
+                np.int32(handoff.first_token), np.int32(len(ids)))
+        try:
+            ex = self._compiled(("kv_import",), self._kv_import_fn,
+                                args, donate=(0, 1))
+            with self.metrics.span("serving/kv_import"):
+                toks, pos, kc, vc = self._timed_call(
+                    ("kv_import",), ex, args)
+        except BaseException:
+            pool.release(slot)
+            raise
+        pool.rebind(kc, vc)
+        self._toks, self._pos = toks, pos
+        pool.commit_prefix(slot, ids)
+        if self._sampler is not None:
+            self._sampler.set_slot(slot, req)
+        now = time.perf_counter()
+        req.state = RUNNING
+        req.slot = slot
+        req.generated = [int(handoff.first_token)]
+        # admission and first token both already happened, fleet-wise:
+        # stamp rather than observe (TTFT was paid on the prefill
+        # tier; the router's handoff histogram prices this hop)
+        req.t_admitted = now
+        req.t_first_token = now
+        sch.active[slot] = req
+        self.metrics.record_admission(req)
+        self.metrics.requests_admitted += 1
+        self.flight.enqueued(req)
+        self.flight.kv_imported(req, n, handoff.wire_bytes)
+        reason = sch.stop_reason(req, req.generated[0])
+        if reason is not None:
+            # max_new_tokens=1 (or first==eos): nothing left to
+            # decode — retire immediately, never leaving a saturated
+            # request for prerelease to orphan
+            sch.finish(req, pool)
+            violations = self.metrics.record_completion(req)
+            self.flight.retired(req, reason,
+                                slo_violations=list(violations))
+            if self.supervisor is not None:
+                self.supervisor.note_completion(req.rid)
+        return req
+
+    def warmup_kv_handoff(self):
+        """Compile the ``("kv_export",)`` / ``("kv_import",)``
+        programs while the engine is idle, so a steady-state handoff
+        is dispatch-only — call during warmup (before
+        ``declare_warmup``) on any replica that may export or import.
+        The warmup import splices zero tiles through the trash block
+        and scribbles slot 0's toks/pos, both dead state on an idle
+        engine; the donated kc/vc are rebound exactly like a real
+        import."""
+        if not self.paged:
+            raise RuntimeError(
+                "warmup_kv_handoff requires the paged pool "
+                "(paged=True)")
+        pool = self.pool
+        layers, _, heads, bs, hd = pool.kc.shape
+        bps = pool.blocks_per_slot
+        idx = np.full((bps,), TRASH_BLOCK, np.int32)
+        args = (pool.kc, pool.vc, idx)
+        ex = self._compiled(("kv_export",), self._kv_export_fn, args)
+        k_dev, v_dev = ex(*args)
+        np.asarray(k_dev), np.asarray(v_dev)
+        tile = np.zeros((layers, bps, heads, bs, hd), pool.kc.dtype)
+        args = (pool.kc, pool.vc, idx, tile, tile, self._toks,
+                self._pos, np.int32(0), np.int32(0), np.int32(0))
+        ex = self._compiled(("kv_import",), self._kv_import_fn, args,
+                            donate=(0, 1))
+        toks, pos, kc, vc = ex(*args)
+        pool.rebind(kc, vc)
+        self._toks, self._pos = toks, pos
+        # these builds land BETWEEN steps: resync the health row's
+        # compile baseline, or the first post-warmup step would charge
+        # them as steady-state compiles and trip the health detector
+        if self._hprev is not None:
+            row = list(self._hprev)
+            row[7] = self.metrics._c_compiles._default()._value
+            self._hprev = tuple(row)
+
     def drain(self):
         """Graceful drain: stop accepting NEW requests (add_request
         raises), finish every already-submitted request — queued and
@@ -887,7 +1149,8 @@ class ServingEngine:
         then the metrics/debug HTTP servers stop. Idempotent; the
         engine is also a context manager."""
         if not self._closed and (self.scheduler.pending
-                                 or self._pending or self._chunk_q):
+                                 or self._pending or self._chunk_q
+                                 or self._held_exports):
             self._abort_inflight()
         self._closed = True
         servers, self._metric_servers = self._metric_servers, []
@@ -917,6 +1180,12 @@ class ServingEngine:
         self._pending = []
         self._chunk_q = []
         self._prefilling.clear()
+        # parked exports are already DONE — just give their blocks back
+        held, self._held_exports = self._held_exports, {}
+        for r in sorted(held.values(), key=lambda r: r.rid):
+            if r.slot is not None:
+                self.pool.release(r.slot)
+                r.slot = None
         for r in sorted(owed.values(), key=lambda r: r.rid):
             r.inflight = 0
             sch.abort(r, self.pool)
@@ -964,6 +1233,8 @@ class ServingEngine:
             "slo": self.metrics.slo.report(),
             "paged": self.paged,
             "paged_attn": self.paged_attn,
+            "role": self.role,
+            "held_exports": len(self._held_exports),
             "decode_layout": self.decode_layout,
             "speculative": self.speculative,
             "spec_k": self.spec_k,
@@ -985,8 +1256,9 @@ class ServingEngine:
         ``dynamic-shape-risk``. ``program`` picks the jaxpr:
         "decode" (default), "chunk" (the chunked-prefill program —
         legacy pool only; the paged flavor's chunks ARE its prefill
-        program) or "spec_verify" (the speculative k-token verify
-        flavor of whichever pool this engine runs). The donation metadata mirrors the real AOT build:
+        program), "spec_verify" (the speculative k-token verify
+        flavor of whichever pool this engine runs) or "kv_import"
+        (the disaggregation block-splice program — paged only). The donation metadata mirrors the real AOT build:
         kc/vc/pos donated iff ``self._donate``
         (``metrics.kv_donation["enabled"]``), aliasing iff the backend
         aliases donated buffers (``kv_donation["effective"]`` on) — so
@@ -1029,6 +1301,21 @@ class ServingEngine:
                         dlen, self.pool.kc, self.pool.vc)
                 donate = (2, 5, 6) if self._donate else ()
             fn = self._verify_fn
+        elif program == "kv_import":
+            if self._kv_import_fn is None:
+                raise ValueError(
+                    "no kv_import program on this engine (the paged "
+                    "pool builds one)")
+            bps = self.pool.blocks_per_slot
+            layers, _, heads, bs, hd = self.pool.kc.shape
+            tile = np.zeros((layers, bps, heads, bs, hd),
+                            self.pool.kc.dtype)
+            args = (self.pool.kc, self.pool.vc,
+                    np.zeros((bps,), np.int32), tile, tile,
+                    self._toks, self._pos, np.int32(0), np.int32(0),
+                    np.int32(0))
+            fn = self._kv_import_fn
+            donate = (0, 1) if self._donate else ()
         elif self.paged:
             args = (self.params, self._toks, self._pos,
                     self.pool.device_tables(), self.pool.kc,
@@ -1143,6 +1430,10 @@ class ServingEngine:
                                 slo_violations=list(violations))
             if self.supervisor is not None:
                 self.supervisor.note_completion(req.rid)
+            if req.hold_kv and req.slot is not None:
+                # prefill-tier retirement: the slot (and its blocks)
+                # stay live, parked for export_kv(rid)
+                self._held_exports[req.rid] = req
 
     def _harvest(self, pending):
         """Read back dispatched results (at most one step's worth: the
@@ -1347,8 +1638,10 @@ class ServingEngine:
             self._expire_deadlines()
 
         with M.span("serving/retirement"):
+            # hold_kv requests never prerelease: their blocks must
+            # survive retirement for export_kv
             for req in [r for r in sch.active.values()
-                        if sch.saturated(r)]:
+                        if sch.saturated(r) and not r.hold_kv]:
                 sch.prerelease(req, pool)
 
         self._triage()
@@ -1525,7 +1818,13 @@ class ServingEngine:
             "queue_depth": len(queue),
             "queue_age_s": time.perf_counter() - queue[0].t_arrival
             if queue else 0.0,
-            "occupied_slots": len(self.scheduler.active),
+            # parked KV exports still OWN their slot and blocks (the
+            # handoff isn't done until export_kv streams them) — count
+            # them occupied or the kv_block_leak detector reads a
+            # mid-handoff prefill tier as a leak and the supervisor
+            # wipes the pool out from under the export
+            "occupied_slots": (len(self.scheduler.active)
+                               + len(self._held_exports)),
             "chunked_inflight": len(self._chunk_q),
             "admitted": int(cur[1] - prev[1]),
             "tokens": int(cur[0] - prev[0]),
@@ -1952,6 +2251,11 @@ class ServingEngine:
         expired_q, expired_a = self.scheduler.expire_deadlines(
             self.pool, prefilling=self._prefilling, now=now)
         for req in expired_q + expired_a:
+            if req.hold_kv and req.slot is not None:
+                # a dead-on-deadline handoff holds nothing: nobody
+                # will export it, so the parked slot goes back now
+                self.pool.release(req.slot)
+                req.slot = None
             self.metrics.record_timeout()
             over = (now - req.t_arrival) * 1000.0 - req.deadline_ms
             self.flight.deadline_exceeded(req, over)
@@ -1995,6 +2299,12 @@ class ServingEngine:
             self._chunk_q = []
             self._prefilling.clear()
             sch.active.clear()
+            # parked exports die with the pool: their blocks live in
+            # the arrays being replaced, so there is nothing to stream
+            # — the router re-drives the prefill on a healthy replica
+            for r in self._held_exports.values():
+                r.slot = None
+            self._held_exports.clear()
             self.pool = self._pool_factory()
             if self.paged:
                 M.set_prefix_pool(self.pool.stats)

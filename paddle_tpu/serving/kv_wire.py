@@ -1,0 +1,216 @@
+"""KV wire format for prefill/decode disaggregation.
+
+The wire unit is the refcounted paged block: one frame per physical
+block, carrying the K and V tiles ``[layers, heads, block_size,
+head_dim]`` for that block plus a crc32 digest over both tiles. A
+handoff payload bundles the frames covering a request's PROMPT
+positions (``ceil(prompt_len / block_size)`` blocks — the partial last
+block ships whole; its tail rows are scratch the decode side never
+reads, exactly as after a local prefill) together with the prompt
+tokens and the first generated token, so the decode tier can bind the
+blocks into its own pool and resume the stream at the first decode
+step with no recompute.
+
+Everything here is pure host-side numpy over already-fetched tiles:
+serialization never touches a pool, and ``deserialize_handoff``
+verifies every frame's digest BEFORE assembling arrays — a corrupted
+frame raises the typed :class:`KVWireError` with zero pool mutation
+on the importing side (the engine only allocates blocks after the
+payload decoded clean).
+
+The JSON encoding (base64 tiles) exists for the HTTP transport; the
+in-process transport hands the same dict across without a byte copy
+beyond serialization itself.
+"""
+import base64
+import binascii
+import zlib
+
+import numpy as np
+
+WIRE_VERSION = 1
+
+
+class KVWireError(RuntimeError):
+    """A KV handoff payload failed validation (bad structure, shape /
+    dtype drift against the importing pool, or a frame whose digest
+    does not match its tiles). Raised BEFORE any pool mutation: an
+    importer that sees this error has a bit-identical pool to one that
+    never saw the payload."""
+
+
+class KVHandoff:
+    """A decoded handoff: stacked block tiles plus the resume facts.
+
+    ``k``/``v`` are ``[layers, n_blocks, heads, block_size, head_dim]``
+    host arrays in block-table row order; ``wire_bytes`` is the raw
+    tile payload size (both caches, pre-base64) — the transfer-cost
+    fact the perf ledger prices per token.
+    """
+
+    __slots__ = ("prompt", "first_token", "block_size", "k", "v",
+                 "wire_bytes")
+
+    def __init__(self, prompt, first_token, block_size, k, v,
+                 wire_bytes):
+        self.prompt = prompt
+        self.first_token = int(first_token)
+        self.block_size = int(block_size)
+        self.k = k
+        self.v = v
+        self.wire_bytes = int(wire_bytes)
+
+    @property
+    def n_blocks(self):
+        return self.k.shape[1]
+
+
+def blocks_for_prompt(prompt_len, block_size):
+    """How many leading row blocks a prompt's K/V occupies (the
+    partial last block counts whole)."""
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    return -(-int(prompt_len) // int(block_size))
+
+
+def serialize_handoff(k_tiles, v_tiles, prompt, first_token):
+    """Pack prompt-covering block tiles into a JSON-safe handoff dict.
+
+    ``k_tiles``/``v_tiles``: ``[layers, n_blocks, heads, block_size,
+    head_dim]`` host arrays (the exporter slices them off its pool in
+    block-table row order). Serialization is pure — no pool access,
+    no device work — so the transfer loop stays off the compiled hot
+    path by construction.
+    """
+    k_tiles = np.ascontiguousarray(k_tiles)
+    v_tiles = np.ascontiguousarray(v_tiles)
+    if k_tiles.ndim != 5 or k_tiles.shape != v_tiles.shape:
+        raise ValueError(
+            f"k/v tiles must be identical 5-D [layers, n_blocks, "
+            f"heads, block_size, head_dim] arrays, got "
+            f"{k_tiles.shape} / {v_tiles.shape}")
+    if k_tiles.dtype != v_tiles.dtype:
+        raise ValueError(
+            f"k/v tile dtype mismatch: {k_tiles.dtype} vs "
+            f"{v_tiles.dtype}")
+    layers, n_blocks, heads, block_size, head_dim = k_tiles.shape
+    prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+    if not prompt:
+        raise ValueError("empty prompt")
+    need = blocks_for_prompt(len(prompt), block_size)
+    if n_blocks != need:
+        raise ValueError(
+            f"{len(prompt)} prompt tokens need {need} blocks of "
+            f"{block_size}, got {n_blocks} tiles")
+    frames = []
+    for i in range(n_blocks):
+        kb = np.ascontiguousarray(k_tiles[:, i]).tobytes()
+        vb = np.ascontiguousarray(v_tiles[:, i]).tobytes()
+        frames.append({
+            "k": base64.b64encode(kb).decode("ascii"),
+            "v": base64.b64encode(vb).decode("ascii"),
+            "digest": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF,
+        })
+    tile_bytes = int(k_tiles[:, 0].nbytes)
+    return {
+        "version": WIRE_VERSION,
+        "dtype": str(np.dtype(k_tiles.dtype)),
+        "tile_shape": [int(layers), int(heads), int(block_size),
+                       int(head_dim)],
+        "tile_bytes": tile_bytes,
+        "prompt": prompt,
+        "first_token": int(first_token),
+        "frames": frames,
+    }
+
+
+def payload_wire_bytes(payload):
+    """Raw K+V tile bytes a payload carries (pre-base64) — the router's
+    wire-accounting read, cheap enough to call without deserializing."""
+    try:
+        return 2 * int(payload["tile_bytes"]) * len(payload["frames"])
+    except (KeyError, TypeError) as e:
+        raise KVWireError(f"malformed handoff payload: {e!r}") from None
+
+
+def _resolve_dtype(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # extension dtypes (bfloat16 & friends) register with numpy
+        # only once ml_dtypes is imported — resolve lazily so this
+        # module never imports jax/ml_dtypes for the float32 case
+        try:
+            import ml_dtypes
+            return np.dtype(getattr(ml_dtypes, str(name)))
+        except (ImportError, AttributeError, TypeError):
+            raise KVWireError(
+                f"unknown tile dtype {name!r}") from None
+
+
+def deserialize_handoff(payload):
+    """Decode + verify a handoff payload into a :class:`KVHandoff`.
+
+    Every frame's crc32 is checked against its decoded tiles BEFORE
+    any array is assembled; structural problems (missing fields, wrong
+    version, tile-count/prompt-length disagreement, bad base64) and
+    digest mismatches all raise :class:`KVWireError` — the caller's
+    pool is untouched either way.
+    """
+    if not isinstance(payload, dict):
+        raise KVWireError(
+            f"handoff payload must be a dict, got "
+            f"{type(payload).__name__}")
+    if payload.get("version") != WIRE_VERSION:
+        raise KVWireError(
+            f"unsupported wire version {payload.get('version')!r} "
+            f"(this importer speaks {WIRE_VERSION})")
+    try:
+        dtype = _resolve_dtype(payload["dtype"])
+        layers, heads, block_size, head_dim = (
+            int(d) for d in payload["tile_shape"])
+        prompt = [int(t) for t in payload["prompt"]]
+        first_token = int(payload["first_token"])
+        frames = payload["frames"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise KVWireError(
+            f"malformed handoff payload: {e!r}") from None
+    if not prompt:
+        raise KVWireError("handoff payload has an empty prompt")
+    need = blocks_for_prompt(len(prompt), block_size)
+    if not isinstance(frames, list) or len(frames) != need:
+        raise KVWireError(
+            f"{len(prompt)} prompt tokens need {need} frames of "
+            f"block_size {block_size}, payload has "
+            f"{len(frames) if isinstance(frames, list) else frames!r}")
+    tile_shape = (layers, heads, block_size, head_dim)
+    tile_bytes = int(np.prod(tile_shape)) * dtype.itemsize
+    k_list, v_list = [], []
+    wire_bytes = 0
+    for i, frame in enumerate(frames):
+        try:
+            kb = base64.b64decode(frame["k"], validate=True)
+            vb = base64.b64decode(frame["v"], validate=True)
+            digest = int(frame["digest"])
+        except (KeyError, TypeError, ValueError,
+                binascii.Error) as e:
+            raise KVWireError(
+                f"malformed frame {i}: {e!r}") from None
+        if len(kb) != tile_bytes or len(vb) != tile_bytes:
+            raise KVWireError(
+                f"frame {i} tile size {len(kb)}/{len(vb)} != expected "
+                f"{tile_bytes} for shape {tile_shape} {dtype}")
+        got = zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF
+        if got != digest & 0xFFFFFFFF:
+            raise KVWireError(
+                f"frame {i} digest mismatch: payload says "
+                f"{digest & 0xFFFFFFFF:#010x}, tiles hash "
+                f"{got:#010x} — frame corrupted in transit, "
+                f"import refused")
+        k_list.append(np.frombuffer(kb, dtype).reshape(tile_shape))
+        v_list.append(np.frombuffer(vb, dtype).reshape(tile_shape))
+        wire_bytes += len(kb) + len(vb)
+    k = np.stack(k_list, axis=1)
+    v = np.stack(v_list, axis=1)
+    return KVHandoff(prompt, first_token, block_size, k, v,
+                     wire_bytes)

@@ -31,6 +31,14 @@ Proven by ``tools/router_drill.py``: SIGKILL a replica mid-traffic —
 every admitted, non-shed request still completes with greedy parity
 and zero slot/block leaks on the survivors, where a no-failover
 baseline loses everything in flight on the dead replica.
+
+Disaggregated serving rides the same machinery: replicas advertise a
+``role`` (``prefill``/``decode``/``monolithic``), the router sends
+fresh requests through ``/v1/prefill`` on the prefill tier, journals
+the first token, then binds the serialized KV blocks
+(``serving/kv_wire.py``) on an affinity-picked decode owner via
+``/v1/import`` — prefill SIGKILL mid-stream replays bit-exact from
+the journal on survivors, exactly like monolithic failover.
 """
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .core import (ROUTER_STATE_KEYS, Router, RouterConfig,

@@ -34,6 +34,22 @@ journal depth, shed/retry/failover/hedge totals — lives on the
 router's own MetricsRegistry and the ``/router/state`` route
 (``router.serve()``); ``tools/fleet_top.py --router`` renders it next
 to the fleet table.
+
+**Disaggregated serving** (ROADMAP direction #1): replicas advertise a
+``role`` in their debug state (``prefill`` / ``decode`` /
+``monolithic``). When an admissible prefill-role replica exists, a
+fresh request takes the two-hop path: hop 1 dispatches
+``transport.prefill`` to the least-loaded prefill replica (prompt KV +
+first token, serialized as wire blocks); the first token is journaled
+BEFORE any decode dispatch — the handoff record — so a prefill SIGKILL
+anywhere after hop 1 replays bit-exact from ``prefill_ids`` on a
+survivor, and one mid-handoff replays the whole (uncommitted) prompt.
+Hop 2 binds the payload on a decode owner picked by the SAME heat
+affinity + spill margin as monolithic placement (prefill-role replicas
+never serve generate or decode dispatches). Every fallback edge —
+refused import, decode death mid-stream, no decode tier left — lands
+in the ordinary monolithic retry machinery, which continues from the
+journal without regenerating committed tokens.
 """
 import itertools
 import os
@@ -42,6 +58,7 @@ import time
 
 from ...observability import MetricsRegistry, start_metrics_server
 from ...observability.fleet.poller import backoff_jitter_unit
+from ..kv_wire import payload_wire_bytes
 from ..paged.radix import path_fingerprint
 from ..resilience.chaos import InjectedFault, resolve_chaos
 from .breaker import CircuitBreaker
@@ -55,8 +72,8 @@ _tag_seq = itertools.count()
 
 # /router/state top-level schema (pinned by tests/test_router.py)
 ROUTER_STATE_KEYS = (
-    "config", "counters", "hedge", "journal", "journal_depth",
-    "replicas",
+    "config", "counters", "disagg", "hedge", "journal",
+    "journal_depth", "replicas",
 )
 
 
@@ -246,6 +263,23 @@ class Router:
         self._h_latency = r.histogram(
             "router_request_latency_seconds",
             "end-to-end routed request latency")
+        self._c_handoffs = r.counter(
+            "router_kv_handoffs_total",
+            "prefill->decode KV handoffs by outcome (ok, or the "
+            "fallback edge that sent the request monolithic)",
+            labelnames=("outcome",))
+        self._c_wire_bytes = r.counter(
+            "router_kv_wire_bytes_total",
+            "raw K+V tile bytes shipped prefill->decode "
+            "(pre-base64, completed handoffs only)")
+        self._c_wire_tokens = r.counter(
+            "router_kv_wire_tokens_total",
+            "prompt tokens whose KV shipped prefill->decode "
+            "(completed handoffs only)")
+        self._h_handoff = r.histogram(
+            "router_kv_handoff_seconds",
+            "two-hop TTFT cost: prefill hop wall + decode-side "
+            "bind wall (the monolithic-TTFT comparable)")
         self._c_overhead_s = r.counter(
             "router_overhead_seconds_total",
             "wall seconds spent in router bookkeeping (admission, "
@@ -265,7 +299,9 @@ class Router:
         self._inflight = {rid: 0 for rid in self.transports}
         self._sticky = {}          # fingerprint -> replica_id
         self._stats = {"ok": 0, "error": 0, "shed": 0, "retries": 0,
-                       "failovers": 0, "hedges": 0, "hedge_wins": 0}
+                       "failovers": 0, "hedges": 0, "hedge_wins": 0,
+                       "handoffs": 0, "handoff_failures": 0,
+                       "wire_bytes": 0, "wire_tokens": 0}
         self._closed = False
         self._threads = []
         self._servers = []
@@ -310,6 +346,7 @@ class Router:
             "draining": bool(health.get("draining")),
             "degraded": bool(health.get("degraded")),
             "healthy": health.get("healthy"),
+            "role": state.get("role") or "monolithic",
             "queue_depth": state.get("queue_depth") or 0,
             "heat": {e["fp"]: e.get("tokens_saved", 0)
                      for e in heat},
@@ -330,6 +367,7 @@ class Router:
             "draining": bool(health.get("draining")),
             "degraded": bool(health.get("degraded")),
             "healthy": health.get("healthy"),
+            "role": state.get("role") or "monolithic",
             "queue_depth": state.get("queue_depth") or 0,
             "heat": {e["fp"]: e.get("tokens_saved", 0)
                      for e in heat},
@@ -351,15 +389,29 @@ class Router:
         self._g_breaker.labels(rid).set(level)
 
     # --------------------------------------------------- placement
+    @staticmethod
+    def _best_scored(scores):
+        """Deterministic argmax over affinity scores: highest score
+        wins, replica-id order breaks ties. The ONE tie-break site —
+        placement must never depend on dict insertion order (posture
+        maps are rebuilt per refresh in whatever order transports
+        answered)."""
+        return min(scores, key=lambda r: (-scores[r], str(r)))
+
     def _select(self, fps, excluded, now):
         """One placement decision: admissible (posture + breaker)
         candidates, failover preference (``excluded`` last), affinity
         first unless the affinity replica is overloaded, else least
-        loaded. Returns a replica id or None."""
+        loaded. Prefill-role replicas never serve generate/decode
+        dispatches — role is a routing posture, and the prefill tier's
+        capacity is reserved for hop-1 work. Returns a replica id or
+        None."""
         with self._lock:
             cands = []
             for rid in self.transports:
                 posture = self._posture.get(rid) or {}
+                if posture.get("role") == "prefill":
+                    continue
                 if not self._admissible(posture):
                     continue
                 if not self.breakers[rid].allow(now):
@@ -386,7 +438,7 @@ class Router:
                     if s > 0:
                         scores[r] = s
                 if scores:
-                    best = max(sorted(scores), key=lambda r: scores[r])
+                    best = self._best_scored(scores)
                     if load[best] <= floor + self.config.affinity_spill:
                         choice = best
             if choice is None:
@@ -394,6 +446,50 @@ class Router:
             self.breakers[choice].claim(now)
             self._inflight[choice] += 1
             return choice
+
+    def _select_prefill(self, excluded, now):
+        """Hop-1 placement: least-loaded admissible prefill-role
+        replica whose transport speaks the handoff protocol. None
+        when no prefill tier exists (or it is all down/excluded) —
+        the caller falls back to the monolithic path."""
+        with self._lock:
+            cands = []
+            for rid, t in self.transports.items():
+                posture = self._posture.get(rid) or {}
+                if posture.get("role") != "prefill":
+                    continue
+                if rid in excluded:
+                    continue
+                if not hasattr(t, "prefill"):
+                    continue
+                if not self._admissible(posture):
+                    continue
+                if not self.breakers[rid].allow(now):
+                    continue
+                cands.append(rid)
+            if not cands:
+                return None
+            load = {r: ((self._posture.get(r) or {})
+                        .get("queue_depth") or 0)
+                    + self._inflight[r] for r in cands}
+            choice = min(sorted(cands), key=lambda r: load[r])
+            self.breakers[choice].claim(now)
+            self._inflight[choice] += 1
+            return choice
+
+    def _note_handoff(self, outcome, wire_bytes=0, wire_tokens=0):
+        self._c_handoffs.labels(outcome).inc()
+        if wire_bytes:
+            self._c_wire_bytes.inc(wire_bytes)
+        if wire_tokens:
+            self._c_wire_tokens.inc(wire_tokens)
+        with self._lock:
+            if outcome == "ok":
+                self._stats["handoffs"] += 1
+                self._stats["wire_bytes"] += wire_bytes
+                self._stats["wire_tokens"] += wire_tokens
+            else:
+                self._stats["handoff_failures"] += 1
 
     def _release(self, rid):
         with self._lock:
@@ -446,8 +542,12 @@ class Router:
         self.refresh()
         now = self._clock()
         with self._lock:
+            # a prefill-only fleet cannot complete a request: the
+            # admission gate asks for a replica that can OWN one
             any_admissible = any(
                 self._admissible(self._posture.get(rid) or {})
+                and (self._posture.get(rid) or {}).get("role")
+                != "prefill"
                 and self.breakers[rid].allow(now)
                 for rid in self.transports)
         if not any_admissible:
@@ -512,6 +612,24 @@ class Router:
                 return self._finish_error(entry, ticket, "deadline",
                                           failures, failovers, hedged,
                                           t_start)
+            # ------------------------- disaggregated two-hop path
+            # Fresh entries only: once ANY token is committed, the
+            # journal's prefill_ids continuation on a monolithic
+            # dispatch is strictly better than re-prefilling for
+            # export. finished=True → the helper resolved the
+            # ticket; False → fall through to the monolithic
+            # machinery (possibly with hop-1 tokens journaled).
+            if not entry.tokens:
+                finished, failures, failovers, last_error = \
+                    self._drive_disagg(entry, ticket, fps, excluded,
+                                       failures, failovers, hedged,
+                                       t_start, last_error)
+                if finished:
+                    return
+                if failures > self.config.max_retries:
+                    return self._finish_error(
+                        entry, ticket, last_error, failures,
+                        failovers, hedged, t_start)
             t_bk = time.perf_counter()
             now = self._clock()
             self.refresh()
@@ -630,6 +748,171 @@ class Router:
             return self._finish_ok(entry, ticket, rid_won, failures,
                                    failovers, hedged, hedge_winner,
                                    t_start)
+
+    def _retry_pause(self, entry, failures):
+        self._c_retries.inc()
+        with self._lock:
+            self._stats["retries"] += 1
+        self._backoff(entry.rid, failures)
+        self.refresh(force=True)
+
+    def _drive_disagg(self, entry, ticket, fps, excluded, failures,
+                      failovers, hedged, t_start, last_error):
+        """The two-hop path for a fresh entry. Returns ``(finished,
+        failures, failovers, last_error)``: finished=True means the
+        ticket is resolved; False means fall back to the monolithic
+        machinery in ``_drive`` — with the first token (and any
+        partial decode stream) already journaled when hop 1 ever
+        completed, so the fallback CONTINUES, never regenerates.
+        Hedging never applies here (a handoff is already two
+        dispatches of real capacity)."""
+        pf_excluded = set()
+        while True:                                    # ---- hop 1
+            remaining = self._remaining_ms(entry)
+            if remaining is not None and remaining <= 0:
+                self._finish_error(entry, ticket, "deadline",
+                                   failures, failovers, hedged,
+                                   t_start)
+                return (True, failures, failovers, "deadline")
+            t_bk = time.perf_counter()
+            now = self._clock()
+            self.refresh()
+            pf_rid = self._select_prefill(pf_excluded, now)
+            self._account_overhead(t_bk)
+            if pf_rid is None:
+                # no prefill tier (or none left): not a handoff
+                # failure, just a monolithic fleet from here on
+                return (False, failures, failovers, last_error)
+            entry.replica = pf_rid
+            entry.attempts += 1
+            self._c_dispatch.labels(pf_rid).inc()
+            t_hop = time.perf_counter()
+            try:
+                pf = self.transports[pf_rid].prefill(
+                    entry.prompt, deadline_ms=remaining)
+            except TransportRefused as e:
+                self._release(pf_rid)
+                self._c_dispatch_fail.labels(pf_rid, "refused").inc()
+                pf_excluded.add(pf_rid)
+                last_error = f"refused: {e}"[:160]
+                continue
+            except TransportError as e:
+                self._release(pf_rid)
+                self._c_dispatch_fail.labels(pf_rid, "error").inc()
+                self._breaker_failure(pf_rid)
+                self._note_handoff("prefill_died")
+                pf_excluded.add(pf_rid)
+                failures += 1
+                last_error = str(e)[:160]
+                if failures > self.config.max_retries:
+                    return (False, failures, failovers, last_error)
+                self._retry_pause(entry, failures)
+                continue
+            self._release(pf_rid)
+            hop1_s = time.perf_counter() - t_hop
+            break
+        first = int(pf["first_token"])
+        handoff = pf["handoff"]
+        # THE journaled handoff: the first token commits before any
+        # decode dispatch, so a prefill SIGKILL from here on replays
+        # bit-exact from prefill_ids on any survivor
+        self.journal.commit(entry, 0, [first])
+        self._breaker_success(pf_rid)
+        dec_prev = None
+        refusals = 0
+        while True:                                    # ---- hop 2
+            remaining = self._remaining_ms(entry)
+            if remaining is not None and remaining <= 0:
+                self._note_handoff("deadline")
+                self._finish_error(entry, ticket, "deadline",
+                                   failures, failovers, hedged,
+                                   t_start)
+                return (True, failures, failovers, "deadline")
+            t_bk = time.perf_counter()
+            now = self._clock()
+            self.refresh()
+            drid = self._select(fps, excluded, now)
+            self._account_overhead(t_bk)
+            if drid is None or not hasattr(
+                    self.transports[drid], "decode_import"):
+                if drid is not None:
+                    self._release(drid)
+                    excluded.add(drid)
+                # the handoff has no taker: orphan it, let the
+                # monolithic machinery (continuing from the
+                # committed first token) own retries/shed
+                self._note_handoff("orphaned")
+                failures += 1
+                last_error = "no_decode_replica"
+                return (False, failures, failovers, last_error)
+            if dec_prev is not None and dec_prev != drid:
+                failovers += 1
+                self._c_failovers.inc()
+                with self._lock:
+                    self._stats["failovers"] += 1
+            dec_prev = drid
+            entry.replica = drid
+            entry.attempts += 1
+            self._c_dispatch.labels(drid).inc()
+            buf = []
+            try:
+                res = self.transports[drid].decode_import(
+                    handoff, entry.max_new_tokens,
+                    eos_id=entry.eos_id, deadline_ms=remaining,
+                    on_token=buf.append)
+            except TransportRefused as e:
+                # clean no (digest/shape drift, full pool,
+                # draining): pool untouched, breaker unchanged —
+                # try the next decode owner with the same payload.
+                # A whole fleet refusing twice over means congestion,
+                # not damage: hand the entry to the monolithic
+                # fallback, whose dispatch QUEUES engine-side
+                # instead of racing imports for free slots
+                self._release(drid)
+                self._c_dispatch_fail.labels(drid, "refused").inc()
+                excluded.add(drid)
+                last_error = f"refused: {e}"[:160]
+                refusals += 1
+                if refusals >= 2 * len(self.transports):
+                    self._note_handoff("congested")
+                    return (False, failures, failovers, last_error)
+                continue
+            except TransportError as e:
+                self._release(drid)
+                self._c_dispatch_fail.labels(drid, "error").inc()
+                self._breaker_failure(drid)
+                self._note_handoff("decode_died")
+                excluded.add(drid)
+                failures += 1
+                if buf:   # partial greedy prefix after the first
+                    # token: committed, the fallback continues it
+                    self.journal.commit(entry, 1, buf)
+                last_error = str(e)[:160]
+                if failures <= self.config.max_retries:
+                    self._retry_pause(entry, failures)
+                return (False, failures, failovers, last_error)
+            self._release(drid)
+            if res.get("shed_reason"):
+                excluded.add(drid)
+                last_error = f"replica_shed: {res['shed_reason']}"
+                continue
+            t_bk = time.perf_counter()
+            tokens = res.get("tokens") or []
+            commit = tokens if len(tokens) >= 1 + len(buf) \
+                else [first] + buf
+            self.journal.commit(entry, 0, commit)
+            self._breaker_success(drid)
+            if fps:
+                self._note_sticky(fps, drid)
+            self._note_handoff("ok",
+                               wire_bytes=payload_wire_bytes(handoff),
+                               wire_tokens=len(entry.prompt))
+            self._h_handoff.observe(
+                hop1_s + float(res.get("bind_s") or 0.0))
+            self._account_overhead(t_bk)
+            self._finish_ok(entry, ticket, drid, failures, failovers,
+                            hedged, None, t_start)
+            return (True, failures, failovers, last_error)
 
     def _begin(self, rid, entry, remaining_ms):
         """One dispatch: prefill_ids continuation + remaining token
@@ -782,9 +1065,20 @@ class Router:
                     "inflight": self._inflight[rid],
                 })
             counters = dict(self._stats)
+            prefill_tier = sorted(
+                rid for rid in self.transports
+                if (self._posture.get(rid) or {}).get("role")
+                == "prefill")
         return {
             "config": self.config.describe(),
             "counters": counters,
+            "disagg": {
+                "prefill_replicas": prefill_tier,
+                "handoffs": counters["handoffs"],
+                "handoff_failures": counters["handoff_failures"],
+                "wire_bytes": counters["wire_bytes"],
+                "wire_tokens": counters["wire_tokens"],
+            },
             "hedge": {"enabled": self.config.hedge,
                       "delay_s": round(self.hedge_delay_s(), 6)},
             "journal": self.journal.snapshot(),

@@ -108,18 +108,27 @@ class EngineGateway:
     def wait(self, req, timeout=None):
         """Block until ``req`` is done. TransportError if the gateway
         dies while waiting; returns False on timeout (request still
-        running), True when done."""
+        running), True when done.
+
+        The death check comes FIRST: ``kill()`` closes the engine,
+        which aborts in-flight requests as done-with-partial-tokens —
+        a waiter that trusted ``req.done`` on a dead gateway would
+        return that truncated stream as a success. Real SIGKILL
+        semantics: a call still unharvested when the replica dies
+        errors out (the response never arrived), and the journal
+        replay regenerates the stream bit-exact elsewhere."""
         deadline = None if timeout is None \
             else time.monotonic() + timeout
-        while not req.done:
+        while True:
             if self._dead:
                 raise TransportError(
                     f"replica {self.replica_id} died mid-request "
                     f"(rid {req.rid})")
+            if req.done:
+                return True
             if deadline is not None and time.monotonic() > deadline:
                 return False
             time.sleep(0.001)
-        return True
 
     def cancel(self, req):
         """Cancel an in-flight request: clamp its token budget so the
@@ -131,6 +140,70 @@ class EngineGateway:
                 req.max_new_tokens = max(1, len(req.generated))
         self._wake.set()
         return True
+
+    # ------------------------------------------- disaggregated hops
+    def prefill(self, prompt, deadline_ms=None, timeout=None):
+        """Hop 1 of a disaggregated request: compute the prompt's KV
+        (+ the first token) on this replica and serialize the blocks
+        for the wire. Blocking; returns ``{rid, replica_id,
+        first_token, handoff}``. TransportRefused when the engine
+        can't take it (draining / legacy pool / request expired before
+        export), TransportError when the gateway died mid-hop."""
+        if self._dead:
+            raise TransportError(f"replica {self.replica_id} is dead")
+        with self._lock:
+            try:
+                req = self.engine.add_request(
+                    prompt, 1, deadline_ms=deadline_ms, hold_kv=True)
+            except (RuntimeError, ValueError) as e:
+                # draining/closed, or no paged pool on this replica
+                raise TransportRefused(str(e)) from e
+        self._wake.set()
+        if timeout is None:
+            timeout = self.generate_timeout_s
+            if deadline_ms is not None:
+                timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        if not self.wait(req, timeout=timeout):
+            raise TransportError(
+                f"prefill timed out (rid {req.rid})")
+        if req.shed_reason or not req.generated:
+            raise TransportRefused(
+                f"prefill produced no token "
+                f"({req.shed_reason or 'deadline'})")
+        with self._lock:
+            try:
+                handoff = self.engine.export_kv(req.rid)
+            except KeyError as e:
+                # retired without its hold (expired/aborted): clean no
+                raise TransportRefused(str(e)) from e
+        return {"rid": req.rid, "replica_id": self.replica_id,
+                "first_token": int(req.generated[0]),
+                "handoff": handoff}
+
+    def import_request(self, payload, max_new_tokens, eos_id=None,
+                       deadline_ms=None, on_token=None):
+        """Hop 2 of a disaggregated request: bind a KV handoff into
+        this replica's pool and start decoding. Returns the live
+        Request as soon as the blocks are BOUND (the caller waits for
+        completion separately — the bind wall is the import half of
+        the handoff latency). TransportRefused on a payload this pool
+        rejects (digest/shape drift — the pool is untouched) or a
+        draining engine / full pool; TransportError when dead."""
+        from ..kv_wire import KVWireError
+        if self._dead:
+            raise TransportError(f"replica {self.replica_id} is dead")
+        with self._lock:
+            try:
+                req = self.engine.import_kv(
+                    payload, max_new_tokens, eos_id=eos_id,
+                    deadline_ms=deadline_ms, on_token=on_token)
+            except KVWireError as e:
+                raise TransportRefused(
+                    f"kv import refused: {e}") from e
+            except RuntimeError as e:   # draining/closed/full pool
+                raise TransportRefused(str(e)) from e
+        self._wake.set()
+        return req
 
     # ---------------------------------------------------- lifecycle
     def drain(self, wait=True, timeout=30.0):
@@ -184,10 +257,15 @@ class EngineGateway:
     def serve(self, port=0, addr="127.0.0.1"):
         """Expose the engine's full debug surface plus
         ``POST /v1/generate`` — the replica is now reachable over the
-        wire by an :class:`HTTPTransport`."""
+        wire by an :class:`HTTPTransport`. ``/v1/prefill`` and
+        ``/v1/import`` are mounted unconditionally: disaggregation is
+        a routing posture, not a capability, so every replica speaks
+        both hops (failover survivors must)."""
         return self.engine.serve_metrics(
             port=port, addr=addr,
-            post_routes={"/v1/generate": self.handle_generate})
+            post_routes={"/v1/generate": self.handle_generate,
+                         "/v1/prefill": self.handle_prefill,
+                         "/v1/import": self.handle_import})
 
     def handle_generate(self, body):
         """The ``POST /v1/generate`` handler: validate, submit, block
@@ -224,6 +302,62 @@ class EngineGateway:
             "replica_id": self.replica_id,
             "tokens": [int(t) for t in req.generated],
             "shed_reason": req.shed_reason,
+        }
+
+    def handle_prefill(self, body):
+        """``POST /v1/prefill``: run hop 1 and answer the serialized
+        handoff. 503 on refusal so :class:`_HTTPCall`'s taxonomy maps
+        it to TransportRefused (clean no, breaker untouched)."""
+        prompt = body.get("prompt")
+        if (not isinstance(prompt, list) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            return (400, {"error": "prompt must be a non-empty list "
+                                   "of token ids"})
+        try:
+            out = self.prefill(prompt,
+                               deadline_ms=body.get("deadline_ms"))
+        except TransportRefused as e:
+            return (503, {"error": "refused", "detail": str(e)[:200]})
+        except TransportError as e:
+            return (504, {"error": str(e)[:200]})
+        except (TypeError, ValueError) as e:
+            return (400, {"error": f"{type(e).__name__}: {e}"[:200]})
+        return out
+
+    def handle_import(self, body):
+        """``POST /v1/import``: bind the handoff (hop 2), decode to
+        completion, answer the full stream plus the server-measured
+        bind wall (``bind_ms`` — the import half of handoff latency,
+        unskewed by the HTTP round trip)."""
+        max_new = body.get("max_new_tokens")
+        if not isinstance(max_new, int) or max_new < 1:
+            return (400, {"error": "max_new_tokens must be an "
+                                   "int >= 1"})
+        deadline_ms = body.get("deadline_ms")
+        t0 = time.monotonic()
+        try:
+            req = self.import_request(
+                body.get("handoff"), max_new,
+                eos_id=body.get("eos_id"), deadline_ms=deadline_ms)
+        except TransportRefused as e:
+            return (503, {"error": "refused", "detail": str(e)[:200]})
+        except TransportError as e:
+            return (504, {"error": str(e)[:200]})
+        except (TypeError, ValueError) as e:
+            return (400, {"error": f"{type(e).__name__}: {e}"[:200]})
+        bind_ms = (time.monotonic() - t0) * 1000.0
+        timeout = self.generate_timeout_s
+        if deadline_ms is not None:
+            timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        if not self.wait(req, timeout=timeout):
+            return (504, {"error": "decode timed out",
+                          "rid": req.rid})
+        return {
+            "rid": req.rid,
+            "replica_id": self.replica_id,
+            "tokens": [int(t) for t in req.generated],
+            "shed_reason": req.shed_reason,
+            "bind_ms": bind_ms,
         }
 
 
@@ -276,6 +410,44 @@ class InProcessTransport:
                                   deadline_ms=deadline_ms,
                                   on_token=cb)
         return _InProcessCall(self.gateway, req)
+
+    def prefill(self, prompt, deadline_ms=None):
+        """Blocking hop 1: prompt KV + first token, serialized."""
+        if self.gateway.dead:
+            raise TransportError(
+                f"replica {self.replica_id} is dead")
+        return self.gateway.prefill(prompt, deadline_ms=deadline_ms)
+
+    def decode_import(self, handoff, max_new_tokens, eos_id=None,
+                      deadline_ms=None, on_token=None):
+        """Blocking hop 2: bind the handoff, decode to completion.
+        ``on_token`` streams post-first tokens live (the first token
+        is already journaled from hop 1). Returns the generate-shaped
+        dict plus ``bind_s``, the import-bind wall."""
+        if self.gateway.dead:
+            raise TransportError(
+                f"replica {self.replica_id} is dead")
+        cb = None
+        if on_token is not None:
+            cb = lambda _req, tok: on_token(int(tok))  # noqa: E731
+        t0 = time.monotonic()
+        req = self.gateway.import_request(
+            handoff, max_new_tokens, eos_id=eos_id,
+            deadline_ms=deadline_ms, on_token=cb)
+        bind_s = time.monotonic() - t0
+        timeout = self.gateway.generate_timeout_s
+        if deadline_ms is not None:
+            timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        if not self.gateway.wait(req, timeout=timeout):
+            raise TransportError(
+                f"in-process decode timed out (rid {req.rid})")
+        return {
+            "rid": req.rid,
+            "replica_id": self.replica_id,
+            "tokens": [int(t) for t in req.generated],
+            "shed_reason": req.shed_reason,
+            "bind_s": bind_s,
+        }
 
     def health(self):
         eng = self.gateway.engine
@@ -375,6 +547,38 @@ class HTTPTransport:
         if deadline_ms is not None:
             timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
         return _HTTPCall(self.url + "/v1/generate", payload, timeout)
+
+    def prefill(self, prompt, deadline_ms=None):
+        """Blocking hop 1 over the wire: POST ``/v1/prefill``."""
+        payload = {"prompt": [int(t) for t in prompt]}
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        timeout = self.timeout_s
+        if deadline_ms is not None:
+            timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        return _HTTPCall(self.url + "/v1/prefill", payload,
+                         timeout).result(timeout=timeout)
+
+    def decode_import(self, handoff, max_new_tokens, eos_id=None,
+                      deadline_ms=None, on_token=None):
+        """Blocking hop 2 over the wire: POST ``/v1/import``.
+        ``on_token`` is unused (request/response wire) — a mid-stream
+        decode death degrades to full re-dispatch on a survivor,
+        which greedy determinism keeps bit-exact."""
+        payload = {"handoff": handoff,
+                   "max_new_tokens": int(max_new_tokens)}
+        if eos_id is not None:
+            payload["eos_id"] = int(eos_id)
+        if deadline_ms is not None:
+            payload["deadline_ms"] = float(deadline_ms)
+        timeout = self.timeout_s
+        if deadline_ms is not None:
+            timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
+        out = _HTTPCall(self.url + "/v1/import", payload,
+                        timeout).result(timeout=timeout)
+        if "bind_ms" in out:
+            out["bind_s"] = float(out.pop("bind_ms")) / 1000.0
+        return out
 
     def _get(self, path):
         try:

@@ -37,7 +37,7 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens, eos_id=None,
                  on_token=None, temperature=0.0, top_k=0, top_p=1.0,
-                 seed=None, deadline_ms=None):
+                 seed=None, deadline_ms=None, hold_kv=False):
         self.rid = next(_rid)
         self.prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
         if self.prompt.size == 0:
@@ -68,6 +68,11 @@ class Request:
         if self.deadline_ms is not None and self.deadline_ms <= 0:
             raise ValueError(
                 f"deadline_ms must be > 0, got {deadline_ms}")
+        # disaggregation: a prefill-tier request keeps its slot (and
+        # the KV blocks under it) live past retirement so export_kv()
+        # can serialize the prompt's blocks for the wire. The export
+        # path — or abort/close — releases the slot.
+        self.hold_kv = bool(hold_kv)
         self.state = QUEUED
         self.slot = None
         self.generated = []
@@ -449,11 +454,17 @@ class StepScheduler:
 
     def finish(self, request, pool):
         """Retire a request: free its slot (unless prereleased) for
-        the next admission."""
+        the next admission. A ``hold_kv`` request keeps its slot — and
+        the KV blocks under it — parked for export_kv(); only the
+        active-table entry is dropped so the scheduler stops stepping
+        it."""
         if request.slot is not None:
-            pool.release(request.slot)
-            del self.active[request.slot]
-            request.slot = None
+            if request.hold_kv:
+                del self.active[request.slot]
+            else:
+                pool.release(request.slot)
+                del self.active[request.slot]
+                request.slot = None
         request.state = DONE
         request.t_done = time.perf_counter()
         self.completed.append(request)

@@ -39,9 +39,14 @@ def main():
                               max_seq_len=64, dropout=0.0)
     m = GPTForCausalLM(cfg)
     m.eval()
+    # ROUTER_ROLE stamps this replica into a disaggregated tier
+    # (prefill|decode|monolithic). Non-monolithic roles require the
+    # paged pool (the KV wire unit is the paged block)
+    role = os.environ.get("ROUTER_ROLE", "monolithic")
+    paged = (role != "monolithic"
+             or os.environ.get("ROUTER_PAGED", "0") == "1")
     eng = ServingEngine(
-        m, num_slots=2, bucket_min=8,
-        paged=os.environ.get("ROUTER_PAGED", "0") == "1",
+        m, num_slots=2, bucket_min=8, paged=paged, role=role,
         replica_id=os.environ.get("ROUTER_REPLICA_ID"),
         slo_ttft_ms=60000.0)
     gateway = EngineGateway(eng)
@@ -59,6 +64,11 @@ def main():
             max_new_tokens=4) for _ in range(2)]
     for req in pair:
         gateway.wait(req, timeout=120.0)
+    if eng.paged:
+        # warm the KV export/import programs too: the disagg drill's
+        # steady-state compile audit covers handoff traffic
+        with gateway._lock:
+            eng.warmup_kv_handoff()
     eng.declare_warmup()
     handle = gateway.serve(port=int(os.environ.get("ROUTER_PORT",
                                                    "0")))

@@ -442,7 +442,7 @@ def test_lint_graft_self_lints_repo_clean():
     assert set(report["targets"]) == {"serving_decode", "paged_decode",
                                       "paged_decode_pallas",
                                       "chunked_prefill", "spec_verify",
-                                      "hapi_train_step",
+                                      "kv_wire", "hapi_train_step",
                                       "to_static_sample"}
     assert {"donation", "dynamic-shape-risk", "f64-upcast",
             "host-callback"} <= set(report["passes"])

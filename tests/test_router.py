@@ -563,6 +563,33 @@ def test_router_affinity_follows_heat_until_overloaded():
     router.close()
 
 
+def test_router_affinity_tie_break_is_replica_id_ordered():
+    """PR-17 satellite: score ties break by replica id, never by dict
+    insertion order — the posture map is rebuilt every refresh in
+    whatever order transports answered, so insertion order is noise.
+    Pinned both at the helper (all permutations of an equal-score
+    dict agree) and end-to-end (reversed transport registration
+    places identically)."""
+    import itertools as it
+    for perm in it.permutations([("rc", 5), ("ra", 5), ("rb", 5)]):
+        assert Router._best_scored(dict(perm)) == "ra"
+    # higher score still wins outright regardless of id order
+    assert Router._best_scored({"rz": 9, "ra": 5}) == "rz"
+    prompt = list(range(8))
+    fps = prompt_fingerprints(prompt, 4)
+    heat = [{"fp": fp, "tokens_saved": 64} for fp in fps]
+
+    def winner(order):
+        ts = [_FakeTransport(r, heat=heat) for r in order]
+        router = Router(ts, config=_cfg(affinity_block=4,
+                                        affinity_spill=4))
+        res = router.generate(prompt, 3, timeout=10.0)
+        router.close()
+        return res["replica_id"]
+
+    assert winner(["a", "b"]) == winner(["b", "a"]) == "a"
+
+
 def test_router_sticky_placement_without_heat():
     """The router's own placements feed affinity too: the same prefix
     keeps landing on the replica that served it first (load ties)."""
@@ -671,6 +698,29 @@ def _reference_streams(prompts, max_new):
     out = [[int(t) for t in r.generated] for r in reqs]
     eng.close()
     return out
+
+
+def test_gateway_kill_never_surfaces_aborted_request_as_success():
+    """kill() closes the engine, which aborts in-flight requests as
+    done-with-partial-tokens and no shed verdict. A waiter must see
+    TransportError for them — trusting ``req.done`` on a dead gateway
+    would hand the router a truncated stream as a committed success
+    (the parity-breaking race the bench kill drill caught)."""
+    gw = _gateway("rkill")
+    prompt = np.asarray([5, 9, 2, 7, 1], dtype=np.int64)
+    warm = gw.submit(prompt, max_new_tokens=2)
+    assert gw.wait(warm, timeout=120.0)
+    req = gw.submit(prompt, max_new_tokens=48)
+    deadline = time.monotonic() + 10.0
+    while not req.generated and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert req.generated, "request never started decoding"
+    gw.kill()
+    # the abort marked it done with a truncated stream...
+    assert req.done and len(req.generated) < 48
+    # ...which the transport layer must refuse to report as success
+    with pytest.raises(TransportError):
+        gw.wait(req, timeout=5.0)
 
 
 def test_router_drain_aware_admission_two_replicas():
@@ -802,4 +852,29 @@ def test_router_drill_fast_subprocess_self_run():
     assert result["result"] == "PASS"
     assert waves["failover"]["lost"] == []
     assert waves["failover"]["parity_mismatch"] == []
+    assert waves["baseline_no_failover"]["lost"]   # kill HURT there
+
+
+def test_router_drill_prefill_kill_subprocess():
+    """tools/router_drill.py --kill prefill (satellite 2): the 1P+2D
+    disaggregated drill — wave 1 completes through real KV handoffs,
+    wave 2 SIGKILLs the PREFILL tier mid-handoff and every request
+    still completes bit-exact with zero leaked blocks on both tiers,
+    and the no-failover baseline demonstrably loses work."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, _DRILL, "--fast", "--kill", "prefill",
+         "--requests", "6", "--max-new", "10"],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, \
+        f"disagg drill failed:\n{proc.stdout}\n{proc.stderr}"
+    lines = [json.loads(ln) for ln in proc.stdout.splitlines()
+             if ln.strip()]
+    waves = {e.get("wave"): e for e in lines if "wave" in e}
+    assert lines[-1]["result"] == "PASS"
+    assert waves["reference"]["handoffs"] > 0      # two-hop path ran
+    assert waves["reference"]["wire_bytes"] > 0
+    assert waves["failover"]["lost"] == []
+    assert waves["failover"]["parity_mismatch"] == []
+    assert waves["failover"]["killed"] == "dr0"    # the prefill tier
     assert waves["baseline_no_failover"]["lost"]   # kill HURT there

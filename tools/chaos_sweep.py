@@ -145,6 +145,97 @@ def _check_cell(site, seed, model, specs, reference, paged, chunk,
     return out
 
 
+def _check_handoff_cell(seed, model, specs, reference):
+    """Disaggregated KV-handoff cell (ISSUE 17): every request
+    prefills on a prefill-role engine, crosses the wire as a
+    serialized block payload, and decodes on a decode-role engine —
+    with a seeded fraction of payloads corrupted in flight (digest
+    flip / dropped frame / garbled base64). The contract: corruption
+    raises the TYPED wire error and never poisons the decode pool (a
+    clean retry of the same handoff must succeed and stay bit-exact
+    with the monolithic reference), both tiers end block-clean, and
+    the same seed reproduces the same corruption schedule and
+    streams."""
+    import copy
+
+    import numpy as np
+
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.kv_wire import KVWireError
+
+    def corrupt(rs, payload):
+        bad = copy.deepcopy(payload)
+        kind = int(rs.randint(3))
+        if kind == 0:
+            f = bad["frames"][int(rs.randint(len(bad["frames"])))]
+            f["digest"] = (f["digest"] + 1) % (1 << 32)
+        elif kind == 1:
+            bad["frames"].pop()
+        else:
+            bad["frames"][0]["k"] = "!!notb64"
+        return bad
+
+    def run_once():
+        pe = ServingEngine(model, num_slots=4, bucket_min=8,
+                           paged=True, role="prefill")
+        de = ServingEngine(model, num_slots=4, bucket_min=8,
+                           paged=True, role="decode")
+        rs = np.random.RandomState(seed)
+        streams, faults = [], 0
+        try:
+            for p, k in specs:
+                req = pe.add_request(p, max_new_tokens=1, hold_kv=True)
+                pe.run()
+                payload = pe.export_kv(req.rid)
+                if rs.rand() < 0.4:
+                    faults += 1
+                    try:
+                        de.import_kv(corrupt(rs, payload),
+                                     max_new_tokens=int(k))
+                    except KVWireError:
+                        pass
+                    else:
+                        return None, faults, \
+                            "corrupted import did not raise KVWireError"
+                dreq = de.import_kv(payload, max_new_tokens=int(k))
+                de.run()
+                streams.append(list(dreq.generated))
+            for eng, tier in ((pe, "prefill"), (de, "decode")):
+                if eng._held_exports:
+                    return None, faults, f"held-export leak: {tier}"
+                try:
+                    eng.pool.check_conservation()
+                except AssertionError as e:
+                    return None, faults, \
+                        f"{tier} block conservation: {e}"
+                if eng.pool.live_blocks > 0:
+                    return None, faults, f"live blocks at idle: {tier}"
+        finally:
+            pe.close()
+            de.close()
+        return streams, faults, None
+
+    out = {"site": "kv_handoff", "seed": seed, "paged": True, "ok": True}
+    streams, faults, reason = run_once()
+    out["faults"] = {"kv_wire_corruption": faults}
+    if reason:
+        return dict(out, ok=False, reason=reason)
+    bad = [i for i, (got, want) in enumerate(zip(streams, reference))
+           if got != want]
+    if bad:
+        return dict(out, ok=False,
+                    reason=f"handoff parity break on requests {bad}")
+    streams2, faults2, reason2 = run_once()
+    if reason2:
+        return dict(out, ok=False, reason=f"rerun: {reason2}")
+    if faults2 != faults:
+        return dict(out, ok=False,
+                    reason="corruption schedule not deterministic")
+    if streams2 != streams:
+        return dict(out, ok=False, reason="streams not deterministic")
+    return out
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description=__doc__.splitlines()[0])
@@ -226,6 +317,20 @@ def main(argv=None):
             result = _check_cell("decode_dispatch", seed, model,
                                  spec_specs, reference, paged, chunk,
                                  spec=True)
+            print(json.dumps(result), flush=True)
+            if not result["ok"]:
+                failures += 1
+    # disaggregated KV-handoff cells (ISSUE 17), paged pool only (the
+    # wire unit IS the paged block): seeded in-flight corruption must
+    # surface as the typed wire error without poisoning the decode
+    # pool, clean retries stay bit-exact with a monolithic reference,
+    # and both tiers end block-clean
+    if True in pools:
+        reference, _, _, _ = _drain(model, specs, True, chunk=chunk)
+        assert reference is not None, "handoff reference drain hung"
+        for seed in seeds:
+            cells += 1
+            result = _check_handoff_cell(seed, model, specs, reference)
             print(json.dumps(result), flush=True)
             if not result["ok"]:
                 failures += 1

@@ -31,6 +31,14 @@ Builds the three kinds of compiled programs this framework ships —
     falls back to must all stay f64/donation clean — the verify
     flavor donates kc/vc/pos exactly like decode, shifted past the
     drafts/dlen host inputs;
+  * ``kv_wire``          — a disaggregated KV handoff between a
+    prefill-role and a decode-role paged engine: the ``kv_import``
+    program is linted like any other jitted entry point, a SECOND
+    handoff after ``declare_warmup`` must not compile (export/import
+    are dispatch-only on the steady-state hot path), and the export
+    program's device->host transfer must stay per-slot sized — an
+    export whose outputs approach the full pool is a ``device_get``
+    of the whole KV cache wearing a trench coat (error severity);
   * ``hapi_train_step``  — a hapi.Model static-adapter train step
     (forward + loss + backward + optimizer captured as ONE to_static
     program), linted via ``TracedFunction.lint()``;
@@ -191,6 +199,78 @@ def lint_spec_verify():
     return findings
 
 
+def lint_kv_wire():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import Finding
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models import GPTForCausalLM, TransformerLMConfig
+
+    def build(role):
+        paddle.seed(7)
+        cfg = TransformerLMConfig(vocab_size=97, hidden_size=32,
+                                  num_layers=2, num_heads=4,
+                                  max_seq_len=64, dropout=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        return ServingEngine(model, num_slots=4, bucket_min=8,
+                             paged=True, block_size=8, role=role)
+
+    pe, de = build("prefill"), build("decode")
+    rs = np.random.RandomState(0)
+
+    def handoff(n):
+        req = pe.add_request(rs.randint(0, 97, (n,)).astype(np.int64),
+                             max_new_tokens=1, hold_kv=True)
+        pe.run()
+        payload = pe.export_kv(req.rid)
+        dreq = de.import_kv(payload, max_new_tokens=4)
+        de.run()
+        assert dreq.state == "done" and len(dreq.generated) == 4, \
+            "kv_wire lint target never completed an imported decode"
+        return payload
+
+    handoff(13)                 # warm both tiers' handoff programs
+    pe.warmup_kv_handoff()
+    de.warmup_kv_handoff()
+    pe.declare_warmup()
+    de.declare_warmup()
+    findings = []
+    c0 = (pe.metrics.compiles, de.metrics.compiles)
+    handoff(14)                 # different length, same prefill bucket
+    c1 = (pe.metrics.compiles, de.metrics.compiles)
+    if c1 != c0:
+        findings.append(Finding(
+            "kv_wire_steady_state", "error",
+            "ServingEngine.export_kv/import_kv",
+            f"a steady-state handoff compiled (prefill {c0[0]}->{c1[0]}, "
+            f"decode {c0[1]}->{c1[1]}) — the KV wire path must be "
+            f"dispatch-only after warmup_kv_handoff"))
+    # the export program's device->host transfer must be ONE slot's
+    # blocks, never the pool: abstract-eval the export and compare its
+    # output bytes against the pool it reads from
+    pool = pe.pool
+    idx = np.zeros((pool.blocks_per_slot,), np.int32)
+    out = jax.eval_shape(pe._kv_export_fn, pool.kc, pool.vc, idx)
+    out_bytes = sum(int(np.prod(o.shape)) * o.dtype.itemsize
+                    for o in jax.tree_util.tree_leaves(out))
+    pool_bytes = pool.kc.nbytes + pool.vc.nbytes
+    if out_bytes * 2 > pool_bytes:
+        findings.append(Finding(
+            "kv_wire_transfer", "error",
+            "ServingEngine._kv_export_fn",
+            f"export fetches {out_bytes} bytes against a "
+            f"{pool_bytes}-byte pool — a per-slot slice should be a "
+            f"small fraction; this is a device_get of the pool"))
+    # the import program is a jitted entry point like any other: the
+    # f64-upcast / host-callback / donation passes must stay clean
+    findings += de.lint(program="kv_import")
+    pe.close()
+    de.close()
+    return findings
+
+
 def lint_hapi_train_step():
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
@@ -243,6 +323,7 @@ TARGETS = {
     "paged_decode_pallas": lint_paged_decode_pallas,
     "chunked_prefill": lint_chunked_prefill,
     "spec_verify": lint_spec_verify,
+    "kv_wire": lint_kv_wire,
     "hapi_train_step": lint_hapi_train_step,
     "to_static_sample": lint_to_static_sample,
 }

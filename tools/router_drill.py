@@ -25,8 +25,18 @@ demonstrably lost the dead replica's in-flight work); exit 1 names
 the lost/mismatched rids. One JSON line per wave on stdout, RESULT
 line last — the same scriptable-gate discipline as chaos_sweep.py.
 
+``--kill prefill`` runs the DISAGGREGATED flavor: replica 0 comes up
+as the prefill tier, the rest as decode (paged pools + warmed KV
+export/import programs), wave 1 must complete through real handoffs
+(``disagg.handoffs > 0``), and wave 2 SIGKILLs the PREFILL replica
+mid-handoff — every request must still complete bit-exact via the
+journaled first token (or a full monolithic replay on a decode
+survivor when hop 1 never finished), with zero leaked blocks and
+zero steady-state compiles on both tiers.
+
     python tools/router_drill.py              # 3 replicas, 12 reqs
     python tools/router_drill.py --fast       # the tier-1 cell
+    python tools/router_drill.py --fast --kill prefill   # disagg cell
 """
 import argparse
 import json
@@ -45,11 +55,13 @@ _WORKER = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "tests", "router_replica_worker.py")
 
 
-def _spawn(idx):
+def _spawn(idx, role=None):
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     env["ROUTER_REPLICA_ID"] = f"dr{idx}"
     env.setdefault("ROUTER_PORT", "0")
+    if role is not None:
+        env["ROUTER_ROLE"] = role
     proc = subprocess.Popen(
         [sys.executable, _WORKER], env=env, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True)
@@ -120,14 +132,36 @@ def _wait_inflight(urls, deadline_s=30.0):
     return None
 
 
+def _leak_audit(url, rid, paged, failures):
+    st = _get(url, "/debug/state")
+    if st.get("queue_depth", 0) != 0 \
+            or st.get("slot_occupancy", 0) != 0 \
+            or st.get("held_exports", 0) != 0:
+        failures.append(
+            f"leak on {rid}: queue_depth={st.get('queue_depth')} "
+            f"slot_occupancy={st.get('slot_occupancy')} "
+            f"held_exports={st.get('held_exports')}")
+    if paged:
+        pool = (st.get("prefix_cache") or {}).get("pool") or {}
+        # indexed prefix blocks are CACHE, not leaks — live counts
+        # only blocks some slot still references
+        if pool.get("live_blocks", 0) != 0:
+            failures.append(
+                f"leaked blocks on {rid}: "
+                f"live_blocks={pool.get('live_blocks')}")
+
+
 def run_drill(replicas=3, requests=12, max_new=16, seed=5,
-              fault_rate=0.1, out=sys.stdout):
+              fault_rate=0.1, kill="replica", out=sys.stdout):
     from paddle_tpu.serving.resilience.chaos import (FaultPlan,
                                                      FaultSpec)
     from paddle_tpu.serving.router import (HTTPTransport, Router,
                                            RouterConfig)
 
-    procs = [_spawn(i) for i in range(replicas)]
+    disagg = kill == "prefill"
+    roles = (["prefill"] + ["decode"] * (replicas - 1)) if disagg \
+        else [None] * replicas
+    procs = [_spawn(i, role=r) for i, r in enumerate(roles)]
     failures = []
     try:
         infos = [_ready(p) for p in procs]
@@ -146,24 +180,52 @@ def run_drill(replicas=3, requests=12, max_new=16, seed=5,
                                 refresh_s=0.1, backoff_base_s=0.05,
                                 backoff_max_s=0.5, seed=seed)
 
+        # in disagg mode the steady-state compile audit covers the
+        # HANDOFF traffic too: baseline every replica before wave 1
+        compiles_w0 = {u: _compiles(u) for u in urls} if disagg \
+            else {}
+
         # ---- wave 1: reference (no kill) — the parity oracle
         router = Router(transports(urls), config=cfg(max_retries=3))
         ref = _route_wave(router, prompts, max_new)
+        w1_state = router.state()
         router.close()
         ref_ok = sum(1 for r in ref if r["ok"])
-        print(json.dumps({"wave": "reference", "ok": ref_ok,
-                          "total": requests}), file=out, flush=True)
+        w1_line = {"wave": "reference", "ok": ref_ok,
+                   "total": requests}
+        if disagg:
+            w1_line["handoffs"] = w1_state["disagg"]["handoffs"]
+            w1_line["wire_bytes"] = w1_state["disagg"]["wire_bytes"]
+        print(json.dumps(w1_line), file=out, flush=True)
         if ref_ok != requests:
+            bad = [(r["rid"], r.get("reason")) for r in ref
+                   if not r["ok"]]
             failures.append(
-                f"reference wave incomplete: {ref_ok}/{requests}")
+                f"reference wave incomplete: {ref_ok}/{requests} "
+                f"{bad}")
             return failures
+        if disagg:
+            if w1_state["disagg"]["handoffs"] == 0:
+                failures.append(
+                    "disagg reference wave completed without a "
+                    "single KV handoff — the two-hop path never ran")
+            # the prefill tier is about to die: audit it NOW (zero
+            # leaked blocks, zero steady-state compiles under
+            # handoff traffic)
+            _leak_audit(urls[0], rids[0], True, failures)
+            after0 = _compiles(urls[0])
+            if after0 != compiles_w0[urls[0]]:
+                failures.append(
+                    f"steady-state compiles on prefill tier "
+                    f"{rids[0]}: {compiles_w0[urls[0]]} -> {after0}")
         ref_streams = [r["tokens"] for r in ref]
 
         # ---- wave 2: failover — SIGKILL mid-traffic + seeded
         # router_dispatch faults; identical prompts, 100% + parity
         # demanded
         survivors = urls[1:]
-        compiles_before = {u: _compiles(u) for u in survivors}
+        compiles_before = {u: compiles_w0[u] for u in survivors} \
+            if disagg else {u: _compiles(u) for u in survivors}
         plan = FaultPlan(seed=seed, faults={
             "router_dispatch": FaultSpec(rate=fault_rate)})
         router = Router(transports(urls), config=cfg(max_retries=4),
@@ -186,12 +248,17 @@ def run_drill(replicas=3, requests=12, max_new=16, seed=5,
         mismatch = [r["rid"] for i, r in enumerate(res)
                     if r["ok"] and r["tokens"] != ref_streams[i]]
         failmoves = state["counters"]["failovers"]
-        print(json.dumps({
+        w2_line = {
             "wave": "failover", "ok": len(ok), "shed": len(shed),
             "lost": lost, "parity_mismatch": mismatch,
             "failovers": failmoves,
             "retries": state["counters"]["retries"],
-            "killed": by_url[victim]}), file=out, flush=True)
+            "killed": by_url[victim]}
+        if disagg:
+            w2_line["handoffs"] = state["disagg"]["handoffs"]
+            w2_line["handoff_failures"] = \
+                state["disagg"]["handoff_failures"]
+        print(json.dumps(w2_line), file=out, flush=True)
         if lost:
             failures.append(f"failover wave lost rids: {lost}")
         if mismatch:
@@ -201,13 +268,7 @@ def run_drill(replicas=3, requests=12, max_new=16, seed=5,
             failures.append("failover wave accounting does not add up")
         # leak + steady-state-compile audit on the survivors
         for u in survivors:
-            st = _get(u, "/debug/state")
-            if st.get("queue_depth", 0) != 0 \
-                    or st.get("slot_occupancy", 0) != 0:
-                failures.append(
-                    f"leak on {by_url[u]}: queue_depth="
-                    f"{st.get('queue_depth')} slot_occupancy="
-                    f"{st.get('slot_occupancy')}")
+            _leak_audit(u, by_url[u], disagg, failures)
             after = _compiles(u)
             if after != compiles_before[u]:
                 failures.append(
@@ -260,6 +321,12 @@ def main(argv=None):
     parser.add_argument("--fault-rate", type=float, default=0.1,
                         help="seeded router_dispatch fault rate for "
                              "the failover wave")
+    parser.add_argument("--kill", choices=("replica", "prefill"),
+                        default="replica",
+                        help="replica: SIGKILL a monolithic replica "
+                             "(the classic drill); prefill: 1P+ND "
+                             "disaggregated topology, SIGKILL the "
+                             "prefill tier mid-handoff")
     parser.add_argument("--fast", action="store_true",
                         help="the tier-1 cell: 3 replicas, fewer/"
                              "shorter requests")
@@ -274,7 +341,7 @@ def main(argv=None):
     failures = run_drill(replicas=args.replicas,
                          requests=args.requests,
                          max_new=args.max_new, seed=args.seed,
-                         fault_rate=args.fault_rate)
+                         fault_rate=args.fault_rate, kill=args.kill)
     verdict = "PASS" if not failures else "FAIL"
     print(json.dumps({"result": verdict,
                       "failures": failures,
