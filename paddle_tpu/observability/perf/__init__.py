@@ -30,8 +30,8 @@ from .attribution import (  # noqa: F401
     format_program_key,
 )
 from .ledger import (  # noqa: F401
-    LEDGER_ROW_KEYS, PERF_LEDGER_SCHEMA, append_rows, compact,
-    compare, config_digest, make_row, read_rows,
+    LEDGER_ROW_KEYS, MEASUREMENTS, PERF_LEDGER_SCHEMA, append_rows,
+    compact, compare, config_digest, make_row, prune, read_rows,
 )
 from .roofline import (  # noqa: F401
     PAGED_GATHER_FACTOR, REF_HBM_BPS, REF_PEAK_FLOPS,

@@ -27,6 +27,13 @@ relative worsening exceeds the threshold AND clears a MAD-based noise
 gate over that history (a single noisy baseline row can't shadow-ban
 a metric, a genuinely bimodal history widens its own gate).
 
+Retention and triage are separate knobs: ``compact()`` bounds healthy
+history (newest N rows per series), ``prune()`` retires poisoned
+history — a host-overloaded run whose trailing rows keep the gate
+red, or a renamed metric's stale series (tools/perf_diff.py
+--prune-run / --prune-series, so triage is recorded CLI usage, not a
+hand edit).
+
 Deliberately dependency-free (stdlib only): tools/perf_diff.py loads
 this file directly via importlib, so the CI gate starts in
 milliseconds without importing paddle_tpu (or jax).
@@ -45,6 +52,13 @@ LEDGER_ROW_KEYS = (
 
 _DIRECTIONS = ("higher_better", "lower_better")
 
+# optional writer-declared row provenance: "timed" rows ride wall
+# clocks (noisy on a shared smoke runner), "deterministic" rows are
+# measured from live run counters but fully determined by the seeded
+# workload + code (zero variance across healthy runs — any movement
+# IS a code-path change, so they carry tight thresholds)
+MEASUREMENTS = ("timed", "deterministic")
+
 
 def config_digest(config):
     """Short stable digest of a (JSON-serializable) config dict: rows
@@ -56,10 +70,11 @@ def config_digest(config):
 
 def make_row(*, timestamp, run_id, source, scenario, metric, value,
              unit, direction, config_digest, device,
-             rel_threshold=None):
+             rel_threshold=None, measurement=None):
     """Validated ledger row. ``timestamp`` is caller-provided (no
     clock reads here); ``direction`` must name which way is worse;
-    ``value`` must be a finite number."""
+    ``value`` must be a finite number; ``measurement`` optionally
+    declares the row's provenance (see ``MEASUREMENTS``)."""
     if direction not in _DIRECTIONS:
         raise ValueError(f"direction must be one of {_DIRECTIONS}, "
                          f"got {direction!r}")
@@ -86,6 +101,11 @@ def make_row(*, timestamp, run_id, source, scenario, metric, value,
         if not (0.0 < t < 10.0):
             raise ValueError(f"rel_threshold out of range: {t}")
         row["rel_threshold"] = t
+    if measurement is not None:
+        if measurement not in MEASUREMENTS:
+            raise ValueError(f"measurement must be one of "
+                             f"{MEASUREMENTS}, got {measurement!r}")
+        row["measurement"] = measurement
     return row
 
 
@@ -155,6 +175,43 @@ def compact(path, keep_last):
             keep.add(id(row))
     kept = [r for r in rows if id(r) in keep]
     tmp = path + ".compact.tmp"
+    with open(tmp, "w") as fh:
+        for row in kept:
+            fh.write(json.dumps(row, sort_keys=True) + "\n")
+    os.replace(tmp, path)
+    return len(kept), len(rows) - len(kept) + skipped
+
+
+def prune(path, run_ids=(), series=()):
+    """Triage the ledger: atomically rewrite it DROPPING every row
+    whose ``run_id`` is in ``run_ids``, or whose (scenario, metric)
+    matches a ``"scenario/metric"`` spec in ``series``.
+
+    ``compact`` bounds healthy history; ``prune`` retires poisoned
+    history — a host-overloaded run that left red verdicts behind
+    (``compare()`` judges each series' LAST row, so one bad trailing
+    run keeps the gate red until a newer run lands or the bad rows
+    are pruned), or a retired metric name whose stale series would
+    otherwise shadow the trajectory table forever. Exposed as
+    ``tools/perf_diff.py --prune-run / --prune-series`` so triage is
+    a recorded CLI operation, not a hand edit. Junk lines and foreign
+    schemas are dropped like ``compact`` does (they were already
+    invisible to ``compare()``); the rewrite is atomic (temp file +
+    replace). Returns ``(kept, dropped)`` row counts."""
+    import os
+    run_ids = {str(r) for r in run_ids}
+    pairs = set()
+    for spec in series:
+        scenario, sep, metric = str(spec).partition("/")
+        if not sep or not scenario or not metric:
+            raise ValueError(f"series spec must be "
+                             f"'scenario/metric', got {spec!r}")
+        pairs.add((scenario, metric))
+    rows, skipped = read_rows(path)
+    kept = [r for r in rows
+            if r.get("run_id") not in run_ids
+            and (r.get("scenario"), r.get("metric")) not in pairs]
+    tmp = path + ".prune.tmp"
     with open(tmp, "w") as fh:
         for row in kept:
             fh.write(json.dumps(row, sort_keys=True) + "\n")

@@ -42,7 +42,7 @@ def test_bench_emits_cached_first_final_last_rc0():
     assert last["value"] > 0
 
 
-def test_bench_serving_smoke_emits_contract_line_rc0():
+def test_bench_serving_smoke_emits_contract_line_rc0(tmp_path):
     """bench_serving.py --smoke: a live CPU measurement in seconds,
     emitting the serving_decode_tokens_per_sec JSON line in bench.py's
     artifact-backed format (value > 0, vs_baseline = engine over
@@ -56,6 +56,16 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
     # fast beats so the run is long enough to capture several ledger-
     # attributed heartbeat lines (the wedge-attribution satellite)
     env["BENCH_HEARTBEAT_SECS"] = "2"
+    # this bench run shares the host with the rest of tier-1, so its
+    # wall clocks measure suite contention — the rows go to a scratch
+    # ledger (asserted below), never into the repo ledger that
+    # tools/perf_diff.py gates real runs against
+    scratch_ledger = tmp_path / "perf_ledger.jsonl"
+    env["BENCH_LEDGER_PATH"] = str(scratch_ledger)
+    _repo_ledger = os.path.join(_ROOT, "bench_artifacts",
+                                "perf_ledger.jsonl")
+    repo_size = os.path.getsize(_repo_ledger) \
+        if os.path.exists(_repo_ledger) else None
     try:
         res = subprocess.run(
             [sys.executable, os.path.join(_ROOT, "bench_serving.py"),
@@ -324,12 +334,15 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         rt = evidence["router"]
         assert set(rt) >= {"replicas", "requests",
                            "goodput_tokens_per_sec", "goodput_x",
-                           "failover", "no_failover_baseline",
-                           "overhead"}
+                           "goodput_attempts", "failover",
+                           "no_failover_baseline", "overhead"}
         assert rt["replicas"] == 3
         assert set(rt["goodput_tokens_per_sec"]) == {"1", "2", "3"}
         assert all(v > 0 for v in
                    rt["goodput_tokens_per_sec"].values())
+        # the noise re-measure loop ran 1-3 scaling attempts and
+        # kept the best ratio
+        assert 1 <= len(rt["goodput_attempts"]) <= 3
         # in-process replicas share one CPU: the bar is sanity (the
         # router must not DESTROY throughput), not linear scaling
         assert rt["goodput_x"] > 0.5, rt
@@ -364,7 +377,13 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         for arm in (dk["xla"], dk["pallas"]):
             assert arm["decode_avg_ms"] > 0
             assert arm["roofline_fraction"] is not None
-        assert last["decode_kernel_speedup_x"] == dk["speedup_x"]
+        # interpret-mode runs emit the A/B ratio under an honest key
+        # ("speedup" is reserved for real-backend runs) — the smoke
+        # runner is CPU, so the interpret key is the expected one
+        dk_key = ("decode_kernel_interp_ratio_x" if dk["interpret"]
+                  else "decode_kernel_speedup_x")
+        assert last[dk_key] == dk["speedup_x"]
+        assert ("decode_kernel_speedup_x" in last) != dk["interpret"]
         # PR 16 speculative decoding A/B: the spec arm vs plain decode
         # on identical shared-prefix traffic — greedy streams bit-exact
         # between the arms (the hard contract), real drafting on the
@@ -405,7 +424,11 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         dz = evidence["disagg"]
         assert set(dz) >= {"topology", "requests", "monolithic",
                            "disagg", "ttft", "decode_goodput_x",
-                           "wire"}
+                           "wire", "attempts"}
+        # the noise re-measure loop ran 1-3 paired attempts and kept
+        # the best pair; each attempt reports [ttft_x, goodput_x]
+        assert 1 <= len(dz["attempts"]) <= 3
+        assert all(len(a) == 2 for a in dz["attempts"])
         assert dz["topology"] == {"prefill": 1, "decode": 2,
                                   "monolithic_baseline": 3}
         assert dz["ttft"]["improvement_x"] > 1.0, dz
@@ -440,6 +463,19 @@ def test_bench_serving_smoke_emits_contract_line_rc0():
         # any earlier lines are provisional cached ones, marked so
         for ln in lines[:-1]:
             assert ln["source"] == "cached" and "note" in ln
+        # the run's perf-ledger rows landed in the scratch ledger —
+        # valid rows, attributed to this run — and the repo ledger
+        # was not touched (suite-contention wall clocks must never
+        # enter the gated cross-run trajectory)
+        from paddle_tpu.observability.perf import read_rows
+        lrows, lskipped = read_rows(str(scratch_ledger))
+        assert lrows and lskipped == 0
+        assert all(r["run_id"] == os.path.basename(art)
+                   for r in lrows)
+        repo_ledger = os.path.join(_ROOT, "bench_artifacts",
+                                   "perf_ledger.jsonl")
+        if repo_size is not None:
+            assert os.path.getsize(repo_ledger) == repo_size
     finally:
         for f in set(glob.glob(smoke_glob)) - before:
             os.unlink(f)  # this test's artifact is noise in git

@@ -23,8 +23,16 @@ via tests/test_perf.py, which self-runs it against synthetic ledgers
 self-run discipline as tools/incident_report.py and
 tools/chaos_sweep.py --fast.
 
+``--prune-run RUN_ID`` / ``--prune-series SCENARIO/METRIC``
+(repeatable) rewrite the ledger first, dropping a poisoned run's rows
+or retiring a stale metric series (ledger.prune — the recorded triage
+operation; compare() judges each series' LAST row, so a bad trailing
+run keeps the gate red until triaged or outrun), then judge what's
+left.
+
 Usage: python tools/perf_diff.py [LEDGER] [--threshold F] [--mad-k K]
                                  [--scenario S] [--history N]
+                                 [--prune-run R]... [--prune-series S/M]...
 """
 import argparse
 import importlib.util
@@ -91,6 +99,17 @@ def main(argv=None):
                         help="only judge this scenario")
     parser.add_argument("--history", type=int, default=5,
                         help="trajectory points shown per metric")
+    parser.add_argument("--prune-run", action="append", default=[],
+                        metavar="RUN_ID",
+                        help="drop every ledger row from this run_id "
+                             "before judging (triage a poisoned run, "
+                             "e.g. a host-overloaded smoke run); "
+                             "repeatable")
+    parser.add_argument("--prune-series", action="append", default=[],
+                        metavar="SCENARIO/METRIC",
+                        help="drop this whole (scenario, metric) "
+                             "series before judging (retire a stale "
+                             "metric name); repeatable")
     args = parser.parse_args(argv)
 
     explicit = args.ledger is not None
@@ -105,6 +124,11 @@ def main(argv=None):
         return 0
 
     ledger = _load_ledger_module()
+    if args.prune_run or args.prune_series:
+        kept, dropped = ledger.prune(path, run_ids=args.prune_run,
+                                     series=args.prune_series)
+        print(f"perf_diff: pruned {dropped} row(s) from {path} "
+              f"({kept} kept)")
     rows, skipped = ledger.read_rows(path)
     if args.scenario:
         rows = [r for r in rows if r["scenario"] == args.scenario]
