@@ -219,6 +219,13 @@ _LEDGER_SPECS = (
     # format or the model geometry does
     ("disagg", "kv_wire_bytes_per_token", "bytes/token",
      "lower_better", 0.05, ("disagg", "wire", "bytes_per_token")),
+    # the handoff's wall price from the assembled distributed traces
+    # (ISSUE 18): median export+wire+import+decode-admission ms per
+    # two-hop request. Raw CPU wall on the smoke runner (the decode
+    # tier's GIL contention lands here), so the threshold is wide —
+    # the row exists for the trajectory, not a tight gate.
+    ("disagg", "kv_handoff_overhead_ms", "ms", "lower_better", 1.0,
+     ("disagg", "ttft_breakdown", "kv_handoff_overhead_ms")),
 )
 
 
@@ -1223,6 +1230,10 @@ def _measure_disagg(model, num_slots):
 
     import numpy as np
 
+    from paddle_tpu.observability.trace import (TraceAssembler,
+                                                TraceContext,
+                                                TraceRecorder,
+                                                ttft_breakdown)
     from paddle_tpu.serving import ServingEngine
     from paddle_tpu.serving.router import (EngineGateway,
                                            InProcessTransport, Router,
@@ -1260,29 +1271,41 @@ def _measure_disagg(model, num_slots):
         results = [t.result(timeout=120.0) for t in tickets]
         wall = _time.perf_counter() - t0
         state = router.state()
+        rtrace = router.trace
         router.close()
         assert all(r["ok"] for r in results), \
             f"disagg bench wave dropped requests: {results}"
-        return results, wall, state
+        return results, wall, state, rtrace
 
     def arm(roles, ttft_owners):
         gws = [gateway(f"dz-{role or 'mono'}{i}", role)
                for i, role in enumerate(roles)]
         wave(gws)                           # warm wave: compiles land
         pre = [len(gws[i].engine.metrics.ttft_s) for i in ttft_owners]
-        results, wall, state = wave(gws)    # the measured warm wave
+        results, wall, state, rtrace = wave(gws)  # measured warm wave
         samples = [s for n0, i in zip(pre, ttft_owners)
                    for s in gws[i].engine.metrics.ttft_s[n0:]]
         ttft_p99 = float(np.percentile(np.asarray(samples) * 1000.0,
                                        99)) if samples else None
         decode_tokens = sum(len(r["tokens"]) - 1 for r in results)
+        # for the disagg arm, assemble the measured wave's distributed
+        # traces (router recorder names the wave's trace ids; engine
+        # recorders hold the replica-side spans) — the TTFT critical
+        # path decomposition rides the same surfaces operators scrape
+        traces = []
+        if any(roles) and rtrace.snapshot()["enabled"]:
+            asm = TraceAssembler()
+            asm.add_recorder(rtrace)
+            for g in gws:
+                asm.add_recorder(g.engine.trace)
+            traces = [asm.assemble(tid) for tid in rtrace.trace_ids()]
         for g in gws:
             g.close()
         return {
             "wall_s": round(wall, 3),
             "ttft_p99_ms": round(ttft_p99, 3),
             "decode_goodput_tps": round(decode_tokens / wall, 2),
-        }, state
+        }, state, traces
 
     # TTFT p99 over 9 samples IS the worst sample: one host-scheduler
     # hiccup or GC pause landing inside either arm's short wave fakes
@@ -1295,13 +1318,13 @@ def _measure_disagg(model, num_slots):
     # [ttft_x, goodput_x] is reported so a REAL disagg-path
     # regression (all attempts low) stays visible in the artifact.
     attempts = []
-    mono = disagg = state = None
+    mono = disagg = state = breakdown = None
     best = -1.0
     last_dz = None
     for _ in range(3):
-        a_mono, _ = arm([None, None, None], ttft_owners=(0, 1, 2))
-        a_dis, a_state = arm(["prefill", "decode", "decode"],
-                             ttft_owners=(0,))
+        a_mono, _, _ = arm([None, None, None], ttft_owners=(0, 1, 2))
+        a_dis, a_state, a_traces = arm(["prefill", "decode", "decode"],
+                                       ttft_owners=(0,))
         dz = last_dz = a_state["disagg"]
         if dz["handoffs"] < requests:
             # the hop-2 congestion valve fired (a starved host made
@@ -1318,15 +1341,69 @@ def _measure_disagg(model, num_slots):
                   / a_mono["decode_goodput_tps"]) \
             if a_mono["decode_goodput_tps"] else 0.0
         attempts.append([round(ttft_x, 3), round(good_x, 3)])
+        a_bd = ttft_breakdown(a_traces) if a_traces else None
         if min(ttft_x, good_x) > best:
             best = min(ttft_x, good_x)
             mono, disagg, state = a_mono, a_dis, a_state
-        if ttft_x >= 1.2 and good_x >= 1.2:
+            breakdown = a_bd
+        # a hiccup that tears the trace (dropped spans / a replica
+        # scrape landing mid-GC inflating the unattributed gap past
+        # the 10% attribution bar) re-measures like a perf hiccup —
+        # the artifact must carry a trace that explains its own TTFT
+        trace_ok = (a_bd is None
+                    or (a_bd["complete"] == a_bd["count"] == requests
+                        and a_bd["unattributed"]["median_frac"] < 0.10))
+        if ttft_x >= 1.2 and good_x >= 1.2 and trace_ok:
             break
     assert state is not None, \
         f"every disagg attempt bypassed the two-hop path: {last_dz}"
     dz = state["disagg"]
     wire_tokens = dz["wire_tokens"]
+
+    # TTFT critical-path decomposition from the best attempt's
+    # assembled traces. kv_handoff_overhead_ms is the price of
+    # disaggregation itself — the median wall the cross-replica hop
+    # adds beyond prefill compute (export + wire + import + decode
+    # admission) — a number the mono arm pays zero of, ledgered so a
+    # wire-format or import-path regression shows up as a trajectory
+    # break even when TTFT hides it inside host noise.
+    bd_section = {"enabled": False}
+    if breakdown is not None and breakdown["count"]:
+        handoff_ms = sum(
+            breakdown["segments"][s]["median_ms"]
+            for s in ("kv/export", "kv/wire", "kv/import",
+                      "decode/queue")
+            if breakdown["segments"].get(s))
+        # span-recording overhead probe: the recorder's record() cost
+        # per call, scaled to the ~11 spans a two-hop request emits,
+        # as a fraction of median TTFT (<2% target, <5% bar — pinned
+        # by the contract test)
+        probe = TraceRecorder("bench-probe", capacity=4096)
+        pctx = TraceContext.mint()
+        t0p = _time.perf_counter()
+        n_probe = 2000
+        for _ in range(n_probe):
+            probe.record(pctx, "probe/span", _time.time(), 0.0,
+                         {"rid": "probe"})
+        per_span_us = (_time.perf_counter() - t0p) / n_probe * 1e6
+        ttft_med = breakdown["ttft"]["median_ms"]
+        overhead_frac = ((11 * per_span_us / 1000.0) / ttft_med
+                         if ttft_med else None)
+        bd_section = {
+            "enabled": True,
+            "count": breakdown["count"],
+            "complete": breakdown["complete"],
+            "ttft_median_ms": breakdown["ttft"]["median_ms"],
+            "segments": breakdown["segments"],
+            "kv_handoff_overhead_ms": round(handoff_ms, 3),
+            "gap_frac": breakdown["unattributed"]["median_frac"],
+            "span_overhead": {
+                "per_span_us": round(per_span_us, 3),
+                "spans_per_request": 11,
+                "frac_of_ttft": round(overhead_frac, 6)
+                if overhead_frac is not None else None,
+            },
+        }
     return {
         "topology": {"prefill": 1, "decode": 2,
                      "monolithic_baseline": 3},
@@ -1353,6 +1430,7 @@ def _measure_disagg(model, num_slots):
             "bytes_per_token": round(dz["wire_bytes"] / wire_tokens, 1)
             if wire_tokens else None,
         },
+        "ttft_breakdown": bd_section,
     }
 
 
@@ -2236,6 +2314,8 @@ def main():
         "spec_goodput_x": evidence["speculative"]["goodput_x"],
         "disagg_decode_goodput_x": evidence["disagg"][
             "decode_goodput_x"],
+        "kv_handoff_overhead_ms": evidence["disagg"][
+            "ttft_breakdown"].get("kv_handoff_overhead_ms"),
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })

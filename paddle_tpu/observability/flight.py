@@ -63,12 +63,16 @@ class RequestTrace:
     ``{"event", "t", ...attrs}`` records (``t`` on the perf_counter
     clock) plus the retirement reason once retired."""
 
-    __slots__ = ("rid", "events", "reason")
+    __slots__ = ("rid", "events", "reason", "trace_id")
 
     def __init__(self, rid):
         self.rid = int(rid)
         self.events = []
         self.reason = None
+        # the request's distributed trace id (32-hex), stamped at
+        # enqueue from the propagated TraceContext — the join key
+        # between /debug/requests and the cross-replica trace surface
+        self.trace_id = None
 
     def t_of(self, event):
         """Timestamp of the FIRST occurrence of ``event``; None if it
@@ -94,7 +98,7 @@ class RequestTrace:
                 d["t_rel_ms"] = round((e["t"] - t0) * 1000.0, 3)
             events.append(d)
         return {"rid": self.rid, "reason": self.reason,
-                "events": events}
+                "trace_id": self.trace_id, "events": events}
 
 
 class FlightRecorder:
@@ -137,6 +141,8 @@ class FlightRecorder:
                 # chain must START here.
                 trace = self._active[rid] = RequestTrace(rid)
                 phase = "s"
+            if "trace_id" in attrs and trace.trace_id is None:
+                trace.trace_id = attrs["trace_id"]
             trace.events.append(dict({"event": event, "t": t}, **attrs))
         args = dict({"rid": rid}, **attrs)
         # marker span + flow point at the SAME timestamp: the flow
@@ -148,9 +154,12 @@ class FlightRecorder:
         return t
 
     def enqueued(self, req):
-        self._event(req.rid, ENQUEUED, "s",
-                    {"prompt_len": int(len(req.prompt)),
-                     "max_new_tokens": int(req.max_new_tokens)})
+        attrs = {"prompt_len": int(len(req.prompt)),
+                 "max_new_tokens": int(req.max_new_tokens)}
+        trace = getattr(req, "trace", None)
+        if trace is not None:
+            attrs["trace_id"] = trace.trace_id
+        self._event(req.rid, ENQUEUED, "s", attrs)
 
     def admitted(self, req, slot, bucket, group_size):
         self._event(req.rid, ADMITTED, "t",

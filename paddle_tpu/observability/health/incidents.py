@@ -36,11 +36,14 @@ INCIDENT_SCHEMA = "paddle_tpu.health.incident/v1"
 # harness (None otherwise) — a chaos-found incident is replayable from
 # the bundle alone. ``replica`` is the writing engine's identity
 # (replica_id / uptime) — a bundle collected off one member of a
-# fleet stays attributable after the fact.
+# fleet stays attributable after the fact. ``traces`` is the
+# assembled distributed traces (ISSUE 18) of every request in flight
+# at capture time — the anomaly's victims arrive with their
+# cross-replica critical path already decomposed.
 INCIDENT_KEYS = (
     "schema", "written_at", "detector", "verdict", "ledger_tail",
     "metrics", "watchdog", "requests", "spans_tail", "health",
-    "chaos", "replica",
+    "chaos", "replica", "traces",
 )
 
 
@@ -120,6 +123,7 @@ class IncidentRecorder:
             "health": health_report,
             "chaos": self._section(context, "chaos"),
             "replica": self._section(context, "replica"),
+            "traces": self._section(context, "traces"),
         }
         os.makedirs(self.directory, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())

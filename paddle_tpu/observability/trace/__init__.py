@@ -1,0 +1,48 @@
+"""Distributed request tracing: cross-replica propagation + assembly.
+
+Since PR 14 (router) and PR 17 (prefill/decode disaggregation) a
+request's life can span three processes — router queue → hop-1 prefill
+replica → KV-wire transfer → hop-2 decode replica — while the flight
+recorder (PR 4) only ever sees one engine. This package is the
+Dapper-style answer, the same propagate-then-assemble design
+DistServe-class disaggregated servers use to price their handoff:
+
+  * :mod:`context` — a W3C-traceparent-style :class:`TraceContext`
+    (trace_id, parent span id, baggage) minted by the router at
+    admission and carried on every wire edge: the ``/v1/generate`` /
+    ``/v1/prefill`` / ``/v1/import`` POST bodies, the KV handoff
+    payload (so the decode-tier import joins the same trace), and the
+    router journal (so a failover replay appears as sibling spans of
+    the dead attempt under one trace_id). ``TraceContext.coerce``
+    NEVER raises: a request arriving with a missing or malformed
+    context gets a locally-minted root and keeps serving.
+  * :mod:`spans` — per-process wall-anchored named spans
+    (``router/queue``, ``router/dispatch``, ``prefill/queue``,
+    ``prefill/compute``, ``kv/export``, ``kv/wire``, ``kv/import``,
+    ``decode/queue``, ``decode/first_step`` + retry/hedge/failover)
+    in a bounded ring, exposed per replica at ``/debug/traces`` (and
+    ``/router/trace`` on the router).
+  * :mod:`assembler` — the fleet-side :class:`TraceAssembler`:
+    scrapes ``/debug/traces`` across replicas, joins spans by
+    trace_id with per-replica clock-offset estimation (the scrape
+    request/response timestamps bound the skew; ordering that falls
+    inside the ambiguity window is FLAGGED, never silently
+    reordered), and renders the end-to-end timeline, a
+    chrome://tracing export (one pid per replica, flow events linking
+    the hops — the PR-4 flow machinery extended cross-process) and
+    the TTFT critical-path decomposition (median/p99 ms per segment
+    over a cohort).
+
+``tools/trace_report.py`` is the stdlib-only CLI over the assembler.
+"""
+from .context import TRACEPARENT_RE, TraceContext
+from .spans import (CANONICAL_SEGMENTS, TRACE_SNAPSHOT_KEYS, TraceSpan,
+                    TraceRecorder)
+from .assembler import (AssembledTrace, TraceAssembler, chrome_trace,
+                        ttft_breakdown)
+
+__all__ = [
+    "TraceContext", "TraceSpan", "TraceRecorder", "TraceAssembler",
+    "AssembledTrace", "chrome_trace", "ttft_breakdown",
+    "CANONICAL_SEGMENTS", "TRACE_SNAPSHOT_KEYS", "TRACEPARENT_RE",
+]

@@ -182,7 +182,8 @@ class ServingConfig:
                  supervisor_cooldown_s=1.0, perf=None,
                  cache_observatory=None, cache_sample_rate=0.125,
                  replica_id=None, speculative=None, spec_k=4,
-                 spec_min_accept=0.35, role="monolithic"):
+                 spec_min_accept=0.35, role="monolithic",
+                 trace_spans=None, trace_span_keep=4096):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -407,6 +408,21 @@ class ServingConfig:
                 f"role must be 'prefill', 'decode' or 'monolithic', "
                 f"got {role!r}")
         self.role = role
+        # distributed request tracing (observability.trace): per-hop
+        # wall-anchored spans into a bounded ring served at
+        # /debug/traces, ON by default (a handful of dict appends per
+        # request lifetime — probe-measured in the bench artifact);
+        # PADDLE_TRACE_SPANS=0 opts out, True/False forces. The
+        # disabled recorder keeps its full surface (scrapes answer,
+        # snapshot shape identical). trace_span_keep bounds the ring.
+        if trace_spans is None:
+            trace_spans = os.environ.get(
+                "PADDLE_TRACE_SPANS", "1") != "0"
+        self.trace_spans = bool(trace_spans)
+        self.trace_span_keep = int(trace_span_keep)
+        if self.trace_span_keep < 1:
+            raise ValueError(
+                f"trace_span_keep must be >= 1, got {trace_span_keep}")
 
 
 class ServingEngine:
@@ -603,6 +619,16 @@ class ServingEngine:
         self.replica_id = self.identity.replica_id
         self.metrics.set_identity(self.identity, version=_pt_version,
                                   jax_version=_jax.__version__)
+        # distributed tracing: this replica's per-hop span ring
+        # (observability.trace), keyed by the TraceContext each
+        # request carries — served at /debug/traces, summarized in
+        # snapshot()["trace"], embedded in incident bundles
+        from ..observability.trace import TraceContext, TraceRecorder
+        self._TraceContext = TraceContext
+        self.trace = TraceRecorder(self.replica_id,
+                                   capacity=config.trace_span_keep,
+                                   enabled=config.trace_spans)
+        self.metrics.set_trace(self.trace.snapshot)
         self.metrics.set_scheduler_info(
             self._policy.name, self.chunk_len,
             self.prefill_token_budget)
@@ -656,11 +682,30 @@ class ServingEngine:
                          "dur": round(s.dur, 6), "tid": s.tid}
                         for s in rec.spans()[-120:]]
 
+            def _incident_traces(trace=self.trace,
+                                 flight=self.flight):
+                # assembled traces of requests ACTIVE at incident
+                # time: the cross-replica spans this replica holds
+                # for them (a fleet collector joins the rest by
+                # trace_id)
+                from ..observability.trace import TraceAssembler
+                tids = sorted({t.trace_id for t in flight.active()
+                               if t.trace_id is not None})
+                asm = TraceAssembler()
+                asm.add_recorder(trace)
+                out = []
+                for tid in tids:
+                    at = asm.assemble(tid)
+                    if at is not None:
+                        out.append(at.as_dict())
+                return out
+
             context = {
                 "metrics": self.metrics.snapshot,
                 "watchdog": self.watchdog.report,
                 "requests": self.flight.debug_requests,
                 "spans_tail": _spans_tail,
+                "traces": _incident_traces,
                 # replica attribution: a bundle collected off one
                 # member of a fleet must name which member wrote it
                 "replica": self.metrics.identity_report,
@@ -759,7 +804,7 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens, eos_id=None,
                     on_token=None, temperature=0.0, top_k=0,
                     top_p=1.0, seed=None, deadline_ms=None,
-                    hold_kv=False):
+                    hold_kv=False, trace=None):
         """Enqueue a prompt; returns the Request handle immediately.
         Tokens stream through on_token(request, token) as steps run
         (with async_depth=1 a token surfaces one engine step after the
@@ -782,7 +827,14 @@ class ServingEngine:
         so ``export_kv(rid)`` can serialize the prompt's KV blocks
         for a disaggregated handoff; the export (or abort/close)
         releases the slot. The prefill tier submits its work this way
-        with ``max_new_tokens=1``."""
+        with ``max_new_tokens=1``.
+
+        ``trace`` is the propagated distributed-trace context
+        (TraceContext, traceparent string, or its dict form from the
+        gateway wire). Whatever arrives is COERCED — None on a direct
+        add_request, or malformed input from a corrupted header,
+        mints a locally-rooted context rather than raising — so every
+        request carries a usable trace id."""
         if self._draining or self._closed:
             raise RuntimeError(
                 "engine is draining/closed: no new requests (drain() "
@@ -797,6 +849,7 @@ class ServingEngine:
                       on_token=on_token, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed,
                       deadline_ms=deadline_ms, hold_kv=hold_kv)
+        req.trace = self._TraceContext.coerce(trace)
         if req.sampled and not self.sampling:
             raise ValueError(
                 "sampled request on a greedy engine: build the engine "
@@ -895,7 +948,9 @@ class ServingEngine:
         /metrics (Prometheus text), /metrics.json (the snapshot
         schema), /debug (the route index — every mounted path, so the
         surface is discoverable without reading source),
-        /debug/requests (flight-recorder traces), /debug/state (live
+        /debug/requests (flight-recorder traces), /debug/traces
+        (this replica's distributed-trace span ring — the surface
+        tools/trace_report.py assembles fleet-wide), /debug/state (live
         engine state), /debug/perf (per-program attribution +
         roofline fractions), /debug/cache (MRC, prefix heat, savings
         attribution, churn) and — with the health observatory on —
@@ -914,6 +969,7 @@ class ServingEngine:
             "/debug/state": self.debug_state,
             "/debug/perf": self.metrics.perf_report,
             "/debug/cache": self.metrics.cache_report,
+            "/debug/traces": self.trace.debug_traces,
         }
         if self.health is not None:
             routes["/debug/health"] = self.health.report
@@ -955,6 +1011,12 @@ class ServingEngine:
         from . import kv_wire
         pool = self.pool
         slot = req.slot
+        # the kv/export span starts when the KV became READY to ship
+        # (first token emitted, blocks parked) — the dwell until the
+        # router collects the hop is part of the handoff price the
+        # TTFT decomposition must attribute, not an unexplained gap
+        t0_exp = self.trace.wall(req.t_first_token) \
+            if req.t_first_token is not None else time.time()
         try:
             n = kv_wire.blocks_for_prompt(len(req.prompt),
                                           pool.block_size)
@@ -973,11 +1035,16 @@ class ServingEngine:
                 k = np.asarray(k_dev)[:, :n]
                 v = np.asarray(v_dev)[:, :n]
             payload = kv_wire.serialize_handoff(
-                k, v, req.prompt, req.generated[0])
+                k, v, req.prompt, req.generated[0],
+                trace=req.trace.as_dict()
+                if req.trace is not None else None)
         finally:
             if req.slot is not None:
                 pool.release(req.slot)
                 req.slot = None
+        self.trace.record(req.trace, "kv/export", t0_exp,
+                          time.time() - t0_exp,
+                          {"rid": req.rid, "blocks": n})
         self.flight.kv_exported(req, n,
                                 kv_wire.payload_wire_bytes(payload))
         return payload
@@ -1010,6 +1077,7 @@ class ServingEngine:
                 "engine is draining/closed: no new requests (drain() "
                 "finishes already-submitted work, close() aborts it)")
         from . import kv_wire
+        t0_imp = time.time()
         handoff = kv_wire.deserialize_handoff(payload)
         pool, sch = self.pool, self.scheduler
         layers, _, heads, bs, hd = pool.kc.shape
@@ -1030,6 +1098,11 @@ class ServingEngine:
                       eos_id=self.config.eos_id if eos_id is None
                       else eos_id,
                       on_token=on_token, deadline_ms=deadline_ms)
+        # join the prefill tier's trace: whatever rode the wire is
+        # coerced (a corrupted/absent trace field mints a local root
+        # — the tiles already verified clean, the import proceeds)
+        req.trace = self._TraceContext.coerce(handoff.trace)
+        req.imported = True
         ids = req.prompt
         alloc = pool.acquire(req.rid, ids, req.cache_tokens, 0)
         if alloc is None:
@@ -1077,6 +1150,13 @@ class ServingEngine:
         self.metrics.requests_admitted += 1
         self.flight.enqueued(req)
         self.flight.kv_imported(req, n, handoff.wire_bytes)
+        # kv/import covers deserialization + verification + the
+        # splice; decode/queue starts here (import done -> first
+        # decode dispatch, stamped in the dispatch loop)
+        self.trace.record(req.trace, "kv/import", t0_imp,
+                          time.time() - t0_imp,
+                          {"rid": req.rid, "blocks": n,
+                           "wire_bytes": handoff.wire_bytes})
         reason = sch.stop_reason(req, req.generated[0])
         if reason is not None:
             # max_new_tokens=1 (or first==eos): nothing left to
@@ -1408,6 +1488,35 @@ class ServingEngine:
                     and req.t_admitted > self._t_last_compile:
                 self._policy.observe_service(
                     (req.t_first_token - req.t_admitted) * 1000.0)
+            # prefill-side TTFT spans: queue (arrival -> admission)
+            # and compute (admission -> first token), wall-converted
+            # from the request's perf_counter lifecycle stamps.
+            # Imported requests never prefill here — their first
+            # token predates the import (kv/import covered it).
+            if not req.imported and req.t_admitted is not None:
+                w = self.trace.wall
+                self.trace.record(
+                    req.trace, "prefill/queue", w(req.t_arrival),
+                    max(0.0, req.t_admitted - req.t_arrival),
+                    {"rid": req.rid})
+                self.trace.record(
+                    req.trace, "prefill/compute", w(req.t_admitted),
+                    max(0.0, req.t_first_token - req.t_admitted),
+                    {"rid": req.rid})
+        elif req.imported and len(req.generated) == 2 \
+                and req.t_decode0 is not None:
+            # decode-side TTFT spans, closed at the FIRST locally
+            # decoded token: queue (import done -> first decode
+            # dispatch) and first_step (dispatch -> this emission)
+            w = self.trace.wall
+            self.trace.record(
+                req.trace, "decode/queue", w(req.t_admitted),
+                max(0.0, req.t_decode0 - req.t_admitted),
+                {"rid": req.rid})
+            self.trace.record(
+                req.trace, "decode/first_step", w(req.t_decode0),
+                max(0.0, time.perf_counter() - req.t_decode0),
+                {"rid": req.rid})
         self.flight.token_emitted(req, len(req.generated))
         if req.on_token is not None:
             # a user callback must never take down the step loop: a
@@ -1678,8 +1787,14 @@ class ServingEngine:
                     # least one slot drafts)
                     drafted = None
             use_spec = drafted is not None
+            t_dec = time.perf_counter()
             for req in snapshot.values():
                 req.inflight += 1
+                if req.t_decode0 is None:
+                    # first decode dispatch carrying this request —
+                    # the decode/queue -> decode/first_step boundary
+                    # for an imported request's trace
+                    req.t_decode0 = t_dec
             args, donate = self._decode_dispatch_args(pool)
             if spec is not None:
                 v_args, v_donate = self._verify_dispatch_args(

@@ -49,16 +49,21 @@ class KVHandoff:
     """
 
     __slots__ = ("prompt", "first_token", "block_size", "k", "v",
-                 "wire_bytes")
+                 "wire_bytes", "trace")
 
     def __init__(self, prompt, first_token, block_size, k, v,
-                 wire_bytes):
+                 wire_bytes, trace=None):
         self.prompt = prompt
         self.first_token = int(first_token)
         self.block_size = int(block_size)
         self.k = k
         self.v = v
         self.wire_bytes = int(wire_bytes)
+        # optional distributed-trace baggage ({"traceparent",
+        # "baggage"} dict or None) — NEVER validated here: a corrupted
+        # trace field must not refuse a payload whose tiles verified
+        # clean (the importer coerces, minting a local root on garbage)
+        self.trace = trace
 
     @property
     def n_blocks(self):
@@ -73,7 +78,8 @@ def blocks_for_prompt(prompt_len, block_size):
     return -(-int(prompt_len) // int(block_size))
 
 
-def serialize_handoff(k_tiles, v_tiles, prompt, first_token):
+def serialize_handoff(k_tiles, v_tiles, prompt, first_token,
+                      trace=None):
     """Pack prompt-covering block tiles into a JSON-safe handoff dict.
 
     ``k_tiles``/``v_tiles``: ``[layers, n_blocks, heads, block_size,
@@ -112,7 +118,7 @@ def serialize_handoff(k_tiles, v_tiles, prompt, first_token):
             "digest": zlib.crc32(vb, zlib.crc32(kb)) & 0xFFFFFFFF,
         })
     tile_bytes = int(k_tiles[:, 0].nbytes)
-    return {
+    payload = {
         "version": WIRE_VERSION,
         "dtype": str(np.dtype(k_tiles.dtype)),
         "tile_shape": [int(layers), int(heads), int(block_size),
@@ -122,6 +128,13 @@ def serialize_handoff(k_tiles, v_tiles, prompt, first_token):
         "first_token": int(first_token),
         "frames": frames,
     }
+    if trace is not None:
+        # distributed tracing: the request's context rides the
+        # handoff so the decode-tier import joins the SAME trace
+        # (TraceContext dict form; absent = pre-trace exporter)
+        payload["trace"] = trace if isinstance(trace, dict) \
+            else trace.as_dict()
+    return payload
 
 
 def payload_wire_bytes(payload):
@@ -213,4 +226,4 @@ def deserialize_handoff(payload):
     k = np.stack(k_list, axis=1)
     v = np.stack(v_list, axis=1)
     return KVHandoff(prompt, first_token, block_size, k, v,
-                     wire_bytes)
+                     wire_bytes, trace=payload.get("trace"))

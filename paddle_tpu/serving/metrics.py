@@ -268,6 +268,7 @@ class ServingMetrics:
         self._prefix_pool_stats = None
         self._health_fn = None
         self._identity = None
+        self._trace_fn = None
         # plain-int mirror of the labeled shed counter: the health
         # tick reads a shed total on EVERY engine step, and iterating
         # the labeled series per step is measurable overhead there
@@ -455,6 +456,23 @@ class ServingMetrics:
             return {"replica_id": None, "uptime_s": None,
                     "started_at": None}
         return self._identity.report()
+
+    def set_trace(self, snapshot_fn):
+        """Attach the trace recorder's ``snapshot()`` as the pull
+        source for ``snapshot()["trace"]`` (the recorder keeps its
+        shape when tracing is disabled, so the schema contract holds
+        either way)."""
+        self._trace_fn = snapshot_fn
+
+    def trace_report(self):
+        """The ``snapshot()["trace"]`` section
+        (observability.trace.TRACE_SNAPSHOT_KEYS pins the key set;
+        engines without a recorder report the disabled shape)."""
+        if self._trace_fn is not None:
+            return self._trace_fn()
+        return {"enabled": False, "spans_recorded": 0,
+                "spans_dropped": 0, "ring_occupancy": 0,
+                "ring_capacity": 0}
 
     def set_health(self, summary_fn):
         """Attach the health monitor's ``summary()`` as the pull
@@ -781,4 +799,5 @@ class ServingMetrics:
             "perf": self.perf_report(),
             "cache": self.cache_report(),
             "replica": self.identity_report(),
+            "trace": self.trace_report(),
         }

@@ -58,6 +58,7 @@ import time
 
 from ...observability import MetricsRegistry, start_metrics_server
 from ...observability.fleet.poller import backoff_jitter_unit
+from ...observability.trace import TraceContext, TraceRecorder
 from ..kv_wire import payload_wire_bytes
 from ..paged.radix import path_fingerprint
 from ..resilience.chaos import InjectedFault, resolve_chaos
@@ -69,6 +70,20 @@ __all__ = ["RouterConfig", "Router", "RouterTicket",
            "prompt_fingerprints", "ROUTER_STATE_KEYS"]
 
 _tag_seq = itertools.count()
+
+
+def _accepts_kw(fn, name):
+    """Whether ``fn`` takes keyword ``name`` — trace propagation is
+    additive: a transport that predates the field (scripted test
+    doubles, third-party shims) is simply called without it."""
+    import inspect
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return name in params or any(
+        p.kind == inspect.Parameter.VAR_KEYWORD
+        for p in params.values())
 
 # /router/state top-level schema (pinned by tests/test_router.py)
 ROUTER_STATE_KEYS = (
@@ -215,6 +230,12 @@ class Router:
                 reset_s=self.config.breaker_reset_s)
             for rid in self.transports}
         self.journal = RequestJournal()
+        # distributed tracing: the router MINTS each request's
+        # TraceContext at admission and records its own hop spans
+        # (router/queue, router/dispatch, kv/wire, retry/failover/
+        # hedge annotations, plus the router/request root) into this
+        # ring, served at /router/trace
+        self.trace = TraceRecorder("router")
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         r = self.registry
@@ -552,13 +573,18 @@ class Router:
                 for rid in self.transports)
         if not any_admissible:
             return self._shed(ticket, "no_admissible_replica", t0)
+        # admission mints the request's distributed TraceContext:
+        # every dispatch attempt — including failover replays from
+        # the journal — carries the SAME trace id fleet-wide
         entry = self.journal.admit(tag, [int(t) for t in prompt],
                                    max_new_tokens, eos_id,
-                                   deadline_ms, now)
+                                   deadline_ms, now,
+                                   trace=TraceContext.mint(
+                                       baggage={"rid": tag}))
         self._g_journal.set(self.journal.depth)
         self._account_overhead(t0)
         worker = threading.Thread(
-            target=self._drive, args=(entry, ticket), daemon=True,
+            target=self._drive, args=(entry, ticket, t0), daemon=True,
             name=f"router-{tag}")
         with self._lock:
             self._threads.append(worker)
@@ -595,8 +621,14 @@ class Router:
         elapsed = (self._clock() - entry.t_admitted) * 1000.0
         return entry.deadline_ms - elapsed
 
-    def _drive(self, entry, ticket):
+    def _drive(self, entry, ticket, t_submit=None):
         t_start = time.perf_counter()
+        # router/queue: admission -> this worker picking the entry up
+        if t_submit is not None:
+            self.trace.record(entry.trace, "router/queue",
+                              self.trace.wall(t_submit),
+                              t_start - t_submit,
+                              {"rid": entry.rid})
         fps = prompt_fingerprints(entry.prompt,
                                   self.config.affinity_block) \
             if self.config.affinity else []
@@ -645,9 +677,13 @@ class Router:
                 self._c_retries.inc()
                 with self._lock:
                     self._stats["retries"] += 1
-                self._backoff(entry.rid, failures)
+                self._backoff(entry.rid, failures, ctx=entry.trace)
                 self.refresh(force=True)
                 continue
+            self.trace.record(entry.trace, "router/dispatch",
+                              self.trace.wall(t_bk),
+                              time.perf_counter() - t_bk,
+                              {"rid": entry.rid, "replica": rid})
             # a failover is counted by what actually happened: this
             # dispatch goes to a DIFFERENT replica than the previous
             # attempt (refused / errored / died / shed — the cause
@@ -657,6 +693,13 @@ class Router:
                 self._c_failovers.inc()
                 with self._lock:
                     self._stats["failovers"] += 1
+                # the span that LINKS a failed attempt's spans to the
+                # replay's: same trace id, annotated with the move
+                self.trace.record(entry.trace, "router/failover",
+                                  time.time(), 0.0,
+                                  {"rid": entry.rid,
+                                   "from": entry.replica, "to": rid,
+                                   "attempt": entry.attempts + 1})
             entry.replica = rid
             entry.attempts += 1
             base = len(entry.tokens)
@@ -684,7 +727,7 @@ class Router:
                 self._c_retries.inc()
                 with self._lock:
                     self._stats["retries"] += 1
-                self._backoff(entry.rid, failures)
+                self._backoff(entry.rid, failures, ctx=entry.trace)
                 self.refresh(force=True)
                 continue
             # optional tail-latency hedge: one extra dispatch to a
@@ -711,7 +754,7 @@ class Router:
                 self._c_retries.inc()
                 with self._lock:
                     self._stats["retries"] += 1
-                self._backoff(entry.rid, failures)
+                self._backoff(entry.rid, failures, ctx=entry.trace)
                 self.refresh(force=True)
                 continue
             rid_won, res, buf = outcome
@@ -753,7 +796,7 @@ class Router:
         self._c_retries.inc()
         with self._lock:
             self._stats["retries"] += 1
-        self._backoff(entry.rid, failures)
+        self._backoff(entry.rid, failures, ctx=entry.trace)
         self.refresh(force=True)
 
     def _drive_disagg(self, entry, ticket, fps, excluded, failures,
@@ -783,13 +826,32 @@ class Router:
                 # no prefill tier (or none left): not a handoff
                 # failure, just a monolithic fleet from here on
                 return (False, failures, failovers, last_error)
+            # the ONE router/dispatch span of a two-hop trace: hop-1
+            # placement (hop-2 placement time lands inside kv/wire —
+            # tiling the segments keeps the TTFT decomposition
+            # overlap-free)
+            self.trace.record(entry.trace, "router/dispatch",
+                              self.trace.wall(t_bk),
+                              time.perf_counter() - t_bk,
+                              {"rid": entry.rid, "replica": pf_rid,
+                               "hop": "prefill"})
+            if entry.replica is not None and entry.replica != pf_rid:
+                self.trace.record(entry.trace, "router/failover",
+                                  time.time(), 0.0,
+                                  {"rid": entry.rid,
+                                   "from": entry.replica,
+                                   "to": pf_rid,
+                                   "attempt": entry.attempts + 1})
             entry.replica = pf_rid
             entry.attempts += 1
             self._c_dispatch.labels(pf_rid).inc()
             t_hop = time.perf_counter()
+            pf_fn = self.transports[pf_rid].prefill
+            pf_kw = {"deadline_ms": remaining}
+            if _accepts_kw(pf_fn, "trace"):
+                pf_kw["trace"] = entry.trace
             try:
-                pf = self.transports[pf_rid].prefill(
-                    entry.prompt, deadline_ms=remaining)
+                pf = pf_fn(entry.prompt, **pf_kw)
             except TransportRefused as e:
                 self._release(pf_rid)
                 self._c_dispatch_fail.labels(pf_rid, "refused").inc()
@@ -810,6 +872,7 @@ class Router:
                 continue
             self._release(pf_rid)
             hop1_s = time.perf_counter() - t_hop
+            t_wire0 = time.time()
             break
         first = int(pf["first_token"])
         handoff = pf["handoff"]
@@ -850,11 +913,17 @@ class Router:
                 self._c_failovers.inc()
                 with self._lock:
                     self._stats["failovers"] += 1
+                self.trace.record(entry.trace, "router/failover",
+                                  time.time(), 0.0,
+                                  {"rid": entry.rid,
+                                   "from": dec_prev, "to": drid,
+                                   "attempt": entry.attempts + 1})
             dec_prev = drid
             entry.replica = drid
             entry.attempts += 1
             self._c_dispatch.labels(drid).inc()
             buf = []
+            t_dec_call = time.time()
             try:
                 res = self.transports[drid].decode_import(
                     handoff, entry.max_new_tokens,
@@ -897,6 +966,16 @@ class Router:
                 last_error = f"replica_shed: {res['shed_reason']}"
                 continue
             t_bk = time.perf_counter()
+            # kv/wire: hop-1 return -> the (successful) hop-2 call.
+            # Covers payload custody at the router, hop-2 placement
+            # and any refused-import shopping — the wire leg of the
+            # TTFT decomposition (kv/import on the decode replica
+            # picks up from the call)
+            self.trace.record(entry.trace, "kv/wire", t_wire0,
+                              max(0.0, t_dec_call - t_wire0),
+                              {"rid": entry.rid, "replica": drid,
+                               "wire_bytes":
+                                   payload_wire_bytes(handoff)})
             tokens = res.get("tokens") or []
             commit = tokens if len(tokens) >= 1 + len(buf) \
                 else [first] + buf
@@ -926,10 +1005,13 @@ class Router:
             except InjectedFault as e:
                 raise TransportError(str(e)) from e
         buf = []
-        call = self.transports[rid].begin(
-            entry.prefill_ids, max(1, entry.remaining_tokens),
-            eos_id=entry.eos_id, deadline_ms=remaining_ms,
-            on_token=buf.append)
+        begin = self.transports[rid].begin
+        kw = {"eos_id": entry.eos_id, "deadline_ms": remaining_ms,
+              "on_token": buf.append}
+        if _accepts_kw(begin, "trace"):
+            kw["trace"] = entry.trace
+        call = begin(entry.prefill_ids,
+                     max(1, entry.remaining_tokens), **kw)
         return (rid, call, buf)
 
     def _maybe_hedge(self, entry, remaining_ms, excluded, calls):
@@ -952,6 +1034,10 @@ class Router:
             self._c_dispatch.labels(rid_h).inc()
             with self._lock:
                 self._stats["hedges"] += 1
+            self.trace.record(entry.trace, "router/hedge",
+                              time.time(), 0.0,
+                              {"rid": entry.rid, "primary": rid0,
+                               "hedge": rid_h})
         except (TransportError, TransportRefused):
             self._release(rid_h)
 
@@ -989,12 +1075,18 @@ class Router:
             time.sleep(0.001)
         return None
 
-    def _backoff(self, who, attempt):
+    def _backoff(self, who, attempt, ctx=None):
         base = min(self.config.backoff_max_s,
                    self.config.backoff_base_s * (2 ** (attempt - 1)))
         stretch = 1.0 + self.config.backoff_jitter \
             * backoff_jitter_unit(self.config.seed, who, attempt)
-        time.sleep(min(self.config.backoff_max_s, base * stretch))
+        delay = min(self.config.backoff_max_s, base * stretch)
+        t0 = time.time()
+        time.sleep(delay)
+        # the retry wall, annotated on the trace: backoff sleeps are
+        # TTFT the client paid that no replica span accounts for
+        self.trace.record(ctx, "router/retry", t0, time.time() - t0,
+                          {"attempt": attempt})
 
     # ------------------------------------------------------- results
     def _finish_ok(self, entry, ticket, rid, failures, failovers,
@@ -1007,6 +1099,11 @@ class Router:
         self._c_requests.labels("ok").inc()
         with self._lock:
             self._stats["ok"] += 1
+        self.trace.record_root(
+            entry.trace, "router/request", self.trace.wall(t_start),
+            latency, {"rid": entry.rid, "outcome": "ok",
+                      "replica": rid, "attempts": entry.attempts,
+                      "failovers": failovers})
         remaining = self._remaining_ms(entry)
         ticket._finish({
             "rid": entry.rid, "ok": True, "shed": False,
@@ -1027,6 +1124,12 @@ class Router:
         self._c_requests.labels("error").inc()
         with self._lock:
             self._stats["error"] += 1
+        self.trace.record_root(
+            entry.trace, "router/request", self.trace.wall(t_start),
+            latency, {"rid": entry.rid, "outcome": reason,
+                      "replica": entry.replica,
+                      "attempts": entry.attempts,
+                      "failovers": failovers})
         ticket._finish({
             "rid": entry.rid, "ok": False, "shed": False,
             "reason": reason, "tokens": list(entry.tokens),
@@ -1087,10 +1190,14 @@ class Router:
         }
 
     def serve(self, port=0, addr="127.0.0.1"):
-        """Expose the router's own registry + ``/router/state``."""
+        """Expose the router's own registry + ``/router/state`` +
+        ``/router/trace`` (the router's span ring — one of the
+        surfaces tools/trace_report.py assembles fleet traces
+        from)."""
         handle = start_metrics_server(
             self.registry, port=port, addr=addr,
-            extra_routes={"/router/state": self.state})
+            extra_routes={"/router/state": self.state,
+                          "/router/trace": self.trace.debug_traces})
         self._servers.append(handle)
         return handle
 

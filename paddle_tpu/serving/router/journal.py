@@ -27,10 +27,10 @@ __all__ = ["JournalEntry", "RequestJournal"]
 class JournalEntry:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id",
                  "deadline_ms", "tokens", "replica", "attempts",
-                 "t_admitted")
+                 "t_admitted", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id,
-                 deadline_ms, t_admitted):
+                 deadline_ms, t_admitted, trace=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -40,6 +40,11 @@ class JournalEntry:
         self.replica = None       # current / last dispatch target
         self.attempts = 0
         self.t_admitted = t_admitted
+        # the request's distributed TraceContext, minted at admission:
+        # every dispatch attempt (including failover replays) carries
+        # it, so replayed work appears as sibling spans of ONE trace.
+        # None tolerated (old-format replay) — the engine coerces.
+        self.trace = trace
 
     @property
     def prefill_ids(self):
@@ -59,9 +64,9 @@ class RequestJournal:
         self._lock = threading.Lock()
 
     def admit(self, rid, prompt, max_new_tokens, eos_id, deadline_ms,
-              t_admitted):
+              t_admitted, trace=None):
         entry = JournalEntry(rid, prompt, max_new_tokens, eos_id,
-                             deadline_ms, t_admitted)
+                             deadline_ms, t_admitted, trace=trace)
         with self._lock:
             self._entries[rid] = entry
         return entry

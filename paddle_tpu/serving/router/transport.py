@@ -32,6 +32,33 @@ __all__ = ["TransportError", "TransportRefused", "EngineGateway",
            "InProcessTransport", "HTTPTransport"]
 
 
+def _body_trace(body):
+    """Extract the distributed-trace fields a gateway wire body may
+    carry (``traceparent`` + optional ``baggage``). Returns None when
+    absent; NEVER validates — the engine's TraceContext.coerce mints
+    a local root on anything malformed, so a corrupted header cannot
+    refuse a request."""
+    tp = body.get("traceparent")
+    if tp is None:
+        return None
+    return {"traceparent": tp, "baggage": body.get("baggage")}
+
+
+def _trace_fields(trace):
+    """The wire form of a trace context for an outbound POST body:
+    ``{"traceparent", "baggage"}`` (baggage omitted when empty).
+    Accepts a TraceContext or its dict form; None -> {}."""
+    if trace is None:
+        return {}
+    d = trace if isinstance(trace, dict) else trace.as_dict()
+    out = {}
+    if d.get("traceparent") is not None:
+        out["traceparent"] = d["traceparent"]
+        if d.get("baggage"):
+            out["baggage"] = d["baggage"]
+    return out
+
+
 class TransportError(RuntimeError):
     """Replica unreachable / died mid-dispatch: breaker-charging."""
 
@@ -88,10 +115,13 @@ class EngineGateway:
 
     # --------------------------------------------------- submission
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline_ms=None, on_token=None):
+               deadline_ms=None, on_token=None, trace=None):
         """Enqueue on the engine; returns the Request handle. Raises
         TransportRefused when the engine is draining/closed (a clean
-        verdict), TransportError when the gateway was killed."""
+        verdict), TransportError when the gateway was killed.
+        ``trace`` is the propagated distributed-trace context (any
+        form TraceContext.coerce accepts — the engine never rejects
+        a request over a bad trace)."""
         if self._dead:
             raise TransportError(
                 f"replica {self.replica_id} is dead")
@@ -99,7 +129,8 @@ class EngineGateway:
             try:
                 req = self.engine.add_request(
                     prompt, max_new_tokens, eos_id=eos_id,
-                    deadline_ms=deadline_ms, on_token=on_token)
+                    deadline_ms=deadline_ms, on_token=on_token,
+                    trace=trace)
             except RuntimeError as e:   # draining/closed
                 raise TransportRefused(str(e)) from e
         self._wake.set()
@@ -142,19 +173,24 @@ class EngineGateway:
         return True
 
     # ------------------------------------------- disaggregated hops
-    def prefill(self, prompt, deadline_ms=None, timeout=None):
+    def prefill(self, prompt, deadline_ms=None, timeout=None,
+                trace=None):
         """Hop 1 of a disaggregated request: compute the prompt's KV
         (+ the first token) on this replica and serialize the blocks
         for the wire. Blocking; returns ``{rid, replica_id,
         first_token, handoff}``. TransportRefused when the engine
         can't take it (draining / legacy pool / request expired before
-        export), TransportError when the gateway died mid-hop."""
+        export), TransportError when the gateway died mid-hop.
+        ``trace`` propagates into the request AND (via export_kv)
+        into the handoff payload, so the decode tier joins the same
+        trace."""
         if self._dead:
             raise TransportError(f"replica {self.replica_id} is dead")
         with self._lock:
             try:
                 req = self.engine.add_request(
-                    prompt, 1, deadline_ms=deadline_ms, hold_kv=True)
+                    prompt, 1, deadline_ms=deadline_ms, hold_kv=True,
+                    trace=trace)
             except (RuntimeError, ValueError) as e:
                 # draining/closed, or no paged pool on this replica
                 raise TransportRefused(str(e)) from e
@@ -285,7 +321,8 @@ class EngineGateway:
         try:
             req = self.submit(prompt, max_new,
                               eos_id=body.get("eos_id"),
-                              deadline_ms=deadline_ms)
+                              deadline_ms=deadline_ms,
+                              trace=_body_trace(body))
         except TransportRefused as e:
             return (503, {"error": "refused", "detail": str(e)[:200],
                           "draining": True})
@@ -315,7 +352,8 @@ class EngineGateway:
                                    "of token ids"})
         try:
             out = self.prefill(prompt,
-                               deadline_ms=body.get("deadline_ms"))
+                               deadline_ms=body.get("deadline_ms"),
+                               trace=_body_trace(body))
         except TransportRefused as e:
             return (503, {"error": "refused", "detail": str(e)[:200]})
         except TransportError as e:
@@ -401,22 +439,23 @@ class InProcessTransport:
         self.replica_id = replica_id or gateway.replica_id
 
     def begin(self, prompt, max_new_tokens, eos_id=None,
-              deadline_ms=None, on_token=None):
+              deadline_ms=None, on_token=None, trace=None):
         cb = None
         if on_token is not None:
             cb = lambda _req, tok: on_token(int(tok))  # noqa: E731
         req = self.gateway.submit(prompt, max_new_tokens,
                                   eos_id=eos_id,
                                   deadline_ms=deadline_ms,
-                                  on_token=cb)
+                                  on_token=cb, trace=trace)
         return _InProcessCall(self.gateway, req)
 
-    def prefill(self, prompt, deadline_ms=None):
+    def prefill(self, prompt, deadline_ms=None, trace=None):
         """Blocking hop 1: prompt KV + first token, serialized."""
         if self.gateway.dead:
             raise TransportError(
                 f"replica {self.replica_id} is dead")
-        return self.gateway.prefill(prompt, deadline_ms=deadline_ms)
+        return self.gateway.prefill(prompt, deadline_ms=deadline_ms,
+                                    trace=trace)
 
     def decode_import(self, handoff, max_new_tokens, eos_id=None,
                       deadline_ms=None, on_token=None):
@@ -536,9 +575,10 @@ class HTTPTransport:
         self.probe_timeout_s = float(probe_timeout_s)
 
     def begin(self, prompt, max_new_tokens, eos_id=None,
-              deadline_ms=None, on_token=None):
+              deadline_ms=None, on_token=None, trace=None):
         payload = {"prompt": [int(t) for t in prompt],
                    "max_new_tokens": int(max_new_tokens)}
+        payload.update(_trace_fields(trace))
         if eos_id is not None:
             payload["eos_id"] = int(eos_id)
         if deadline_ms is not None:
@@ -548,9 +588,10 @@ class HTTPTransport:
             timeout = min(timeout, deadline_ms / 1000.0 + 5.0)
         return _HTTPCall(self.url + "/v1/generate", payload, timeout)
 
-    def prefill(self, prompt, deadline_ms=None):
+    def prefill(self, prompt, deadline_ms=None, trace=None):
         """Blocking hop 1 over the wire: POST ``/v1/prefill``."""
         payload = {"prompt": [int(t) for t in prompt]}
+        payload.update(_trace_fields(trace))
         if deadline_ms is not None:
             payload["deadline_ms"] = float(deadline_ms)
         timeout = self.timeout_s

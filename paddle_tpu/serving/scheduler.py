@@ -90,6 +90,15 @@ class Request:
         self.t_admitted = None
         self.t_first_token = None
         self.t_done = None
+        # distributed tracing: the propagated TraceContext (the engine
+        # coerces whatever arrived — None on a direct add_request gets
+        # a locally-minted root), whether this request entered through
+        # a KV import (its TTFT was paid on the prefill tier), and the
+        # perf_counter stamp of its first post-import decode dispatch
+        # (the decode/queue -> decode/first_step boundary)
+        self.trace = None
+        self.imported = False
+        self.t_decode0 = None
 
     @property
     def done(self):

@@ -440,6 +440,27 @@ def test_bench_serving_smoke_emits_contract_line_rc0(tmp_path):
         assert wire["bytes_per_token"] > 0
         assert last["disagg_decode_goodput_x"] == \
             dz["decode_goodput_x"]
+        # PR 18 distributed tracing: the disagg wave's TTFT must
+        # explain itself — every measured request assembled into ONE
+        # complete cross-replica trace (all nine canonical segments),
+        # the unattributed gap under 10% of the trace window, and the
+        # kv-handoff price (export+wire+import+decode-admission)
+        # extracted for the ledger. The span-recording overhead probe
+        # stays under the 5% bar (2% is the target on a quiet host).
+        bd = dz["ttft_breakdown"]
+        assert bd["enabled"] is True
+        assert bd["count"] == bd["complete"] == dz["requests"]
+        assert bd["gap_frac"] < 0.10, bd
+        assert bd["kv_handoff_overhead_ms"] > 0
+        segs = bd["segments"]
+        for name in ("router/queue", "router/dispatch",
+                     "prefill/queue", "prefill/compute", "kv/export",
+                     "kv/wire", "kv/import", "decode/queue",
+                     "decode/first_step"):
+            assert segs[name]["count"] == dz["requests"], name
+        assert bd["span_overhead"]["frac_of_ttft"] < 0.05, bd
+        assert last["kv_handoff_overhead_ms"] == \
+            bd["kv_handoff_overhead_ms"]
         # heartbeat wedge attribution: beats name the last ledger step
         # and the phase-relative step rate
         beats = [ln for ln in res.stderr.splitlines()

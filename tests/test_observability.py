@@ -409,6 +409,7 @@ _SNAPSHOT_KEYS = {
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
     "scheduler", "health", "resilience", "perf", "replica", "cache",
+    "trace",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",

@@ -17,7 +17,11 @@ replicas on stderr. ``--json`` dumps the pinned-schema FleetSnapshot
 instead of the table. ``--router URL`` additionally scrapes a serving
 router's ``/router/state`` and stamps a router line under the fleet
 line (journal depth, shed/retry/failover/hedge totals, per-replica
-breaker states). Tier-1 self-runs this against two in-process
+breaker states). ``--traces`` additionally scrapes each target's
+``/debug/traces`` ring (and the router's ``/router/trace``),
+assembles the distributed traces, and renders one line per trace
+(window, unattributed gap, completeness). Tier-1 self-runs this
+against two in-process
 engines (tests/test_fleet.py), the same discipline as
 incident_report / chaos_sweep / perf_diff.
 """
@@ -111,6 +115,45 @@ def render_router(state, out=sys.stdout):
           f"hedges={c['hedges']}  breakers[{breakers}]", file=out)
 
 
+def fetch_fleet_traces(targets, router=None, timeout=2.0):
+    """Assemble distributed traces off the fleet's ``/debug/traces``
+    rings (plus the router's ``/router/trace``) — best-effort; an
+    unreachable replica just contributes no spans, so a partial trace
+    renders with its missing segments named instead of hiding."""
+    from paddle_tpu.observability.trace import TraceAssembler
+    asm = TraceAssembler()
+    scraped = 0
+    urls = list(targets)
+    if router:
+        url = router.rstrip("/")
+        if "://" not in url:
+            url = "http://" + url
+        urls.append(url + "/router/trace")
+    for u in urls:
+        try:
+            asm.scrape(u, timeout=timeout)
+            scraped += 1
+        except Exception:   # noqa: BLE001 - best-effort stamp
+            pass
+    return asm.assemble_all() if scraped else []
+
+
+def render_traces(traces, out=sys.stdout, limit=8):
+    if not traces:
+        print("traces: none assembled", file=out)
+        return
+    print(f"traces: {len(traces)} assembled "
+          f"(newest {min(limit, len(traces))})", file=out)
+    for t in traces[-limit:]:
+        status = "complete" if t.complete else \
+            "missing:" + ",".join(t.missing_segments())
+        print(f"  {t.trace_id[:16]}  "
+              f"replicas={','.join(t.replicas)}  "
+              f"window={_fmt(t.window_ms())}ms  "
+              f"gap={_fmt(t.unattributed_ms())}ms  {status}",
+              file=out)
+
+
 def verdict_exit(snap, out=sys.stderr):
     """0 iff all replicas up and healthy; else 1, naming offenders."""
     bad = {rid: e for rid, e in snap["replicas"].items()
@@ -160,6 +203,12 @@ def main(argv=None):
                         help="also scrape a router's /router/state "
                              "and stamp its line (journal, breaker "
                              "states, dispatch counters)")
+    parser.add_argument("--traces", action="store_true",
+                        help="also assemble distributed traces off "
+                             "the targets' /debug/traces rings (and "
+                             "the router's /router/trace when "
+                             "--router is given) and render one line "
+                             "per trace")
     args = parser.parse_args(argv)
     if not args.targets and not args.registry:
         parser.error("give targets or --registry")
@@ -179,6 +228,10 @@ def main(argv=None):
                 render(snap)
                 if args.router:
                     render_router(fetch_router_state(args.router))
+                if args.traces:
+                    render_traces(fetch_fleet_traces(
+                        args.targets, router=args.router,
+                        timeout=args.timeout))
                 time.sleep(args.watch)
         except KeyboardInterrupt:
             return verdict_exit(poller.snapshot())
@@ -190,14 +243,21 @@ def main(argv=None):
     snap = poller.snapshot()
     router_state = fetch_router_state(args.router) \
         if args.router else None
+    traces = fetch_fleet_traces(args.targets, router=args.router,
+                                timeout=args.timeout) \
+        if args.traces else None
     if args.json:
         if args.router:
             snap = dict(snap, router=router_state)
+        if traces is not None:
+            snap = dict(snap, traces=[t.as_dict() for t in traces])
         print(json.dumps(snap, indent=1, sort_keys=True, default=str))
     else:
         render(snap)
         if args.router:
             render_router(router_state)
+        if traces is not None:
+            render_traces(traces)
     return verdict_exit(snap)
 
 

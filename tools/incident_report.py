@@ -15,7 +15,9 @@ human (or a CI gate) wants first:
   * top regressed step phases: the tail rows' wall/dispatch/sync
     columns compared, final stretch vs the window median, sorted by
     regression — "sync went 14x" beats eyeballing raw JSON;
-  * the engine vitals from the embedded metrics snapshot.
+  * the engine vitals from the embedded metrics snapshot;
+  * the assembled distributed traces of requests in flight at capture
+    time — each victim's cross-replica critical path (ISSUE 18).
 
 Exit status is the CI contract: an incident bundle is by definition
 UNHEALTHY -> exit 1; a ``/debug/health`` body (the ``{healthy, ...}``
@@ -157,6 +159,25 @@ def report_incident(bundle, tail=None, out=sys.stdout):
             events = [e.get("event") for e in t.get("events", [])]
             print(f"  rid={t.get('rid')}  last={events[-1] if events else '?'}"
                   f"  events={len(events)}", file=out)
+    traces = bundle.get("traces")
+    if traces:
+        # assembled distributed traces of requests in flight at
+        # capture time (ISSUE 18): where each victim's TTFT went,
+        # cross-replica, as of the anomaly
+        print(f"\nIN-FLIGHT TRACES ({len(traces)})", file=out)
+        for t in traces[:4]:
+            segs = t.get("segments") or {}
+            window = t.get("window_ms")
+            gap = t.get("unattributed_ms")
+            print(f"  trace={t.get('trace_id')}  "
+                  f"replicas={','.join(t.get('replicas') or [])}  "
+                  f"window={window}ms  gap={gap}ms", file=out)
+            for row in (t.get("timeline") or [])[:12]:
+                amb = " ~skew" if row.get("skew_ambiguous") else ""
+                print(f"    {row['t_rel_ms']:9.3f}  "
+                      f"{row['dur_ms']:9.3f}  "
+                      f"{row['replica']:<10} {row['name']}{amb}",
+                      file=out)
     chaos = bundle.get("chaos")
     if isinstance(chaos, dict) and chaos.get("enabled"):
         # the replay recipe: this incident was found under the fault-

@@ -14,7 +14,10 @@ promise the hard way:
      non-shed requests complete, every stream is bit-exact vs the
      reference, the survivors end with zero queued requests / zero
      occupied slots, and their compile counters did not move (zero
-     steady-state compiles under failover);
+     steady-state compiles under failover). The wave also audits the
+     distributed traces (ISSUE 18): every failed-over request must
+     remain ONE trace — the replay's survivor-side spans land under
+     the ORIGINAL trace id with a router/failover annotation;
   3. **no-failover baseline** — the same kill against a
      ``max_retries=0`` router: the drill DEMANDS lost requests here
      (if losing a replica were free, the failover machinery would be
@@ -258,6 +261,37 @@ def run_drill(replicas=3, requests=12, max_new=16, seed=5,
             w2_line["handoffs"] = state["disagg"]["handoffs"]
             w2_line["handoff_failures"] = \
                 state["disagg"]["handoff_failures"]
+        # distributed-trace audit (ISSUE 18): a failed-over request
+        # must remain ONE trace — the replay's spans land under the
+        # ORIGINAL trace id (minted at admission, carried by the
+        # journal through every dispatch attempt), annotated with a
+        # router/failover span. The victim's ring died with it, so
+        # assembly joins the router's recorder with the SURVIVORS'
+        # /debug/traces — the replayed attempt's replica-side spans
+        # must appear under the same id.
+        from paddle_tpu.observability.trace import TraceAssembler
+        asm = TraceAssembler()
+        asm.add_recorder(router.trace)
+        for u in survivors:
+            try:
+                asm.scrape(u, timeout=3.0)
+            except Exception:   # noqa: BLE001 - audit is best-effort
+                pass
+        failed_over = [t for t in asm.assemble_all()
+                       if any(s["name"] == "router/failover"
+                              for s in t.spans)]
+        w2_line["traced_failovers"] = len(failed_over)
+        if failmoves and not failed_over:
+            failures.append(
+                f"router counted {failmoves} failovers but no "
+                f"assembled trace carries a router/failover span")
+        survivor_rids = {by_url[u] for u in survivors}
+        for t in failed_over:
+            if not ({s["replica"] for s in t.spans} & survivor_rids):
+                failures.append(
+                    f"failed-over trace {t.trace_id} has no "
+                    f"survivor-side spans under the original trace "
+                    f"id — the replay forked the trace")
         print(json.dumps(w2_line), file=out, flush=True)
         if lost:
             failures.append(f"failover wave lost rids: {lost}")

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""trace_report — assemble per-replica span rings into end-to-end
+request traces and render the TTFT critical path.
+
+Inputs are trace surfaces, mixed freely:
+
+  * URLs — a replica's ``host:port`` (scrapes ``/debug/traces``) or a
+    full path like ``http://host:port/router/trace`` (the router's
+    ring). Scrapes stamp the round trip, so cross-host clock skew is
+    bounded and skew-ambiguous orderings are flagged in the timeline.
+  * Files — saved ``/debug/traces`` JSON bodies (``-`` reads one from
+    stdin), offset-free (same-host clocks).
+
+Renders, per assembled trace, the end-to-end timeline (one row per
+span: relative start, duration, replica, name) and, over the whole
+cohort, the nine-segment TTFT decomposition (median/p99 ms per
+segment + the unattributed gap). ``--chrome OUT.json`` additionally
+writes the cross-process chrome://tracing export (one pid per
+replica, flow arrows linking the hops).
+
+Exit code: 0 — every requested trace assembled complete (all nine
+canonical segments present); 1 — a requested trace is missing or
+incomplete; 2 — unreadable input / nothing to assemble. Tier-1
+self-runs this against a live 1P+1D in-process handoff
+(tests/test_trace.py), the same discipline as incident_report /
+cache_report / fleet_top.
+
+Usage: python tools/trace_report.py SOURCE [SOURCE...]
+           [--trace ID]... [--breakdown-only] [--chrome OUT.json]
+           [--json] [--timeout S]
+
+Zero heavy imports (no jax, no paddle_tpu package import): the
+assembler modules load by file path, so this starts in milliseconds
+against a live fleet.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import types
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_modules():
+    """Load observability/trace/{context,spans,assembler} as a
+    synthetic package by file path — the assembler without the
+    paddle_tpu package import (which would pull jax)."""
+    pkgdir = os.path.join(_REPO, "paddle_tpu", "observability",
+                          "trace")
+    pkg = types.ModuleType("_pt_trace")
+    pkg.__path__ = [pkgdir]
+    sys.modules["_pt_trace"] = pkg
+    mods = {}
+    for name in ("context", "spans", "assembler"):
+        spec = importlib.util.spec_from_file_location(
+            f"_pt_trace.{name}", os.path.join(pkgdir, name + ".py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"_pt_trace.{name}"] = mod
+        spec.loader.exec_module(mod)
+        mods[name] = mod
+    return mods
+
+
+def _table(headers, rows, out):
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows
+              else len(h) for i, h in enumerate(headers)]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+          file=out)
+    print("  ".join("-" * w for w in widths), file=out)
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)),
+              file=out)
+
+
+def _fmt_ms(v):
+    return "-" if v is None else f"{v:.3f}"
+
+
+def render_trace(trace, out=sys.stdout):
+    """One trace's header + timeline table."""
+    flags = []
+    if not trace.complete:
+        flags.append("INCOMPLETE: missing "
+                     + ", ".join(trace.missing_segments()))
+    gap = trace.unattributed_ms()
+    frac = trace.unattributed_frac()
+    print(f"trace {trace.trace_id}  replicas="
+          f"{','.join(trace.replicas)}  "
+          f"window={_fmt_ms(trace.window_ms())}ms  "
+          f"unattributed={_fmt_ms(gap)}ms"
+          + (f" ({frac:.1%})" if frac is not None else ""),
+          file=out)
+    for f in flags:
+        print(f"  {f}", file=out)
+    rows = []
+    for r in trace.timeline():
+        rows.append((
+            f"{r['t_rel_ms']:.3f}", f"{r['dur_ms']:.3f}",
+            r["replica"][:20], r["name"],
+            "skew?" if r["skew_ambiguous"] else "",
+        ))
+    _table(("T_REL_MS", "DUR_MS", "REPLICA", "SPAN", "FLAGS"), rows,
+           out)
+
+
+def render_breakdown(breakdown, out=sys.stdout):
+    """The cohort TTFT decomposition table."""
+    print(f"ttft breakdown over {breakdown['count']} trace(s) "
+          f"({breakdown['complete']} complete): "
+          f"window median={_fmt_ms(breakdown['ttft']['median_ms'])}ms "
+          f"p99={_fmt_ms(breakdown['ttft']['p99_ms'])}ms", file=out)
+    rows = []
+    for name, s in breakdown["segments"].items():
+        rows.append((name, _fmt_ms(s["median_ms"]),
+                     _fmt_ms(s["p99_ms"]), str(s["count"])))
+    un = breakdown["unattributed"]
+    frac = un.get("median_frac")
+    rows.append(("(unattributed)", _fmt_ms(un["median_ms"]),
+                 _fmt_ms(un["p99_ms"]),
+                 "-" if frac is None else f"{frac:.1%}"))
+    _table(("SEGMENT", "MEDIAN_MS", "P99_MS", "COUNT"), rows, out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="assemble /debug/traces rings into end-to-end "
+                    "request traces; exit 0 iff every requested "
+                    "trace is complete")
+    parser.add_argument("sources", nargs="+",
+                        help="trace surfaces: URLs (host:port or "
+                             "http://.../router/trace) and/or saved "
+                             "/debug/traces JSON files ('-' = stdin)")
+    parser.add_argument("--trace", action="append", default=None,
+                        metavar="ID",
+                        help="render only this trace id (repeatable; "
+                             "default: every assembled trace)")
+    parser.add_argument("--breakdown-only", action="store_true",
+                        help="skip per-trace timelines, print only "
+                             "the cohort segment decomposition")
+    parser.add_argument("--chrome", default=None, metavar="OUT.json",
+                        help="also write the cross-process "
+                             "chrome://tracing export")
+    parser.add_argument("--json", action="store_true",
+                        help="dump assembled traces + breakdown as "
+                             "JSON instead of tables")
+    parser.add_argument("--timeout", type=float, default=5.0,
+                        help="per-URL scrape timeout seconds")
+    args = parser.parse_args(argv)
+
+    mods = _load_trace_modules()
+    asm = mods["assembler"].TraceAssembler()
+    for src in args.sources:
+        try:
+            if src == "-":
+                asm.add_body(json.load(sys.stdin))
+            elif os.path.exists(src):
+                with open(src, encoding="utf-8") as fh:
+                    asm.add_body(json.load(fh))
+            else:
+                asm.scrape(src, timeout=args.timeout)
+        except Exception as e:   # noqa: BLE001 - CLI verdict, exit 2
+            print(f"ERROR: cannot read {src}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+    wanted = args.trace
+    traces = []
+    missing_ids = []
+    if wanted:
+        for tid in wanted:
+            t = asm.assemble(tid)
+            if t is None:
+                missing_ids.append(tid)
+            else:
+                traces.append(t)
+    else:
+        traces = asm.assemble_all()
+    if not traces and not missing_ids:
+        print("ERROR: no traces assembled from "
+              f"{len(args.sources)} source(s)", file=sys.stderr)
+        return 2
+
+    breakdown = mods["assembler"].ttft_breakdown(traces)
+    if args.json:
+        print(json.dumps({
+            "traces": [t.as_dict() for t in traces],
+            "ttft_breakdown": breakdown,
+            "missing_trace_ids": missing_ids,
+        }, indent=1, sort_keys=True))
+    else:
+        if not args.breakdown_only:
+            for t in traces:
+                render_trace(t)
+                print()
+        render_breakdown(breakdown)
+
+    if args.chrome:
+        with open(args.chrome, "w", encoding="utf-8") as fh:
+            json.dump(mods["assembler"].chrome_trace(traces), fh)
+        print(f"chrome trace written: {args.chrome}",
+              file=sys.stderr)
+
+    rc = 0
+    for tid in missing_ids:
+        print(f"INCOMPLETE: trace {tid} not found in any source",
+              file=sys.stderr)
+        rc = 1
+    # a REQUESTED trace must be whole (the unfiltered sweep renders
+    # monolithic traces too, which legitimately lack the handoff
+    # segments — only --trace selections gate completeness)
+    if wanted:
+        for t in traces:
+            if not t.complete:
+                print(f"INCOMPLETE: trace {t.trace_id} missing "
+                      + ", ".join(t.missing_segments()),
+                      file=sys.stderr)
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
