@@ -123,7 +123,7 @@ _PERF_LEDGER = os.environ.get(
 # gate is vacuous at zero spread, the relative gate does the judging.
 _DETERMINISTIC_METRICS = frozenset({
     "cache_hit_rate", "spec_effective_tokens_per_dispatch",
-    "kv_wire_bytes_per_token"})
+    "kv_wire_bytes_per_token", "tenant_conservation_ok"})
 
 # (scenario, metric, unit, direction, rel_threshold, path-in-evidence)
 # — the normalized rows every run contributes. Thresholds are the
@@ -226,6 +226,17 @@ _LEDGER_SPECS = (
     # the row exists for the trajectory, not a tight gate.
     ("disagg", "kv_handoff_overhead_ms", "ms", "lower_better", 1.0,
      ("disagg", "ttft_breakdown", "kv_handoff_overhead_ms")),
+    # tenant observatory (ISSUE 19): the attribution cost per
+    # representative step (an overhead probe — the noisiest class,
+    # same threshold as the other probes) and the exact-conservation
+    # verdict (1.0 iff every per-tenant-sums == global-counters
+    # identity held on BOTH arms — counter math, zero timing noise,
+    # so it rides the deterministic tight gate and ANY movement off
+    # 1.0 is an attribution leak, not host weather)
+    ("tenants", "tenant_attribution_overhead_frac", "fraction",
+     "lower_better", 1.0, ("tenants", "overhead", "overhead_frac")),
+    ("tenants", "tenant_conservation_ok", "fraction",
+     "higher_better", 0.05, ("tenants", "conservation_ok_frac")),
 )
 
 
@@ -457,6 +468,7 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
     disagg_sec = _measure_disagg(m_eng, num_slots)
     decode_kernel_sec = _measure_decode_kernel(m_eng, num_slots)
     speculative_sec = _measure_speculative(spec_cfg)
+    tenants_sec = _measure_tenants(m_eng, num_slots, health_sec)
 
     import jax
     dev = jax.devices()[0]
@@ -540,6 +552,13 @@ def _measure(hidden, layers, heads, vocab, max_seq_len, num_slots,
         # acceptance rate + effective tokens per dispatch, and the
         # wall-clock goodput ratio the ledger tracks
         "speculative": speculative_sec,
+        # PR 19 tenant observatory: fair vs adversarial two-tenant
+        # arms on live engines + pollers — exact counter conservation
+        # on both pools, noisy_neighbor fires on the adversarial arm
+        # ONLY, the 10k-tenant flood stays bounded at max_tenants+1
+        # series, and the per-request attribution cost is quoted
+        # against the representative step (same <2% bar)
+        "tenants": tenants_sec,
     }
 
 
@@ -1026,6 +1045,198 @@ def _measure_fleet_poll(model, num_slots, health_sec):
     }
 
 
+def _measure_tenants(model, num_slots, health_sec):
+    """The artifact's ``tenants`` section (ISSUE 19): the tenant
+    observatory proven end to end on live engines, four claims:
+
+      * **conservation** — per-tenant counter sums equal the engine's
+        own global counters EXACTLY on both arms (attribution that
+        doesn't add up is worse than none);
+      * **detection** — a fair two-tenant workload and an adversarial
+        hog/victim workload run through identical FleetPoller
+        machinery; the ``noisy_neighbor`` detector must fire on the
+        adversarial arm and ONLY there (the false-positive bar);
+      * **bounded cardinality** — a 10k-unique-tenant-id flood against
+        the ledger stays capped at ``max_tenants``+1 series (the
+        ``~other`` fold), never 10k;
+      * **overhead** — the per-request attribution cost, micro-timed
+        against a scratch ledger (the _perf_section discipline: never
+        the live engine's, which would corrupt its counters) and
+        quoted per representative step. The quote is CONSERVATIVE —
+        one full admission+first-token+completion lifecycle per step,
+        though a real request amortizes that one lifecycle over its
+        many decode steps — and the <2%-of-a-representative-step bar
+        still holds with an order of magnitude to spare."""
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.observability import MetricsRegistry
+    from paddle_tpu.observability.fleet import FleetPoller
+    from paddle_tpu.observability.tenant import TenantLedger
+    from paddle_tpu.serving import ServingEngine
+
+    _set_phase("tenants")
+    rs = np.random.RandomState(19)
+
+    def prompt(n):
+        return rs.randint(0, model.cfg.vocab_size,
+                          (int(n),)).astype(np.int64)
+
+    def conservation(eng):
+        """Exact per-tenant-sums == global-counters identities (the
+        same checks tests/test_tenant.py asserts)."""
+        snap = eng.metrics.snapshot()
+        rows = snap["tenants"]["tenants"].values()
+        slo = snap["slo"]
+
+        def tsum(key):
+            return sum(e[key] for e in rows)
+
+        return {
+            "requests": tsum("requests") == snap["requests_admitted"],
+            "completed": tsum("completed")
+            == snap["requests_completed"],
+            "tokens_out": tsum("tokens_out") == slo["total_tokens"],
+            "goodput_tokens": tsum("goodput_tokens")
+            == slo["goodput_tokens"],
+            "attained": tsum("attained") == slo["attained"],
+            "violations": (sum(sum(e["violations"].values())
+                               for e in rows) + tsum("timeouts"))
+            == sum(slo["violations"].values()),
+            "prometheus_tokens_out": sum(
+                (eng.metrics.registry.snapshot()
+                 ["serving_tenant_tokens_out_total"]["values"])
+                .values()) == slo["total_tokens"],
+        }
+
+    def run_arm(name, rounds, slo_ttft_ms, paged):
+        """One arm: a live engine + its own FleetPoller, polled once
+        per traffic round so every poll carries one round's fairness
+        deltas — the deterministic mirror of the background cycle."""
+        kw = dict(paged=True, block_size=8) if paged else {}
+        eng = ServingEngine(model, num_slots=num_slots, bucket_min=8,
+                            replica_id=f"tenant-{name}",
+                            slo_ttft_ms=slo_ttft_ms, **kw)
+        _watch_engine(eng)
+        handle = eng.serve_metrics()
+        try:
+            poller = FleetPoller([f"127.0.0.1:{handle.port}"],
+                                 interval_s=0.05, timeout_s=2.0)
+            # warmup (compiles out of the way), then the baseline poll
+            # that seeds the poller's cumulative-counter diffs
+            for tenant, n_reqs, plen, k in rounds:
+                eng.add_request(prompt(plen), max_new_tokens=k,
+                                tenant_id=tenant)
+            eng.run()
+            eng.declare_warmup()
+            poller.poll_once()
+            # 9 rounds: the noisy_neighbor window (8 polls) fills and
+            # judges sustained behavior, not one burst
+            for _ in range(9):
+                for tenant, n_reqs, plen, k in rounds:
+                    for _ in range(n_reqs):
+                        eng.add_request(prompt(plen),
+                                        max_new_tokens=k,
+                                        tenant_id=tenant)
+                eng.run()
+                poller.poll_once()
+            counts = poller.detector_counts()
+            ften = poller.fleet_tenants()
+            cons = conservation(eng)
+            rep = eng.metrics.snapshot()["tenants"]
+            return {
+                "pool": "paged" if paged else "legacy",
+                "polls": ften["polls"],
+                "tenants": {
+                    t: {k: e[k] for k in ("requests", "completed",
+                                          "tokens_out", "attainment")}
+                    for t, e in rep["tenants"].items()},
+                "conservation": cons,
+                "noisy_neighbor_fired": counts.get(
+                    "noisy_neighbor", 0),
+                "tenant_starvation_fired": counts.get(
+                    "tenant_starvation", 0),
+                "last_verdicts": ften["last_verdicts"],
+            }
+        finally:
+            handle.close()
+            eng.close()
+
+    # fair arm: two tenants at identical volume, attainable SLO —
+    # dominance and victim-pain gates must BOTH stay quiet
+    fair = run_arm("fair", [("acme", 1, 6, 6), ("beta", 1, 6, 6)],
+                   slo_ttft_ms=60000.0, paged=False)
+    # adversarial arm: one hog at ~90% token share while the victim's
+    # every completion violates the (unattainably tight) TTFT target
+    adv = run_arm("adversarial",
+                  [("hog", 3, 6, 6), ("victim", 1, 4, 2)],
+                  slo_ttft_ms=0.000001, paged=True)
+
+    # bounded cardinality: a 10k-unique-id flood against a scratch
+    # ledger must stay at max_tenants + ~other, never 10k series
+    flood_reg = MetricsRegistry()
+    flood_led = TenantLedger(flood_reg, max_tenants=32)
+    unique_ids = 10000
+    for i in range(unique_ids):
+        flood_led.note_admission(f"flood-{i}", 16, 0.0)
+    flood_series = len(flood_reg.snapshot()
+                       ["serving_tenant_requests_total"]["values"])
+    flood = {
+        "unique_ids": unique_ids,
+        "max_tenants": 32,
+        "tenant_count": flood_led.tenant_count,
+        "folded_events": flood_led.overflow_events,
+        "series_per_family": flood_series,
+        "bounded_ok": (flood_led.tenant_count == 33
+                       and flood_series == 33
+                       and flood_led.overflow_events
+                       == unique_ids - 32),
+    }
+
+    # overhead: the full per-request attribution lifecycle against a
+    # scratch ledger, cycling through a realistic in-cap tenant mix
+    scratch = TenantLedger(MetricsRegistry(), max_tenants=32)
+    names = [f"t{i}" for i in range(16)]
+    reps = 10000
+    t0 = _time.perf_counter()
+    for i in range(reps):
+        t = names[i % len(names)]
+        scratch.note_admission(t, 16, 0.001)
+        scratch.note_first_token(t, 0.01)
+        scratch.note_completion(t, 6, ())
+    per_request_us = (_time.perf_counter() - t0) / reps * 1e6
+    step_wall_us = (health_sec.get("overhead") or {}).get(
+        "step_wall_us")
+
+    conservation_ok = (all(fair["conservation"].values())
+                       and all(adv["conservation"].values()))
+    return {
+        "arms": {"fair": fair, "adversarial": adv},
+        "conservation_ok": conservation_ok,
+        # the ledgered deterministic form (make_row wants a number)
+        "conservation_ok_frac": 1.0 if conservation_ok else 0.0,
+        "detector": {
+            "fair_noisy_fired": fair["noisy_neighbor_fired"],
+            "adversarial_noisy_fired": adv["noisy_neighbor_fired"],
+            "fired_only_adversarial":
+                fair["noisy_neighbor_fired"] == 0
+                and adv["noisy_neighbor_fired"] >= 1,
+        },
+        "flood": flood,
+        "overhead": {
+            "per_request_us": round(per_request_us, 3),
+            # denominator: the health probe's representative low-ms
+            # step; one full request lifecycle per step is the
+            # conservative quote (real requests amortize it over
+            # every decode step they hold a slot for)
+            "step_wall_us": step_wall_us,
+            "overhead_frac": round(per_request_us / step_wall_us, 6)
+            if step_wall_us else None,
+        },
+    }
+
+
 def _router_counter(registry, name):
     fam = registry.snapshot().get(name)
     return sum(fam["values"].values()) if fam else 0.0
@@ -1319,7 +1530,7 @@ def _measure_disagg(model, num_slots):
     # regression (all attempts low) stays visible in the artifact.
     attempts = []
     mono = disagg = state = breakdown = None
-    best = -1.0
+    best = None
     last_dz = None
     for _ in range(3):
         a_mono, _, _ = arm([None, None, None], ttft_owners=(0, 1, 2))
@@ -1342,18 +1553,25 @@ def _measure_disagg(model, num_slots):
             if a_mono["decode_goodput_tps"] else 0.0
         attempts.append([round(ttft_x, 3), round(good_x, 3)])
         a_bd = ttft_breakdown(a_traces) if a_traces else None
-        if min(ttft_x, good_x) > best:
-            best = min(ttft_x, good_x)
-            mono, disagg, state = a_mono, a_dis, a_state
-            breakdown = a_bd
-        # a hiccup that tears the trace (dropped spans / a replica
-        # scrape landing mid-GC inflating the unattributed gap past
-        # the 10% attribution bar) re-measures like a perf hiccup —
-        # the artifact must carry a trace that explains its own TTFT
+        # a hiccup that tears the trace (dropped spans / host
+        # scheduler stalls landing BETWEEN segment boundaries and
+        # inflating the unattributed gap past the 10% attribution
+        # target) re-measures like a perf hiccup — the artifact
+        # should carry a trace that explains its own TTFT
         trace_ok = (a_bd is None
                     or (a_bd["complete"] == a_bd["count"] == requests
                         and a_bd["unattributed"]["median_frac"] < 0.10))
-        if ttft_x >= 1.2 and good_x >= 1.2 and trace_ok:
+        # keep the best attempt lexicographically: perf bars cleared
+        # first, then a clean trace, then the weaker ratio — so one
+        # trace-clean attempt is never discarded for a noisy one
+        # that scored marginally better on the ratios
+        score = (ttft_x >= 1.2 and good_x >= 1.2, trace_ok,
+                 min(ttft_x, good_x))
+        if best is None or score > best:
+            best = score
+            mono, disagg, state = a_mono, a_dis, a_state
+            breakdown = a_bd
+        if score[0] and score[1]:
             break
     assert state is not None, \
         f"every disagg attempt bypassed the two-hop path: {last_dz}"
@@ -2316,9 +2534,19 @@ def main():
             "decode_goodput_x"],
         "kv_handoff_overhead_ms": evidence["disagg"][
             "ttft_breakdown"].get("kv_handoff_overhead_ms"),
+        "tenant_conservation_ok": evidence["tenants"][
+            "conservation_ok"],
         "source": "live-smoke" if smoke else "live",
         "artifact": f"bench_artifacts/{fname}",
     })
+    # hard exit: everything is emitted and flushed, and interpreter
+    # teardown with live backend/server threads can abort from C++
+    # ("terminate called without an active exception" — a joinable
+    # thread destructed at static destruction), turning a finished
+    # run into rc!=0. The watchdog path already exits this way.
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

@@ -114,6 +114,10 @@ from .registry import (  # noqa: F401
     start_metrics_server,
 )
 from .slo import SLOTracker  # noqa: F401
+from .tenant import (  # noqa: F401
+    TENANT_ENTRY_KEYS, TENANT_KEYS, TenantLedger,
+    disabled_tenant_report,
+)
 from .tracing import (  # noqa: F401
     FlowEvent, HostSpan, HostSpanRecorder, default_recorder, span_timer,
 )

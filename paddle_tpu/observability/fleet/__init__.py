@@ -25,11 +25,13 @@ on.
     the fixed-bucket histograms merge bucket-wise, so fleet TTFT /
     latency percentiles come from the merged distribution, never
     averaged percentiles), judged by ``scope="fleet"`` detectors
-    (``replica_flap`` / ``fleet_goodput_collapse`` / ``load_skew``)
-    in the PR-8 ``register_detector`` framework;
+    (``replica_flap`` / ``fleet_goodput_collapse`` / ``load_skew`` /
+    ``noisy_neighbor`` / ``tenant_starvation``) in the PR-8
+    ``register_detector`` framework;
   * **server.FleetServer** — ``/fleet/health``, ``/fleet/state``,
     ``/fleet/metrics`` (Prometheus text with a ``replica`` label on
-    every series).
+    every series), ``/fleet/tenants`` (the federated per-tenant
+    attribution rollup + fairness-detector state).
 
 ``tools/fleet_top.py`` renders the fleet table from the same poller
 (one-shot or ``--watch``), exiting 0 iff every replica is up and
@@ -38,11 +40,11 @@ healthy.
 from . import detectors as _fleet_detectors  # noqa: F401 - registers
 from .identity import ReplicaIdentity, default_replica_id  # noqa: F401
 from .poller import (  # noqa: F401
-    FLEET_ROW_KEYS, FleetPoller, ReplicaState,
+    FLEET_ROW_KEYS, FLEET_TENANT_ROW_KEYS, FleetPoller, ReplicaState,
 )
 from .rollup import (  # noqa: F401
     FLEET_AGG_KEYS, FLEET_REPLICA_KEYS, FLEET_SCHEMA,
-    FLEET_SNAPSHOT_KEYS, fleet_aggregate, fleet_cache,
-    merged_latency, replica_entry,
+    FLEET_SNAPSHOT_KEYS, FLEET_TENANT_ENTRY_KEYS, fleet_aggregate,
+    fleet_cache, fleet_tenants, merged_latency, replica_entry,
 )
 from .server import FleetServer  # noqa: F401

@@ -25,12 +25,24 @@ one replica's steps; these judge the fleet's SHAPE:
     the fleet mean while the mean shows real load): the
     dispatch-layer-is-broken signature — one replica drowning while
     its peers idle means routing, not capacity, is the problem.
+``noisy_neighbor``
+    one tenant dominating the fleet's generated tokens over a poll
+    window WHILE the other tenants' SLO attainment over the same
+    window is poor — capacity is being monopolized at the victims'
+    expense. Judged from the per-tenant deltas in ``row["tenants"]``
+    (exact fleet counter sums differenced between cycles).
+``tenant_starvation``
+    a tenant with work QUEUED somewhere gets zero admissions for
+    ``sustain`` consecutive polls while OTHER tenants keep getting
+    admitted — the fairness inverse of noisy_neighbor: not slow
+    service, no service.
 """
 import collections
 
 from ..health.detectors import Detector, register_detector
 
-__all__ = ["ReplicaFlap", "FleetGoodputCollapse", "LoadSkew"]
+__all__ = ["ReplicaFlap", "FleetGoodputCollapse", "LoadSkew",
+           "NoisyNeighbor", "TenantStarvation"]
 
 
 @register_detector("replica_flap", scope="fleet")
@@ -151,4 +163,123 @@ class LoadSkew(Detector):
                 max_queue_depth=int(depths[worst]),
                 peer_mean_queue_depth=round(peer_mean, 2),
                 polls_skewed=self._streak)
+        return None
+
+
+@register_detector("noisy_neighbor", scope="fleet")
+class NoisyNeighbor(Detector):
+    """One tenant's generated-token share over the last ``window``
+    polls >= ``share_frac`` of the fleet total WHILE the OTHER
+    tenants' SLO attainment over the same window (their summed
+    attained / summed completions+violations) is below
+    ``attain_floor``. Both halves must hold: a tenant dominating an
+    otherwise-healthy fleet is just the biggest customer, and poor
+    fleet-wide attainment without a dominant tenant is overload, not
+    a neighbor problem. Volume gates (``min_tokens`` window tokens,
+    ``min_victim_judged`` victim verdicts) keep idle/cold windows
+    quiet. Fires once per episode; re-arms when either half clears."""
+
+    def __init__(self, window=8, share_frac=0.6, attain_floor=0.5,
+                 min_tokens=100, min_victim_judged=3):
+        self.window = int(window)
+        self.share_frac = float(share_frac)
+        self.attain_floor = float(attain_floor)
+        self.min_tokens = float(min_tokens)
+        self.min_victim_judged = float(min_victim_judged)
+        self._rows = collections.deque(maxlen=self.window)
+        self._fired = False
+
+    def observe(self, row, ledger):
+        self._rows.append(row.get("tenants") or {})
+        if len(self._rows) < self.window:
+            return None
+        tokens, attained, judged = {}, {}, {}
+        for facts in self._rows:
+            for t, f in facts.items():
+                tokens[t] = tokens.get(t, 0.0) \
+                    + (f.get("tokens_delta") or 0.0)
+                att = f.get("attained_delta") or 0.0
+                attained[t] = attained.get(t, 0.0) + att
+                judged[t] = judged.get(t, 0.0) + att \
+                    + (f.get("violated_delta") or 0.0)
+        total = sum(tokens.values())
+        if total < self.min_tokens or len(tokens) < 2:
+            self._fired = False
+            return None
+        top = max(tokens, key=lambda t: (tokens[t], t))
+        share = tokens[top] / total
+        victim_judged = sum(v for t, v in judged.items() if t != top)
+        if victim_judged < self.min_victim_judged:
+            self._fired = False
+            return None
+        victim_attain = sum(
+            v for t, v in attained.items() if t != top) / victim_judged
+        noisy = (share >= self.share_frac
+                 and victim_attain < self.attain_floor)
+        if not noisy:
+            self._fired = False
+            return None
+        if self._fired:
+            return None
+        self._fired = True
+        return self._verdict(
+            row,
+            f"tenant {top} holds {share:.0%} of fleet tokens over "
+            f"{self.window} polls while other tenants attain "
+            f"{victim_attain:.0%}",
+            tenant=top,
+            token_share=round(share, 4),
+            victim_attainment=round(victim_attain, 4),
+            window_polls=self.window,
+            window_tokens=round(total, 1))
+
+
+@register_detector("tenant_starvation", scope="fleet")
+class TenantStarvation(Detector):
+    """A tenant with queued work admitted NOWHERE for ``sustain``
+    consecutive polls while other tenants' admissions kept flowing.
+    Per-tenant streaks (several tenants can starve at once, each
+    fires on its own schedule); a poll with zero fleet-wide
+    admissions resets nothing — an idle or wedged fleet is a
+    different detector's problem, starvation is specifically unfair
+    SHARING of admissions that are happening."""
+
+    def __init__(self, sustain=3, min_queued=1):
+        self.sustain = int(sustain)
+        self.min_queued = int(min_queued)
+        self._streaks = {}
+        self._fired = set()
+
+    def observe(self, row, ledger):
+        facts = row.get("tenants") or {}
+        total_adm = sum((f.get("requests_delta") or 0.0)
+                        for f in facts.values())
+        for t in list(self._streaks):
+            if t not in facts:
+                self._streaks.pop(t, None)
+                self._fired.discard(t)
+        for t, f in sorted(facts.items()):
+            own_adm = f.get("requests_delta") or 0.0
+            queued = f.get("queued") or 0
+            if own_adm > 0 or queued < self.min_queued:
+                self._streaks.pop(t, None)
+                self._fired.discard(t)
+                continue
+            if total_adm - own_adm <= 0:
+                # nobody got admitted: the fleet is idle/wedged, not
+                # unfair — hold the streak, don't grow it
+                continue
+            streak = self._streaks.get(t, 0) + 1
+            self._streaks[t] = streak
+            if streak >= self.sustain and t not in self._fired:
+                self._fired.add(t)
+                return self._verdict(
+                    row,
+                    f"tenant {t} starved: {queued} queued, zero "
+                    f"admissions for {streak} polls while peers "
+                    f"admitted {total_adm:.0f}",
+                    tenant=t,
+                    queued=int(queued),
+                    polls_starved=streak,
+                    peer_admissions=round(total_adm, 1))
         return None

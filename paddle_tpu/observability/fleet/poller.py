@@ -60,7 +60,8 @@ from ..registry import MetricsRegistry, prometheus_text_from_snapshots
 from ..tracing import default_recorder
 from . import rollup
 
-__all__ = ["FleetPoller", "ReplicaState", "FLEET_ROW_KEYS"]
+__all__ = ["FleetPoller", "ReplicaState", "FLEET_ROW_KEYS",
+           "FLEET_TENANT_ROW_KEYS"]
 
 # the per-poll fleet row the fleet detectors evaluate (``step`` is the
 # poll sequence number, so the shared Detector/ledger machinery from
@@ -76,6 +77,24 @@ FLEET_ROW_KEYS = (
     "goodput_total",  # fleet cumulative SLO-met tokens (last known)
     "goodput_delta",  # of those, new since the previous cycle
     "work_pending",   # any replica reports queued work or occupancy
+    "tenants",        # {tenant: per-cycle fairness facts} (see below)
+)
+
+# per-tenant per-cycle facts inside row["tenants"]: cumulative fleet
+# sums of the tenant-labelled counters differenced between cycles
+# (the noisy_neighbor / tenant_starvation detectors' evidence), plus
+# the live queue depth from the replicas' /debug/state tenant sections
+FLEET_TENANT_ROW_KEYS = (
+    "tokens_delta", "requests_delta", "completed_delta",
+    "attained_delta", "violated_delta", "queued",
+)
+
+_TENANT_ROW_COUNTERS = (
+    ("tokens_delta", "serving_tenant_tokens_out_total"),
+    ("requests_delta", "serving_tenant_requests_total"),
+    ("completed_delta", "serving_tenant_completed_total"),
+    ("attained_delta", "serving_tenant_slo_attained_total"),
+    ("violated_delta", "serving_tenant_slo_violations_total"),
 )
 
 
@@ -198,6 +217,7 @@ class FleetPoller:
         self._polls = 0
         self._last_poll_t = None
         self._prev_goodput = None
+        self._prev_tenants = None   # tenant -> cumulative fleet sums
         self._stop = threading.Event()
         self._thread = None
 
@@ -387,6 +407,45 @@ class FleetPoller:
                 work_pending = True
         prev_good = self._prev_goodput
         self._prev_goodput = goodput
+        # per-tenant fleet sums this cycle (cumulative, last-known):
+        # differenced against the previous cycle's sums into the
+        # fairness deltas the tenant detectors judge. A replica that
+        # died keeps contributing its last-known totals, so deltas
+        # never go negative on eviction.
+        cum = {}
+        queued = {}
+        for st in self.replicas:
+            if st.metrics is not None:
+                for key, family in _TENANT_ROW_COUNTERS:
+                    fam = st.metrics.get(family)
+                    for labels, v in ((fam or {}).get("values")
+                                      or {}).items():
+                        if not labels.startswith("tenant=") \
+                                or not isinstance(v, (int, float)):
+                            continue
+                        t = labels[len("tenant="):]
+                        cell = cum.setdefault(
+                            t, dict.fromkeys(
+                                (k for k, _ in _TENANT_ROW_COUNTERS),
+                                0.0))
+                        cell[key] += v
+            if st.verdict != "down" and st.state is not None:
+                sec = st.state.get("tenants") or {}
+                for t, entry in (sec.get("tenants") or {}).items():
+                    queued[t] = queued.get(t, 0) \
+                        + (entry.get("queued") or 0)
+        prev_ten = self._prev_tenants
+        self._prev_tenants = cum
+        tenants = {}
+        for t in sorted(set(cum) | set(queued)):
+            cell = cum.get(t) or {}
+            prev = (prev_ten or {}).get(t) or {}
+            fact = {key: max(0.0, (cell.get(key) or 0.0)
+                             - (prev.get(key) or 0.0))
+                    if prev_ten is not None else 0.0
+                    for key, _ in _TENANT_ROW_COUNTERS}
+            fact["queued"] = int(queued.get(t, 0))
+            tenants[t] = fact
         return {
             "step": self._polls,
             "t": time.time(),
@@ -402,6 +461,7 @@ class FleetPoller:
             "goodput_delta": goodput - prev_good
             if prev_good is not None else 0.0,
             "work_pending": work_pending,
+            "tenants": tenants,
         }
 
     def _observe(self, row):
@@ -511,6 +571,32 @@ class FleetPoller:
             "fleet": rollup.fleet_aggregate(entries, snapshots,
                                             states),
             "health": self._health_block(),
+        }
+
+    def fleet_tenants(self):
+        """The ``/fleet/tenants`` body: the federated per-tenant
+        rollup (exact counter sums across replicas) plus the tenant
+        detectors' firing state — the one-page noisy-neighbor view."""
+        with self._lock:
+            snapshots = [st.metrics for st in self.replicas
+                         if st.metrics is not None]
+            states = [st.state for st in self.replicas
+                      if st.state is not None]
+            polls = self._polls
+        counts = self.detector_counts()
+        with self._lock:
+            last = {n: dict(st["last_verdict"])
+                    for n, st in self._detector_state.items()
+                    if st.get("last_verdict")
+                    and n in ("noisy_neighbor", "tenant_starvation")}
+        return {
+            "polls": polls,
+            "fleet": rollup.fleet_tenants(snapshots, states),
+            "detectors": {n: counts.get(n, 0)
+                          for n in ("noisy_neighbor",
+                                    "tenant_starvation")
+                          if n in counts},
+            "last_verdicts": last,
         }
 
     def fleet_health(self):

@@ -71,6 +71,26 @@ FLEET_AGG_KEYS = (
     "size", "up", "stale", "down", "healthy", "queue_depth",
     "occupancy", "step_rate", "tokens_generated", "goodput_tokens",
     "requests_completed", "latency", "roofline_fraction", "cache",
+    "tenants",
+)
+
+# per-tenant fleet rollup: (entry key, tenant-labelled counter family)
+# — every one an additive fact, so the fleet row is the exact sum of
+# per-replica series (never a mean of per-replica rates)
+_TENANT_COUNTERS = (
+    ("requests", "serving_tenant_requests_total"),
+    ("completed", "serving_tenant_completed_total"),
+    ("tokens_in", "serving_tenant_tokens_in_total"),
+    ("tokens_out", "serving_tenant_tokens_out_total"),
+    ("goodput_tokens", "serving_tenant_goodput_tokens_total"),
+    ("attained", "serving_tenant_slo_attained_total"),
+    ("violations", "serving_tenant_slo_violations_total"),
+    ("shed", "serving_tenant_shed_total"),
+    ("cache_saved_tokens", "serving_tenant_cache_saved_tokens_total"),
+)
+
+FLEET_TENANT_ENTRY_KEYS = tuple(k for k, _ in _TENANT_COUNTERS) + (
+    "queued", "attainment", "token_share",
 )
 
 _PCTS = ((50, "p50_ms"), (90, "p90_ms"), (99, "p99_ms"))
@@ -242,6 +262,61 @@ def fleet_cache(snapshots, states):
     }
 
 
+def fleet_tenants(snapshots, states):
+    """The fleet-level ``tenants`` block: every per-tenant counter
+    sums exactly across replicas (same merge rule as every other
+    fleet counter), queue depths sum from the replicas' last-known
+    ``/debug/state`` tenant sections, and the derived rates —
+    ``attainment`` (attained / requests) and ``token_share``
+    (tokens_out / fleet tokens_out) — divide the SUMS, never average
+    per-replica ratios. None when no replica exposes tenant series
+    (an all-disabled or pre-tenant fleet)."""
+    rows = {}
+    seen = False
+
+    def _row(t):
+        return rows.setdefault(
+            t, dict({k: 0 for k, _ in _TENANT_COUNTERS}, queued=0))
+
+    for snap in snapshots:
+        for key, family in _TENANT_COUNTERS:
+            fam = (snap or {}).get(family)
+            if not fam:
+                continue
+            seen = True
+            for labels, v in (fam.get("values") or {}).items():
+                if not labels.startswith("tenant=") \
+                        or not isinstance(v, (int, float)):
+                    continue
+                _row(labels[len("tenant="):])[key] += v
+    folded = 0
+    for state in states:
+        sec = (state or {}).get("tenants") or {}
+        if not sec.get("enabled"):
+            continue
+        seen = True
+        folded += (sec.get("overflow") or {}).get("folded_events") or 0
+        for t, entry in (sec.get("tenants") or {}).items():
+            _row(t)["queued"] += entry.get("queued") or 0
+    if not seen:
+        return None
+    total_out = sum(r["tokens_out"] for r in rows.values())
+    for row in rows.values():
+        row["attainment"] = round(
+            row["attained"] / row["requests"], 4) \
+            if row["requests"] else None
+        row["token_share"] = round(
+            row["tokens_out"] / total_out, 4) if total_out else None
+    ordered = dict(sorted(rows.items(),
+                          key=lambda kv: (-kv[1]["tokens_out"],
+                                          kv[0])))
+    return {
+        "tenant_count": len(ordered),
+        "overflow_folded": folded,
+        "tenants": ordered,
+    }
+
+
 def fleet_aggregate(entries, snapshots, states=()):
     """The ``FLEET_AGG_KEYS`` block: availability census + exact
     counter sums + bucket-wise merged latency percentiles. ``entries``
@@ -276,4 +351,5 @@ def fleet_aggregate(entries, snapshots, states=()):
         "roofline_fraction": _mean_known(
             [e["roofline_fraction"] for e in live]),
         "cache": fleet_cache(snapshots, states),
+        "tenants": fleet_tenants(snapshots, states),
     }

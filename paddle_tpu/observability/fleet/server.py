@@ -12,7 +12,9 @@ PR-12 router will consume:
     latency percentiles);
   * ``/fleet/metrics`` — every non-down replica's metrics re-exposed
     as one Prometheus text exposition with a ``replica`` label on
-    every series (scrape-merge-time labeling).
+    every series (scrape-merge-time labeling);
+  * ``/fleet/tenants`` — the federated per-tenant attribution rollup
+    plus the noisy_neighbor / tenant_starvation detector state.
 
 ``/metrics`` + ``/metrics.json`` serve the poller's OWN registry
 (scrape outcomes, availability gauges, ``fleet_anomalies_total``) —
@@ -45,6 +47,7 @@ class FleetServer:
             "/fleet/health": self.poller.fleet_health,
             "/fleet/state": self.poller.snapshot,
             "/fleet/metrics": self.poller.prometheus_text,
+            "/fleet/tenants": self.poller.fleet_tenants,
         }
 
     def serve(self, port=0, addr="127.0.0.1", poll=True):

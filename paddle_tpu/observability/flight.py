@@ -63,7 +63,7 @@ class RequestTrace:
     ``{"event", "t", ...attrs}`` records (``t`` on the perf_counter
     clock) plus the retirement reason once retired."""
 
-    __slots__ = ("rid", "events", "reason", "trace_id")
+    __slots__ = ("rid", "events", "reason", "trace_id", "tenant_id")
 
     def __init__(self, rid):
         self.rid = int(rid)
@@ -73,6 +73,9 @@ class RequestTrace:
         # enqueue from the propagated TraceContext — the join key
         # between /debug/requests and the cross-replica trace surface
         self.trace_id = None
+        # attribution: which tenant this request billed to (stamped at
+        # enqueue; the ?tenant= filter on /debug/requests keys on it)
+        self.tenant_id = None
 
     def t_of(self, event):
         """Timestamp of the FIRST occurrence of ``event``; None if it
@@ -98,7 +101,8 @@ class RequestTrace:
                 d["t_rel_ms"] = round((e["t"] - t0) * 1000.0, 3)
             events.append(d)
         return {"rid": self.rid, "reason": self.reason,
-                "trace_id": self.trace_id, "events": events}
+                "trace_id": self.trace_id, "tenant_id": self.tenant_id,
+                "events": events}
 
 
 class FlightRecorder:
@@ -143,6 +147,8 @@ class FlightRecorder:
                 phase = "s"
             if "trace_id" in attrs and trace.trace_id is None:
                 trace.trace_id = attrs["trace_id"]
+            if "tenant" in attrs and trace.tenant_id is None:
+                trace.tenant_id = attrs["tenant"]
             trace.events.append(dict({"event": event, "t": t}, **attrs))
         args = dict({"rid": rid}, **attrs)
         # marker span + flow point at the SAME timestamp: the flow
@@ -159,6 +165,9 @@ class FlightRecorder:
         trace = getattr(req, "trace", None)
         if trace is not None:
             attrs["trace_id"] = trace.trace_id
+        tenant = getattr(req, "tenant_id", None)
+        if tenant is not None:
+            attrs["tenant"] = tenant
         self._event(req.rid, ENQUEUED, "s", attrs)
 
     def admitted(self, req, slot, bucket, group_size):
@@ -315,9 +324,14 @@ class FlightRecorder:
         """Close the request's trace (reason: "eos" / "max_tokens" /
         anything the engine decides, e.g. future cancellations) and
         move it into the bounded completed ring."""
-        self._event(req.rid, RETIRED, "f",
-                    dict({"reason": str(reason),
-                          "tokens": int(len(req.generated))}, **attrs))
+        base = {"reason": str(reason),
+                "tokens": int(len(req.generated))}
+        tenant = getattr(req, "tenant_id", None)
+        if tenant is not None:
+            # retirement carries the attribution too: a grep of
+            # retired events alone can bill tokens per tenant
+            base["tenant"] = tenant
+        self._event(req.rid, RETIRED, "f", dict(base, **attrs))
         with self._lock:
             trace = self._active.pop(req.rid, None)
             if trace is None:
@@ -354,11 +368,20 @@ class FlightRecorder:
                 "decode_window": self.decode_window,
             }
 
-    def debug_requests(self):
+    def debug_requests(self, tenant=None):
         """The ``/debug/requests`` JSON body: recorder state plus every
-        kept trace, completed and in-flight."""
-        return {
+        kept trace, completed and in-flight. ``tenant`` filters both
+        lists to one tenant's requests (the ``?tenant=<id>`` query
+        form of the route); the ``state`` summary stays fleet-wide."""
+        completed, active = self.completed(), self.active()
+        if tenant:
+            completed = [t for t in completed if t.tenant_id == tenant]
+            active = [t for t in active if t.tenant_id == tenant]
+        out = {
             "state": self.state(),
-            "completed": [t.as_dict() for t in self.completed()],
-            "active": [t.as_dict() for t in self.active()],
+            "completed": [t.as_dict() for t in completed],
+            "active": [t.as_dict() for t in active],
         }
+        if tenant:
+            out["tenant"] = tenant
+        return out

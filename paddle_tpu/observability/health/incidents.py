@@ -43,7 +43,7 @@ INCIDENT_SCHEMA = "paddle_tpu.health.incident/v1"
 INCIDENT_KEYS = (
     "schema", "written_at", "detector", "verdict", "ledger_tail",
     "metrics", "watchdog", "requests", "spans_tail", "health",
-    "chaos", "replica", "traces",
+    "chaos", "replica", "traces", "tenants",
 )
 
 
@@ -124,6 +124,7 @@ class IncidentRecorder:
             "chaos": self._section(context, "chaos"),
             "replica": self._section(context, "replica"),
             "traces": self._section(context, "traces"),
+            "tenants": self._section(context, "tenants"),
         }
         os.makedirs(self.directory, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())

@@ -190,8 +190,22 @@ class _HistogramChild:
         return out
 
 
+# where an over-cardinality label value folds to: one shared overflow
+# series per family instead of an unbounded child dict (an adversarial
+# label flood — 10k unique tenant ids, say — must cost O(cap) memory)
+OVERFLOW_LABEL = "~other"
+
+
 class _Family:
-    """A named metric family: help text, label names, children."""
+    """A named metric family: help text, label names, children.
+
+    Label cardinality is BOUNDED: once a family holds
+    ``registry.max_label_values`` distinct label-value tuples, any NEW
+    tuple folds into the ``OVERFLOW_LABEL`` series (every label
+    position set to ``~other``) and the fold is counted in the
+    lazily-registered ``metrics_label_overflow_total{family}`` counter
+    — so a label flood degrades to one aggregate series plus an
+    attributed alarm, never an unbounded registry."""
 
     kind = None
 
@@ -221,10 +235,19 @@ class _Family:
             raise ValueError(
                 f"{self.name} expects labels {self.labelnames}, got "
                 f"{values}")
+        folded = False
         with self._lock:
             child = self._children.get(values)
             if child is None:
-                child = self._children[values] = self._make_child()
+                cap = getattr(self._registry, "max_label_values", 0)
+                if cap and len(self._children) >= cap:
+                    folded = True
+                    values = tuple(OVERFLOW_LABEL for _ in values)
+                    child = self._children.get(values)
+                if child is None:
+                    child = self._children[values] = self._make_child()
+        if folded and self.name != "metrics_label_overflow_total":
+            self._registry.label_overflow(self.name)
         return child
 
     def _default(self):
@@ -572,9 +595,16 @@ class MetricsRegistry:
     """Named families, one namespace; snapshot() and prometheus_text()
     are the two exposition surfaces (JSON artifact / scrape)."""
 
-    def __init__(self):
+    def __init__(self, max_label_values=128):
         self._lock = threading.RLock()
         self._families = {}
+        # per-family distinct-label-value cap (0 disables): generous
+        # enough that every legitimate family in this stack (span
+        # scopes, detectors, shed reasons, bounded tenant ids) never
+        # folds, small enough that an adversarial flood can't blow up
+        # the registry — overflow folds into OVERFLOW_LABEL and counts
+        # in metrics_label_overflow_total{family}
+        self.max_label_values = int(max_label_values)
 
     def _register(self, cls, name, help_text, labelnames, **kw):
         with self._lock:
@@ -612,6 +642,17 @@ class MetricsRegistry:
             "gauge set_function callbacks that raised at scrape time "
             "(the series exported NaN; the exposition survived)",
             labelnames=("metric",)).labels(str(metric_name)).inc()
+
+    def label_overflow(self, family_name):
+        """Record one over-cardinality label fold (see _Family.labels).
+        The ``metrics_label_overflow_total{family}`` counter is
+        registered LAZILY on the first fold, so a registry that never
+        overflows exposes no overflow family at all."""
+        self.counter(
+            "metrics_label_overflow_total",
+            "label-value tuples folded into the ~other overflow "
+            "series because the family hit max_label_values",
+            labelnames=("family",)).labels(str(family_name)).inc()
 
     def get(self, name):
         with self._lock:
@@ -828,7 +869,8 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
                 self._reply(200, out)
 
         def do_GET(self):
-            path = self.path.split("?", 1)[0].rstrip("/") or "/metrics"
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/") or "/metrics"
             try:
                 if path == "/metrics":
                     body = reg.prometheus_text().encode("utf-8")
@@ -837,7 +879,17 @@ def start_metrics_server(registry=None, port=0, addr="127.0.0.1",
                     body = reg.snapshot_json().encode("utf-8")
                     ctype = "application/json"
                 elif path in routes:
-                    payload = routes[path]()
+                    fn = routes[path]
+                    if getattr(fn, "accepts_query", False):
+                        # a route opting into query params (e.g. the
+                        # engine's /debug/requests?tenant= filter)
+                        # receives {param: last_value}
+                        from urllib.parse import parse_qs
+                        params = {k: v[-1] for k, v in
+                                  parse_qs(query).items()}
+                        payload = fn(params)
+                    else:
+                        payload = fn()
                     if isinstance(payload, str):
                         body = payload.encode("utf-8")
                         ctype = ("text/plain; version=0.0.4; "

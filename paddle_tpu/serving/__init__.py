@@ -311,6 +311,15 @@ Tuning knobs
                 EWMA acceptance floor below which the request falls
                 back to plain decode. Greedy-only: combining with
                 ``sampling=True`` raises at config time.
+``max_tenants`` per-tenant attribution cardinality cap (default 32;
+                0 disables the tenant ledger, same report shape).
+                ``add_request(..., tenant_id=)`` / the ``tenant_id``
+                POST field attributes a request (unset = trace-baggage
+                tenant, else ``"default"``); ids past the cap fold
+                into ``~other`` with counters conserved. Surfaces:
+                ``snapshot()["tenants"]``, ``/debug/tenants``,
+                ``serving_tenant_*_total{tenant=}``, the fleet's
+                ``/fleet/tenants`` + ``tools/tenant_report.py``.
 ``eos_id``      default stop token (per-request override on
                 add_request).
 

@@ -183,7 +183,8 @@ class ServingConfig:
                  cache_observatory=None, cache_sample_rate=0.125,
                  replica_id=None, speculative=None, spec_k=4,
                  spec_min_accept=0.35, role="monolithic",
-                 trace_spans=None, trace_span_keep=4096):
+                 trace_spans=None, trace_span_keep=4096,
+                 max_tenants=32):
         self.num_slots = int(num_slots)
         self.max_len = max_len
         self.buckets = buckets
@@ -423,6 +424,15 @@ class ServingConfig:
         if self.trace_span_keep < 1:
             raise ValueError(
                 f"trace_span_keep must be >= 1, got {trace_span_keep}")
+        # tenant observatory (observability.tenant): per-tenant
+        # attribution ledger cardinality bound — at most max_tenants
+        # live tenant ids per engine, every further unique id folds
+        # into "~other" with an overflow counter. 0 disables the
+        # ledger entirely (snapshot()["tenants"] keeps its shape).
+        self.max_tenants = int(max_tenants)
+        if self.max_tenants < 0:
+            raise ValueError(
+                f"max_tenants must be >= 0, got {max_tenants}")
 
 
 class ServingEngine:
@@ -606,9 +616,20 @@ class ServingEngine:
             slo_window_s=config.slo_window_s,
             perf=config.perf,
             cache=config.cache_observatory,
-            cache_sample_rate=config.cache_sample_rate)
+            cache_sample_rate=config.cache_sample_rate,
+            max_tenants=config.max_tenants)
         self._perf_on = config.perf
         self.metrics.set_spec(self.speculative, self.spec_k)
+
+        # scrape-time per-tenant queue depth: a read-only walk of the
+        # live admission queue (no accrual — reports only)
+        def _tenant_queue_depths(sch=self.scheduler):
+            depths = {}
+            for r in sch.queue:
+                t = getattr(r, "tenant_id", None) or "default"
+                depths[t] = depths.get(t, 0) + 1
+            return depths
+        self.metrics.tenants.set_queue_probe(_tenant_queue_depths)
         # replica identity: who this engine is in a fleet of
         # lookalikes — uptime + build-info gauges in the exposition,
         # and a "replica" section on snapshot()/debug/state/incidents
@@ -709,6 +730,9 @@ class ServingEngine:
                 # replica attribution: a bundle collected off one
                 # member of a fleet must name which member wrote it
                 "replica": self.metrics.identity_report,
+                # who was on the box when it went down: top tenants
+                # by token share (the noisy-neighbor suspect list)
+                "tenants": self.metrics.tenants.top,
             }
             if self.chaos is not None:
                 # a chaos-found incident must be replayable from its
@@ -804,7 +828,7 @@ class ServingEngine:
     def add_request(self, prompt, max_new_tokens, eos_id=None,
                     on_token=None, temperature=0.0, top_k=0,
                     top_p=1.0, seed=None, deadline_ms=None,
-                    hold_kv=False, trace=None):
+                    hold_kv=False, trace=None, tenant_id=None):
         """Enqueue a prompt; returns the Request handle immediately.
         Tokens stream through on_token(request, token) as steps run
         (with async_depth=1 a token surfaces one engine step after the
@@ -834,7 +858,15 @@ class ServingEngine:
         gateway wire). Whatever arrives is COERCED — None on a direct
         add_request, or malformed input from a corrupted header,
         mints a locally-rooted context rather than raising — so every
-        request carries a usable trace id."""
+        request carries a usable trace id.
+
+        ``tenant_id`` attributes the request in the tenant observatory
+        (tokens, SLO verdict, queue wait, cache savings — see
+        observability.tenant). None falls back to the ``"tenant"``
+        trace-baggage entry (the router stamps it at admission, so a
+        decode-tier import or failover replay keeps the original
+        tenant), then to ``"default"``. The resolved id is written
+        back into the baggage so every downstream hop inherits it."""
         if self._draining or self._closed:
             raise RuntimeError(
                 "engine is draining/closed: no new requests (drain() "
@@ -843,13 +875,25 @@ class ServingEngine:
             raise ValueError(
                 "hold_kv requires the paged pool (paged=True): the "
                 "KV wire unit is the paged block")
+        ctx = self._TraceContext.coerce(trace)
+        if tenant_id is None:
+            tenant_id = ctx.baggage.get("tenant")
         req = Request(prompt, max_new_tokens,
                       eos_id=self.config.eos_id if eos_id is None
                       else eos_id,
                       on_token=on_token, temperature=temperature,
                       top_k=top_k, top_p=top_p, seed=seed,
-                      deadline_ms=deadline_ms, hold_kv=hold_kv)
-        req.trace = self._TraceContext.coerce(trace)
+                      deadline_ms=deadline_ms, hold_kv=hold_kv,
+                      tenant_id=tenant_id)
+        if ctx.baggage.get("tenant") != req.tenant_id:
+            # write the resolved tenant back into the baggage (same
+            # trace/span ids — this is annotation, not a new hop) so
+            # export_kv()/failover journals carry it downstream
+            ctx = self._TraceContext(
+                ctx.trace_id, ctx.span_id,
+                baggage={**ctx.baggage, "tenant": req.tenant_id},
+                minted_local=ctx.minted_local)
+        req.trace = ctx
         if req.sampled and not self.sampling:
             raise ValueError(
                 "sampled request on a greedy engine: build the engine "
@@ -948,12 +992,14 @@ class ServingEngine:
         /metrics (Prometheus text), /metrics.json (the snapshot
         schema), /debug (the route index — every mounted path, so the
         surface is discoverable without reading source),
-        /debug/requests (flight-recorder traces), /debug/traces
+        /debug/requests (flight-recorder traces; ``?tenant=<id>``
+        filters to one tenant's requests), /debug/traces
         (this replica's distributed-trace span ring — the surface
         tools/trace_report.py assembles fleet-wide), /debug/state (live
         engine state), /debug/perf (per-program attribution +
         roofline fractions), /debug/cache (MRC, prefix heat, savings
-        attribution, churn) and — with the health observatory on —
+        attribution, churn), /debug/tenants (the per-tenant
+        attribution ledger) and — with the health observatory on —
         /debug/health ({healthy, detectors, last_incident}: the
         per-replica router signal) and /debug/ledger (the per-step
         ring). ``post_routes`` mounts POST handlers alongside (the
@@ -964,12 +1010,18 @@ class ServingEngine:
         handle is also closed by ``engine.close()`` so the server
         thread shuts down with the engine."""
         from ..observability import start_metrics_server
+
+        def _debug_requests(params):
+            return self.flight.debug_requests(
+                tenant=params.get("tenant"))
+        _debug_requests.accepts_query = True
         routes = {
-            "/debug/requests": self.flight.debug_requests,
+            "/debug/requests": _debug_requests,
             "/debug/state": self.debug_state,
             "/debug/perf": self.metrics.perf_report,
             "/debug/cache": self.metrics.cache_report,
             "/debug/traces": self.trace.debug_traces,
+            "/debug/tenants": self.metrics.tenant_report,
         }
         if self.health is not None:
             routes["/debug/health"] = self.health.report
@@ -1100,8 +1152,13 @@ class ServingEngine:
                       on_token=on_token, deadline_ms=deadline_ms)
         # join the prefill tier's trace: whatever rode the wire is
         # coerced (a corrupted/absent trace field mints a local root
-        # — the tiles already verified clean, the import proceeds)
+        # — the tiles already verified clean, the import proceeds).
+        # The tenant id rides the baggage, so attribution survives
+        # the tier hop without any kv_wire format change.
         req.trace = self._TraceContext.coerce(handoff.trace)
+        tenant = req.trace.baggage.get("tenant")
+        if tenant:
+            req.tenant_id = str(tenant)
         req.imported = True
         ids = req.prompt
         alloc = pool.acquire(req.rid, ids, req.cache_tokens, 0)
@@ -1269,7 +1326,7 @@ class ServingEngine:
         for r in sorted(owed.values(), key=lambda r: r.rid):
             r.inflight = 0
             sch.abort(r, self.pool)
-            self.metrics.record_abort()
+            self.metrics.record_abort(r.tenant_id)
             self.flight.retired(r, "aborted")
             if self.supervisor is not None:
                 self.supervisor.note_completion(r.rid)
@@ -1325,6 +1382,7 @@ class ServingEngine:
                 chunked_inflight=len(self._chunk_q)),
             "health": self.metrics.health_report(),
             "resilience": self.metrics.resilience_report(),
+            "tenants": self.metrics.tenant_report(),
         }
 
     def lint(self, passes=None, min_donation_bytes=1 << 20,
@@ -1992,7 +2050,7 @@ class ServingEngine:
             M.record_deprioritized()
             self.flight.deprioritized(req, headroom)
         for req, headroom in shed:
-            M.record_shed(req.shed_reason)
+            M.record_shed(req.shed_reason, req.tenant_id)
             self.flight.shed(req, req.shed_reason, headroom)
 
     def _legacy_prefills(self, sync):
@@ -2143,7 +2201,7 @@ class ServingEngine:
             M.prefills += 1
             M.prefill_requests += 1
             M.record_prefill_group(1)
-            M.record_prefix_reuse(start, tail)
+            M.record_prefix_reuse(start, tail, req.tenant_id)
             entry = ("prefill", first, [(req, alloc.slot)],
                      ("paged_prefill", bucket))
             if sync:
@@ -2252,7 +2310,8 @@ class ServingEngine:
                 self._prefilling.discard(plan.slot)
                 if self.paged:
                     pool.commit_prefix(plan.slot, plan.ids)
-                    M.record_prefix_reuse(plan.start0, 0)
+                    M.record_prefix_reuse(plan.start0, 0,
+                                          req.tenant_id)
                 M.record_admission(req)
                 M.requests_admitted += 1
                 M.prefill_requests += 1
@@ -2353,7 +2412,7 @@ class ServingEngine:
         already rolled back into the queue): counted, flight-closed,
         zero further tokens."""
         self.scheduler.abort(req, self.pool)
-        self.metrics.record_abort()
+        self.metrics.record_abort(req.tenant_id)
         self.flight.retired(req, reason)
         if self.supervisor is not None:
             self.supervisor.note_completion(req.rid)
@@ -2371,7 +2430,7 @@ class ServingEngine:
                 # will export it, so the parked slot goes back now
                 self.pool.release(req.slot)
                 req.slot = None
-            self.metrics.record_timeout()
+            self.metrics.record_timeout(req.tenant_id)
             over = (now - req.t_arrival) * 1000.0 - req.deadline_ms
             self.flight.deadline_exceeded(req, over)
             self.flight.retired(req, "deadline",

@@ -27,7 +27,7 @@ import time
 from .. import profiler as _profiler
 from ..observability import (CacheObservatory, MetricsRegistry,
                              ProgramPerf, Reservoir, SLOTracker,
-                             WindowedReservoir)
+                             TenantLedger, WindowedReservoir)
 
 # serving latencies are sub-ms (CPU smoke) to tens of seconds (deep
 # queues on big models) — the default time buckets cover that span
@@ -67,13 +67,18 @@ class ServingMetrics:
 
     def __init__(self, registry=None, slo_ttft_ms=None,
                  slo_tpot_ms=None, slo_window_s=60.0, perf=True,
-                 cache=True, cache_sample_rate=0.125):
+                 cache=True, cache_sample_rate=0.125, max_tenants=32):
         self.registry = registry if registry is not None \
             else MetricsRegistry()
         r = self.registry
         self.slo = SLOTracker(r, slo_ttft_ms=slo_ttft_ms,
                               slo_tpot_ms=slo_tpot_ms,
                               window_s=slo_window_s)
+        # tenant observatory (observability.tenant): bounded per-tenant
+        # attribution accrued at the SAME record_* sites as the global
+        # counters (conservation by construction). max_tenants=0
+        # disables it (the report keeps its schema shape).
+        self.tenants = TenantLedger(r, max_tenants=max_tenants)
         # per-program perf attribution (observability.perf): the
         # engine records measured dispatch/sync wall per AOT-table key
         # through this; snapshot()["perf"] / /debug/perf report it
@@ -357,7 +362,8 @@ class ServingMetrics:
     def record_prefill_group(self, group_size):
         self._c_groups.labels(str(int(group_size))).inc()
 
-    def record_prefix_reuse(self, cached_tokens, computed_tokens):
+    def record_prefix_reuse(self, cached_tokens, computed_tokens,
+                            tenant=None):
         """One paged admission's prefix economy: ``cached_tokens``
         came straight from the radix-matched blocks (a hit when > 0),
         ``computed_tokens`` is the uncached tail the prefill actually
@@ -376,7 +382,11 @@ class ServingMetrics:
             self._c_prefill_tokens.inc(int(computed_tokens))
         self._w_prefix_hits.add(1.0 if cached_tokens > 0 else 0.0)
         self._w_prefix_cached.add(float(cached_tokens or 0))
-        return self.cache.note_reuse(int(cached_tokens or 0))
+        saved_ms = self.cache.note_reuse(int(cached_tokens or 0))
+        if cached_tokens:
+            self.tenants.note_cache_savings(tenant, int(cached_tokens),
+                                            saved_ms)
+        return saved_ms
 
     def record_prefill_tokens(self, computed_tokens):
         """Legacy-pool prefill accounting: every prompt token is
@@ -499,7 +509,7 @@ class ServingMetrics:
         }
         self._g_policy.labels(str(policy_name)).set(1)
 
-    def record_shed(self, reason):
+    def record_shed(self, reason, tenant=None):
         """One request dropped by the admission policy: counted by
         reason here AND judged by the SLO tracker (a shed request is a
         violated request with zero goodput tokens — shedding must
@@ -507,6 +517,7 @@ class ServingMetrics:
         self._c_shed.labels(str(reason)).inc()
         self.shed_count += 1
         self.slo.observe_shed(str(reason))
+        self.tenants.note_shed(tenant, str(reason))
 
     def record_deprioritized(self):
         self._c_deprioritized.inc()
@@ -544,16 +555,18 @@ class ServingMetrics:
     def record_retry(self):
         self._c_retries.inc()
 
-    def record_timeout(self):
+    def record_timeout(self, tenant=None):
         """One request retired at its deadline: counted here AND
         SLO-judged as a violation (dimension "deadline", zero goodput)
         — a timed-out answer is worth nothing to its caller, so
         timeouts must never inflate attainment."""
         self._c_timeouts.inc()
         self.slo.observe_shed("deadline")
+        self.tenants.note_timeout(tenant)
 
-    def record_abort(self):
+    def record_abort(self, tenant=None):
         self._c_aborted.inc()
+        self.tenants.note_abort(tenant)
 
     def record_callback_error(self):
         self._c_callback_errors.inc()
@@ -600,16 +613,22 @@ class ServingMetrics:
     def record_admission(self, request):
         """Queue-wait accounting at slot-claim time (the scheduler
         stamps request.t_admitted in admit())."""
+        wait = 0.0
         if request.t_admitted is not None:
             wait = request.t_admitted - request.t_arrival
             self._h_queue_wait.observe(wait)
             self._res["queue_wait"].add(wait)
+        self.tenants.note_admission(
+            getattr(request, "tenant_id", None), len(request.prompt),
+            wait)
 
     def record_first_token(self, request):
         request.t_first_token = time.perf_counter()
         ttft = request.t_first_token - request.t_arrival
         self._h_ttft.observe(ttft)
         self._res["ttft"].add(ttft)
+        self.tenants.note_first_token(
+            getattr(request, "tenant_id", None), ttft)
 
     def record_completion(self, request):
         """Completion accounting + the request's SLO verdict; returns
@@ -621,8 +640,15 @@ class ServingMetrics:
         self._res["request_latency"].add(latency)
         ttft = (None if request.t_first_token is None
                 else request.t_first_token - request.t_arrival)
-        return self.slo.observe_request(ttft, latency,
-                                        len(request.generated))
+        violations = self.slo.observe_request(ttft, latency,
+                                              len(request.generated))
+        # the tenant ledger receives the engine's OWN verdict — never
+        # a re-judgment — so per-tenant attainment/goodput sums match
+        # the global SLO counters bit-exactly
+        self.tenants.note_completion(
+            getattr(request, "tenant_id", None),
+            len(request.generated), violations)
+        return violations
 
     # ---------------------------------------------------- cost model
     def set_decode_cost(self, flops=None, bytes_accessed=None):
@@ -759,6 +785,12 @@ class ServingMetrics:
         report["spec"] = self.spec_report()
         return report
 
+    def tenant_report(self):
+        """The ``snapshot()["tenants"]`` / ``/debug/tenants`` body:
+        per-tenant attribution rows plus the overflow accounting (see
+        observability.tenant.TENANT_KEYS / TENANT_ENTRY_KEYS)."""
+        return self.tenants.report()
+
     def prometheus_text(self):
         """This engine's registry in Prometheus text exposition format
         (also served over HTTP by ServingEngine.serve_metrics())."""
@@ -800,4 +832,5 @@ class ServingMetrics:
             "cache": self.cache_report(),
             "replica": self.identity_report(),
             "trace": self.trace_report(),
+            "tenants": self.tenant_report(),
         }

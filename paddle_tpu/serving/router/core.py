@@ -545,12 +545,18 @@ class Router:
 
     # ---------------------------------------------------- admission
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline_ms=None, tag=None):
+               deadline_ms=None, tag=None, tenant_id=None):
         """Admit and route one request; returns a RouterTicket
         immediately (the dispatch runs on a worker thread). A shed
         verdict resolves the ticket synchronously with
         ``{"shed": True, "reason": ...}`` — the caller always gets an
-        explicit answer, never silent buffering."""
+        explicit answer, never silent buffering.
+
+        ``tenant_id`` (default ``"default"``) attributes the request
+        fleet-wide: minted into the trace baggage here at admission,
+        it rides every dispatch attempt — both disaggregation hops,
+        the KV handoff, and failover replays from the journal — so
+        every engine bills the same tenant the router admitted."""
         t0 = time.perf_counter()
         if deadline_ms is None:
             deadline_ms = self.config.default_deadline_ms
@@ -575,12 +581,17 @@ class Router:
             return self._shed(ticket, "no_admissible_replica", t0)
         # admission mints the request's distributed TraceContext:
         # every dispatch attempt — including failover replays from
-        # the journal — carries the SAME trace id fleet-wide
+        # the journal — carries the SAME trace id fleet-wide, and the
+        # tenant rides its baggage so replayed work bills the same
+        # tenant as the original attempt
+        tenant = str(tenant_id) if tenant_id else "default"
         entry = self.journal.admit(tag, [int(t) for t in prompt],
                                    max_new_tokens, eos_id,
                                    deadline_ms, now,
                                    trace=TraceContext.mint(
-                                       baggage={"rid": tag}))
+                                       baggage={"rid": tag,
+                                                "tenant": tenant}),
+                                   tenant=tenant)
         self._g_journal.set(self.journal.depth)
         self._account_overhead(t0)
         worker = threading.Thread(
@@ -593,10 +604,11 @@ class Router:
         return ticket
 
     def generate(self, prompt, max_new_tokens, eos_id=None,
-                 deadline_ms=None, timeout=None):
+                 deadline_ms=None, timeout=None, tenant_id=None):
         """Blocking convenience: submit + result."""
         return self.submit(prompt, max_new_tokens, eos_id=eos_id,
-                           deadline_ms=deadline_ms).result(timeout)
+                           deadline_ms=deadline_ms,
+                           tenant_id=tenant_id).result(timeout)
 
     def _shed(self, ticket, reason, t0):
         self._c_shed.labels(reason).inc()

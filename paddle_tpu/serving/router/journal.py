@@ -27,10 +27,10 @@ __all__ = ["JournalEntry", "RequestJournal"]
 class JournalEntry:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id",
                  "deadline_ms", "tokens", "replica", "attempts",
-                 "t_admitted", "trace")
+                 "t_admitted", "trace", "tenant")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_id,
-                 deadline_ms, t_admitted, trace=None):
+                 deadline_ms, t_admitted, trace=None, tenant=None):
         self.rid = rid
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
@@ -45,6 +45,10 @@ class JournalEntry:
         # it, so replayed work appears as sibling spans of ONE trace.
         # None tolerated (old-format replay) — the engine coerces.
         self.trace = trace
+        # the admitting tenant: a failover replay bills the SAME
+        # tenant as the original attempt (it also rides the trace
+        # baggage; this slot keeps the journal snapshot greppable)
+        self.tenant = tenant
 
     @property
     def prefill_ids(self):
@@ -64,9 +68,10 @@ class RequestJournal:
         self._lock = threading.Lock()
 
     def admit(self, rid, prompt, max_new_tokens, eos_id, deadline_ms,
-              t_admitted, trace=None):
+              t_admitted, trace=None, tenant=None):
         entry = JournalEntry(rid, prompt, max_new_tokens, eos_id,
-                             deadline_ms, t_admitted, trace=trace)
+                             deadline_ms, t_admitted, trace=trace,
+                             tenant=tenant)
         with self._lock:
             self._entries[rid] = entry
         return entry
@@ -99,5 +104,6 @@ class RequestJournal:
             return [{"rid": e.rid, "replica": e.replica,
                      "attempts": e.attempts,
                      "tokens_so_far": len(e.tokens),
-                     "remaining_tokens": e.remaining_tokens}
+                     "remaining_tokens": e.remaining_tokens,
+                     "tenant": e.tenant}
                     for e in self._entries.values()]

@@ -115,13 +115,16 @@ class EngineGateway:
 
     # --------------------------------------------------- submission
     def submit(self, prompt, max_new_tokens, eos_id=None,
-               deadline_ms=None, on_token=None, trace=None):
+               deadline_ms=None, on_token=None, trace=None,
+               tenant_id=None):
         """Enqueue on the engine; returns the Request handle. Raises
         TransportRefused when the engine is draining/closed (a clean
         verdict), TransportError when the gateway was killed.
         ``trace`` is the propagated distributed-trace context (any
         form TraceContext.coerce accepts — the engine never rejects
-        a request over a bad trace)."""
+        a request over a bad trace). ``tenant_id`` overrides the
+        attribution id; None defers to the trace baggage (the routed
+        case), then to ``"default"``."""
         if self._dead:
             raise TransportError(
                 f"replica {self.replica_id} is dead")
@@ -130,7 +133,7 @@ class EngineGateway:
                 req = self.engine.add_request(
                     prompt, max_new_tokens, eos_id=eos_id,
                     deadline_ms=deadline_ms, on_token=on_token,
-                    trace=trace)
+                    trace=trace, tenant_id=tenant_id)
             except RuntimeError as e:   # draining/closed
                 raise TransportRefused(str(e)) from e
         self._wake.set()
@@ -318,11 +321,15 @@ class EngineGateway:
             return (400, {"error": "max_new_tokens must be an "
                                    "int >= 1"})
         deadline_ms = body.get("deadline_ms")
+        tenant_id = body.get("tenant_id")
+        if tenant_id is not None and not isinstance(tenant_id, str):
+            return (400, {"error": "tenant_id must be a string"})
         try:
             req = self.submit(prompt, max_new,
                               eos_id=body.get("eos_id"),
                               deadline_ms=deadline_ms,
-                              trace=_body_trace(body))
+                              trace=_body_trace(body),
+                              tenant_id=tenant_id)
         except TransportRefused as e:
             return (503, {"error": "refused", "detail": str(e)[:200],
                           "draining": True})

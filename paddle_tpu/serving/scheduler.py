@@ -37,7 +37,8 @@ class Request:
 
     def __init__(self, prompt, max_new_tokens, eos_id=None,
                  on_token=None, temperature=0.0, top_k=0, top_p=1.0,
-                 seed=None, deadline_ms=None, hold_kv=False):
+                 seed=None, deadline_ms=None, hold_kv=False,
+                 tenant_id=None):
         self.rid = next(_rid)
         self.prompt = np.asarray(prompt).reshape(-1).astype(np.int64)
         if self.prompt.size == 0:
@@ -99,6 +100,11 @@ class Request:
         self.trace = None
         self.imported = False
         self.t_decode0 = None
+        # multi-tenancy: the attribution id every ServingMetrics hook
+        # charges this request's tokens/SLO verdict/shed to. Rides the
+        # trace baggage across disaggregation hops and failover replay
+        # (the engine backfills from baggage when the caller omits it).
+        self.tenant_id = str(tenant_id) if tenant_id else "default"
 
     @property
     def done(self):

@@ -434,7 +434,8 @@ def _fleet_row(step, **kw):
             "size": 2, "up": 2, "stale": 0, "down": 0,
             "transitions": [], "queue_depths": {"a": 0, "b": 0},
             "queue_depth": 0, "goodput_total": 0.0,
-            "goodput_delta": 0.0, "work_pending": False}
+            "goodput_delta": 0.0, "work_pending": False,
+            "tenants": {}}
     assert set(base) == set(FLEET_ROW_KEYS)
     base.update(kw)
     return base
@@ -442,7 +443,8 @@ def _fleet_row(step, **kw):
 
 def test_fleet_detector_registry_scope_isolation():
     assert detector_names(scope="fleet") == [
-        "fleet_goodput_collapse", "load_skew", "replica_flap"]
+        "fleet_goodput_collapse", "load_skew", "noisy_neighbor",
+        "replica_flap", "tenant_starvation"]
     # the engine scope is untouched — a HealthMonitor never
     # instantiates a fleet detector (pin from test_observability holds)
     assert "replica_flap" not in detector_names()

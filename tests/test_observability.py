@@ -86,6 +86,39 @@ def test_labeled_families_and_snapshot_stability():
         reg.snapshot_json())
 
 
+def test_label_cardinality_guard_folds_flood():
+    """PR-19 registry hardening: a label flood costs O(cap) series —
+    past ``max_label_values`` distinct tuples, new values fold into
+    the shared ``~other`` series and the fold is counted in the
+    lazily-registered ``metrics_label_overflow_total{family}``."""
+    reg = MetricsRegistry(max_label_values=4)
+    c = reg.counter("flood_total", "flood", labelnames=("who",))
+    for i in range(100):
+        c.labels(f"tenant-{i}").inc()
+    snap = reg.snapshot()
+    series = snap["flood_total"]["values"]
+    assert len(series) == 5                # 4 distinct + ~other
+    assert series["who=~other"] == 96      # every fold lands there
+    assert sum(series.values()) == 100     # nothing dropped
+    over = snap["metrics_label_overflow_total"]["values"]
+    assert over["family=flood_total"] == 96
+    # a tuple minted BEFORE the cap keeps accruing to its own series
+    c.labels("tenant-2").inc(9)
+    assert reg.snapshot()["flood_total"]["values"]["who=tenant-2"] == 10
+    # two-label families fold EVERY position (one aggregate series)
+    g = reg.gauge("depth", "d", labelnames=("a", "b"))
+    for i in range(10):
+        g.labels(str(i), str(i)).set(1)
+    assert "a=~other,b=~other" in reg.snapshot()["depth"]["values"]
+    # max_label_values=0 disables the guard entirely
+    free = MetricsRegistry(max_label_values=0)
+    f = free.counter("free_total", "f", labelnames=("who",))
+    for i in range(300):
+        f.labels(f"t{i}").inc()
+    assert len(free.snapshot()["free_total"]["values"]) == 300
+    assert "metrics_label_overflow_total" not in free.snapshot()
+
+
 def test_registry_thread_safety():
     reg = MetricsRegistry()
     c = reg.counter("n_total")
@@ -409,7 +442,7 @@ _SNAPSHOT_KEYS = {
     "requests_admitted", "requests_completed", "dispatch_s", "sync_s",
     "span_s", "latency_percentiles", "slo", "prefix_cache",
     "scheduler", "health", "resilience", "perf", "replica", "cache",
-    "trace",
+    "trace", "tenants",
 }
 _SCHEDULER_KEYS = {
     "policy", "prefill_chunk", "prefill_token_budget", "shed",
@@ -461,6 +494,17 @@ _PERF_PROGRAM_KEYS = {
 _CACHE_KEYS = {
     "enabled", "accesses", "hits", "hit_rate", "capacity_blocks",
     "sampled", "mrc", "heat", "savings", "churn",
+}
+# the PR-19 tenant observatory section: per-tenant attribution rows +
+# overflow accounting (same key set whether the ledger is on or off)
+_TENANT_KEYS = {
+    "enabled", "max_tenants", "tenant_count", "overflow", "tenants",
+}
+_TENANT_ENTRY_KEYS = {
+    "requests", "completed", "tokens_in", "tokens_out",
+    "goodput_tokens", "attained", "attainment", "violations", "shed",
+    "timeouts", "aborts", "cache_saved_tokens", "cache_saved_ms",
+    "queued", "queue_wait", "ttft",
 }
 
 
@@ -566,6 +610,23 @@ def test_serving_snapshot_schema_contract():
     off_cache = eng_nocache.metrics.snapshot()["cache"]
     assert set(off_cache) == _CACHE_KEYS
     assert off_cache["enabled"] is False
+    # the PR-19 tenant observatory: on by default, all three requests
+    # attributed to the implicit "default" tenant, entry schema pinned
+    ten = snap["tenants"]
+    assert set(ten) == _TENANT_KEYS
+    assert ten["enabled"] is True
+    assert ten["overflow"]["folded_events"] == 0
+    assert set(ten["tenants"]) == {"default"}
+    entry = ten["tenants"]["default"]
+    assert set(entry) == _TENANT_ENTRY_KEYS
+    assert entry["requests"] == 3 and entry["completed"] == 3
+    # max_tenants=0 disables the ledger but keeps the SAME key shape
+    eng_noten = ServingEngine(m, num_slots=2, bucket_min=8,
+                              max_tenants=0)
+    _drive(eng_noten, np.random.RandomState(1), [(4, 3)])
+    off_ten = eng_noten.metrics.snapshot()["tenants"]
+    assert set(off_ten) == _TENANT_KEYS
+    assert off_ten["enabled"] is False and off_ten["tenants"] == {}
     pcts = snap["latency_percentiles"]
     assert set(pcts) == {"ttft", "request_latency", "queue_wait"}
     for entry in pcts.values():

@@ -20,7 +20,10 @@ line (journal depth, shed/retry/failover/hedge totals, per-replica
 breaker states). ``--traces`` additionally scrapes each target's
 ``/debug/traces`` ring (and the router's ``/router/trace``),
 assembles the distributed traces, and renders one line per trace
-(window, unattributed gap, completeness). Tier-1 self-runs this
+(window, unattributed gap, completeness). ``--tenants`` additionally
+renders the federated per-tenant attribution table (exact counter
+sums across replicas) plus the noisy_neighbor / tenant_starvation
+detector state. Tier-1 self-runs this
 against two in-process
 engines (tests/test_fleet.py), the same discipline as
 incident_report / chaos_sweep / perf_diff.
@@ -154,6 +157,28 @@ def render_traces(traces, out=sys.stdout, limit=8):
               file=out)
 
 
+def render_tenants(doc, out=sys.stdout, limit=8):
+    """One line per tenant off the poller's federated rollup, biggest
+    token consumer first, plus the fairness detectors' verdicts."""
+    fleet = (doc or {}).get("fleet")
+    if not fleet:
+        print("tenants: no tenant series reported", file=out)
+        return
+    rows = fleet["tenants"]
+    print(f"tenants: {fleet['tenant_count']} "
+          f"(folded={fleet['overflow_folded']}, showing "
+          f"{min(limit, len(rows))})", file=out)
+    for name, e in list(rows.items())[:limit]:
+        print(f"  {name[:20]:<20} tokens={_fmt(e['tokens_out'], 0)}  "
+              f"share={_fmt(e['token_share'], 3)}  "
+              f"req={_fmt(e['requests'], 0)}  "
+              f"attain={_fmt(e['attainment'], 3)}  "
+              f"queued={_fmt(e['queued'], 0)}", file=out)
+    for name, verdict in sorted((doc.get("last_verdicts")
+                                 or {}).items()):
+        print(f"  ! {name}: {verdict.get('reason', '?')}", file=out)
+
+
 def verdict_exit(snap, out=sys.stderr):
     """0 iff all replicas up and healthy; else 1, naming offenders."""
     bad = {rid: e for rid, e in snap["replicas"].items()
@@ -209,6 +234,10 @@ def main(argv=None):
                              "the router's /router/trace when "
                              "--router is given) and render one line "
                              "per trace")
+    parser.add_argument("--tenants", action="store_true",
+                        help="also render the federated per-tenant "
+                             "attribution table and the fairness "
+                             "detectors' state")
     args = parser.parse_args(argv)
     if not args.targets and not args.registry:
         parser.error("give targets or --registry")
@@ -232,6 +261,8 @@ def main(argv=None):
                     render_traces(fetch_fleet_traces(
                         args.targets, router=args.router,
                         timeout=args.timeout))
+                if args.tenants:
+                    render_tenants(poller.fleet_tenants())
                 time.sleep(args.watch)
         except KeyboardInterrupt:
             return verdict_exit(poller.snapshot())
@@ -246,11 +277,14 @@ def main(argv=None):
     traces = fetch_fleet_traces(args.targets, router=args.router,
                                 timeout=args.timeout) \
         if args.traces else None
+    tenants = poller.fleet_tenants() if args.tenants else None
     if args.json:
         if args.router:
             snap = dict(snap, router=router_state)
         if traces is not None:
             snap = dict(snap, traces=[t.as_dict() for t in traces])
+        if tenants is not None:
+            snap = dict(snap, tenants=tenants)
         print(json.dumps(snap, indent=1, sort_keys=True, default=str))
     else:
         render(snap)
@@ -258,6 +292,8 @@ def main(argv=None):
             render_router(router_state)
         if traces is not None:
             render_traces(traces)
+        if tenants is not None:
+            render_tenants(tenants)
     return verdict_exit(snap)
 
 

@@ -17,7 +17,9 @@ human (or a CI gate) wants first:
     regression — "sync went 14x" beats eyeballing raw JSON;
   * the engine vitals from the embedded metrics snapshot;
   * the assembled distributed traces of requests in flight at capture
-    time — each victim's cross-replica critical path (ISSUE 18).
+    time — each victim's cross-replica critical path (ISSUE 18);
+  * the top tenants by token share at capture time — who was
+    hammering the engine when the detector fired (ISSUE 19).
 
 Exit status is the CI contract: an incident bundle is by definition
 UNHEALTHY -> exit 1; a ``/debug/health`` body (the ``{healthy, ...}``
@@ -178,6 +180,18 @@ def report_incident(bundle, tail=None, out=sys.stdout):
                       f"{row['dur_ms']:9.3f}  "
                       f"{row['replica']:<10} {row['name']}{amb}",
                       file=out)
+    tenants = bundle.get("tenants")
+    if tenants:
+        # top tenants by token share at capture time (ISSUE 19): who
+        # was hammering us when the detector fired
+        print(f"\nTOP TENANTS ({len(tenants)})", file=out)
+        for row in tenants[:8]:
+            share = row.get("token_share")
+            share = "-" if share is None else f"{share:.3f}"
+            print(f"  {str(row.get('tenant'))[:20]:<20} "
+                  f"tokens={row.get('tokens_out')}  share={share}  "
+                  f"requests={row.get('requests')}  "
+                  f"completed={row.get('completed')}", file=out)
     chaos = bundle.get("chaos")
     if isinstance(chaos, dict) and chaos.get("enabled"):
         # the replay recipe: this incident was found under the fault-

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""tenant_report — the per-tenant attribution table and the
+noisy-neighbor verdict, off live surfaces or saved bodies.
+
+Inputs, mixed freely:
+
+  * URLs — an engine's ``host:port`` (scrapes ``/debug/tenants``) or
+    a full path like ``http://host:port/fleet/tenants`` (the fleet
+    federation's rollup + fairness-detector state);
+  * Files — saved ``/debug/tenants`` / ``/fleet/tenants`` JSON bodies
+    (``-`` reads one from stdin).
+
+Counters from multiple sources SUM (the same exact-merge rule the
+fleet rollup applies — never averaged ratios); ``token_share`` and
+``attainment`` are derived from the merged sums. The table is one row
+per tenant, biggest token consumer first, plus the overflow-fold
+line when the bounded ledger folded ids into ``~other``.
+
+Exit code is the fairness gate: 1 when a noisy tenant is detected —
+either a scraped ``/fleet/tenants`` body carries a live
+``noisy_neighbor`` / ``tenant_starvation`` verdict, or the merged
+totals themselves show one tenant holding >= ``--share-threshold``
+of all generated tokens while the OTHER tenants' SLO attainment sits
+below ``--attain-floor`` — naming the tenant on stderr. 0 when the
+tenancy looks fair; 2 on unreadable input / no tenant data. Tier-1
+self-runs this against a live engine (tests/test_tenant.py), the
+same discipline as trace_report / incident_report / fleet_top.
+
+Stdlib-only, zero heavy imports: starts in milliseconds against a
+live fleet.
+
+Usage: python tools/tenant_report.py SOURCE [SOURCE...]
+           [--share-threshold F] [--attain-floor F] [--min-tokens N]
+           [--json] [--timeout S]
+"""
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+# counters that sum exactly across sources (engine-report entries and
+# fleet-rollup rows both carry these names)
+_SUM_KEYS = ("requests", "completed", "tokens_in", "tokens_out",
+             "goodput_tokens", "attained", "timeouts", "aborts",
+             "cache_saved_tokens", "queued")
+
+
+def fetch(src, timeout=5.0):
+    """One source -> parsed JSON body. URL forms: ``host:port``
+    scrapes ``/debug/tenants``; anything with a path is used as-is."""
+    if src == "-":
+        return json.load(sys.stdin)
+    if os.path.exists(src):
+        with open(src, encoding="utf-8") as fh:
+            return json.load(fh)
+    url = src if "://" in src else "http://" + src
+    if url.count("/") <= 2:              # bare host:port
+        url += "/debug/tenants"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _violations_count(entry):
+    v = entry.get("violations")
+    if isinstance(v, dict):
+        return sum(v.values())
+    return v or 0
+
+
+def merge(bodies):
+    """Fold engine-report and fleet-rollup bodies into one
+    ``{tenants, folded, verdicts, sources_with_data}`` view, counters
+    summed exactly."""
+    rows, folded, verdicts = {}, 0, []
+    seen = 0
+    for body in bodies:
+        if not isinstance(body, dict):
+            continue
+        fleet = body.get("fleet")
+        if fleet is not None or "last_verdicts" in body:
+            # /fleet/tenants shape
+            for name, v in sorted((body.get("last_verdicts")
+                                   or {}).items()):
+                verdicts.append((name, v))
+            if not fleet:
+                continue
+            seen += 1
+            folded += fleet.get("overflow_folded") or 0
+            entries = fleet.get("tenants") or {}
+        elif "tenants" in body:
+            # /debug/tenants (engine report) shape
+            if not body.get("enabled", True):
+                continue
+            seen += 1
+            folded += (body.get("overflow")
+                       or {}).get("folded_events") or 0
+            entries = body.get("tenants") or {}
+        else:
+            continue
+        for t, entry in entries.items():
+            row = rows.setdefault(
+                t, dict({k: 0 for k in _SUM_KEYS},
+                        violations=0, shed=0))
+            for k in _SUM_KEYS:
+                row[k] += entry.get(k) or 0
+            row["violations"] += _violations_count(entry)
+            shed = entry.get("shed")
+            row["shed"] += sum(shed.values()) \
+                if isinstance(shed, dict) else (shed or 0)
+    total_out = sum(r["tokens_out"] for r in rows.values())
+    for row in rows.values():
+        row["token_share"] = row["tokens_out"] / total_out \
+            if total_out else None
+        row["attainment"] = row["attained"] / row["completed"] \
+            if row["completed"] else None
+    ordered = dict(sorted(rows.items(),
+                          key=lambda kv: (-kv[1]["tokens_out"],
+                                          kv[0])))
+    return {"tenants": ordered, "folded": folded,
+            "verdicts": verdicts, "sources_with_data": seen}
+
+
+def judge(merged, share_threshold=0.6, attain_floor=0.5,
+          min_tokens=100):
+    """(tenant, reason) when the merged totals show a noisy neighbor;
+    None when the tenancy looks fair. Mirrors the fleet
+    ``noisy_neighbor`` detector's BOTH-halves rule on cumulative
+    sums: dominance alone is just the biggest customer."""
+    for name, verdict in merged["verdicts"]:
+        t = verdict.get("tenant")
+        if t:
+            return t, f"live {name} verdict: {verdict.get('reason')}"
+    rows = merged["tenants"]
+    total = sum(r["tokens_out"] for r in rows.values())
+    if len(rows) < 2 or total < min_tokens:
+        return None
+    top = max(rows, key=lambda t: (rows[t]["tokens_out"], t))
+    share = rows[top]["tokens_out"] / total
+    victim_done = sum(r["completed"] + r["violations"]
+                     for t, r in rows.items() if t != top)
+    victim_att = sum(r["attained"] for t, r in rows.items()
+                     if t != top) / victim_done if victim_done else None
+    if (share >= share_threshold and victim_att is not None
+            and victim_att < attain_floor):
+        return top, (f"{share:.0%} of {total:.0f} tokens while other "
+                     f"tenants attain {victim_att:.0%}")
+    return None
+
+
+def _fmt(v, nd=3):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _table(headers, rows, out):
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows
+              else len(h) for i, h in enumerate(headers)]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+          file=out)
+    print("  ".join("-" * w for w in widths), file=out)
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)),
+              file=out)
+
+
+def render(merged, out=sys.stdout):
+    rows = []
+    for t, r in merged["tenants"].items():
+        rows.append((
+            t[:24], _fmt(int(r["requests"])), _fmt(int(r["completed"])),
+            _fmt(int(r["tokens_in"])), _fmt(int(r["tokens_out"])),
+            _fmt(r["token_share"]), _fmt(r["attainment"]),
+            _fmt(int(r["violations"])), _fmt(int(r["shed"])),
+            _fmt(int(r["queued"])),
+            _fmt(int(r["cache_saved_tokens"])),
+        ))
+    _table(("TENANT", "REQ", "DONE", "TOK_IN", "TOK_OUT", "SHARE",
+            "ATTAIN", "VIOL", "SHED", "QUEUED", "CACHE_SAVED"),
+           rows, out)
+    if merged["folded"]:
+        print(f"overflow: {merged['folded']} unique tenant id(s) "
+              f"folded into ~other (bounded ledger)", file=out)
+    for name, verdict in merged["verdicts"]:
+        print(f"! {name}: {verdict.get('reason', '?')}", file=out)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="render the per-tenant attribution table; exit 1 "
+                    "naming the noisy tenant when one is detected")
+    parser.add_argument("sources", nargs="+",
+                        help="tenant surfaces: URLs (host:port or "
+                             "http://.../fleet/tenants) and/or saved "
+                             "JSON bodies ('-' = stdin)")
+    parser.add_argument("--share-threshold", type=float, default=0.6,
+                        help="token share above which a dominant "
+                             "tenant CAN be judged noisy")
+    parser.add_argument("--attain-floor", type=float, default=0.5,
+                        help="other tenants' attainment below which "
+                             "the dominant tenant IS judged noisy")
+    parser.add_argument("--min-tokens", type=float, default=100,
+                        help="minimum merged generated tokens before "
+                             "judging at all (cold surfaces are fair)")
+    parser.add_argument("--json", action="store_true",
+                        help="dump the merged view as JSON instead of "
+                             "the table")
+    parser.add_argument("--timeout", type=float, default=5.0,
+                        help="per-URL scrape timeout seconds")
+    args = parser.parse_args(argv)
+
+    bodies = []
+    for src in args.sources:
+        try:
+            bodies.append(fetch(src, timeout=args.timeout))
+        except Exception as e:   # noqa: BLE001 - CLI verdict, exit 2
+            print(f"ERROR: cannot read {src}: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+    merged = merge(bodies)
+    if not merged["sources_with_data"]:
+        print(f"ERROR: no tenant data in {len(bodies)} source(s) "
+              f"(ledger disabled everywhere?)", file=sys.stderr)
+        return 2
+    noisy = judge(merged, share_threshold=args.share_threshold,
+                  attain_floor=args.attain_floor,
+                  min_tokens=args.min_tokens)
+    if args.json:
+        print(json.dumps({
+            "tenants": merged["tenants"],
+            "overflow_folded": merged["folded"],
+            "verdicts": [{"detector": n, **v}
+                         for n, v in merged["verdicts"]],
+            "noisy_tenant": noisy[0] if noisy else None,
+        }, indent=1, sort_keys=True))
+    else:
+        render(merged)
+    if noisy:
+        print(f"NOISY: tenant {noisy[0]} — {noisy[1]}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
