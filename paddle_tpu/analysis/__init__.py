@@ -1,7 +1,8 @@
-"""Trace/graph static analysis: tracer-leak detection + jaxpr lint.
+"""Trace/graph static analysis: tracer-leak detection, jaxpr lint, and
+concurrency analysis.
 
-Two tools over the compiler path, mirroring what PR 3/4 gave the
-serving path (the attributed compile watchdog):
+Four tools, mirroring what PR 3/4 gave the serving path (the attributed
+compile watchdog):
 
 * **Tracer-leak detector** (:mod:`.birth`) — birth-site attribution
   for Tensors created under a TraceContext, sub-trace scopes at the
@@ -22,6 +23,26 @@ serving path (the attributed compile watchdog):
   ``tools/lint_graft.py`` (repo self-lint, JSON output, nonzero exit
   on error findings).
 
+* **Lock patrol** (:mod:`.threads`) — lockdep-style runtime deadlock
+  lint: :func:`lock_patrol` wraps every Lock/RLock/Condition created
+  inside ``paddle_tpu.*`` with a site-attributed proxy, records the
+  acquired-while-holding graph across threads, and reports cycles
+  (``lock-order``) and locks held across timed AOT dispatches or
+  blocking socket calls (``lock-held-across-dispatch``). Off by
+  default — same gating as :func:`birth_tracking`; when off the only
+  hot-path residue is one boolean test.
+
+* **Concurrency lint** (:mod:`.concurrency`) — static AST passes:
+  ``cross-role-write`` classifies methods by thread role (step-loop /
+  http-handler / poller / scrape / router-dispatch / caller) and flags
+  unlocked attribute writes reachable from two or more roles, against
+  an allowlist whose rules carry source-asserted evidence so they rot
+  loudly; ``snapshot-discipline`` flags live mutable buffers (mutated
+  in place elsewhere in the class) handed to a jax dispatch or wire
+  serialization — the PR-6 ``.copy()``-before-upload bug class.
+  :func:`audit_default` runs both over the serving stack and is the
+  ``tools/lint_graft.py concurrency`` tier-1 target.
+
 Quick start::
 
     from paddle_tpu import analysis
@@ -33,6 +54,12 @@ Quick start::
     print(analysis.findings_to_json(findings))
 
     engine.lint()                        # serving decode executable
+
+    with analysis.lock_patrol() as patrol:   # race/deadlock drill
+        drive_engine()
+    assert not patrol.findings()
+
+    findings = analysis.audit_default()  # static concurrency audit
 """
 import os as _os
 
@@ -45,7 +72,15 @@ from .lint import (  # noqa: F401
     findings_to_json, iter_eqns, lint_fn, lint_jaxpr, lint_passes,
     register_lint_pass,
 )
+from .threads import (  # noqa: F401
+    HeldAcrossFinding, LockOrderFinding, LockPatrol, disable_patrol,
+    enable_patrol, lock_patrol, note_blocking, patrol_report,
+)
+from .concurrency import (  # noqa: F401
+    AllowRule, AuditFinding, SnapshotFinding, audit_default,
+)
 
 if _os.environ.get("PADDLE_TPU_ANALYSIS", "").lower() not in (
         "", "0", "false", "off"):
     enable()
+    enable_patrol()

@@ -141,7 +141,8 @@ class _GaugeChild(_Child):
         exposition (snapshot / Prometheus scrape) — the sliding-window
         percentile gauges use this so /metrics reflects the window at
         scrape time, not at the last observation."""
-        self._fn = fn
+        with self._lock:
+            self._fn = fn
 
 
 class _HistogramChild:

@@ -63,6 +63,7 @@ import weakref
 
 import numpy as np
 
+from ..analysis import threads as _lockpatrol
 from ..observability import (CompileWatchdog, FlightRecorder,
                              abstract_signature, device_memory_stats,
                              executable_cost)
@@ -966,6 +967,10 @@ class ServingEngine:
         wall seconds to its program key (the perf observatory's
         dispatch leg; harvest attributes the sync leg). With perf off
         this is a bare call — no clock reads."""
+        if _lockpatrol._armed:
+            # Any patrolled lock held here is the PR-9 pause class: a
+            # dispatch stall propagates to every waiter on that lock.
+            _lockpatrol.note_blocking("aot_dispatch", str(key))
         if not self._perf_on:
             return ex(*args)
         t0 = time.perf_counter()

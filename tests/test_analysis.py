@@ -443,7 +443,7 @@ def test_lint_graft_self_lints_repo_clean():
                                       "paged_decode_pallas",
                                       "chunked_prefill", "spec_verify",
                                       "kv_wire", "hapi_train_step",
-                                      "to_static_sample"}
+                                      "to_static_sample", "concurrency"}
     assert {"donation", "dynamic-shape-risk", "f64-upcast",
             "host-callback"} <= set(report["passes"])
 

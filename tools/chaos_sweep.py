@@ -236,6 +236,27 @@ def _check_handoff_cell(seed, model, specs, reference):
     return out
 
 
+def _patrolled(check, *args, **kwargs):
+    """Run one cell with the lock patrol armed: every seeded fault
+    schedule doubles as a race/deadlock drill. A lock-order or
+    held-across-dispatch finding fails the cell with the finding JSON
+    in the cell row."""
+    from paddle_tpu.analysis import lock_patrol
+
+    with lock_patrol() as patrol:
+        result = check(*args, **kwargs)
+        findings = patrol.findings()
+    if findings:
+        patrol_json = [f.to_dict() for f in findings]
+        if result.get("ok"):
+            result = dict(result, ok=False,
+                          reason="lock patrol findings",
+                          patrol=patrol_json)
+        else:   # keep the cell's own failure reason, attach the drill
+            result = dict(result, patrol=patrol_json)
+    return result
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description=__doc__.splitlines()[0])
@@ -274,8 +295,8 @@ def main(argv=None):
                 if site == "block_exhaustion" and not paged:
                     continue   # legacy pool has no block economy
                 cells += 1
-                result = _check_cell(site, seed, model, specs,
-                                     reference, paged, chunk)
+                result = _patrolled(_check_cell, site, seed, model,
+                                    specs, reference, paged, chunk)
                 print(json.dumps(result), flush=True)
                 if not result["ok"]:
                     failures += 1
@@ -291,9 +312,9 @@ def main(argv=None):
         assert reference is not None, "pallas reference drain hung"
         for seed in seeds:
             cells += 1
-            result = _check_cell("decode_dispatch", seed, model,
-                                 specs, reference, True, chunk,
-                                 paged_attn=True)
+            result = _patrolled(_check_cell, "decode_dispatch", seed,
+                                model, specs, reference, True, chunk,
+                                paged_attn=True)
             print(json.dumps(result), flush=True)
             if not result["ok"]:
                 failures += 1
@@ -314,9 +335,9 @@ def main(argv=None):
         assert reference is not None, "spec reference drain hung"
         for seed in seeds:
             cells += 1
-            result = _check_cell("decode_dispatch", seed, model,
-                                 spec_specs, reference, paged, chunk,
-                                 spec=True)
+            result = _patrolled(_check_cell, "decode_dispatch", seed,
+                                model, spec_specs, reference, paged,
+                                chunk, spec=True)
             print(json.dumps(result), flush=True)
             if not result["ok"]:
                 failures += 1
@@ -330,7 +351,8 @@ def main(argv=None):
         assert reference is not None, "handoff reference drain hung"
         for seed in seeds:
             cells += 1
-            result = _check_handoff_cell(seed, model, specs, reference)
+            result = _patrolled(_check_handoff_cell, seed, model,
+                                specs, reference)
             print(json.dumps(result), flush=True)
             if not result["ok"]:
                 failures += 1

@@ -317,6 +317,16 @@ def lint_to_static_sample():
     return sample.lint()
 
 
+def lint_concurrency():
+    """Static concurrency audit over the serving stack: cross-role
+    unlocked writes (thread-role auditor) + live-buffer-to-dispatch
+    (snapshot discipline, the PR-6 bug class). Pure AST — no engine
+    builds, no jax dispatches."""
+    from paddle_tpu.analysis import concurrency as cc
+
+    return cc.audit_default()
+
+
 TARGETS = {
     "serving_decode": lint_serving_decode,
     "paged_decode": lint_paged_decode,
@@ -326,6 +336,7 @@ TARGETS = {
     "kv_wire": lint_kv_wire,
     "hapi_train_step": lint_hapi_train_step,
     "to_static_sample": lint_to_static_sample,
+    "concurrency": lint_concurrency,
 }
 
 
